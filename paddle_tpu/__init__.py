@@ -1,0 +1,67 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas.
+
+The public namespace mirrors ``paddle.*`` (reference: python/paddle/__init__.py)
+so reference users can switch with an import swap. The compute path is jax
+arrays + XLA; parallelism is device meshes + GSPMD/shard_map; fused kernels are
+Pallas. See SURVEY.md at the repo root for the design mapping.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool, complex64, complex128, float16, float32, float64,
+    float8_e4m3fn, float8_e5m2, int8, int16, int32, int64, uint8,
+    get_default_dtype, set_default_dtype,
+)
+from .core.device import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_xpu,
+)
+from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+
+from .ops import *  # noqa: F401,F403  (installs Tensor methods)
+from . import ops as _ops_pkg
+
+from .autograd import (  # noqa: F401
+    no_grad, enable_grad, grad, set_grad_enabled, is_grad_enabled,
+)
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from .core.tensor import Parameter  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_dynamic_or_pir_mode():
+    return True
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def disable_static(*a, **k):
+    return None
+
+
+def enable_static(*a, **k):
+    return None
+
+
+def disable_signal_handler():
+    return None

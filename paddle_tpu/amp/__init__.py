@@ -1,0 +1,93 @@
+"""paddle.amp — mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py (auto_cast :703, decorate :787) and
+grad_scaler.py (GradScaler :578). TPU-native notes: bf16 needs no loss
+scaling, so GradScaler with bf16 degenerates to a pass-through (scale=1, no
+inf checks unless requested); fp16 keeps full dynamic loss scaling for parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import state
+from ..core.tensor import Tensor
+from . import amp_lists  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+           "is_bfloat16_supported", "is_float16_supported", "debugging"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = state.STATE
+    prev = (st.amp_level, st.amp_dtype, st.amp_custom_white, st.amp_custom_black)
+    if enable:
+        st.amp_level = level
+        st.amp_dtype = dtypes.convert_dtype(dtype)
+        st.amp_custom_white = frozenset(custom_white_list or ())
+        st.amp_custom_black = frozenset(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.amp_level, st.amp_dtype, st.amp_custom_white,
+         st.amp_custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast model params to low precision (reference amp/auto_cast.py:787).
+    Master weights live in the optimizer's fp32 accumulators by design."""
+    if level == "O2":
+        d = dtypes.convert_dtype(dtype)
+        items = models if isinstance(models, (list, tuple)) else [models]
+        excluded = excluded_layers or []
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+        for m in items:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm)) or \
+                        any(isinstance(layer, e) for e in
+                            (excluded if isinstance(excluded, (list, tuple))
+                             else [excluded])):
+                    continue
+                layer._cast_params(d)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+class debugging:
+    """Namespace parity for paddle.amp.debugging (accuracy compare tools)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def collect_operator_stats():
+        import contextlib
+
+        return contextlib.nullcontext()

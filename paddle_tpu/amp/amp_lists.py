@@ -1,0 +1,57 @@
+"""AMP op lists + cast logic.
+
+Reference: python/paddle/amp/amp_lists.py (white/black lists) and the AMP
+auto-cast insertion in eager_gen.py:515. On TPU the preferred low-precision
+dtype is bfloat16 (no loss scaling needed); float16 is supported for parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import state
+
+# ops that run in low precision under O1 (matmul/conv-class, MXU-bound)
+WHITE_LIST = {
+    "matmul", "conv_nd", "conv_nd_transpose", "linear_op", "mm", "bmm",
+    "addmm", "einsum_op", "sdpa_ref", "flash_attention_pallas",
+}
+
+# ops kept in fp32 under O1 (numerically sensitive)
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos",
+    "sin", "softmax_f", "log_softmax_f", "cross_entropy_op", "nll_loss_op",
+    "bce_op", "bce_logits_op", "layer_norm_op", "batch_norm_train",
+    "batch_norm_infer", "rms_norm_op", "group_norm_op", "instance_norm_op",
+    "p_norm", "cumsum", "logsumexp", "sigmoid_f", "kl_div_op", "mse_loss_op",
+    "l1_loss_op", "smooth_l1_op",
+}
+
+
+def _cast_arr(a, dtype):
+    if a is None or not hasattr(a, "dtype"):
+        return a
+    if jnp.issubdtype(np.dtype(a.dtype), jnp.floating) and \
+            np.dtype(a.dtype) != np.dtype(dtype):
+        return a.astype(dtype) if isinstance(a, jax.Array) or hasattr(a, "astype") else a
+    return a
+
+
+def maybe_cast(op_name, arrs):
+    st = state.STATE
+    amp_dtype = st.amp_dtype or np.dtype("bfloat16")
+    white = (WHITE_LIST | st.amp_custom_white) - st.amp_custom_black
+    black = BLACK_LIST | st.amp_custom_black
+    if st.amp_level == "O1":
+        if op_name in white:
+            return [_cast_arr(a, amp_dtype) for a in arrs]
+        if op_name in black:
+            return [_cast_arr(a, np.dtype("float32")) for a in arrs]
+        return arrs
+    if st.amp_level == "O2":
+        if op_name in black:
+            return [_cast_arr(a, np.dtype("float32")) for a in arrs]
+        return [_cast_arr(a, amp_dtype) for a in arrs]
+    return arrs

@@ -1,0 +1,211 @@
+"""User-facing autograd API.
+
+Reference: python/paddle/autograd (PyLayer at autograd/py_layer.py,
+paddle.grad in base/dygraph/base.py, no_grad).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..core import state
+from ..core.engine import run_backward
+from ..core.tensor import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext", "saved_tensors_hooks"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad analog (imperative partial-grad GeneralGrad,
+    paddle/fluid/eager/general_grad.h)."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported yet"
+        )
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    capture = {id(t): t for t in inputs}
+    retain = bool(retain_graph) if retain_graph is not None else False
+    captured = run_backward(list(outputs), grad_outputs, retain_graph=retain,
+                            capture=capture)
+    results = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input tensor {t.name} is unused in the graph; pass "
+                    "allow_unused=True to return None for it"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor._wrap(g))
+    return results
+
+
+class no_grad:
+    """Usable as decorator or context manager (paddle.no_grad)."""
+
+    def __init__(self, func=None):
+        self._func = func
+
+    def __call__(self, *args, **kwargs):
+        if self._func is not None:
+            with state.no_grad_guard():
+                return self._func(*args, **kwargs)
+        return self
+
+    def __enter__(self):
+        self._cm = state.no_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+class enable_grad:
+    def __enter__(self):
+        self._cm = state.enable_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    prev = state.STATE.grad_enabled
+    state.STATE.grad_enabled = bool(mode)
+    try:
+        yield
+    finally:
+        state.STATE.grad_enabled = prev
+
+
+def is_grad_enabled() -> bool:
+    return state.STATE.grad_enabled
+
+
+class PyLayerContext:
+    """Reference: python/paddle/autograd/py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+
+class _PyLayerNodeBuilder:
+    """Bridges a user PyLayer.backward into the engine's GradNode protocol."""
+
+    def __init__(self, layer_cls, ctx, n_inputs):
+        self.layer_cls = layer_cls
+        self.ctx = ctx
+        self.n_inputs = n_inputs
+
+    def __call__(self, primals, cts):
+        import jax.numpy as jnp
+
+        cts_t = (
+            tuple(Tensor._wrap(c) for c in cts)
+            if isinstance(cts, tuple)
+            else (Tensor._wrap(cts),)
+        )
+        with state.no_grad_guard():
+            grads = self.layer_cls.backward(self.ctx, *cts_t)
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+            elif isinstance(g, Tensor):
+                out.append(g._data)
+            else:
+                out.append(jnp.asarray(g))
+        return tuple(out)
+
+
+class PyLayer:
+    """Custom autograd op via subclassing (paddle.autograd.PyLayer).
+
+    class Tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x): ...
+        @staticmethod
+        def backward(ctx, dy): ...
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.engine import Edge, GradNode
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        requires_grad = state.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args
+        )
+        with state.no_grad_guard():
+            out = cls.forward(ctx, *args, **kwargs)
+        out_is_tuple = isinstance(out, (list, tuple))
+        outs = tuple(out) if out_is_tuple else (out,)
+        if requires_grad:
+            edges = [Edge.from_tensor(a) if isinstance(a, Tensor) else Edge(stop=True)
+                     for a in args]
+            out_avals = [(tuple(o._data.shape), o._data.dtype) for o in outs]
+            node = GradNode(
+                f"pylayer_{cls.__name__}",
+                _PyLayerNodeBuilder(cls, ctx, len(args)),
+                (),
+                edges,
+                out_avals,
+                out_is_tuple,
+            )
+            new_outs = []
+            for i, o in enumerate(outs):
+                t = Tensor._wrap(o._data)
+                t.stop_gradient = False
+                t._node = node
+                t._out_idx = i
+                new_outs.append(t)
+            outs = tuple(new_outs)
+        return (list(outs) if isinstance(out, list) else tuple(outs)) if out_is_tuple else outs[0]
+
+
+@contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """API parity stub: jax arrays are immutable and the engine stores primal
+    arrays directly, so pack/unpack hooks have nothing to intercept. Reference:
+    python/paddle/autograd/saved_tensors_hooks.py."""
+    yield

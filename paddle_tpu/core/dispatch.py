@@ -1,0 +1,181 @@
+"""Op registry + eager dispatch.
+
+TPU-native replacement for the reference's per-op dispatch spine:
+``KernelFactory::SelectKernelOrThrowError`` (paddle/phi/core/kernel_factory.h:324)
+plus the generated ``*_ad_func`` eager functions
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:251). Here an
+"op" is a pure JAX function; dispatch
+
+1. unwraps Tensor args to jax.Arrays,
+2. applies AMP auto-cast by op list (analog of eager_gen.py:515),
+3. runs a jit-cached executable (the "kernel"), and
+4. when grad is required, records a GradNode whose backward is a jit-cached
+   ``jax.vjp`` of the same function (see engine.py).
+
+Convention: **positional args are tensor-like, keyword args are static** python
+values (hashed into the jit cache key). Inside a jax trace (to_static / pallas /
+shard_map), dispatch degrades to a plain function call so the surrounding trace
+captures the ops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import state
+from .engine import Edge, GradNode
+
+OPS: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "differentiable", "wrapper")
+
+    def __init__(self, name, fn, differentiable=True):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+
+    def __repr__(self):
+        return f"<OpDef {self.name}>"
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.dtype):
+        # .name round-trips extended dtypes (bfloat16, float8_*) via ml_dtypes;
+        # .str would degrade them to void ("|V2")
+        return ("npdtype", v.name)
+    if isinstance(v, np.ndarray):
+        return ("nparr", v.tobytes(), v.dtype.name, v.shape)
+    return v
+
+
+def _unhash_dtype(v):
+    from . import dtype as _dtypes
+
+    if isinstance(v, tuple) and len(v) == 2 and v[0] == "npdtype":
+        return _dtypes.convert_dtype(v[1])
+    if isinstance(v, tuple) and len(v) == 4 and v[0] == "nparr":
+        return np.frombuffer(v[1], dtype=_dtypes.convert_dtype(v[2])).reshape(v[3])
+    return v
+
+
+@functools.lru_cache(maxsize=None)
+def _build_execs(name: str, kwargs_key: tuple):
+    opdef = OPS[name]
+    kwargs = {k: _unhash_dtype(v) for k, v in kwargs_key}
+
+    def f(*arrs):
+        return opdef.fn(*arrs, **kwargs)
+
+    fwd = jax.jit(f)
+
+    def bwd(primals, cts):
+        _, vjp = jax.vjp(f, *primals)
+        return vjp(cts)
+
+    return fwd, jax.jit(bwd)
+
+
+def call_op(name: str, *args, **kwargs):
+    """Invoke a registered op on tensor-like positional args."""
+    from .tensor import Tensor
+
+    opdef = OPS[name]
+    arrs = []
+    tensor_args = []  # Tensor or None per positional arg
+    any_tracer = state.in_trace()
+    requires_grad = False
+    for a in args:
+        if isinstance(a, Tensor):
+            tensor_args.append(a)
+            arrs.append(a._data)
+            if not a.stop_gradient:
+                requires_grad = True
+            if isinstance(a._data, jax.core.Tracer):
+                any_tracer = True
+        elif a is None:
+            tensor_args.append(None)
+            arrs.append(None)
+        else:
+            arr = a if isinstance(a, (jax.Array, np.ndarray)) else np.asarray(a)
+            if isinstance(arr, jax.core.Tracer):
+                any_tracer = True
+            tensor_args.append(None)
+            arrs.append(arr)
+
+    # --- AMP auto-cast (analog of eager_gen.py:515) ---
+    if state.STATE.amp_level in ("O1", "O2"):
+        from ..amp import amp_lists
+
+        arrs = amp_lists.maybe_cast(name, arrs)
+
+    if any_tracer:
+        out = opdef.fn(*arrs, **kwargs)
+        return _wrap_out(out, None, requires_grad and state.STATE.grad_enabled)
+
+    kwargs_key = tuple(sorted((k, _hashable(v)) for k, v in kwargs.items()))
+    fwd, bwd = _build_execs(name, kwargs_key)
+    out = fwd(*arrs)
+
+    requires_grad = requires_grad and state.grad_enabled() and opdef.differentiable
+    node = None
+    if requires_grad:
+        out_is_tuple = isinstance(out, (list, tuple))
+        outs = tuple(out) if out_is_tuple else (out,)
+        out_avals = [(o.shape, o.dtype) for o in outs]
+        if not any(jnp.issubdtype(av[1], jnp.floating) for av in out_avals):
+            requires_grad = False
+        else:
+            edges = [Edge.from_tensor(t) if t is not None else Edge(stop=True)
+                     for t in tensor_args]
+            node = GradNode(name, bwd, tuple(arrs), edges, out_avals, out_is_tuple)
+    return _wrap_out(out, node, requires_grad)
+
+
+def _wrap_out(out, node, requires_grad):
+    from .tensor import Tensor
+
+    def wrap(o, idx):
+        t = Tensor._wrap(o)
+        t.stop_gradient = not requires_grad
+        if node is not None:
+            t._node = node
+            t._out_idx = idx
+        return t
+
+    if isinstance(out, (list, tuple)):
+        return type(out)(wrap(o, i) for i, o in enumerate(out))
+    return wrap(out, 0)
+
+
+def op(name=None, differentiable=True):
+    """Register a pure-JAX function as a framework op.
+
+    The decorated function remains directly callable with jax arrays; calling it
+    with Tensor args routes through eager dispatch.
+    """
+
+    def deco(fn):
+        opname = name or fn.__name__
+        opdef = OpDef(opname, fn, differentiable)
+        OPS[opname] = opdef
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return call_op(opname, *args, **kwargs)
+
+        wrapper.op_name = opname
+        wrapper.raw_fn = fn
+        opdef.wrapper = wrapper
+        return wrapper
+
+    return deco

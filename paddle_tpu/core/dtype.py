@@ -1,0 +1,108 @@
+"""Dtype system.
+
+TPU-native analog of the reference's dtype plumbing (paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py). Dtypes are thin aliases over numpy/jax dtypes; the
+canonical in-framework representation is a ``jnp.dtype``.
+
+Divergence from the reference: default integer dtype is int32 (TPU-friendly, matches
+JAX x32 mode) where paddle defaults to int64. float64 is supported but discouraged on
+TPU (XLA emulates it slowly).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (numpy dtype instances, usable anywhere jax accepts a dtype)
+bool = np.dtype("bool")  # noqa: A001
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_STR_ALIASES = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float": float32,
+    "float64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATS = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTS = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype):
+    """Normalize any user-supplied dtype spec to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _STR_ALIASES:
+            return _STR_ALIASES[key]
+        return np.dtype(dtype)
+    if dtype is builtins.float:
+        return float32
+    if dtype is builtins.int:
+        return int32
+    if dtype is builtins.bool:
+        return np.dtype("bool")
+    return np.dtype(dtype)
+
+
+def is_floating_point(dtype) -> builtins.bool:
+    return np.dtype(dtype) in _FLOATS
+
+
+def is_integer(dtype) -> builtins.bool:
+    return np.dtype(dtype) in _INTS or np.dtype(dtype) == np.dtype("bool")
+
+
+def is_complex(dtype) -> builtins.bool:
+    return np.dtype(dtype) in _COMPLEX
+
+
+def get_default_dtype():
+    from . import state
+
+    return state.DEFAULT_DTYPE
+
+
+def set_default_dtype(dtype):
+    from . import state
+
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports float dtypes, got {dtype}")
+    state.DEFAULT_DTYPE = d
+
+
+def promote_types(a, b):
+    return jnp.promote_types(a, b)

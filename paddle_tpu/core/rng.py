@@ -1,0 +1,90 @@
+"""Random number generation.
+
+Replaces the reference's ``phi::Generator`` (paddle/phi/core/generator.h) and the
+hybrid-parallel RNG state tracker
+(fleet/meta_parallel/parallel_layers/random.py ``get_rng_state_tracker``).
+
+Design: a ``Generator`` owns a JAX PRNG key plus a monotonically increasing
+counter; ``next_key()`` returns ``fold_in(base, counter)`` so that
+
+* eager mode draws a fresh concrete key per random op, and
+* under ``to_static`` tracing the base key is lifted to a *traced* argument and
+  the counter is folded in at trace time, so each compiled call site gets a
+  distinct, reproducible stream without retracing (the caller advances the base
+  key between steps).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._base = jax.random.key(self._seed)
+        self._counter = 0
+        # When tracing, a traced key injected by jit/to_static machinery.
+        self._traced_base = None
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._base = jax.random.key(self._seed)
+        self._counter = 0
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        base = self._traced_base if self._traced_base is not None else self._base
+        self._counter += 1
+        return jax.random.fold_in(base, self._counter)
+
+    def get_state(self):
+        return {"seed": self._seed, "counter": self._counter}
+
+    def set_state(self, st):
+        self._seed = int(st["seed"])
+        self._base = jax.random.key(self._seed)
+        self._counter = int(st["counter"])
+
+    @contextlib.contextmanager
+    def traced_base(self, key):
+        prev = self._traced_base
+        self._traced_base = key
+        try:
+            yield
+        finally:
+            self._traced_base = prev
+
+
+DEFAULT_GENERATOR = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed analog (python/paddle/framework/random.py)."""
+    DEFAULT_GENERATOR.manual_seed(s)
+    np.random.seed(s % (2**32))
+    return DEFAULT_GENERATOR
+
+
+def default_generator() -> Generator:
+    return DEFAULT_GENERATOR
+
+
+def next_key():
+    return DEFAULT_GENERATOR.next_key()
+
+
+def get_rng_state():
+    return DEFAULT_GENERATOR.get_state()
+
+
+def set_rng_state(st):
+    DEFAULT_GENERATOR.set_state(st)

@@ -1,0 +1,70 @@
+"""Global interpreter state.
+
+Replaces the reference's thread-local tracer/controller state
+(paddle/fluid/eager/api/utils/global_utils.h ``egr::Controller``,
+paddle/fluid/imperative/tracer.h:60): grad mode, AMP mode, default dtype,
+and the eager/trace mode switch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+DEFAULT_DTYPE = np.dtype("float32")
+
+
+class _ThreadLocalState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        # AMP: None | "O1" | "O2"  (amp/auto_cast.py drives these)
+        self.amp_level = "O0"
+        self.amp_dtype = None  # np.dtype when amp active
+        self.amp_custom_white = frozenset()
+        self.amp_custom_black = frozenset()
+        # When inside a jax trace (to_static / grad tracing), per-op jit and
+        # autograd taping are disabled; ops run as plain traceable jax calls.
+        self.trace_depth = 0
+
+
+STATE = _ThreadLocalState()
+
+
+def grad_enabled() -> bool:
+    return STATE.grad_enabled and STATE.trace_depth == 0
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = False
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = True
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def trace_guard():
+    """Mark that we're inside a jax trace: disable per-op jit + taping."""
+    STATE.trace_depth += 1
+    try:
+        yield
+    finally:
+        STATE.trace_depth -= 1
+
+
+def in_trace() -> bool:
+    return STATE.trace_depth > 0

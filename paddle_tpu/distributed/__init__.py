@@ -1,0 +1,80 @@
+"""paddle.distributed — parallelism UX (reference: python/paddle/distributed/).
+
+TPU-native design (SURVEY.md §5.8): no ProcessGroup/NCCL object model — a
+single-controller JAX program over a device Mesh. Collective *APIs* are traced
+``lax.p*`` ops inside shard_map / GSPMD-sharded jit; ``jax.distributed``'s
+coordination service replaces TCPStore for multi-host bring-up.
+
+This module grows across milestones; env/bring-up + rank info live here.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+           "ParallelEnv"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Reference: python/paddle/distributed/parallel.py:943. Multi-host: uses
+    jax.distributed.initialize driven by env (coordinator addr, process id)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8471")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}",
+            num_processes=nprocs,
+            process_id=pid,
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    try:
+        from .collective import _default_group
+
+        if _default_group is not None:
+            return _default_group.nranks
+    except ImportError:
+        pass
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size

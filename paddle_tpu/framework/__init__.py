@@ -1,0 +1,6 @@
+"""paddle.framework namespace."""
+
+from ..core.dtype import convert_dtype, get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.rng import seed  # noqa: F401
+from .io import load, save  # noqa: F401
+from .random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401

@@ -1,0 +1,70 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py (save :721, load :960) — pickle of
+nested state structures with tensors converted to numpy. Files written by this
+module are plain pickles of numpy-fied pytrees, readable anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        return _TensorPayload(arr, obj.name, isinstance(obj, Parameter),
+                              not obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "name", "is_param", "trainable")
+
+    def __init__(self, array, name, is_param, trainable):
+        self.array = array
+        self.name = name
+        self.is_param = is_param
+        self.trainable = trainable
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            p = Parameter(obj.array, name=obj.name, trainable=obj.trainable)
+            return p
+        return Tensor(obj.array, name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _from_saveable(data, return_numpy=return_numpy)

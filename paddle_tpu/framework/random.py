@@ -1,0 +1,19 @@
+"""RNG state API parity (reference: python/paddle/framework/random.py)."""
+
+from ..core import rng
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "get_cuda_rng_state",
+           "set_cuda_rng_state"]
+
+seed = rng.seed
+get_rng_state = rng.get_rng_state
+set_rng_state = rng.set_rng_state
+
+
+def get_cuda_rng_state():
+    return [rng.get_rng_state()]
+
+
+def set_cuda_rng_state(states):
+    if states:
+        rng.set_rng_state(states[0])

@@ -1,0 +1,102 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm at :590). Operates on (param, grad) pairs like the
+reference; used by optimizers via grad_clip=."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._wrap(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.linalg.norm(g._data.astype(jnp.float32))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor._wrap((g._data * scale).astype(g._data.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g._data.astype(jnp.float32))) for g in grads]
+        return jnp.sqrt(jnp.sum(jnp.stack(sq)))
+
+    def _dygraph_clip(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        global_norm = self._global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor._wrap((g._data * scale).astype(g._data.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor._wrap(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(
+            jnp.sum(jnp.stack(
+                [jnp.sum(jnp.power(jnp.abs(g._data.astype(jnp.float32)),
+                                   norm_type)) for g in grads])),
+            1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad._rebind((p.grad._data * scale).astype(p.grad._data.dtype))
+    return Tensor._wrap(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad._rebind(jnp.clip(p.grad._data, -clip_value, clip_value))

@@ -1,0 +1,16 @@
+"""paddle.nn.functional namespace (reference: python/paddle/nn/functional/)."""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attn_unpadded, scaled_dot_product_attention,
+    sdp_kernel,
+)
+from . import flash_attention as flash_attention_mod  # noqa: F401
+
+from ...ops.manipulation import gather, gather_nd, scatter, scatter_nd_add  # noqa: F401
+from ...ops.creation import one_hot  # noqa: F401

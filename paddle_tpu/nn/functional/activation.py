@@ -1,0 +1,248 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py,
+PHI kernels paddle/phi/kernels/activation_kernel.h). Pure JAX; XLA fuses these
+into surrounding matmuls."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "log_sigmoid", "tanh",
+    "softmax", "log_softmax", "silu", "swish", "mish", "hardswish",
+    "hardsigmoid", "hardtanh", "leaky_relu", "elu", "selu", "celu", "prelu",
+    "rrelu", "softplus", "softsign", "softshrink", "hardshrink", "tanhshrink",
+    "thresholded_relu", "maxout", "glu", "gumbel_softmax",
+]
+
+relu = op("relu")(jax.nn.relu)
+sigmoid = op("sigmoid_f")(jax.nn.sigmoid)
+tanh = op("tanh_f")(jnp.tanh)
+log_sigmoid = op("log_sigmoid")(jax.nn.log_sigmoid)
+silu = op("silu")(jax.nn.silu)
+softsign = op("softsign")(jax.nn.soft_sign)
+tanhshrink = op("tanhshrink")(lambda x: x - jnp.tanh(x))
+mish = op("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = op("hardswish")(lambda x: x * jnp.clip(x + 3, 0, 6) / 6)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+@op("relu6")
+def _relu6(x, threshold=6.0):
+    return jnp.clip(x, 0, threshold)
+
+
+def relu6(x, name=None):
+    return _relu6(x)
+
+
+@op("gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(x, approximate=bool(approximate))
+
+
+@op("softmax_f")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _softmax(x, axis=int(axis))
+
+
+@op("log_softmax_f")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _log_softmax(x, axis=int(axis))
+
+
+@op("swish")
+def _swish(x):
+    return jax.nn.silu(x)
+
+
+def swish(x, name=None):
+    return _swish(x)
+
+
+@op("hardsigmoid")
+def _hardsigmoid(x, slope=1 / 6, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _hardsigmoid(x, slope=float(slope), offset=float(offset))
+
+
+@op("hardtanh")
+def _hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh(x, min=float(min), max=float(max))
+
+
+@op("leaky_relu")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(x, negative_slope=float(negative_slope))
+
+
+@op("elu")
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(x, alpha=float(alpha))
+
+
+@op("selu")
+def _selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(x, scale=float(scale), alpha=float(alpha))
+
+
+@op("celu")
+def _celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(x, alpha=float(alpha))
+
+
+@op("prelu_op")
+def _prelu(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format == "NCHW" and x.ndim >= 2 else x.ndim - 1
+        shape[ch_axis] = weight.size
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(x, weight, data_format=data_format)
+
+
+@op("rrelu_train")
+def _rrelu(x, key, lower=0.125, upper=0.333):
+    a = jax.random.uniform(key, x.shape, jnp.float32, lower, upper).astype(x.dtype)
+    return jnp.where(x >= 0, x, a * x)
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    if not training:
+        return _leaky_relu(x, negative_slope=(lower + upper) / 2)
+    from ...core import rng
+
+    return _rrelu(x, rng.next_key(), lower=float(lower), upper=float(upper))
+
+
+@op("softplus")
+def _softplus(x, beta=1.0, threshold=20.0):
+    scaled = x * beta
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus(x, beta=float(beta), threshold=float(threshold))
+
+
+@op("softshrink")
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(x, threshold=float(threshold))
+
+
+@op("hardshrink")
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(x, threshold=float(threshold))
+
+
+@op("thresholded_relu")
+def _thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _thresholded_relu(x, threshold=float(threshold), value=float(value))
+
+
+@op("maxout")
+def _maxout(x, groups=1, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(x, groups=int(groups), axis=int(axis))
+
+
+@op("glu_op")
+def _glu(x, axis=-1):
+    return jax.nn.glu(x, axis=axis)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(x, axis=int(axis))
+
+
+@op("gumbel_softmax_op")
+def _gumbel_softmax(x, key, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0, axis=axis,
+                                    inplace=False)
+        y = onehot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng
+
+    return _gumbel_softmax(x, rng.next_key(), temperature=float(temperature),
+                           hard=bool(hard), axis=int(axis))

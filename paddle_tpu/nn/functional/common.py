@@ -1,0 +1,337 @@
+"""Common functionals: linear, dropout, embedding, padding, interpolate.
+
+Reference: python/paddle/nn/functional/common.py + input.py (embedding).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad as _pad_nd  # noqa: F401  (re-export as F.pad)
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "label_smooth", "zeropad2d",
+]
+
+pad = _pad_nd
+
+
+@op("linear_op")
+def _linear(x, weight, bias=None):
+    # paddle stores Linear weight as [in, out] (python/paddle/nn/layer/common.py)
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def linear(x, weight, bias=None, name=None):
+    return _linear(x, weight, bias)
+
+
+@op("dropout_op")
+def _dropout(x, key, p=0.5, mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops.math import scale as scale_op
+
+            return scale_op(x, scale=1.0 - p)
+        return x
+    if axis is not None:
+        return _dropout_axis(x, rng.next_key(), p=float(p),
+                             axis=tuple(np.atleast_1d(axis).tolist()), mode=mode)
+    return _dropout(x, rng.next_key(), p=float(p), mode=mode)
+
+
+@op("dropout_axis")
+def _dropout_axis(x, key, p=0.5, axis=(0,), mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask_shape = tuple(x.shape[i] if i in axis else 1 for i in range(x.ndim))
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    if mode == "upscale_in_train":
+        return (jnp.where(mask, x / keep, 0.0)).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return _dropout_axis(x, rng.next_key(), p=float(p), axis=ax)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return _dropout_axis(x, rng.next_key(), p=float(p), axis=ax)
+
+
+@op("alpha_dropout_op")
+def _alpha_dropout(x, key, p=0.5):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p**2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout(x, rng.next_key(), p=float(p))
+
+
+@op("embedding_op")
+def _embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _embedding(x, weight,
+                      padding_idx=None if padding_idx is None else int(padding_idx),
+                      sparse=bool(sparse))
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+@op("label_smooth_op")
+def _label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _label_smooth(label, prior_dist, epsilon=float(epsilon))
+
+
+@op("cosine_similarity_op")
+def _cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return _cosine_similarity(x1, x2, axis=int(axis), eps=float(eps))
+
+
+@op("bilinear_op")
+def _bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return _bilinear(x1, x2, weight, bias)
+
+
+@op("interpolate_op")
+def _interpolate(x, size=None, mode="nearest", align_corners=False,
+                 data_format="NCHW"):
+    # channels-first only; convert to jax.image convention
+    if data_format in ("NCHW", "NCDHW", "NCW"):
+        spatial = x.shape[2:]
+        new_shape = (*x.shape[:2], *size)
+    else:
+        spatial = x.shape[1:-1]
+        new_shape = (x.shape[0], *size, x.shape[-1])
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+    if align_corners and method != "nearest":
+        # jax.image.resize has no align_corners; emulate with explicit gather
+        def resize_axis(arr, axis, out_len):
+            in_len = arr.shape[axis]
+            if out_len == 1 or in_len == 1:
+                idx = jnp.zeros((out_len,), jnp.float32)
+            else:
+                idx = jnp.linspace(0.0, in_len - 1, out_len)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, in_len - 1)
+            w = (idx - lo).astype(arr.dtype)
+            shape = [1] * arr.ndim
+            shape[axis] = out_len
+            w = w.reshape(shape)
+            return (jnp.take(arr, lo, axis=axis) * (1 - w)
+                    + jnp.take(arr, hi, axis=axis) * w)
+
+        out = x
+        axes = range(2, x.ndim) if data_format.startswith("NC") else range(1, x.ndim - 1)
+        for i, ax in enumerate(axes):
+            out = resize_axis(out, ax, new_shape[ax])
+        return out
+    return jax.image.resize(x, new_shape, method=method)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    if size is None:
+        assert scale_factor is not None
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * (x.ndim - 2)
+        spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    size = tuple(int(s.item() if isinstance(s, Tensor) else s) for s in size)
+    return _interpolate(x, size=size, mode=mode, align_corners=bool(align_corners),
+                        data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@op("pixel_shuffle_op")
+def _pixel_shuffle(x, upscale_factor=1, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(x, upscale_factor=int(upscale_factor),
+                          data_format=data_format)
+
+
+@op("pixel_unshuffle_op")
+def _pixel_unshuffle(x, downscale_factor=1, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(x, downscale_factor=int(downscale_factor),
+                            data_format=data_format)
+
+
+@op("channel_shuffle_op")
+def _channel_shuffle(x, groups=1, data_format="NCHW"):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = x.transpose(0, 2, 1, 3, 4)
+    return x.reshape(n, c, h, w)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _channel_shuffle(x, groups=int(groups), data_format=data_format)
+
+
+@op("unfold_op")
+def _unfold(x, kernel_sizes=(3, 3), strides=(1, 1), paddings=(0, 0, 0, 0),
+            dilations=(1, 1)):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    dh, dw = dilations
+    pt, pl, pb, pr = paddings
+    x = jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v, n=2):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+    pads = pair(paddings, 4)
+    if len(pads) == 2:
+        pads = (pads[0], pads[1], pads[0], pads[1])
+    return _unfold(x, kernel_sizes=pair(kernel_sizes), strides=pair(strides),
+                   paddings=pads, dilations=pair(dilations))
+
+
+@op("fold_op")
+def _fold(x, output_sizes=(0, 0), kernel_sizes=(3, 3), strides=(1, 1),
+          paddings=(0, 0, 0, 0), dilations=(1, 1)):
+    n, ckk, l = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    sh, sw = strides
+    dh, dw = dilations
+    pt, pl, pb, pr = paddings
+    ph, pw = oh + pt + pb, ow + pl + pr
+    lh = (ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, lh, lw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi : hi + sh * lh : sh, wj : wj + sw * lw : sw].add(
+                cols[:, :, i, j]
+            )
+    return out[:, :, pt : pt + oh, pl : pl + ow]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def pair(v, n=2):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+    pads = pair(paddings, 4)
+    if len(pads) == 2:
+        pads = (pads[0], pads[1], pads[0], pads[1])
+    return _fold(x, output_sizes=pair(output_sizes), kernel_sizes=pair(kernel_sizes),
+                 strides=pair(strides), paddings=pads, dilations=pair(dilations))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)

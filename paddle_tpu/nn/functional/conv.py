@@ -1,0 +1,197 @@
+"""Convolution functionals on lax.conv_general_dilated.
+
+Reference: python/paddle/nn/functional/conv.py (conv2d at :549), PHI kernels
+paddle/phi/kernels/conv_kernel.h. Paddle layouts (NCHW default, OIHW weights)
+are expressed via dimension_numbers; XLA lowers to MXU-tiled convs on TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # per-side pairs flattened
+            return tuple(v)
+        return tuple(int(v[0]) for _ in range(n))
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and len(padding) and \
+            isinstance(padding[0], (list, tuple)):
+        # [[0,0],[0,0],[pt,pb],[pl,pr]] paddle style incl. batch/channel dims
+        sp = [tuple(p) for p in padding[-n:]]
+        return tuple(sp)
+    p = _tup(padding, n)
+    if len(p) == 2 * n:
+        return tuple((int(p[2 * i]), int(p[2 * i + 1])) for i in range(n))
+    return tuple((int(x), int(x)) for x in p)
+
+
+def _dn(ndim, channel_last, transpose=False):
+    if ndim == 3:
+        lhs = "NWC" if channel_last else "NCW"
+        out = lhs
+        rhs = "WIO" if transpose else "OIW"
+    elif ndim == 4:
+        lhs = "NHWC" if channel_last else "NCHW"
+        out = lhs
+        rhs = "HWIO" if transpose else "OIHW"
+    else:
+        lhs = "NDHWC" if channel_last else "NCDHW"
+        out = lhs
+        rhs = "DHWIO" if transpose else "OIDHW"
+    return (lhs, rhs, out)
+
+
+@op("conv_nd")
+def _conv(x, weight, bias=None, stride=(1,), padding="VALID", dilation=(1,),
+          groups=1, channel_last=False):
+    n = x.ndim
+    dn = _dn(n, channel_last)
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        shape = [1] * n
+        shape[1 if not channel_last else n - 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("conv_nd_transpose")
+def _conv_transpose(x, weight, bias=None, stride=(1,), padding=((0, 0),),
+                    output_padding=(0,), dilation=(1,), groups=1,
+                    channel_last=False):
+    # paddle/torch-style transposed conv: gradient of conv w.r.t. input.
+    # weight layout [in, out/groups, *k] (paddle conv_transpose convention)
+    nd = x.ndim - 2
+    kernel = weight
+    # lax.conv_transpose wants IO... layouts; use conv_general_dilated with
+    # lhs_dilation (fractional stride) which is the canonical XLA lowering.
+    k_spatial = kernel.shape[2:]
+    pads = []
+    for i in range(nd):
+        k_eff = (k_spatial[i] - 1) * dilation[i] + 1
+        pt, pb = padding[i]
+        lo = k_eff - 1 - pt
+        hi = k_eff - 1 - pb + output_padding[i]
+        pads.append((lo, hi))
+    # flip spatial dims + swap I/O for the transposed kernel
+    flip_axes = tuple(range(2, 2 + nd))
+    w = jnp.flip(kernel, flip_axes)
+    # [in, out/g, *k] -> groups: reshape to [g, in/g, out/g, *k] -> [g*out/g, in/g, *k]
+    cin = w.shape[0]
+    og = w.shape[1]
+    w = w.reshape(groups, cin // groups, og, *k_spatial)
+    w = jnp.swapaxes(w, 1, 2).reshape(groups * og, cin // groups, *k_spatial)
+    dn = _dn(x.ndim, channel_last)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1] * x.ndim
+        shape[1 if not channel_last else x.ndim - 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def _conv_fwd(x, weight, bias, stride, padding, dilation, groups, data_format, nd):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    return _conv(
+        x, weight, bias,
+        stride=_tup(stride, nd),
+        padding=_padding(padding, nd),
+        dilation=_tup(dilation, nd),
+        groups=int(groups),
+        channel_last=channel_last,
+    )
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_fwd(x, weight, bias, stride, padding, dilation, groups,
+                     "NWC" if data_format == "NLC" else "NCW", 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_fwd(x, weight, bias, stride, padding, dilation, groups,
+                     data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_fwd(x, weight, bias, stride, padding, dilation, groups,
+                     data_format, 3)
+
+
+def _conv_transpose_fwd(x, weight, bias, stride, padding, output_padding,
+                        dilation, groups, data_format, nd):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    pads = _padding(padding, nd)
+    if isinstance(pads, str):
+        assert pads == "VALID" or pads == "SAME", pads
+        if pads == "VALID":
+            pads = tuple((0, 0) for _ in range(nd))
+        else:
+            k = weight.shape[2:]
+            pads = tuple((int(ki // 2), int(ki // 2)) for ki in k)
+    return _conv_transpose(
+        x, weight, bias,
+        stride=_tup(stride, nd),
+        padding=pads,
+        output_padding=_tup(output_padding, nd),
+        dilation=_tup(dilation, nd),
+        groups=int(groups),
+        channel_last=channel_last,
+    )
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_fwd(x, weight, bias, stride, padding, output_padding,
+                               dilation, groups,
+                               "NWC" if data_format == "NLC" else "NCW", 1)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_fwd(x, weight, bias, stride, padding, output_padding,
+                               dilation, groups, data_format, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_fwd(x, weight, bias, stride, padding, output_padding,
+                               dilation, groups, data_format, 3)

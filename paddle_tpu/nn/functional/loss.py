@@ -1,0 +1,416 @@
+"""Loss functionals.
+
+Reference: python/paddle/nn/functional/loss.py (cross_entropy at :2399),
+PHI kernels cross_entropy_kernel.h etc.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss",
+]
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+@op("cross_entropy_op")
+def _cross_entropy(logits, label, weight=None, soft_label=False,
+                   ignore_index=-100, reduction="mean", axis=-1,
+                   label_smoothing=0.0, use_softmax=True):
+    lf = logits.astype(jnp.float32)
+    if use_softmax:
+        logp = jax.nn.log_softmax(lf, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(lf, 1e-30))
+    if soft_label or (label.ndim == logits.ndim and label.shape == logits.shape):
+        soft = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            soft = soft * (1 - label_smoothing) + label_smoothing / k
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(soft * weight, axis=axis)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(w)
+        return _reduce(loss, reduction)
+    lab = label
+    if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+        lab = jnp.squeeze(lab, axis)
+    lab = lab.astype(jnp.int32)
+    valid = lab != ignore_index
+    safe_lab = jnp.where(valid, lab, 0)
+    if label_smoothing > 0:
+        k = logits.shape[axis]
+        nll = -jnp.take_along_axis(
+            logp, safe_lab[..., None] if axis in (-1, logits.ndim - 1)
+            else jnp.expand_dims(safe_lab, axis), axis=axis).squeeze(axis)
+        mean_logp = jnp.mean(logp, axis=axis)
+        loss = (1 - label_smoothing) * nll - label_smoothing * mean_logp
+    else:
+        idx = jnp.expand_dims(safe_lab, axis)
+        loss = -jnp.take_along_axis(logp, idx, axis=axis).squeeze(axis)
+    if weight is not None:
+        w = jnp.take(weight, safe_lab, axis=0).astype(jnp.float32)
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        return _reduce(loss, reduction)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(loss) / n_valid
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    return _cross_entropy(input, label, weight, soft_label=bool(soft_label),
+                          ignore_index=int(ignore_index), reduction=reduction,
+                          axis=int(axis), label_smoothing=float(label_smoothing),
+                          use_softmax=bool(use_softmax))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = _cross_entropy(logits, label, None, soft_label=bool(soft_label),
+                          ignore_index=int(ignore_index), reduction="none",
+                          axis=int(axis))
+    from .activation import softmax as softmax_fn
+
+    loss_keep = loss.unsqueeze(int(axis)) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        return loss_keep, softmax_fn(logits, axis=axis)
+    return loss_keep
+
+
+@op("mse_loss_op")
+def _mse(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(input, label, reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return _mse(input, label, reduction="none")
+
+
+@op("l1_loss_op")
+def _l1(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(input, label, reduction=reduction)
+
+
+@op("nll_loss_op")
+def _nll(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lab = label.astype(jnp.int32)
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, 0)
+    # input: [N, C, ...]
+    idx = jnp.expand_dims(safe, 1)
+    picked = -jnp.take_along_axis(input, idx, axis=1).squeeze(1)
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0)
+        picked = picked * w
+        picked = jnp.where(valid, picked, 0.0)
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.sum(jnp.where(valid, w, 0.0))
+    picked = jnp.where(valid, picked, 0.0)
+    if reduction == "mean":
+        return jnp.sum(picked) / jnp.maximum(jnp.sum(valid.astype(input.dtype)), 1.0)
+    return _reduce(picked, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll(input, label, weight, ignore_index=int(ignore_index),
+                reduction=reduction)
+
+
+@op("bce_op")
+def _bce(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    return _bce(input, label, weight, reduction=reduction)
+
+
+@op("bce_logits_op")
+def _bce_logits(logit, label, weight=None, pos_weight=None, reduction="mean"):
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    return _bce_logits(logit, label, weight, pos_weight, reduction=reduction)
+
+
+@op("smooth_l1_op")
+def _smooth_l1(input, label, delta=1.0, reduction="mean"):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(input, label, delta=float(delta), reduction=reduction)
+
+
+@op("kl_div_op")
+def _kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.maximum(label, 1e-12)
+        loss = label * (jnp.log(safe) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return _kl_div(input, label, reduction=reduction, log_target=bool(log_target))
+
+
+@op("margin_ranking_op")
+def _margin_ranking(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _margin_ranking(input, other, label, margin=float(margin),
+                           reduction=reduction)
+
+
+@op("hinge_embedding_op")
+def _hinge_embedding(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _hinge_embedding(input, label, margin=float(margin), reduction=reduction)
+
+
+@op("cosine_embedding_op")
+def _cosine_embedding(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    return _cosine_embedding(input1, input2, label, margin=float(margin),
+                             reduction=reduction)
+
+
+@op("triplet_margin_op")
+def _triplet(anchor, positive, negative, margin=1.0, p=2.0, eps=1e-6,
+             swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + eps, p), axis=-1),
+                         1.0 / p)
+
+    d_pos = dist(anchor, positive)
+    d_neg = dist(anchor, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet(input, positive, negative, margin=float(margin), p=float(p),
+                    eps=float(epsilon), swap=bool(swap), reduction=reduction)
+
+
+@op("log_loss_op")
+def _log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(
+        1 - input + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(input, label, epsilon=float(epsilon))
+
+
+@op("sigmoid_focal_op")
+def _sigmoid_focal(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                   reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return _sigmoid_focal(logit, label, normalizer, alpha=float(alpha),
+                          gamma=float(gamma), reduction=reduction)
+
+
+@op("poisson_nll_op")
+def _poisson_nll(input, label, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label + 0.5 * jnp.log(
+            2 * np.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return _poisson_nll(input, label, log_input=bool(log_input), full=bool(full),
+                        epsilon=float(epsilon), reduction=reduction)
+
+
+@op("soft_margin_op")
+def _soft_margin(input, label, reduction="mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _soft_margin(input, label, reduction=reduction)
+
+
+@op("multi_label_soft_margin_op")
+def _ml_soft_margin(input, label, weight=None, reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    loss = jnp.mean(loss, axis=-1)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    return _ml_soft_margin(input, label, weight, reduction=reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha-recursion in log space (lax.scan over time).
+    Reference: paddle warpctc binding (paddle/phi/kernels/gpu/warpctc_kernel.cu)."""
+
+    @op("ctc_loss_op")
+    def _ctc(log_probs, labels, input_lengths, label_lengths, blank=0):
+        # log_probs: [T, N, C] (paddle convention)
+        T, N, C = log_probs.shape
+        L = labels.shape[1]
+        S = 2 * L + 1
+        lab = labels.astype(jnp.int32)
+        ext = jnp.full((N, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = -1e30
+        lp0 = log_probs[0]
+        alpha0 = jnp.full((N, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp0[:, blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp0, ext[:, 1:2], 1)[:, 0])
+
+        def logaddexp3(a, b, c):
+            m = jnp.maximum(jnp.maximum(a, b), c)
+            m_safe = jnp.where(m == neg_inf, 0.0, m)
+            return jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe)
+                           + jnp.exp(c - m_safe)) + m
+
+        same = jnp.concatenate(
+            [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_prev2 = jnp.where(same, neg_inf, a_prev2)
+            merged = logaddexp3(alpha, a_prev1, a_prev2)
+            emit = jnp.take_along_axis(lp, ext, axis=1)
+            return merged + emit, None
+
+        def masked_step(carry, inp):
+            alpha, t = carry
+            lp = inp
+            new_alpha, _ = step(alpha, lp)
+            keep = (t + 1) < input_lengths  # [N]
+            alpha = jnp.where(keep[:, None], new_alpha, alpha)
+            return (alpha, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.zeros((), jnp.int32)),
+                                     log_probs[1:])
+        end = 2 * label_lengths.astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha, end[:, None], 1)[:, 0]
+        a_last2 = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None], 1)[:, 0]
+        m = jnp.maximum(a_last, a_last2)
+        m_safe = jnp.where(m == neg_inf, 0.0, m)
+        ll = jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_last2 - m_safe)) + m
+        return -ll
+
+    loss = _ctc(log_probs, labels, input_lengths, label_lengths, blank=int(blank))
+    if reduction == "mean":
+        from ...ops.math import mean as mean_op
+
+        return mean_op(loss / label_lengths.astype(loss.dtype))
+    if reduction == "sum":
+        from ...ops.math import sum as sum_op
+
+        return sum_op(loss)
+    return loss

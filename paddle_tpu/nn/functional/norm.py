@@ -1,0 +1,205 @@
+"""Normalization functionals.
+
+Reference: python/paddle/nn/functional/norm.py, PHI kernels
+layer_norm_kernel.h / batch_norm_kernel.h. Stats are computed in float32
+regardless of input dtype (matches the reference's AMP-safe norm kernels),
+then cast back — important for bf16 training.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "local_response_norm",
+    "normalize", "rms_norm",
+]
+
+
+@op("layer_norm_op")
+def _layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(normalized_shape)
+    return _layer_norm(x, weight, bias, epsilon=float(epsilon),
+                       begin_norm_axis=int(begin))
+
+
+@op("rms_norm_op")
+def _rms_norm(x, weight=None, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native extension (the reference has fused rms_norm in
+    paddle/phi/kernels/gpu/rms_norm_kernel.cu via incubate)."""
+    return _rms_norm(x, weight, epsilon=float(epsilon))
+
+
+@op("batch_norm_infer")
+def _batch_norm_infer(x, mean, var, weight=None, bias=None, epsilon=1e-5,
+                      channel_axis=1):
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    xf = x.astype(jnp.float32)
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape).astype(jnp.float32) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@op("batch_norm_train")
+def _batch_norm_train(x, weight=None, bias=None, epsilon=1e-5, channel_axis=1):
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    channel_axis = 1 if data_format.startswith("NC") or x.ndim <= 2 else x.ndim - 1
+    if x.ndim <= 2:
+        channel_axis = x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _batch_norm_infer(x, running_mean, running_var, weight, bias,
+                                 epsilon=float(epsilon), channel_axis=channel_axis)
+    out, mean, var = _batch_norm_train(x, weight, bias, epsilon=float(epsilon),
+                                       channel_axis=channel_axis)
+    if running_mean is not None and not isinstance(mean._data, jax.core.Tracer):
+        # Running-stat update is a host-side side effect; under @to_static
+        # tracing it is skipped (stats are frozen at trace time — use
+        # use_global_stats or eval mode for compiled BN, as with the
+        # reference's static-graph BN).
+        m = float(momentum)
+        running_mean._rebind(
+            (running_mean._data * m + mean._data * (1 - m)).astype(
+                running_mean._data.dtype))
+        running_var._rebind(
+            (running_var._data * m + var._data * (1 - m)).astype(
+                running_var._data.dtype))
+    return out
+
+
+@op("instance_norm_op")
+def _instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return _instance_norm(x, weight, bias, epsilon=float(eps))
+
+
+@op("group_norm_op")
+def _group_norm(x, weight=None, bias=None, epsilon=1e-5, num_groups=1,
+                channel_axis=1):
+    n = x.shape[0]
+    c = x.shape[channel_axis]
+    g = num_groups
+    xf = x.astype(jnp.float32)
+    if channel_axis == 1:
+        grouped = xf.reshape(n, g, c // g, *x.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+    else:
+        grouped = xf.reshape(*x.shape[:-1], g, c // g)
+        axes = tuple(range(1, grouped.ndim - 2)) + (grouped.ndim - 1,)
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1] * x.ndim
+    shape[channel_axis] = c
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    return _group_norm(x, weight, bias, epsilon=float(epsilon),
+                       num_groups=int(num_groups), channel_axis=channel_axis)
+
+
+@op("local_response_norm_op")
+def _lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    padded = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[1] = size
+    summed = jax.lax.reduce_window(padded, np.array(0, x.dtype), jax.lax.add,
+                                   tuple(window), (1,) * x.ndim, "VALID")
+    div = jnp.power(k + alpha * summed, beta)
+    return x / div
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _lrn(x, size=int(size), alpha=float(alpha), beta=float(beta),
+                k=float(k))
+
+
+@op("normalize_op")
+def _normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, p=float(p), axis=int(axis), epsilon=float(epsilon))

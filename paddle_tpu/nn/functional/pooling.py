@@ -1,0 +1,211 @@
+"""Pooling functionals on lax.reduce_window.
+
+Reference: python/paddle/nn/functional/pooling.py, PHI pool kernels
+(paddle/phi/kernels/pool_kernel.h). NCHW layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else [v[0]] * n))
+    return (int(v),) * n
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _tup(padding, n)
+    return tuple((x, x) for x in p)
+
+
+@op("max_pool_nd")
+def _max_pool(x, ksize=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0)),
+              ceil_mode=False):
+    nd = len(ksize)
+    window = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    if isinstance(padding, str):
+        pads = padding
+    else:
+        pads = ((0, 0), (0, 0)) + tuple(padding)
+        if ceil_mode:
+            pads = ((0, 0), (0, 0)) + tuple(
+                (lo, hi + s - 1) for (lo, hi), s in zip(padding, stride)
+            )
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        init = np.array(-np.inf, x.dtype)
+    else:
+        init = np.array(np.iinfo(x.dtype).min, x.dtype)
+    return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+
+
+@op("avg_pool_nd")
+def _avg_pool(x, ksize=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0)),
+              exclusive=True, ceil_mode=False):
+    window = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    if isinstance(padding, str):
+        pads = padding
+    else:
+        pads = ((0, 0), (0, 0)) + tuple(padding)
+    summed = jax.lax.reduce_window(x, np.array(0, x.dtype), jax.lax.add,
+                                   window, strides, pads)
+    if exclusive and not isinstance(padding, str):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, np.array(0, x.dtype), jax.lax.add,
+                                       window, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(ksize))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    out = _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 2),
+                    ceil_mode=bool(ceil_mode))
+    if return_mask:
+        from ...ops.manipulation import argmax
+
+        return out, None  # mask indices unsupported (reference: pool w/ mask)
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    ks = _tup(kernel_size, 1)
+    st = _tup(stride if stride is not None else kernel_size, 1)
+    return _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 1),
+                     ceil_mode=bool(ceil_mode))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    ks = _tup(kernel_size, 3)
+    st = _tup(stride if stride is not None else kernel_size, 3)
+    return _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 3),
+                     ceil_mode=bool(ceil_mode))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    ks = _tup(kernel_size, 1)
+    st = _tup(stride if stride is not None else kernel_size, 1)
+    return _avg_pool(x, ksize=ks, stride=st, padding=_pads(padding, 1),
+                     exclusive=bool(exclusive), ceil_mode=bool(ceil_mode))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    return _avg_pool(x, ksize=ks, stride=st, padding=_pads(padding, 2),
+                     exclusive=bool(exclusive), ceil_mode=bool(ceil_mode))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    ks = _tup(kernel_size, 3)
+    st = _tup(stride if stride is not None else kernel_size, 3)
+    return _avg_pool(x, ksize=ks, stride=st, padding=_pads(padding, 3),
+                     exclusive=bool(exclusive), ceil_mode=bool(ceil_mode))
+
+
+@op("adaptive_avg_pool_nd")
+def _adaptive_avg_pool(x, out_size=(1, 1)):
+    nd = len(out_size)
+    spatial = x.shape[2:]
+    # even split windows (same as reference adaptive pooling formula)
+    out = x
+    for i in range(nd):
+        in_len = spatial[i]
+        o = out_size[i]
+        if in_len % o == 0:
+            k = in_len // o
+            window = [1] * out.ndim
+            window[2 + i] = k
+            strides = [1] * out.ndim
+            strides[2 + i] = k
+            out = jax.lax.reduce_window(out, np.array(0, x.dtype), jax.lax.add,
+                                        tuple(window), tuple(strides), "VALID") / k
+        else:
+            starts = (np.arange(o) * in_len) // o
+            ends = ((np.arange(o) + 1) * in_len + o - 1) // o
+            pieces = [
+                jnp.mean(
+                    jax.lax.slice_in_dim(out, int(s), int(e), axis=2 + i),
+                    axis=2 + i, keepdims=True)
+                for s, e in zip(starts, ends)
+            ]
+            out = jnp.concatenate(pieces, axis=2 + i)
+    return out
+
+
+@op("adaptive_max_pool_nd")
+def _adaptive_max_pool(x, out_size=(1, 1)):
+    nd = len(out_size)
+    spatial = x.shape[2:]
+    out = x
+    for i in range(nd):
+        in_len = spatial[i]
+        o = out_size[i]
+        if in_len % o == 0:
+            k = in_len // o
+            window = [1] * out.ndim
+            window[2 + i] = k
+            strides = [1] * out.ndim
+            strides[2 + i] = k
+            out = jax.lax.reduce_window(
+                out, np.array(-np.inf, x.dtype), jax.lax.max,
+                tuple(window), tuple(strides), "VALID")
+        else:
+            starts = (np.arange(o) * in_len) // o
+            ends = ((np.arange(o) + 1) * in_len + o - 1) // o
+            pieces = [
+                jnp.max(jax.lax.slice_in_dim(out, int(s), int(e), axis=2 + i),
+                        axis=2 + i, keepdims=True)
+                for s, e in zip(starts, ends)
+            ]
+            out = jnp.concatenate(pieces, axis=2 + i)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_avg_pool(x, out_size=_tup(output_size, 1))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_avg_pool(x, out_size=_tup(output_size, 2))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_avg_pool(x, out_size=_tup(output_size, 3))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, out_size=_tup(output_size, 1))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, out_size=_tup(output_size, 2))
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, out_size=_tup(output_size, 3))

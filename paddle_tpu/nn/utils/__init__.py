@@ -1,0 +1,104 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [jnp.ravel(p._data) for p in parameters]
+    return Tensor._wrap(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._rebind(vec._data[offset : offset + n].reshape(p._data.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference nn/utils/weight_norm_hook.py)."""
+    from ...core.tensor import Parameter
+
+    weight = getattr(layer, name)
+    w = weight._data
+    if dim is None:
+        norm = jnp.linalg.norm(w)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=False))
+    g = Parameter(norm)
+    v = Parameter(w)
+    delattr(layer, name)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(layer_, inputs):
+        import jax
+
+        vv = getattr(layer_, name + "_v")
+        gg = getattr(layer_, name + "_g")
+        if dim is None:
+            w_new = vv * (gg / (jnp.linalg.norm(vv._data) + 1e-12))
+        else:
+            axes = tuple(i for i in range(vv._data.ndim) if i != dim)
+            from ...ops import math as _m
+
+            norm_v = jnp.sqrt(jnp.sum(jnp.square(vv._data), axis=axes,
+                                      keepdims=True))
+            shape = [1] * vv._data.ndim
+            shape[dim] = -1
+            w_new = vv * Tensor._wrap(gg._data.reshape(shape) / (norm_v + 1e-12))
+        object.__setattr__(layer_, "_" + name + "_computed", w_new)
+        layer_._buffers[name] = w_new
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    layer._weight_norm_hook_name = name
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ...core.tensor import Parameter
+
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = layer._buffers.get(name)
+    delattr(layer, name + "_g")
+    delattr(layer, name + "_v")
+    layer._buffers.pop(name, None)
+    layer.add_parameter(name, Parameter(w._data if w is not None else v._data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm
+
+    weight = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(list(weight._data.shape), dim=dim,
+                      power_iters=n_power_iterations, epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = weight
+
+    def hook(layer_, inputs):
+        w = sn(orig)
+        layer_._buffers[name] = w
+
+    from ...core.tensor import Parameter
+
+    delattr(layer, name)
+    layer.add_parameter(name + "_orig", orig)
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

@@ -1,0 +1,155 @@
+"""Functional op namespace.
+
+Analog of the reference's generated ``paddle._C_ops`` + ``python/paddle/tensor``
+package: every op is a pure-JAX function registered with the dispatch layer
+(core/dispatch.py). Importing this package also installs the op-method surface
+onto ``Tensor`` (the reference generates those bindings from YAML via
+python_c_gen.py; here installation is introspective).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import dtype as _dtypes
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from . import creation, indexing, linalg, logic, manipulation, math  # noqa: F401
+
+from .math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, remainder, matmul, pow,
+    neg, abs, maximum, minimum, sum, mean, max, min, all, any,
+)
+from .manipulation import cast, reshape, transpose, concat, where  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# Tensor method + operator installation
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [creation, math, manipulation, logic, linalg]
+
+# names whose first parameter is NOT a tensor (skip when installing methods)
+_NON_METHODS = {
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+    "logspace", "eye", "meshgrid", "rand", "randn", "randint", "uniform",
+    "normal", "randperm", "standard_normal", "gaussian", "einsum", "multi_dot",
+    "broadcast_tensors", "one_hot", "scatter_nd", "is_tensor",
+}
+
+
+def _install():
+    import types
+
+    for mod in _METHOD_SOURCES:
+        names = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")
+        ]
+        for name in names:
+            fn = getattr(mod, name, None)
+            if not isinstance(fn, types.FunctionType):
+                continue
+            if name in _NON_METHODS:
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+
+    def _swap(fn):
+        def rev(self, other):
+            return fn(other, self)
+
+        return rev
+
+    def _coerce(fn):
+        def method(self, other):
+            return fn(self, other)
+
+        return method
+
+    Tensor.__add__ = _coerce(add)
+    Tensor.__radd__ = _swap(add)
+    Tensor.__sub__ = _coerce(subtract)
+    Tensor.__rsub__ = _swap(subtract)
+    Tensor.__mul__ = _coerce(multiply)
+    Tensor.__rmul__ = _swap(multiply)
+    Tensor.__truediv__ = _coerce(divide)
+    Tensor.__rtruediv__ = _swap(divide)
+    Tensor.__floordiv__ = _coerce(floor_divide)
+    Tensor.__rfloordiv__ = _swap(floor_divide)
+    Tensor.__mod__ = _coerce(remainder)
+    Tensor.__rmod__ = _swap(remainder)
+    Tensor.__pow__ = _coerce(pow)
+    Tensor.__rpow__ = _swap(pow)
+    Tensor.__matmul__ = _coerce(matmul)
+    Tensor.__rmatmul__ = _swap(matmul)
+    Tensor.__neg__ = lambda self: neg(self)
+    Tensor.__abs__ = lambda self: abs(self)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__eq__ = _coerce(logic.equal)
+    Tensor.__ne__ = _coerce(logic.not_equal)
+    Tensor.__lt__ = _coerce(logic.less_than)
+    Tensor.__le__ = _coerce(logic.less_equal)
+    Tensor.__gt__ = _coerce(logic.greater_than)
+    Tensor.__ge__ = _coerce(logic.greater_equal)
+    Tensor.__and__ = _coerce(logic.logical_and)
+    Tensor.__or__ = _coerce(logic.logical_or)
+    Tensor.__xor__ = _coerce(logic.logical_xor)
+    Tensor.__hash__ = lambda self: id(self)
+
+    # common in-place helpers (rebind semantics; see tensor.py docstring)
+    def _inplace(name, fn):
+        def method(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            self._data, self._node, self._out_idx = out._data, out._node, out._out_idx
+            self.stop_gradient = out.stop_gradient and self.stop_gradient
+            return self
+
+        method.__name__ = name
+        setattr(Tensor, name, method)
+
+    _inplace("add_", add)
+    _inplace("subtract_", subtract)
+    _inplace("multiply_", multiply)
+    _inplace("divide_", divide)
+    _inplace("scale_", math.scale)
+    _inplace("clip_", math.clip)
+    _inplace("exp_", math.exp)
+    _inplace("sqrt_", math.sqrt)
+    _inplace("rsqrt_", math.rsqrt)
+    _inplace("floor_", math.floor)
+    _inplace("ceil_", math.ceil)
+    _inplace("round_", math.round)
+    _inplace("abs_", math.abs)
+    _inplace("tanh_", math.tanh)
+
+    def zero_(self):
+        import jax.numpy as jnp
+
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def fill_(self, value):
+        import jax.numpy as jnp
+
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    Tensor.zero_ = zero_
+    Tensor.fill_ = fill_
+    Tensor.item = Tensor.item  # keep
+
+    # paddle-style aliases
+    Tensor.mm = math.mm
+    Tensor.t = manipulation.t
+    Tensor.dim = lambda self: self.ndim
+    Tensor.rank = lambda self: self.ndim
+    Tensor.cpu = Tensor.cpu
+
+
+_install()
+del _install

@@ -1,0 +1,320 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py,
+kernels paddle/phi/kernels/full_kernel.h etc.)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import rng
+from ..core.dispatch import op
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "tril",
+    "triu", "diag", "diagflat", "meshgrid", "assign", "clone", "one_hot",
+    "rand", "randn", "randint", "randint_like", "uniform", "normal", "randperm",
+    "standard_normal", "bernoulli", "multinomial", "gaussian",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default or dtypes.get_default_dtype()
+    return d
+
+
+@op("full")
+def _full(shape=(), fill_value=0, dtype=None):
+    return jnp.full(shape, fill_value, dtype)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _full(shape=_shape(shape), fill_value=fill_value, dtype=_dt(dtype))
+
+
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0, dtype)
+
+
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1, dtype)
+
+
+def empty(shape, dtype=None, name=None):
+    return full(shape, 0, dtype)
+
+
+@op("full_like")
+def _full_like(x, fill_value=0, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like(x, fill_value=fill_value, dtype=dtypes.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+@op("arange")
+def _arange(start=0, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if dtype is None:
+        dtype = (
+            dtypes.int64
+            if all(isinstance(v, (int, type(None))) for v in (start, end, step))
+            else dtypes.get_default_dtype()
+        )
+        if dtype == dtypes.int64:
+            dtype = dtypes.int32  # TPU-friendly default (see core/dtype.py)
+    return _arange(start=start, end=end, step=step, dtype=dtypes.convert_dtype(dtype))
+
+
+@op("linspace")
+def _linspace(start=0.0, stop=1.0, num=100, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return _linspace(start=val(start), stop=val(stop), num=int(val(num)),
+                     dtype=_dt(dtype))
+
+
+@op("logspace")
+def _logspace(start=0.0, stop=1.0, num=100, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, num, base=base, dtype=dtype)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return _logspace(start=val(start), stop=val(stop), num=int(val(num)),
+                     base=float(val(base)), dtype=_dt(dtype))
+
+
+@op("eye")
+def _eye(num_rows=0, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _eye(num_rows=int(num_rows),
+                num_columns=None if num_columns is None else int(num_columns),
+                dtype=_dt(dtype))
+
+
+@op("tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=int(diagonal))
+
+
+@op("triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=int(diagonal))
+
+
+@op("diag")
+def _diag(x, offset=0):
+    return jnp.diag(x, offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0 and x.ndim == 1:
+        d = _diag(x, offset=int(offset))
+        import paddle_tpu.ops as ops
+
+        n = d.shape[0]
+        mask = eye(n, dtype="bool")
+        if offset:
+            mask = to_tensor(np.eye(n, k=offset, dtype=bool))
+        return ops.where(mask, d, full_like(d, padding_value))
+    return _diag(x, offset=int(offset))
+
+
+def diagflat(x, offset=0, name=None):
+    import paddle_tpu.ops as ops
+
+    return _diag(ops.flatten(x), offset=int(offset))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor._wrap(o) for o in outs]
+
+
+@op("assign")
+def _assign(x):
+    return x + 0 if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(np.asarray(x))
+    out = _assign(x)
+    if output is not None:
+        output._rebind(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+@op("one_hot")
+def _one_hot(x, num_classes=-1, dtype=None):
+    return jnp.asarray(
+        jnp.arange(num_classes, dtype=jnp.int32) == x[..., None],
+        dtype or jnp.float32,
+    )
+
+
+def one_hot(x, num_classes, name=None):
+    return _one_hot(x, num_classes=int(num_classes))
+
+
+# ---- random creation (phi::Generator analog: core/rng.py) ----
+
+@op("random_uniform")
+def _uniform(key, shape=(), dtype=None, min=0.0, max=1.0):
+    import jax
+
+    return jax.random.uniform(key, shape, dtype or jnp.float32, min, max)
+
+
+@op("random_normal")
+def _normal(key, shape=(), dtype=None, mean=0.0, std=1.0):
+    import jax
+
+    return jax.random.normal(key, shape, dtype or jnp.float32) * std + mean
+
+
+@op("random_randint")
+def _randint(key, shape=(), low=0, high=1, dtype=None):
+    import jax
+
+    return jax.random.randint(key, shape, low, high, dtype or jnp.int32)
+
+
+@op("random_permutation", differentiable=False)
+def _randperm(key, n=0, dtype=None):
+    import jax
+
+    return jax.random.permutation(key, n).astype(dtype or jnp.int32)
+
+
+@op("random_bernoulli", differentiable=False)
+def _bernoulli(x, key):
+    import jax
+
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+@op("random_categorical", differentiable=False)
+def _categorical(logits, key, num_samples=1, replacement=False):
+    import jax
+
+    return jax.random.categorical(key, logits, axis=-1,
+                                  shape=(*logits.shape[:-1], num_samples))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return _uniform(rng.next_key(), shape=_shape(shape), dtype=_dt(dtype),
+                    min=float(min), max=float(max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        import paddle_tpu.ops as ops
+
+        m = mean if isinstance(mean, Tensor) else None
+        shp = _shape(m.shape if m is not None else std.shape)
+        base = _normal(rng.next_key(), shape=shp, dtype=dtypes.get_default_dtype())
+        return ops.add(ops.multiply(base, std), mean)
+    return _normal(rng.next_key(), shape=_shape(shape or []),
+                   dtype=dtypes.get_default_dtype(), mean=float(mean), std=float(std))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    return _normal(rng.next_key(), shape=_shape(shape), dtype=_dt(dtype),
+                   mean=float(mean), std=float(std))
+
+
+def randn(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _randint(rng.next_key(), shape=_shape(shape), low=int(low),
+                    high=int(high), dtype=_dt(dtype, dtypes.int32))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int32", name=None):
+    return _randperm(rng.next_key(), n=int(n), dtype=_dt(dtype, dtypes.int32))
+
+
+def bernoulli(x, name=None):
+    return _bernoulli(x, rng.next_key())
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    import paddle_tpu.ops as ops
+
+    logits = ops.log(x)
+    return _categorical(logits, rng.next_key(), num_samples=int(num_samples),
+                        replacement=bool(replacement))

@@ -1,0 +1,126 @@
+"""__getitem__/__setitem__ support.
+
+Reference: the pybind slice machinery in paddle/fluid/pybind/eager_method.cc
+(``__getitem__``) + set_value op. Static python indices (ints/slices/ellipsis/
+None) are baked into the jit cache key; Tensor indices are passed as dynamic
+args (XLA gather). Boolean-mask indexing is eager-only (dynamic output shape).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+_SLICE = "s"
+_INT = "i"
+_NONE = "n"
+_ELL = "e"
+_TENSOR = "t"
+_ARRAY = "a"
+
+
+def _canon(idx):
+    """Split an index expr into a hashable static spec + dynamic tensor list."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec = []
+    tensors = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if it.dtype == np.dtype("bool"):
+                return None, None  # boolean mask → eager path
+            spec.append((_TENSOR, len(tensors)))
+            tensors.append(it)
+        elif isinstance(it, (jax.Array, np.ndarray)):
+            if np.dtype(it.dtype) == np.dtype("bool"):
+                return None, None
+            spec.append((_TENSOR, len(tensors)))
+            tensors.append(Tensor._wrap(jnp.asarray(it)))
+        elif isinstance(it, slice):
+            spec.append((_SLICE, it.start, it.stop, it.step))
+        elif it is None:
+            spec.append((_NONE,))
+        elif it is Ellipsis:
+            spec.append((_ELL,))
+        elif isinstance(it, (int, np.integer)):
+            spec.append((_INT, int(it)))
+        elif isinstance(it, (list, tuple)):
+            arr = np.asarray(it)
+            if arr.dtype == np.dtype("bool"):
+                return None, None
+            spec.append((_TENSOR, len(tensors)))
+            tensors.append(Tensor._wrap(jnp.asarray(arr)))
+        elif isinstance(it, (bool, np.bool_)):
+            return None, None
+        else:
+            raise TypeError(f"unsupported index type {type(it)}")
+    return tuple(spec), tensors
+
+
+def _rebuild(spec, arrs):
+    out = []
+    for s in spec:
+        tag = s[0]
+        if tag == _SLICE:
+            out.append(slice(s[1], s[2], s[3]))
+        elif tag == _INT:
+            out.append(s[1])
+        elif tag == _NONE:
+            out.append(None)
+        elif tag == _ELL:
+            out.append(Ellipsis)
+        elif tag == _TENSOR:
+            out.append(arrs[s[1]])
+    return tuple(out)
+
+
+@op("getitem")
+def _getitem(x, *index_arrays, spec=()):
+    return x[_rebuild(spec, index_arrays)]
+
+
+@op("set_value")
+def _setitem(x, value, *index_arrays, spec=()):
+    return x.at[_rebuild(spec, index_arrays)].set(value)
+
+
+def getitem(x, idx):
+    spec, tensors = _canon(idx)
+    if spec is None:
+        # boolean mask: eager-only dynamic shape
+        mask = idx if not isinstance(idx, tuple) else idx
+        data = np.asarray(x._data)[_np_index(mask)]
+        return Tensor._wrap(jnp.asarray(data))
+    return _getitem(x, *tensors, spec=spec)
+
+
+def setitem_(x, idx, value):
+    spec, tensors = _canon(idx)
+    if not isinstance(value, Tensor):
+        value = Tensor._wrap(jnp.asarray(np.asarray(value), x._data.dtype))
+    if value.dtype != x.dtype:
+        value = Tensor._wrap(jnp.asarray(value._data, x._data.dtype))
+    if spec is None:
+        arr = np.asarray(x._data)
+        arr[_np_index(idx)] = np.asarray(value._data)
+        x._rebind(jnp.asarray(arr))
+        return x
+    out = _setitem(x, value, *tensors, spec=spec)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x
+
+
+def _np_index(idx):
+    def conv(it):
+        if isinstance(it, Tensor):
+            return np.asarray(it._data)
+        return it
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)

@@ -1,0 +1,292 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, kernels
+paddle/phi/kernels/{cholesky,qr,svd,...}_kernel.h). Decompositions lower to
+XLA's native linalg on CPU/TPU."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "norm", "vector_norm", "matrix_norm", "cholesky", "qr", "svd", "eig",
+    "eigh", "eigvals", "eigvalsh", "inv", "pinv", "det", "slogdet", "solve",
+    "triangular_solve", "cholesky_solve", "lstsq", "matrix_power", "matrix_rank",
+    "cond", "cov", "corrcoef", "multi_dot", "cross", "histogramdd", "lu",
+    "einsum",
+]
+
+
+@op("p_norm")
+def _norm(x, p=2.0, axis=None, keepdim=False):
+    if p == "fro" or p is None:
+        p = 2.0
+    if p == "inf":
+        p = jnp.inf
+    if p == "-inf":
+        p = -jnp.inf
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = axis
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(int(a) for a in ax)
+        if p is None:
+            p = "fro"
+        if p == "fro":
+            from .math import sqrt, sum as sum_op, square
+
+            return sqrt(sum_op(square(x), axis=ax, keepdim=keepdim))
+    elif ax is not None:
+        ax = int(ax)
+    if p is None:
+        p = 2.0
+    return _norm(x, p=p if isinstance(p, str) else float(p), axis=ax,
+                 keepdim=bool(keepdim))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+@op("matrix_norm")
+def _matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return _matrix_norm(x, p=p if isinstance(p, str) else float(p),
+                        axis=tuple(axis), keepdim=bool(keepdim))
+
+
+def _simple(name, fn, differentiable=True, multi_out=False):
+    fwd = op(name, differentiable=differentiable)(fn)
+
+    def public(x, name=None):
+        out = fwd(x)
+        return tuple(out) if multi_out else out
+
+    public.__name__ = name
+    return public
+
+
+cholesky_ = op("cholesky")(lambda x, upper=False: jnp.linalg.cholesky(x) if not upper
+                           else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2).conj())
+
+
+def cholesky(x, upper=False, name=None):
+    return cholesky_(x, upper=bool(upper))
+
+
+@op("qr")
+def _qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+def qr(x, mode="reduced", name=None):
+    return tuple(_qr(x, mode=mode))
+
+
+@op("svd")
+def _svd(x, full_matrices=False):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+def svd(x, full_matrices=False, name=None):
+    return tuple(_svd(x, full_matrices=bool(full_matrices)))
+
+
+@op("eigh")
+def _eigh(x, UPLO="L"):
+    return tuple(jnp.linalg.eigh(x, UPLO=UPLO))
+
+
+def eigh(x, UPLO="L", name=None):
+    return tuple(_eigh(x, UPLO=UPLO))
+
+
+def eig(x, name=None):
+    # general eig only on CPU in XLA; run via numpy for parity
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor._wrap(jnp.asarray(w)), Tensor._wrap(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(x._data))
+    return Tensor._wrap(jnp.asarray(w))
+
+
+@op("eigvalsh", differentiable=False)
+def _eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _eigvalsh(x, UPLO=UPLO)
+
+
+inv = _simple("inv", jnp.linalg.inv)
+
+
+@op("pinv")
+def _pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv(x, rcond=float(rcond), hermitian=bool(hermitian))
+
+
+det = _simple("det", jnp.linalg.det)
+
+
+@op("slogdet")
+def _slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def slogdet(x, name=None):
+    return _slogdet(x)
+
+
+@op("solve")
+def _solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def solve(x, y, name=None):
+    if y.ndim == x.ndim - 1:
+        return _solve(x, y)
+    return _solve(x, y)
+
+
+@op("triangular_solve")
+def _triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return _triangular_solve(x, y, upper=bool(upper), transpose=bool(transpose),
+                             unitriangular=bool(unitriangular))
+
+
+@op("cholesky_solve")
+def _cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _cholesky_solve(x, y, upper=bool(upper))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = np.linalg.lstsq(np.asarray(x._data), np.asarray(y._data),
+                                         rcond=rcond)
+    return (Tensor._wrap(jnp.asarray(sol)), Tensor._wrap(jnp.asarray(res)),
+            Tensor._wrap(jnp.asarray(rank)), Tensor._wrap(jnp.asarray(sv)))
+
+
+@op("matrix_power")
+def _matrix_power(x, n=1):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power(x, n=int(n))
+
+
+@op("matrix_rank", differentiable=False)
+def _matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int32)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return _matrix_rank(x, tol=None if tol is None else float(tol),
+                        hermitian=bool(hermitian))
+
+
+@op("cond_op", differentiable=False)
+def _cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond(x, p=p if (p is None or isinstance(p, str)) else float(p))
+
+
+@op("cov")
+def _cov(x, rowvar=True, ddof=True):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _cov(x, rowvar=bool(rowvar), ddof=bool(ddof))
+
+
+corrcoef_ = op("corrcoef")(lambda x, rowvar=True: jnp.corrcoef(x, rowvar=rowvar))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return corrcoef_(x, rowvar=bool(rowvar))
+
+
+@op("multi_dot")
+def _multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def multi_dot(x, name=None):
+    return _multi_dot(*x)
+
+
+@op("cross")
+def _cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:  # paddle default: first axis with dim 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return _cross(x, y, axis=int(axis))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    h, edges = np.histogramdd(np.asarray(x._data), bins=bins, range=ranges,
+                              density=density,
+                              weights=None if weights is None else np.asarray(weights._data))
+    return Tensor._wrap(jnp.asarray(h)), [Tensor._wrap(jnp.asarray(e)) for e in edges]
+
+
+@op("lu")
+def _lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    l_, p = _lu(x, pivot=bool(pivot))
+    if get_infos:
+        from .creation import zeros
+
+        return l_, p, zeros([], "int32")
+    return l_, p
+
+
+@op("einsum_op")
+def _einsum(*operands, equation=""):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    """paddle.einsum (reference: python/paddle/tensor/einsum.py)."""
+    return _einsum(*operands, equation=equation)

@@ -1,0 +1,101 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = []
+
+
+def _cmp(name, fn):
+    fwd = op(name, differentiable=False)(fn)
+
+    def public(x, y, name=None):
+        return fwd(x, y)
+
+    public.__name__ = name
+    __all__.append(name)
+    return public
+
+
+equal = _cmp("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _cmp("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _cmp("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _cmp("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _cmp("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _cmp("less_equal", lambda x, y: jnp.less_equal(x, y))
+logical_and = _cmp("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _cmp("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _cmp("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = _cmp("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _cmp("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _cmp("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+bitwise_left_shift = _cmp("bitwise_left_shift", lambda x, y: jnp.left_shift(x, y))
+bitwise_right_shift = _cmp("bitwise_right_shift", lambda x, y: jnp.right_shift(x, y))
+
+
+@op("logical_not", differentiable=False)
+def _logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical_not(x)
+
+
+@op("bitwise_not", differentiable=False)
+def _bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_not(x, out=None, name=None):
+    return _bitwise_not(x)
+
+
+@op("isclose", differentiable=False)
+def _isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _isclose(x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    from .math import all as all_op
+
+    return all_op(isclose(x, y, rtol, atol, equal_nan))
+
+
+def equal_all(x, y, name=None):
+    from .math import all as all_op
+
+    if tuple(x.shape) != tuple(y.shape):
+        from .creation import to_tensor
+
+        return to_tensor(False)
+    return all_op(equal(x, y))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    from .creation import to_tensor
+
+    return to_tensor(x.size == 0)
+
+
+def in_dynamic_mode():
+    return True
+
+
+__all__ += [
+    "logical_not", "bitwise_not", "isclose", "allclose", "equal_all",
+    "is_tensor", "is_empty",
+]

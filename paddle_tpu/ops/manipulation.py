@@ -1,0 +1,819 @@
+"""Shape/layout manipulation ops.
+
+Reference: python/paddle/tensor/manipulation.py + PHI kernels
+(reshape_kernel.h, concat_kernel.h, gather_kernel.h ...). All shape arguments
+are static (XLA requirement); Tensor-valued shapes are concretized eagerly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "cast", "reshape", "reshape_", "transpose", "concat", "stack", "split",
+    "chunk", "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten",
+    "expand", "broadcast_to", "expand_as", "tile", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "index_sample", "index_add", "index_put", "where", "masked_select",
+    "masked_fill", "topk", "sort", "argsort", "argmax", "argmin", "unbind",
+    "unique", "unique_consecutive", "nonzero", "pad", "take_along_axis",
+    "put_along_axis", "tensordot", "moveaxis", "swapaxes", "as_real",
+    "as_complex", "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d",
+    "repeat_interleave", "broadcast_tensors", "crop", "tolist", "unstack",
+    "strided_slice", "slice", "searchsorted", "bucketize", "numel", "shard_index",
+    "diagonal", "kthvalue", "mode", "flatten_", "tensor_split", "hsplit",
+    "vsplit", "dsplit", "as_strided", "histogram", "bincount",
+]
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(x.item() if isinstance(x, Tensor) else x) for x in v)
+
+
+@op("cast")
+def _cast(x, dtype=None):
+    return x.astype(dtype)
+
+
+def cast(x, dtype, name=None):
+    return _cast(x, dtype=dtypes.convert_dtype(dtype))
+
+
+@op("reshape")
+def _reshape(x, shape=()):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, shape=_ints(shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@op("transpose")
+def _transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=_ints(perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+def moveaxis(x, source, destination, name=None):
+    fwd = op_moveaxis(x, source=_ints(source), destination=_ints(destination))
+    return fwd
+
+
+@op("moveaxis")
+def op_moveaxis(x, source=0, destination=0):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op("swapaxes")
+def _swapaxes(x, axis1=0, axis2=1):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return _swapaxes(x, axis1=int(axis1), axis2=int(axis2))
+
+
+swapdims = swapaxes
+
+
+@op("concat_n")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(*x, axis=int(axis))
+
+
+@op("stack_n")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*x, axis=int(axis))
+
+
+def row_stack(x, name=None):
+    return _stack(*x, axis=0)
+
+
+@op("split")
+def _split(x, indices=(), axis=0):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        assert dim % n == 0, f"dim {dim} not divisible by {n}"
+        indices = tuple(dim // n * i for i in range(1, n))
+    else:
+        secs = [int(s.item() if isinstance(s, Tensor) else s) for s in num_or_sections]
+        n_neg = [i for i, s in enumerate(secs) if s < 0]
+        if n_neg:
+            secs[n_neg[0]] = dim - sum(s for s in secs if s >= 0)
+        indices = tuple(np.cumsum(secs[:-1]).tolist())
+    return list(_split(x, indices=indices, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    axis = int(axis)
+    if isinstance(num_or_indices, int):
+        arrs = np.array_split(np.arange(x.shape[axis]), num_or_indices)
+        indices = tuple(int(a[0]) for a in arrs[1:])
+    else:
+        indices = tuple(int(i) for i in num_or_indices)
+    return list(_split(x, indices=indices, axis=axis))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = x.shape[axis] if num is None else num
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+@op("squeeze")
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    return _squeeze(x, axis=None if axis is None else _ints(axis))
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+@op("unsqueeze")
+def _unsqueeze(x, axis=0):
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    return jnp.expand_dims(x, axes)
+
+
+def unsqueeze(x, axis, name=None):
+    return _unsqueeze(x, axis=_ints(axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+@op("flatten")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    shape = list(x.shape)
+    n = len(shape)
+    s = start_axis % n if n else 0
+    e = stop_axis % n if n else 0
+    new = shape[:s] + [int(np.prod(shape[s : e + 1] or [1]))] + shape[e + 1 :]
+    return jnp.reshape(x, new)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    if x.ndim == 0:
+        return reshape(x, [1])
+    return _flatten(x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+@op("broadcast_to")
+def _broadcast_to(x, shape=()):
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape, name=None):
+    shape = list(_ints(shape))
+    # paddle expand semantics: -1 means keep dim
+    xs = list(x.shape)
+    offset = len(shape) - len(xs)
+    for i, s in enumerate(shape):
+        if s == -1 and i >= offset:
+            shape[i] = xs[i - offset]
+    return _broadcast_to(x, shape=tuple(shape))
+
+
+def expand(x, shape, name=None):
+    return broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return broadcast_to(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+@op("tile")
+def _tile(x, repeat_times=()):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, repeat_times=_ints(repeat_times))
+
+
+@op("flip")
+def _flip(x, axis=()):
+    return jnp.flip(x, axis)
+
+
+def flip(x, axis, name=None):
+    return _flip(x, axis=_ints(axis))
+
+
+@op("rot90")
+def _rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k, axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(x, k=int(k), axes=_ints(axes))
+
+
+@op("roll")
+def _roll(x, shifts=0, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _roll(x, shifts=_ints(shifts), axis=None if axis is None else _ints(axis))
+
+
+# ---------------- gather/scatter ----------------
+
+@op("gather")
+def _gather(x, index, axis=0):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _gather(x, index, axis=int(axis))
+
+
+@op("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(x, index)
+
+
+@op("scatter")
+def _scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero out target rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, overwrite=bool(overwrite))
+
+
+@op("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    return _scatter_nd_add(zeros(shape, updates.dtype), index, updates)
+
+
+@op("index_select")
+def _index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, axis=int(axis))
+
+
+@op("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index, name=None):
+    return _index_sample(x, index)
+
+
+@op("index_add")
+def _index_add(x, index, value, axis=0):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(x, index, value, axis=int(axis))
+
+
+@op("index_put")
+def _index_put(x, value, *indices, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    return _index_put(x, value, *indices, accumulate=bool(accumulate))
+
+
+@op("take_along_axis")
+def _take_along_axis(x, index, axis=0):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return _take_along_axis(x, indices, axis=int(axis))
+
+
+@op("put_along_axis")
+def _put_along_axis(x, index, value, axis=0, reduce="assign"):
+    if reduce in ("add", "sum"):
+        return jnp.put_along_axis(x, index, value, axis=axis, inplace=False, mode="add")
+    return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None, **kw):
+    if not isinstance(values, (Tensor, jax.Array, np.ndarray)):
+        values = jnp.asarray(values, x.dtype)
+    return _put_along_axis(x, indices, values, axis=int(axis), reduce=reduce)
+
+
+@op("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+@op("masked_fill")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return _masked_fill(x, mask, value)
+    return _masked_fill(x, mask, jnp.asarray(value))
+
+
+# ---- dynamic-shape ops: eager-only (not traceable under jit; the reference's
+# LoD/dynamic ops have no XLA analog — callers inside @to_static should use
+# masking instead). ----
+
+def masked_select(x, mask, name=None):
+    data = np.asarray(x._data)[np.asarray(mask._data)]
+    return Tensor._wrap(jnp.asarray(data))
+
+
+def nonzero(x, as_tuple=False):
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(i)) for i in idx)
+    return Tensor._wrap(jnp.asarray(np.stack(idx, axis=-1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = np.unique(np.asarray(x._data), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor._wrap(jnp.asarray(res))
+    return tuple(Tensor._wrap(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.ravel()
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        diff = (np.diff(arr, axis=axis) != 0).any(
+            axis=tuple(i for i in range(arr.ndim) if i != axis)
+        )
+        keep = np.concatenate([[True], diff])
+    out = arr[keep] if axis is None else np.compress(keep, arr, axis=axis)
+    outs = [Tensor._wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor._wrap(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.concatenate([idx, [len(keep)]]))
+        outs.append(Tensor._wrap(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---------------- sort/search ----------------
+
+@op("topk")
+def _topk(x, k=1, axis=-1, largest=True):
+    if largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    else:
+        vals, idx = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int32)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return tuple(_topk(x, k=int(k), axis=int(axis if axis is not None else -1),
+                       largest=bool(largest)))
+
+
+@op("sort_op")
+def _sort(x, axis=-1, descending=False):
+    s = jnp.sort(x, axis=axis)
+    return jnp.flip(s, axis=axis) if descending else s
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return _sort(x, axis=int(axis), descending=bool(descending))
+
+
+@op("argsort", differentiable=False)
+def _argsort(x, axis=-1, descending=False, stable=False):
+    idx = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return idx.astype(jnp.int32)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return _argsort(x, axis=int(axis), descending=bool(descending), stable=bool(stable))
+
+
+@op("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype or jnp.int32)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(x, axis=None if axis is None else int(axis), keepdim=bool(keepdim),
+                   dtype=jnp.int32)
+
+
+@op("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype or jnp.int32)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(x, axis=None if axis is None else int(axis), keepdim=bool(keepdim),
+                   dtype=jnp.int32)
+
+
+@op("kthvalue")
+def _kthvalue(x, k=1, axis=-1, keepdim=False):
+    s = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis).astype(jnp.int32)
+    vals = jnp.take(s, k - 1, axis=axis)
+    inds = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return vals, inds
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return tuple(_kthvalue(x, k=int(k), axis=int(axis), keepdim=bool(keepdim)))
+
+
+@op("mode")
+def _mode(x, axis=-1, keepdim=False):
+    moved = jnp.moveaxis(x, axis, -1)
+    n = moved.shape[-1]
+    # O(n^2) count of equal values — fine for the modest n this op sees; keeps
+    # the whole thing one fused XLA kernel with static shapes.
+    eq = moved[..., :, None] == moved[..., None, :]
+    counts = jnp.sum(eq, axis=-1)
+    # bias ties toward the largest value (paddle/torch semantics)
+    score = counts.astype(jnp.float32) * n + jnp.argsort(
+        jnp.argsort(moved, axis=-1), axis=-1
+    ).astype(jnp.float32) / n
+    best = jnp.argmax(score, axis=-1)
+    vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+    eqv = moved == vals[..., None]
+    idxs = jnp.where(eqv, jnp.arange(n, dtype=jnp.int32), -1)
+    inds = jnp.max(idxs, axis=-1)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return vals, inds
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return tuple(_mode(x, axis=int(axis), keepdim=bool(keepdim)))
+
+
+@op("searchsorted", differentiable=False)
+def _searchsorted(sorted_sequence, values, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side).astype(jnp.int32)
+    vs = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))
+    flat_s = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+    flat_v = values.reshape(-1, values.shape[-1])
+    return vs(flat_s, flat_v).reshape(values.shape).astype(jnp.int32)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return _searchsorted(sorted_sequence, values, right=bool(right))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return _searchsorted(sorted_sequence, x, right=bool(right))
+
+
+@op("histogram", differentiable=False)
+def _histogram(x, bins=100, min=0, max=0):
+    lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+    if lo is None:
+        lo = jnp.min(x)
+        hi = jnp.max(x)
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return h.astype(jnp.int32)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    return _histogram(input, bins=int(bins), min=min, max=max)
+
+
+@op("bincount", differentiable=False)
+def _bincount(x, minlength=0):
+    return jnp.bincount(x, minlength=minlength, length=None)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._data)
+    w = None if weights is None else np.asarray(weights._data)
+    return Tensor._wrap(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+# ---------------- pad / slice ----------------
+
+@op("pad_nd")
+def _pad(x, paddings=(), mode="constant", value=0.0):
+    pads = list(paddings)
+    if mode == "constant":
+        return jnp.pad(x, pads, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pads, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):  # noqa: A002
+    """paddle.nn.functional.pad-style; `pad` is [before,after] per trailing dims
+    (paddle order: last dim first) or full nd spec."""
+    pad = _ints(pad)
+    n = x.ndim
+    if len(pad) == 2 * n:
+        pairs = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(n))
+    else:
+        k = len(pad) // 2
+        pairs = tuple((0, 0) for _ in range(n - k)) + tuple(
+            (pad[2 * i], pad[2 * i + 1]) for i in range(k)
+        )
+    return _pad(x, paddings=pairs, mode=mode, value=float(value))
+
+
+@op("slice_op")
+def _slice(x, axes=(), starts=(), ends=(), strides=None):
+    idx = [slice(None)] * x.ndim
+    for i, ax in enumerate(axes):
+        st = strides[i] if strides else 1
+        idx[ax] = slice(starts[i], ends[i], st)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    return _slice(x, axes=_ints(axes), starts=_ints(starts), ends=_ints(ends))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _slice(x, axes=_ints(axes), starts=_ints(starts), ends=_ints(ends),
+                  strides=_ints(strides))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else tuple(0 for _ in shape)
+    axes = tuple(range(x.ndim))
+    xs = x.shape
+    shape = tuple(xs[i] if s == -1 else s for i, s in enumerate(shape))
+    return _slice(x, axes=axes, starts=offsets,
+                  ends=tuple(o + s for o, s in zip(offsets, shape)))
+
+
+@op("diagonal")
+def _diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset, axis1, axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+@op("repeat_interleave")
+def _repeat_interleave(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        arr = np.asarray(x._data)
+        out = np.repeat(arr, np.asarray(repeats._data), axis=axis)
+        return Tensor._wrap(jnp.asarray(out))
+    return _repeat_interleave(x, repeats=int(repeats),
+                              axis=None if axis is None else int(axis))
+
+
+@op("tensordot")
+def _tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(_ints(a)) if isinstance(a, (list, tuple)) else int(a)
+                     for a in axes)
+    else:
+        axes = int(axes)
+    return _tensordot(x, y, axes=axes)
+
+
+@op("as_complex")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return _as_complex(x)
+
+
+@op("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    return _as_real(x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(x, [1]) if x.ndim == 0 else x for x in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 2:
+            x = unsqueeze(x, 0)
+        outs.append(x)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 3:
+            x = unsqueeze(x, -1) if x.ndim >= 2 else unsqueeze(x, 0)
+        outs.append(x)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x._data).ravel()[offset:],
+        shape=shape,
+        strides=[s * x.dtype.itemsize for s in stride],
+    )
+    return Tensor._wrap(jnp.asarray(arr.copy()))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def numel(x, name=None):
+    from .creation import to_tensor
+
+    return to_tensor(x.size, dtype="int64")
+
+
+@op("shard_index", differentiable=False)
+def _shard_index(x, index_num=0, nshards=1, shard_id=0, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+    owner = x // size
+    local = x % size
+    return jnp.where(owner == shard_id, local, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _shard_index(input, index_num=int(index_num), nshards=int(nshards),
+                        shard_id=int(shard_id), ignore_value=int(ignore_value))

@@ -1,0 +1,563 @@
+"""Elementwise math + reduction ops.
+
+Reference: python/paddle/tensor/math.py dispatching to PHI kernels
+(paddle/phi/kernels/elementwise_*.h, reduce_*.h). Here each op is a pure JAX
+function; XLA fuses chains of these into single kernels, which replaces the
+reference's hand-fused CUDA elementwise kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = []
+
+
+def _export(name):
+    __all__.append(name)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------- binary elementwise ----------------
+
+def _binary(name, fn):
+    fwd = op(name)(fn)
+
+    def public(x, y, name=None):
+        return fwd(x, y)
+
+    public.__name__ = name
+    _export(name)
+    return public
+
+
+add = _binary("add", lambda x, y: jnp.add(x, y))
+subtract = _binary("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _binary("multiply", lambda x, y: jnp.multiply(x, y))
+divide = _binary("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binary("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+remainder = _binary("remainder", lambda x, y: jnp.remainder(x, y))
+mod = remainder
+floor_mod = remainder
+pow_ = _binary("elementwise_pow", lambda x, y: jnp.power(x, y))
+elementwise_pow = pow_
+maximum = _binary("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _binary("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = _binary("fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binary("fmin", lambda x, y: jnp.fmin(x, y))
+atan2 = _binary("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binary("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _binary("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+nextafter = _binary("nextafter", lambda x, y: jnp.nextafter(x, y))
+copysign = _binary("copysign", lambda x, y: jnp.copysign(x, y))
+heaviside = _binary("heaviside", lambda x, y: jnp.heaviside(x, y))
+gcd = _binary("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _binary("lcm", lambda x, y: jnp.lcm(x, y))
+inner = _binary("inner", lambda x, y: jnp.inner(x, y))
+outer = _binary("outer", lambda x, y: jnp.outer(x.ravel(), y.ravel()))
+kron = _binary("kron", lambda x, y: jnp.kron(x, y))
+_export("mod"), _export("floor_mod")
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return pow_(x, y)
+
+
+_export("pow")
+
+
+# ---------------- unary elementwise ----------------
+
+def _unary(name, fn, differentiable=True):
+    fwd = op(name, differentiable=differentiable)(fn)
+
+    def public(x, name=None):
+        return fwd(x)
+
+    public.__name__ = name
+    _export(name)
+    return public
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+isnan = _unary("isnan", jnp.isnan, differentiable=False)
+isinf = _unary("isinf", jnp.isinf, differentiable=False)
+isfinite = _unary("isfinite", jnp.isfinite, differentiable=False)
+i0 = _unary("i0", jnp.i0)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+
+
+@op("scale")
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    if bias_after_scale:
+        out = x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * jnp.asarray(scale, x.dtype)
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if isinstance(scale, Tensor):
+        scale = scale.item()
+    return _scale(x, scale=float(scale), bias=float(bias),
+                  bias_after_scale=bool(bias_after_scale))
+
+
+_export("scale")
+
+
+@op("clip")
+def _clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    def val(v):
+        return float(v.item()) if isinstance(v, Tensor) else (None if v is None else float(v))
+    return _clip(x, min=val(min), max=val(max))
+
+
+_export("clip")
+
+
+@op("nan_to_num")
+def _nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _nan_to_num(x, nan=float(nan),
+                       posinf=None if posinf is None else float(posinf),
+                       neginf=None if neginf is None else float(neginf))
+
+
+_export("nan_to_num")
+
+
+@op("lerp")
+def _lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    return _lerp(x, y, weight)
+
+
+_export("lerp")
+
+
+@op("stanh")
+def _stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(x, scale_a=float(scale_a), scale_b=float(scale_b))
+
+
+_export("stanh")
+
+
+# ---------------- matmul family ----------------
+
+@op("matmul")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        axes = list(range(x.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        x = jnp.transpose(x, axes)
+    if transpose_y:
+        axes = list(range(y.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        y = jnp.transpose(y, axes)
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y))
+
+
+_export("matmul")
+
+
+@op("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _dot(x, y)
+
+
+_export("dot")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+_export("mm"), _export("bmm")
+
+
+@op("addmm")
+def _addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _addmm(input, x, y, beta=float(beta), alpha=float(alpha))
+
+
+_export("addmm")
+
+
+@op("multiply_acc")  # t-accumulate helper used by optimizers
+def _axpy(x, y, alpha=1.0):
+    return x + alpha * y
+
+
+# ---------------- reductions ----------------
+
+@op("sum")
+def _sum(x, axis=None, dtype=None, keepdim=False):
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = jnp.int32
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    return _sum(x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype),
+                keepdim=bool(keepdim))
+
+
+_export("sum")
+
+
+@op("nansum")
+def _nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _nansum(x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype),
+                   keepdim=bool(keepdim))
+
+
+_export("nansum")
+
+
+@op("mean")
+def _mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _mean(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("mean")
+
+
+@op("nanmean")
+def _nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _nanmean(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("nanmean")
+
+
+@op("prod")
+def _prod(x, axis=None, dtype=None, keepdim=False):
+    return jnp.prod(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _prod(x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype),
+                 keepdim=bool(keepdim))
+
+
+_export("prod")
+
+
+@op("max")
+def _max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _max(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("max")
+
+
+@op("min")
+def _min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _min(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("min")
+
+
+@op("amax")
+def _amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return _amax(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+@op("amin")
+def _amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return _amin(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("amax"), _export("amin")
+
+
+@op("std")
+def _std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_axis(axis), unbiased=bool(unbiased), keepdim=bool(keepdim))
+
+
+_export("std")
+
+
+@op("var")
+def _var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_axis(axis), unbiased=bool(unbiased), keepdim=bool(keepdim))
+
+
+_export("var")
+
+
+@op("median")
+def _median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _median(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("median")
+
+
+@op("quantile")
+def _quantile(x, q=0.5, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _quantile(x, q=q if isinstance(q, (list, tuple)) else float(q),
+                     axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("quantile")
+
+
+@op("logsumexp")
+def _logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("logsumexp")
+
+
+@op("all", differentiable=False)
+def _all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _all(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("all")
+
+
+@op("any", differentiable=False)
+def _any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _any(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("any")
+
+
+@op("count_nonzero", differentiable=False)
+def _count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int32)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _count_nonzero(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+_export("count_nonzero")
+
+
+# ---------------- scans ----------------
+
+@op("cumsum")
+def _cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return _cumsum(x, axis=None if axis is None else int(axis),
+                   dtype=dtypes.convert_dtype(dtype))
+
+
+_export("cumsum")
+
+
+@op("cumprod")
+def _cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.ravel()
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return _cumprod(x, dim=None if dim is None else int(dim),
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+_export("cumprod")
+
+
+@op("cummax", differentiable=False)
+def _cummax(x, axis=-1):
+    return jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    vals = _cummax(x if axis is not None else x.flatten(), axis=ax)
+    return vals
+
+
+_export("cummax")
+
+
+@op("trace")
+def _trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+_export("trace")
+
+
+@op("diff")
+def _diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _diff(x, n=int(n), axis=int(axis))
+
+
+_export("diff")
+
+
+def increment(x, value=1.0, name=None):
+    x._rebind((x + float(value))._data)
+    return x
+
+
+_export("increment")

@@ -1,0 +1,152 @@
+"""Optimizer base.
+
+Reference: python/paddle/optimizer/optimizer.py. TPU-native design: each
+``step()`` gathers (param, grad) arrays into one pytree and runs a single
+jit-compiled update for the whole model — one XLA executable per step instead
+of the reference's per-param kernel launches (its _C_ops.adam_ loop). The
+update function is pure; parameter handles are rebound to the new arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    _opt_name = "base"
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        assert parameters is not None, (
+            "parameters is required in dygraph mode (pass model.parameters())"
+        )
+        self._parameter_list = list(parameters)
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self.regularization = weight_decay
+        self._accumulators: dict[str, dict[int, jax.Array]] = {}
+        self._global_step = 0
+        self.helper = None
+
+    # ------------- lr -------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate.get_lr())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ------------- accumulators -------------
+    def _acc(self, name, param, init=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(param)
+        if pid not in store:
+            store[pid] = (jnp.zeros_like(param._data) if init is None
+                          else init(param))
+        return store[pid]
+
+    def _set_acc(self, name, param, value):
+        self._accumulators[name][id(param)] = value
+
+    # ------------- main entry -------------
+    def _collect_params_grads(self):
+        pgs = []
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            pgs.append((p, g))
+        return pgs
+
+    def _weight_decay_value(self, param):
+        """L2Decay-style coupled decay (reference regularizer). Returns coeff."""
+        reg = getattr(param, "regularizer", None) or self.regularization
+        if reg is None:
+            return 0.0
+        if isinstance(reg, (int, float)):
+            return float(reg)
+        coeff = getattr(reg, "_coeff", None)
+        if coeff is None:
+            coeff = getattr(reg, "coeff", 0.0)
+        return float(coeff)
+
+    def step(self):
+        pgs = self._collect_params_grads()
+        if not pgs:
+            return
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        self._apply(pgs)
+        self._global_step += 1
+
+    def _apply(self, params_grads):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ------------- checkpointing -------------
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for p in self._parameter_list:
+                if id(p) in store:
+                    sd[f"{p.name}_{name}"] = Tensor._wrap(store[id(p)])
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for name, store in self._accumulators.items():
+            for p in self._parameter_list:
+                key = f"{p.name}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    store[id(p)] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+        # re-init missing accumulators happens lazily on next step
+        if "global_step" in state_dict:
+            gs = state_dict["global_step"]
+            self._global_step = int(gs.item() if isinstance(gs, Tensor) else gs)
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    load_state_dict = set_state_dict
+
+    def _create_accumulators(self, *a, **k):  # API parity
+        pass

@@ -1,0 +1,22 @@
+"""Regularizers (reference: python/paddle/regularizer.py). Only the decay
+coefficient matters — optimizers read ``_coeff`` and fold L2 into the jitted
+update (L1 applied via sign term)."""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self._l1 = True
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

@@ -1,0 +1,34 @@
+"""Test config: force CPU backend with 8 virtual devices so distributed/
+sharding tests run anywhere (SURVEY.md §4 takeaway (2): multi-process CPU
+simulation via xla_force_host_platform_device_count).
+
+jax may already be imported by pytest plugins, so configuration goes through
+jax.config.update (env vars would be ignored); XLA_FLAGS is still honored
+because backends initialize lazily at first array op.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# correctness tests compare against float64/float32 numpy references
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

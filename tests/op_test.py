@@ -1,0 +1,76 @@
+"""OpTest harness — the analog of the reference's test/legacy_test/op_test.py
+(OpTest.check_output :2016, check_grad :2963): run an op against a NumPy
+reference and compare analytic grads with numeric finite differences."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(fn, np_fn, inputs, kwargs=None, rtol=1e-5, atol=1e-6):
+    """fn: framework op taking Tensors; np_fn: numpy reference taking ndarrays."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i) if isinstance(i, np.ndarray) else i
+               for i in inputs]
+    out = fn(*tensors, **kwargs)
+    ref = np_fn(*[np.asarray(i) for i in inputs], **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
+    for o, r in zip(outs, refs):
+        if o is None:
+            continue
+        np.testing.assert_allclose(
+            np.asarray(o.numpy(), np.float64) if o.dtype != np.bool_ else o.numpy(),
+            np.asarray(r, np.float64) if np.asarray(r).dtype != np.bool_ else r,
+            rtol=rtol, atol=atol,
+            err_msg=f"op output mismatch for {getattr(fn, 'op_name', fn)}")
+    return out
+
+
+def check_grad(fn, inputs, kwargs=None, grad_inputs=None, eps=1e-3, rtol=1e-2,
+               atol=1e-3, output_index=None):
+    """Compare analytic grads (tape backward) vs central finite differences."""
+    kwargs = kwargs or {}
+    grad_inputs = grad_inputs if grad_inputs is not None else list(range(len(inputs)))
+    tensors = []
+    for i, x in enumerate(inputs):
+        t = paddle.to_tensor(np.asarray(x, np.float64).astype(np.float32))
+        t.stop_gradient = i not in grad_inputs
+        tensors.append(t)
+
+    def run(ts):
+        out = fn(*ts, **kwargs)
+        if isinstance(out, (list, tuple)):
+            out = out[output_index if output_index is not None else 0]
+        return out
+
+    out = run(tensors)
+    seed = np.random.RandomState(0).randn(*out.shape).astype(np.float32)
+    loss = (out * paddle.to_tensor(seed)).sum()
+    loss.backward()
+
+    for gi in grad_inputs:
+        analytic = tensors[gi].grad.numpy().astype(np.float64)
+        x0 = np.asarray(inputs[gi], np.float64)
+        numeric = np.zeros_like(x0)
+        flat = x0.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            for sign in (+1, -1):
+                pert = flat.copy()
+                pert[j] += sign * eps
+                ts = [paddle.to_tensor(
+                    pert.reshape(x0.shape).astype(np.float32))
+                    if k == gi else
+                    paddle.to_tensor(np.asarray(inputs[k], np.float32))
+                    for k in range(len(inputs))]
+                val = float((run(ts) * paddle.to_tensor(seed)).sum().item())
+                num_flat[j] += sign * val / (2 * eps)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=rtol, atol=atol,
+            err_msg=f"grad mismatch for input {gi} of "
+                    f"{getattr(fn, 'op_name', fn)}")

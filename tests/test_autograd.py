@@ -1,0 +1,167 @@
+"""Autograd engine tests (reference model: test/legacy_test/test_imperative_*)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBackward:
+    def test_chain(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x * x  # x^3
+        y.backward()
+        assert abs(x.grad.item() - 12.0) < 1e-5
+
+    def test_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward()
+        z = (x * 3).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_multi_use(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x + x * x  # 2x^2, dy/dx = 4x
+        y.backward()
+        assert abs(x.grad.item() - 12.0) < 1e-5
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        d = y.detach()
+        assert d.stop_gradient
+        z = (x * d).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        with paddle.no_grad():
+            y = x * x
+        assert y.stop_gradient
+        assert y._node is None
+
+    def test_grad_api(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.to_tensor(3.0, stop_gradient=False)
+        z = x * x * y
+        gx, gy = paddle.grad(z, [x, y])
+        assert abs(gx.item() - 12.0) < 1e-5
+        assert abs(gy.item() - 4.0) < 1e-5
+        assert x.grad is None  # paddle.grad must not touch .grad
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = paddle.to_tensor(3.0, stop_gradient=False)
+        z = x * x
+        with pytest.raises(RuntimeError):
+            paddle.grad(z, [y])
+        (g,) = paddle.grad(z, [y], allow_unused=True)
+        assert g is None
+
+    def test_non_scalar_backward_needs_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y2 = x * 2
+        y2.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert abs(x.grad.item() - 8.0) < 1e-5
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        seen = {}
+
+        def hook(g):
+            seen["grad"] = g.numpy().copy()
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(seen["grad"], [3.0, 3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32),
+                             stop_gradient=False)
+        vals, idx = paddle.topk(x, k=2, axis=1)
+        vals.sum().backward()
+        g = x.grad.numpy()
+        assert (g.sum(axis=1) == 2).all()  # each row: two 1s
+
+
+class TestPyLayer:
+    def test_custom(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 3 * x * x
+
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = Cube.apply(x)
+        assert abs(y.item() - 8.0) < 1e-5
+        y.backward()
+        assert abs(x.grad.item() - 12.0) < 1e-5
+
+    def test_multi_io(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class AddMul(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a + b, a * b
+
+            @staticmethod
+            def backward(ctx, da, dm):
+                a, b = ctx.saved_tensor
+                return da + dm * b, da + dm * a
+
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        b = paddle.to_tensor(5.0, stop_gradient=False)
+        s, m = AddMul.apply(a, b)
+        (s + m).backward()
+        assert abs(a.grad.item() - 6.0) < 1e-5
+        assert abs(b.grad.item() - 3.0) < 1e-5
+
+
+class TestInplace:
+    def test_inplace_rebind(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+        x.zero_()
+        assert x.numpy().sum() == 0
+
+    def test_inplace_autograd_safety(self):
+        # in-place on a tensor does not corrupt an existing graph (immutability)
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x * x).sum()
+        x.fill_(100.0)  # rebind after graph capture
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])

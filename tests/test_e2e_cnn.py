@@ -1,0 +1,120 @@
+"""M0 exit test (SURVEY.md §7.2): a ResNet-style CNN trains end-to-end,
+loss decreases (reference model: test/book/ smoke tests)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class BasicBlock(nn.Layer):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2D(cin, cout, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(cout)
+        self.conv2 = nn.Conv2D(cout, cout, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(cout)
+        self.short = None
+        if stride != 1 or cin != cout:
+            self.short = nn.Sequential(
+                nn.Conv2D(cin, cout, 1, stride=stride, bias_attr=False),
+                nn.BatchNorm2D(cout))
+
+    def forward(self, x):
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        sc = x if self.short is None else self.short(x)
+        return F.relu(out + sc)
+
+
+class TinyResNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 16, 3, padding=1, bias_attr=False),
+            nn.BatchNorm2D(16), nn.ReLU())
+        self.layer1 = BasicBlock(16, 16)
+        self.layer2 = BasicBlock(16, 32, stride=2)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(32, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = paddle.flatten(self.pool(x), 1)
+        return self.fc(x)
+
+
+def test_cnn_trains():
+    paddle.seed(0)
+    np.random.seed(0)
+    model = TinyResNet(num_classes=4)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    lossfn = nn.CrossEntropyLoss()
+
+    # synthetic separable data: class = quadrant of mean color
+    X = np.random.randn(64, 3, 12, 12).astype(np.float32)
+    Y = ((X[:, 0].mean((1, 2)) > 0).astype(int) * 2
+         + (X[:, 1].mean((1, 2)) > 0).astype(int)).astype(np.int32)
+
+    model.train()
+    losses = []
+    for epoch in range(15):
+        x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+        loss = lossfn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.6, f"no convergence: {losses}"
+
+    model.eval()
+    logits = model(paddle.to_tensor(X))
+    acc = (logits.argmax(axis=-1).numpy() == Y).mean()
+    assert acc > 0.7, f"train acc too low: {acc}"
+
+
+def test_dataloader_training_loop():
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    X = np.random.randn(40, 4).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.int32)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+    dl = DataLoader(ds, batch_size=8, shuffle=True, drop_last=True)
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    lossfn = nn.CrossEntropyLoss()
+    first = last = None
+    for epoch in range(10):
+        for x, y in dl:
+            loss = lossfn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = loss.item()
+            last = loss.item()
+    assert last < first
+
+
+def test_save_load_checkpoint(tmp_path):
+    model = TinyResNet(4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    x = paddle.to_tensor(np.random.randn(2, 3, 12, 12).astype(np.float32))
+    model(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save(model.state_dict(), str(tmp_path / "model.pdparams"))
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+
+    model2 = TinyResNet(4)
+    model2.set_state_dict(paddle.load(str(tmp_path / "model.pdparams")))
+    out1 = model.eval()(x).numpy()
+    out2 = model2.eval()(x).numpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)

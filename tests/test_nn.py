@@ -1,0 +1,227 @@
+"""nn.Layer + layers tests (reference model: test/legacy_test/test_layers.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def r(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_params_and_naming(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 4)
+                self.blocks = nn.LayerList([nn.Linear(4, 4) for _ in range(2)])
+
+            def forward(self, x):
+                x = self.fc(x)
+                for b in self.blocks:
+                    x = b(x)
+                return x
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc.weight" in names and "blocks.0.bias" in names
+        assert len(net.parameters()) == 6
+        out = net(paddle.to_tensor(r(2, 3)))
+        assert out.shape == [2, 4]
+
+    def test_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), np.ones(100))
+        d.train()
+        out = d(x).numpy()
+        assert (out == 0).any() and (out > 1).any()
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd and "weight" in sd
+
+    def test_apply_and_to(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+        net.to(dtype="bfloat16")
+        assert net[0].weight.dtype == paddle.bfloat16
+        net.float()
+        assert net[0].weight.dtype == np.dtype("float32")
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.to_tensor(r(1, 2)))
+        assert calls
+        h.remove()
+        lin(paddle.to_tensor(r(1, 2)))
+        assert len(calls) == 1
+
+
+class TestLayers:
+    def test_linear(self):
+        lin = nn.Linear(4, 3)
+        x = r(5, 4)
+        out = lin(paddle.to_tensor(x))
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_conv2d_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = r(2, 3, 8, 8)
+        conv = nn.Conv2D(3, 6, 3, stride=2, padding=1)
+        out = conv(paddle.to_tensor(x))
+        tout = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(conv.weight.numpy()),
+            torch.tensor(conv.bias.numpy()), stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conv_transpose_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = r(2, 4, 5, 5)
+        conv = nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1,
+                                  output_padding=1)
+        out = conv(paddle.to_tensor(x))
+        tout = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(conv.weight.numpy()),
+            torch.tensor(conv.bias.numpy()), stride=2, padding=1,
+            output_padding=1)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_pool_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = r(2, 3, 8, 8)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy())
+        out = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1)
+        ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                                             count_include_pad=False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3)
+        ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 3)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_batchnorm_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = r(4, 3, 5, 5)
+        bn = nn.BatchNorm2D(3)
+        tbn = torch.nn.BatchNorm2d(3, momentum=0.1)
+        bn.train()
+        out = bn(paddle.to_tensor(x))
+        ref = tbn(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        # running stats (paddle momentum=0.9 == torch 1-0.1)
+        np.testing.assert_allclose(bn._mean.numpy(),
+                                   tbn.running_mean.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_layernorm_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = r(2, 5, 8)
+        ln = nn.LayerNorm(8)
+        out = ln(paddle.to_tensor(x))
+        ref = torch.nn.functional.layer_norm(
+            torch.tensor(x), [8], torch.tensor(ln.weight.numpy()),
+            torch.tensor(ln.bias.numpy()))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]], np.int32))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_activations(self):
+        x = r(3, 4)
+        np.testing.assert_allclose(F.relu(paddle.to_tensor(x)).numpy(),
+                                   np.maximum(x, 0))
+        import math as pymath
+
+        np.testing.assert_allclose(
+            F.gelu(paddle.to_tensor(x)).numpy(),
+            0.5 * x * (1 + np.vectorize(pymath.erf)(x / np.sqrt(2))),
+            rtol=1e-4, atol=1e-5)
+        s = F.softmax(paddle.to_tensor(x), axis=-1).numpy()
+        np.testing.assert_allclose(s.sum(-1), np.ones(3), rtol=1e-5)
+
+    def test_losses_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        logits = r(8, 5)
+        labels = np.random.randint(0, 5, (8,))
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels.astype(np.int32)))
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels))
+        assert abs(out.item() - ref.item()) < 1e-5
+        # soft label + smoothing
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels.astype(np.int32)),
+                              label_smoothing=0.1)
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels), label_smoothing=0.1)
+        assert abs(out.item() - ref.item()) < 1e-5
+        # bce with logits
+        x, y = r(6), (np.random.rand(6) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(paddle.to_tensor(x),
+                                                 paddle.to_tensor(y))
+        ref = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(x), torch.tensor(y))
+        assert abs(out.item() - ref.item()) < 1e-5
+        # kl_div
+        p = np.log(np.random.dirichlet(np.ones(5), 4).astype(np.float32))
+        q = np.random.dirichlet(np.ones(5), 4).astype(np.float32)
+        out = F.kl_div(paddle.to_tensor(p), paddle.to_tensor(q),
+                       reduction="batchmean")
+        ref = torch.nn.functional.kl_div(torch.tensor(p), torch.tensor(q),
+                                         reduction="batchmean")
+        assert abs(out.item() - ref.item()) < 1e-5
+
+    def test_attention_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        q = r(2, 6, 4, 8)  # [B,S,H,D] paddle layout
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        tq = torch.tensor(q).permute(0, 2, 1, 3)
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            tq, tq, tq, is_causal=True).permute(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_mha_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(r(2, 5, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_interpolate(self):
+        torch = pytest.importorskip("torch")
+        x = r(1, 2, 4, 4)
+        out = F.interpolate(paddle.to_tensor(x), size=[8, 8], mode="bilinear")
+        ref = torch.nn.functional.interpolate(torch.tensor(x), (8, 8),
+                                              mode="bilinear")
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_clip_grad_global_norm(self):
+        lin = nn.Linear(3, 3)
+        (lin(paddle.to_tensor(r(4, 3))).sum() * 1000).backward()
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        pgs = clip([(p, p.grad) for p in lin.parameters()])
+        total = np.sqrt(sum((g.numpy().astype(np.float64) ** 2).sum()
+                            for _, g in pgs))
+        assert total < 1.0 + 1e-4

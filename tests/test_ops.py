@@ -1,0 +1,246 @@
+"""Op unit tests vs NumPy (reference model: test/legacy_test/ OpTest files)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import op_test
+
+
+def r(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestMath:
+    def test_binary_ops(self):
+        x, y = r(3, 4), r(3, 4)
+        op_test.check_output(paddle.add, np.add, [x, y])
+        op_test.check_output(paddle.subtract, np.subtract, [x, y])
+        op_test.check_output(paddle.multiply, np.multiply, [x, y])
+        op_test.check_output(paddle.divide, np.divide, [x, y + 2.0])
+        op_test.check_output(paddle.maximum, np.maximum, [x, y])
+        op_test.check_output(paddle.minimum, np.minimum, [x, y])
+
+    def test_broadcast(self):
+        op_test.check_output(paddle.add, np.add, [r(3, 1, 4), r(2, 1)])
+
+    def test_unary(self):
+        x = np.abs(r(5, 3)) + 0.5
+        op_test.check_output(paddle.exp, np.exp, [x])
+        op_test.check_output(paddle.log, np.log, [x])
+        op_test.check_output(paddle.sqrt, np.sqrt, [x])
+        op_test.check_output(paddle.tanh, np.tanh, [x], rtol=1e-4)
+        op_test.check_output(paddle.abs, np.abs, [r(4)])
+        op_test.check_output(paddle.floor, np.floor, [r(4)])
+        op_test.check_output(paddle.sin, np.sin, [x])
+
+    def test_matmul(self):
+        op_test.check_output(paddle.matmul, np.matmul, [r(3, 4), r(4, 5)],
+                             rtol=1e-4)
+        a, b = r(2, 3, 4), r(2, 4, 5)
+        op_test.check_output(paddle.matmul, np.matmul, [a, b], rtol=1e-4)
+        # transpose flags
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.swapaxes(1, 2)),
+                            transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+    def test_reductions(self):
+        x = r(3, 4, 5)
+        op_test.check_output(paddle.sum, lambda a: np.sum(a), [x], rtol=1e-4)
+        op_test.check_output(lambda t: paddle.sum(t, axis=1),
+                             lambda a: a.sum(axis=1), [x], rtol=1e-4)
+        op_test.check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                             lambda a: a.mean(axis=(0, 2), keepdims=True), [x],
+                             rtol=1e-4)
+        op_test.check_output(lambda t: paddle.max(t, axis=1),
+                             lambda a: a.max(axis=1), [x])
+        op_test.check_output(lambda t: paddle.std(t),
+                             lambda a: a.std(ddof=1), [x], rtol=1e-3)
+        op_test.check_output(paddle.logsumexp,
+                             lambda a: np.log(np.exp(a).sum()), [x], rtol=1e-4)
+
+    def test_cumsum(self):
+        x = r(3, 4)
+        op_test.check_output(lambda t: paddle.cumsum(t, axis=1),
+                             lambda a: np.cumsum(a, axis=1), [x], rtol=1e-4)
+
+    def test_clip_scale(self):
+        x = r(4, 4)
+        op_test.check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                             lambda a: np.clip(a, -0.5, 0.5), [x])
+        out = paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0)
+        np.testing.assert_allclose(out.numpy(), x * 2 + 1, rtol=1e-5)
+
+
+class TestGrad:
+    def test_matmul_grad(self):
+        op_test.check_grad(paddle.matmul, [r(3, 4), r(4, 5)])
+
+    def test_mul_grad(self):
+        op_test.check_grad(paddle.multiply, [r(3, 4), r(3, 4)])
+
+    def test_tanh_grad(self):
+        op_test.check_grad(paddle.tanh, [r(3, 3)])
+
+    def test_softmax_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        op_test.check_grad(F.softmax, [r(4, 6)])
+
+    def test_reduce_grad(self):
+        op_test.check_grad(lambda t: paddle.sum(t, axis=0), [r(3, 4)])
+        op_test.check_grad(lambda t: paddle.mean(t, axis=1, keepdim=True),
+                           [r(3, 4)])
+
+    def test_broadcast_grad(self):
+        op_test.check_grad(paddle.add, [r(3, 4), r(4)])
+
+    def test_concat_grad(self):
+        op_test.check_grad(lambda a, b: paddle.concat([a, b], axis=1),
+                           [r(2, 3), r(2, 4)])
+
+    def test_layernorm_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        op_test.check_grad(
+            lambda x, w, b: F.layer_norm(x, 8, w, b), [r(4, 8), r(8), r(8)],
+            rtol=5e-2, atol=5e-3)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = r(2, 3, 4)
+        op_test.check_output(lambda t: paddle.reshape(t, [6, 4]),
+                             lambda a: a.reshape(6, 4), [x])
+        op_test.check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                             lambda a: a.transpose(2, 0, 1), [x])
+        op_test.check_output(lambda t: paddle.flatten(t, 1),
+                             lambda a: a.reshape(2, 12), [x])
+
+    def test_concat_stack_split(self):
+        x, y = r(2, 3), r(2, 3)
+        out = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([x, y], 0))
+        out = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([x, y], 1))
+        parts = paddle.split(paddle.to_tensor(x), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(r(2, 7)), [2, 5], axis=1)
+        assert parts[1].shape == [2, 5]
+
+    def test_gather_scatter(self):
+        x = r(5, 3)
+        idx = np.array([0, 2, 4], np.int32)
+        op_test.check_output(paddle.gather, lambda a, i: a[i], [x, idx])
+        nd_idx = np.array([[0, 1], [2, 2]], np.int32)
+        op_test.check_output(paddle.gather_nd,
+                             lambda a, i: a[tuple(i.T)], [x, nd_idx])
+
+    def test_where_topk_sort(self):
+        x = r(3, 5)
+        cond = x > 0
+        out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                           paddle.to_tensor(-x))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, x, -x))
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        out = paddle.sort(paddle.to_tensor(x), axis=1, descending=True)
+        np.testing.assert_allclose(out.numpy(), np.sort(x, 1)[:, ::-1])
+
+    def test_indexing(self):
+        x = r(4, 5, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+        np.testing.assert_allclose(t[:, None, 0].numpy(), x[:, None, 0])
+        idx = paddle.to_tensor(np.array([0, 2], np.int32))
+        np.testing.assert_allclose(t[idx].numpy(), x[[0, 2]])
+        # boolean mask (eager-only)
+        mask = x[:, 0, 0] > 0
+        np.testing.assert_allclose(t[paddle.to_tensor(mask)].numpy(), x[mask])
+
+    def test_setitem(self):
+        x = r(4, 5)
+        t = paddle.to_tensor(x.copy())
+        t[1:3, 0] = 7.0
+        x[1:3, 0] = 7.0
+        np.testing.assert_allclose(t.numpy(), x)
+
+    def test_pad_tile_flip(self):
+        x = r(2, 3)
+        op_test.check_output(lambda t: paddle.tile(t, [2, 1]),
+                             lambda a: np.tile(a, (2, 1)), [x])
+        op_test.check_output(lambda t: paddle.flip(t, [0]),
+                             lambda a: np.flip(a, 0).copy(), [x])
+
+    def test_argmax_unique(self):
+        x = r(3, 4)
+        assert paddle.argmax(paddle.to_tensor(x)).item() == x.argmax()
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+class TestLogic:
+    def test_comparisons(self):
+        x, y = r(3, 4), r(3, 4)
+        op_test.check_output(paddle.equal, np.equal, [x, x])
+        op_test.check_output(paddle.greater_than, np.greater, [x, y])
+        assert bool(paddle.allclose(paddle.to_tensor(x),
+                                    paddle.to_tensor(x + 1e-9)).item())
+
+    def test_operator_overloads(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose((x + 1).numpy(), [2, 3])
+        np.testing.assert_allclose((2 * x).numpy(), [2, 4])
+        np.testing.assert_allclose((1 - x).numpy(), [0, -1])
+        np.testing.assert_allclose((x ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-x).numpy(), [-1, -2])
+        assert (x > 1.5).numpy().tolist() == [False, True]
+
+
+class TestLinalg:
+    def test_basic(self):
+        a = r(4, 4)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        op_test.check_output(paddle.linalg.cholesky, np.linalg.cholesky, [spd],
+                             rtol=1e-4, atol=1e-5)
+        op_test.check_output(paddle.linalg.inv, np.linalg.inv, [spd],
+                             rtol=1e-4, atol=1e-5)
+        op_test.check_output(paddle.linalg.det, np.linalg.det, [spd], rtol=1e-4)
+        x = r(6)
+        assert abs(paddle.linalg.norm(paddle.to_tensor(x)).item()
+                   - np.linalg.norm(x)) < 1e-4
+
+    def test_einsum(self):
+        a, b = r(3, 4), r(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+
+class TestCreation:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3], "int32").dtype == np.dtype("int32")
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        assert paddle.full([2], 7).item(0) == 7
+        e = paddle.eye(3).numpy()
+        np.testing.assert_array_equal(e, np.eye(3, dtype=np.float32))
+
+    def test_random(self):
+        paddle.seed(7)
+        a = paddle.randn([100, 100])
+        assert abs(a.numpy().mean()) < 0.1
+        u = paddle.uniform([1000], min=0.0, max=1.0)
+        assert 0 <= u.numpy().min() and u.numpy().max() <= 1
+        paddle.seed(7)
+        b = paddle.randn([100, 100])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_one_hot(self):
+        x = paddle.to_tensor(np.array([0, 2], np.int32))
+        oh = paddle.one_hot(x, 3).numpy()
+        np.testing.assert_array_equal(oh, [[1, 0, 0], [0, 0, 1]])

@@ -1,0 +1,139 @@
+"""Optimizer tests (reference model: test/legacy_test/test_adam_op.py etc.)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_problem(opt_cls, **kw):
+    steps = kw.pop("steps", 120)
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.create_parameter = None
+    p = paddle.Parameter(np.zeros(3, np.float32))
+    opt = opt_cls(parameters=[p], **kw)
+    for _ in range(steps):
+        loss = ((p - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return p.numpy(), target
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (paddle.optimizer.SGD, dict(learning_rate=0.1)),
+        (paddle.optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+        (paddle.optimizer.Adam, dict(learning_rate=0.1)),
+        (paddle.optimizer.AdamW, dict(learning_rate=0.1, weight_decay=0.0)),
+        (paddle.optimizer.RMSProp, dict(learning_rate=0.05)),
+        (paddle.optimizer.Adagrad, dict(learning_rate=0.5)),
+        (paddle.optimizer.Adamax, dict(learning_rate=0.2)),
+        (paddle.optimizer.Lamb, dict(learning_rate=0.05, lamb_weight_decay=0.0)),
+        (paddle.optimizer.Adadelta, dict(learning_rate=5.0, steps=800)),
+    ])
+    def test_converges(self, cls, kw):
+        got, target = quad_problem(cls, **kw)
+        np.testing.assert_allclose(got, target, atol=0.15)
+
+    def test_adam_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.random.randn(4, 3).astype(np.float32)
+        g = np.random.randn(4, 3).astype(np.float32)
+
+        p = paddle.Parameter(w0.copy())
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+        tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = torch.optim.Adam([tp], lr=0.01)
+        for _ in range(5):
+            from paddle_tpu.core.tensor import Tensor
+
+            p.grad = Tensor(g.copy())
+            opt.step()
+            tp.grad = torch.tensor(g.copy())
+            topt.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.random.randn(4).astype(np.float32)
+        g = np.random.randn(4).astype(np.float32)
+        p = paddle.Parameter(w0.copy())
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[p],
+                                     weight_decay=0.1)
+        tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.1)
+        from paddle_tpu.core.tensor import Tensor
+
+        for _ in range(5):
+            p.grad = Tensor(g.copy())
+            opt.step()
+            tp.grad = torch.tensor(g.copy())
+            topt.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        p = paddle.Parameter(np.ones(3, np.float32))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p])
+        from paddle_tpu.core.tensor import Tensor
+
+        p.grad = Tensor(np.ones(3, np.float32))
+        opt.step()
+        sd = opt.state_dict()
+        p2 = paddle.Parameter(np.ones(3, np.float32))
+        p2.name = p.name
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 1
+
+    def test_grad_clip_in_optimizer(self):
+        p = paddle.Parameter(np.zeros(3, np.float32))
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[p],
+            grad_clip=nn.ClipGradByGlobalNorm(0.001))
+        loss = (p * paddle.to_tensor([100.0, 100.0, 100.0])).sum()
+        loss.backward()
+        opt.step()
+        assert np.abs(p.numpy()).max() < 0.01
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sch.get_lr())
+            sch.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_warmup_cosine(self):
+        cos = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+        sch = paddle.optimizer.lr.LinearWarmup(cos, warmup_steps=5,
+                                               start_lr=0.0, end_lr=0.1)
+        lrs = [sch.get_lr()]
+        for _ in range(6):
+            sch.step()
+            lrs.append(sch.get_lr())
+        assert lrs[0] == 0.0 and abs(lrs[4] - 0.08) < 1e-6
+        assert lrs[6] < 0.1
+
+    def test_scheduler_drives_optimizer(self):
+        p = paddle.Parameter(np.zeros(2, np.float32))
+        sch = paddle.optimizer.lr.ExponentialDecay(0.1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sch, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sch.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_noam(self):
+        sch = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10,
+                                            learning_rate=1.0)
+        vals = []
+        for _ in range(20):
+            vals.append(sch.get_lr())
+            sch.step()
+        assert np.argmax(vals) in (9, 10, 11)
