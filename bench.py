@@ -1,0 +1,85 @@
+"""Benchmark entry — prints ONE JSON line.
+
+Workload: Llama-125M-class causal-LM training step (BASELINE.md configs 2/5
+scaled to one chip): bf16 params, seq 1024, full fwd+bwd+AdamW through the
+public API (paddle.jit.to_static + paddle.optimizer.AdamW).
+Metric: steady-state training tokens/sec on the default backend.
+vs_baseline: the reference publishes no in-tree numbers (BASELINE.md —
+"published": {}); reported vs the run's own first-epoch warmup? No — fixed at
+1.0 until a reference measurement exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+    from paddle_tpu.models import LlamaForCausalLM, llama_125m
+
+    paddle.seed(0)
+    np.random.seed(0)
+
+    on_tpu = True
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        pass
+
+    if on_tpu:
+        cfg = llama_125m()
+        bs, seq, steps, warmup = 8, 1024, 20, 3
+    else:  # CI / CPU smoke sizing
+        from paddle_tpu.models import llama_tiny
+
+        cfg = llama_tiny()
+        bs, seq, steps, warmup = 2, 64, 5, 1
+
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    model = jit.to_static(model)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+
+    def step():
+        loss, _ = model(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(warmup):
+        loss = step()
+    float(loss.item())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    float(loss.item())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = bs * seq * steps / dt
+    print(json.dumps({
+        "metric": "llama125m_train_tokens_per_sec" if on_tpu
+                  else "llama_tiny_cpu_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

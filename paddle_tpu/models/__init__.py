@@ -1,0 +1,8 @@
+"""Model zoo (capability analog of the reference's ecosystem model repos the
+BASELINE workloads come from: PaddleNLP Llama/ERNIE, PaddleClas ResNet,
+PaddleRec DeepFM)."""
+
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_1b, llama_7b, llama_13b,
+    llama_125m, llama_small, llama_tiny,
+)

@@ -1,0 +1,322 @@
+"""Llama-family decoder — the flagship transformer.
+
+Capability target: PaddleNLP's Llama implementation driven by the reference's
+Fleet hybrid-parallel stack (BASELINE.md config 5: Llama-2-13B TP+PP+DP).
+TPU-first design choices:
+
+* bf16-native; norms/softmax accumulate in fp32 (see nn/functional/norm.py)
+* attention dispatches to the Pallas flash-attention kernel on TPU
+  (ops/pallas/flash_attention.py) with an XLA fallback
+* GQA (num_kv_heads <= num_heads), RoPE, SwiGLU — matmul shapes kept
+  multiple-of-128 so XLA tiles cleanly onto the MXU
+* ``tp_partition_spec`` publishes the Megatron-style sharding plan consumed by
+  GSPMD (auto_parallel) and by the meta_parallel TP layers — column-parallel
+  qkv/gate/up, row-parallel o/down, vocab-parallel embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..ops import creation, manipulation as M, math as ops_math
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
+           "llama_small", "llama_125m", "llama_1b", "llama_7b", "llama_13b"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dropout: float = 0.0
+    # MoE (expert-parallel axis); 0 = dense
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_every: int = 2  # every Nth layer is MoE when num_experts > 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _rope_cache(seq_len, head_dim, theta, dtype=np.float32):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                           / head_dim))
+    t = np.arange(seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)
+    return (np.cos(freqs).astype(dtype), np.sin(freqs).astype(dtype))
+
+
+from ..core.dispatch import op as _op
+
+
+@_op("rope_apply")
+def _rope_apply(x, cos, sin):
+    import jax.numpy as jnp
+
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, D]; cos/sin: [S, D/2] tensors."""
+    return _rope_apply(x, cos, sin)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.head_dim
+        std = 0.02
+        init = Normal(0.0, std)
+        self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.k_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.v_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
+                             weight_attr=init, bias_attr=False)
+
+    def forward(self, x, cos, sin, attn_mask=None, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if cache is not None:
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=False)
+            return self.o_proj(M.reshape(out, [b, s, -1])), new_cache
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=attn_mask is None)
+        return self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = Normal(0.0, 0.02)
+        self.gate_proj = Linear(config.hidden_size, config.intermediate_size,
+                                weight_attr=init, bias_attr=False)
+        self.up_proj = Linear(config.hidden_size, config.intermediate_size,
+                              weight_attr=init, bias_attr=False)
+        self.down_proj = Linear(config.intermediate_size, config.hidden_size,
+                                weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+@_op("moe_dense_topk")
+def _moe_dense_topk(x, logits, gate_w, up_w, down_w, top_k=2):
+    """Token-choice top-k MoE, dense-dispatch form: every expert computes all
+    tokens with per-token weights. Under GSPMD the expert dim shards over the
+    'ep' mesh axis and XLA turns the weighted combine into the all_to_all the
+    reference implements by hand (global_scatter/global_gather ops)."""
+    import jax
+    import jax.numpy as jnp
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(probs, top_k)  # [B,S,K]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    e = gate_w.shape[0]
+    onehot = jax.nn.one_hot(idx, e, dtype=x.dtype)  # [B,S,K,E]
+    weights = jnp.einsum("bske,bsk->bse", onehot, vals.astype(x.dtype))
+    hidden = jnp.einsum("bsh,ehi->ebsi", x, gate_w)
+    hidden = jax.nn.silu(hidden) * jnp.einsum("bsh,ehi->ebsi", x, up_w)
+    out = jnp.einsum("ebsi,eih->ebsh", hidden, down_w)
+    return jnp.einsum("ebsh,bse->bsh", out, weights)
+
+
+class LlamaMoE(Layer):
+    """Mixtral-style token-choice MoE (reference analog:
+    incubate/distributed/models/moe/moe_layer.py via global_scatter/gather;
+    TPU-native: dense einsum over experts — under GSPMD the expert dimension
+    shards over the 'ep' mesh axis and XLA inserts the all_to_all)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_experts = c.num_experts
+        self.top_k = c.num_experts_per_tok
+        init = Normal(0.0, 0.02)
+        self.router = Linear(c.hidden_size, c.num_experts, weight_attr=init,
+                             bias_attr=False)
+        e, h, i = c.num_experts, c.hidden_size, c.intermediate_size
+        self.gate_w = self.create_parameter([e, h, i], default_initializer=init)
+        self.up_w = self.create_parameter([e, h, i], default_initializer=init)
+        self.down_w = self.create_parameter([e, i, h], default_initializer=init)
+
+    def forward(self, x):
+        logits = self.router(x)
+        return _moe_dense_topk(x, logits, self.gate_w, self.up_w, self.down_w,
+                               top_k=self.top_k)
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        use_moe = (config.num_experts > 0
+                   and layer_idx % config.moe_every == config.moe_every - 1)
+        self.mlp = LlamaMoE(config) if use_moe else LlamaMLP(config)
+
+    def forward(self, x, cos, sin, attn_mask=None, cache=None):
+        if cache is not None:
+            attn_out, new_cache = self.self_attn(
+                self.input_layernorm(x), cos, sin, attn_mask, cache)
+            x = x + attn_out
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=Normal(0.0, 0.02))
+        self.layers = LayerList([
+            LlamaDecoderLayer(config, i)
+            for i in range(config.num_hidden_layers)
+        ])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = _rope_cache(config.max_position_embeddings, config.head_dim,
+                               config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None, caches=None):
+        x = self.embed_tokens(input_ids)
+        s = input_ids.shape[1]
+        if caches is not None:
+            past = caches[0][0].shape[1] if caches[0] is not None else 0
+            cos = self.rope_cos[past : past + s]
+            sin = self.rope_sin[past : past + s]
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                x, c = layer(x, cos, sin, attn_mask, cache)
+                new_caches.append(c)
+            return self.norm(x), new_caches
+        cos = self.rope_cos[:s]
+        sin = self.rope_sin[:s]
+        for layer in self.layers:
+            x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=Normal(0.0, 0.02),
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = F.linear(h, self.llama.embed_tokens.weight.t())
+        if labels is not None:
+            loss = F.cross_entropy(
+                M.reshape(logits, [-1, self.config.vocab_size]),
+                M.reshape(labels, [-1]))
+            return loss, logits
+        return logits
+
+    # ---- sharding plan (consumed by auto_parallel / graft dryrun) ----
+    @staticmethod
+    def tp_partition_spec(param_name: str):
+        """Megatron TP plan as (dim -> mesh axis) specs keyed on param name.
+        Column-parallel: shard output dim on 'tp'; row-parallel: input dim.
+        Weights are stored [in, out] (Linear convention)."""
+        n = param_name
+        if "embed_tokens" in n or "lm_head" in n:
+            return {1: "tp"} if "lm_head" in n else {0: "tp"}
+        if any(k in n for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
+                                "up_proj")):
+            return {1: "tp"}  # column parallel: [in, out/tp]
+        if any(k in n for k in ("o_proj", "down_proj")):
+            return {0: "tp"}  # row parallel: [in/tp, out]
+        if any(k in n for k in ("gate_w", "up_w", "down_w")):
+            return {0: "ep"}  # expert parallel: [E/ep, ...]
+        return {}
+
+
+def llama_tiny(**kw):
+    return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=256,
+                       **kw)
+
+
+def llama_small(**kw):
+    return LlamaConfig(vocab_size=8192, hidden_size=512,
+                       intermediate_size=1408, num_hidden_layers=8,
+                       num_attention_heads=8, num_key_value_heads=8,
+                       max_position_embeddings=2048, **kw)
+
+
+def llama_125m(**kw):
+    return LlamaConfig(vocab_size=32000, hidden_size=768,
+                       intermediate_size=2048, num_hidden_layers=12,
+                       num_attention_heads=12, num_key_value_heads=12,
+                       max_position_embeddings=2048, **kw)
+
+
+def llama_1b(**kw):
+    return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                       intermediate_size=5504, num_hidden_layers=22,
+                       num_attention_heads=16, num_key_value_heads=16,
+                       max_position_embeddings=2048, **kw)
+
+
+def llama_7b(**kw):
+    return LlamaConfig(**kw)
+
+
+def llama_13b(**kw):
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                       num_hidden_layers=40, num_attention_heads=40,
+                       num_key_value_heads=40, **kw)

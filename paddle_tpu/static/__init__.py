@@ -1,0 +1,91 @@
+"""paddle.static — minimal compatibility facade.
+
+Reference: python/paddle/static/ + python/paddle/base/executor.py. The
+reference's Program/Executor machinery collapses into jax.jit (SURVEY.md §7.1:
+"StandaloneExecutor/streams/GC → XLA runtime; nothing to build"); this module
+keeps the legacy entry points importable for code that guards on them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .input_spec import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "Executor", "global_scope", "name_scope",
+           "save_inference_model", "load_inference_model"]
+
+
+class Program:
+    """Placeholder Program (reference base/framework.py:5736). Real compiled
+    execution goes through paddle.jit.to_static."""
+
+    def __init__(self):
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+class Executor:
+    """Facade: .run on a to_static-compiled callable (reference
+    base/executor.py:1152)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "paddle_tpu is dygraph+jit-first: use paddle.jit.to_static to "
+            "compile models (the reference's static Program path maps onto "
+            "jax.jit; see SURVEY.md §3.3)")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError("use paddle.jit.save (jax.export-backed)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError("use paddle.jit.load")

@@ -1,0 +1,12 @@
+"""Utilities."""
+
+from .functional_call import functional_call, params_dict  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

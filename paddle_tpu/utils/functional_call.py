@@ -1,0 +1,60 @@
+"""Functional execution of a Layer — run a stateful Layer as a pure function
+of a params pytree. This is the bridge between the eager Layer world and raw
+jax transforms (grad/jit/shard_map); jit.to_static and the distributed train
+steps are built on it."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..core import state
+from ..core.tensor import Tensor
+
+
+def params_dict(layer, include_buffers=False):
+    """name -> jax.Array for all (unique) parameters."""
+    out = {}
+    for name, p in layer.named_parameters():
+        out[name] = p._data
+    if include_buffers:
+        for name, b in layer.named_buffers():
+            out[name] = b._data
+    return out
+
+
+@contextlib.contextmanager
+def _bound(layer, arrays_by_name):
+    handles = {}
+    for name, p in list(layer.named_parameters()) + list(layer.named_buffers()):
+        if name in arrays_by_name:
+            handles[name] = (p, p._data)
+            p._data = arrays_by_name[name]
+    try:
+        yield
+    finally:
+        for p, old in handles.values():
+            p._data = old
+
+
+def functional_call(layer, arrays_by_name, *args, trace=True, **kwargs):
+    """Run ``layer(*args)`` with parameters temporarily bound to the given
+    arrays. Tensor args may be raw jax arrays. Returns raw arrays (pytree)."""
+
+    def to_tensor(a):
+        if isinstance(a, Tensor):
+            return a
+        if isinstance(a, (jax.Array,)) or hasattr(a, "dtype"):
+            return Tensor._wrap(a)
+        return a
+
+    args = [to_tensor(a) for a in args]
+    kwargs = {k: to_tensor(v) for k, v in kwargs.items()}
+    ctx = state.trace_guard() if trace else contextlib.nullcontext()
+    with _bound(layer, arrays_by_name), ctx:
+        out = layer(*args, **kwargs)
+    return jax.tree.map(
+        lambda o: o._data if isinstance(o, Tensor) else o, out,
+        is_leaf=lambda o: isinstance(o, Tensor))
