@@ -91,13 +91,16 @@ def _accumulate(slot, g):
     return g if slot is None else slot + g
 
 
-def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
+def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
+                 accumulate_others=False):
     """Backward pass from ``tensors``.
 
     capture: optional dict mapping ``id(tensor)`` -> tensor for which the
     cotangent should be captured and returned (used by ``paddle.grad``).
     Leaf tensors with ``stop_gradient=False`` get ``.grad`` accumulated unless
-    ``capture`` is given (grad API semantics: don't touch .grad).
+    ``capture`` is given (grad API semantics: don't touch .grad);
+    accumulate_others=True restores .grad accumulation for non-captured
+    leaves (recompute's inner backward needs both).
     """
     from .tensor import Tensor
 
@@ -203,8 +206,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
                     captured[leaf_capture[id(t)]] = _accumulate(
                         captured.get(leaf_capture[id(t)]), g
                     )
-                    # grad() still accumulates .grad in paddle? No: paddle.grad
-                    # does not mutate .grad. Keep capture-only.
+                elif accumulate_others:
+                    t._accumulate_grad(g)
         node_cts[nid] = None  # free cotangent memory as we go
         if not retain_graph:
             node.primals = None

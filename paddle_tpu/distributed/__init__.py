@@ -4,18 +4,26 @@ TPU-native design (SURVEY.md §5.8): no ProcessGroup/NCCL object model — a
 single-controller JAX program over a device Mesh. Collective *APIs* are traced
 ``lax.p*`` ops inside shard_map / GSPMD-sharded jit; ``jax.distributed``'s
 coordination service replaces TCPStore for multi-host bring-up.
-
-This module grows across milestones; env/bring-up + rank info live here.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 
 import jax
 
-__all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
-           "ParallelEnv"]
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "fleet", "DataParallel", "new_group", "get_group",
+    "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "alltoall", "alltoall_single", "broadcast", "scatter",
+    "gather", "send", "recv", "isend", "irecv", "barrier", "wait", "ReduceOp",
+    "P2POp", "batch_isend_irecv", "stream", "shard_tensor", "reshard",
+    "shard_layer", "shard_optimizer", "dtensor_from_fn", "unshard_dtensor",
+    "ProcessMesh", "Shard", "Replicate", "Partial", "get_mesh", "set_mesh",
+    "spawn", "launch", "save_state_dict", "load_state_dict",
+]
 
 _initialized = False
 
@@ -53,13 +61,6 @@ def get_rank(group=None):
 def get_world_size(group=None):
     if group is not None:
         return group.nranks
-    try:
-        from .collective import _default_group
-
-        if _default_group is not None:
-            return _default_group.nranks
-    except ImportError:
-        pass
     return jax.process_count()
 
 
@@ -73,8 +74,51 @@ class ParallelEnv:
         return get_world_size()
 
     @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
     def device_id(self):
         return 0
 
-    local_rank = rank
-    nranks = world_size
+
+from .collective import Group, get_group, new_group  # noqa: E402,F401
+from .communication import (  # noqa: E402,F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, batch_isend_irecv, broadcast,
+    broadcast_object_list, gather, irecv, isend, recv, reduce, reduce_scatter,
+    scatter, scatter_object_list, send, stream, wait,
+)
+all_to_all = alltoall
+from .auto_parallel import (  # noqa: E402,F401
+    Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn, get_mesh,
+    reshard, set_mesh, shard_layer, shard_optimizer, shard_tensor,
+    unshard_dtensor,
+)
+from .parallel import DataParallel  # noqa: E402,F401
+from . import fleet  # noqa: E402,F401
+from . import sharding  # noqa: E402,F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
+
+# paddle code imports meta_parallel via fleet.meta_parallel; alias it
+from . import meta_parallel as _meta_parallel  # noqa: E402
+
+sys.modules[__name__ + ".fleet.meta_parallel"] = _meta_parallel
+fleet.meta_parallel = _meta_parallel
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: distributed/spawn.py. Single-controller JAX: the launcher
+    owns multi-process bring-up; in-process we just call func (world of 1
+    per-process semantics are preserved by the collective layer)."""
+    func(*args)
+
+
+def launch():
+    from .launch.main import main
+
+    return main()

@@ -1,0 +1,21 @@
+"""Semi-automatic SPMD parallel API.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor :118,
+reshard :288, shard_layer :387) + C++ DistTensor
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39), SPMD rules
+(paddle/phi/infermeta/spmd_rules/), reshard kernels
+(.../auto_parallel/reshard/).
+
+TPU-native design (SURVEY.md §7.1): DistTensor ≡ a jax.Array with a
+NamedSharding; SPMD rule propagation ≡ GSPMD; the reference's 9 hand-written
+reshard functions ({r,s,p}_to_{r,s,p}) ≡ one device_put/with_sharding_constraint
+— XLA emits the collective (all_gather for s→r, reduce for p→r, slice for
+r→s, ...) that the reference implements by hand per case.
+"""
+
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn, reshard, shard_layer, shard_optimizer, shard_tensor,
+    unshard_dtensor,
+)

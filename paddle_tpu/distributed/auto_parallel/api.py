@@ -1,0 +1,138 @@
+"""shard_tensor / reshard / shard_layer / shard_optimizer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor :118,
+reshard :288, shard_layer :387, shard_optimizer and dist to_static :1338).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core import state
+from ...core.tensor import Parameter, Tensor
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "dtensor_from_fn", "unshard_dtensor", "placements_to_spec"]
+
+
+def placements_to_spec(placements, ndim=None):
+    """[Shard(0), Replicate()] over mesh dims -> PartitionSpec on tensor dims."""
+    dim_axes = {}
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            dim_axes.setdefault(pl.dim, []).append(mesh_dim)
+    n = ndim if ndim is not None else (
+        max(dim_axes.keys(), default=-1) + 1)
+    axes = []
+    for d in range(n):
+        mds = dim_axes.get(d)
+        axes.append(None if not mds else mds)
+    return axes
+
+
+def _named_sharding(mesh: ProcessMesh, placements, ndim):
+    names = mesh.dim_names
+    dim_axes = placements_to_spec(placements, ndim)
+    spec = []
+    for entry in dim_axes:
+        if entry is None:
+            spec.append(None)
+        elif len(entry) == 1:
+            spec.append(names[entry[0]])
+        else:
+            spec.append(tuple(names[m] for m in entry))
+    return NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """Reference api.py:118. Returns a Tensor whose array carries a
+    NamedSharding (the DistTensor analog)."""
+    if isinstance(data, Tensor):
+        t = data
+    else:
+        t = Tensor(data, dtype=dtype)
+    sharding = _named_sharding(mesh, placements, t._data.ndim)
+    if isinstance(t._data, jax.core.Tracer):
+        arr = jax.lax.with_sharding_constraint(t._data, sharding)
+        out = Tensor._wrap(arr)
+        out.stop_gradient = t.stop_gradient
+    elif any(isinstance(p, Partial) for p in placements):
+        # Partial is only meaningful inside traced code; eagerly it's the
+        # value itself (single-controller holds the already-reduced value)
+        out = t
+    else:
+        arr = jax.device_put(t._data, sharding)
+        if isinstance(t, Parameter) or not t.is_leaf:
+            t._data = arr
+            out = t
+        else:
+            out = Tensor._wrap(arr)
+            out.stop_gradient = t.stop_gradient if stop_gradient is None \
+                else stop_gradient
+    out._placement = (mesh, tuple(placements))
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """Reference api.py:288 + reshard kernels. One call covers every
+    {r,s,p}→{r,s,p} transition: XLA inserts the matching collective."""
+    sharding = _named_sharding(mesh, placements, dist_tensor._data.ndim)
+    if isinstance(dist_tensor._data, jax.core.Tracer):
+        arr = jax.lax.with_sharding_constraint(dist_tensor._data, sharding)
+    else:
+        arr = jax.device_put(dist_tensor._data, sharding)
+    out = Tensor._wrap(arr)
+    out.stop_gradient = dist_tensor.stop_gradient
+    out._placement = (mesh, tuple(placements))
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Reference api.py:387 — apply shard_fn(name, layer, mesh) to every
+    sublayer; default replicates every parameter over the mesh."""
+
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None and p._placement is None:
+                shard_tensor(p, mesh,
+                             [Replicate() for _ in range(mesh.ndim)])
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference api.py shard_optimizer — states inherit each param's
+    sharding automatically here (accumulators are created zeros_like the
+    sharded param array), so this is mostly API parity."""
+    return optimizer
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    arr = dist_tensor._data
+    if hasattr(arr, "sharding"):
+        devs = list(arr.devices()) if hasattr(arr, "devices") else None
+        arr = jax.device_put(
+            arr, jax.sharding.SingleDeviceSharding(
+                devs[0] if devs else jax.devices()[0]))
+    out = Tensor._wrap(arr)
+    out.stop_gradient = dist_tensor.stop_gradient
+    return out

@@ -1,0 +1,131 @@
+"""ProcessMesh — device mesh wrapper.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py +
+paddle/phi/core/distributed/auto_parallel/process_mesh.h. Wraps a
+jax.sharding.Mesh (AxisType.Auto so GSPMD propagates shardings).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_global_mesh: "ProcessMesh | None" = None
+
+
+def _pick_devices(n):
+    devs = jax.devices()
+    if len(devs) < n:
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n:
+                return cpu[:n]
+        except RuntimeError:
+            pass
+        raise ValueError(f"mesh needs {n} devices, only {len(devs)} available")
+    return devs[:n]
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None):
+        if isinstance(mesh, jax.sharding.Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = tuple(mesh.axis_names)
+            self._process_ids = [d.id for d in mesh.devices.flat]
+            return
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            shape = arr.shape
+            process_ids = arr.reshape(-1).tolist()
+        else:
+            assert shape is not None
+            shape = tuple(shape)
+            process_ids = list(range(int(np.prod(shape))))
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(shape))]
+        self._shape = tuple(int(s) for s in shape)
+        self._dim_names = tuple(dim_names)
+        self._process_ids = process_ids
+        all_devices = {d.id: d for d in jax.devices()}
+        if not all(pid in all_devices for pid in process_ids):
+            try:
+                for d in jax.devices("cpu"):
+                    all_devices.setdefault(d.id, d)
+            except RuntimeError:
+                pass
+        if all(pid in all_devices for pid in process_ids):
+            devs = np.array([all_devices[p] for p in process_ids],
+                            dtype=object).reshape(self._shape)
+        else:
+            # abstract mesh (more processes than local devices — multi-host
+            # compile-only contexts)
+            devs = np.array(_pick_devices(int(np.prod(self._shape))),
+                            dtype=object).reshape(self._shape)
+        self._jax_mesh = jax.sharding.Mesh(
+            devs, self._dim_names,
+            axis_types=(jax.sharding.AxisType.Auto,) * len(self._shape))
+
+    # ---- paddle API surface ----
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> jax.sharding.Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh along one axis (reference process_mesh.py API)."""
+        axis = self._dim_names.index(dim_name)
+        arr = self.mesh
+        moved = np.moveaxis(arr, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        pm = ProcessMesh(moved, names)
+        if index is not None:
+            sub = moved[index]
+            return ProcessMesh(sub, names[1:])
+        return pm
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((self._shape, self._dim_names, tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={list(self._shape)}, "
+                f"dim_names={list(self._dim_names)})")
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> "ProcessMesh | None":
+    return _global_mesh

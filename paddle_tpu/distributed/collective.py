@@ -1,0 +1,106 @@
+"""Process groups.
+
+Reference: python/paddle/distributed/collective.py (Group registry, new_group)
++ C++ ProcessGroup (paddle/fluid/distributed/collective/process_group.h:47).
+
+TPU-native design (SURVEY.md §5.8): a Group is a *view over a mesh axis* of
+the global device mesh — there is no communicator object to create. Inside
+traced code (shard_map/jit) collectives lower to lax.p* ops over the group's
+axis name; eagerly, a collective over arrays sharded on the group axis is a
+device_put-induced XLA collective.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+__all__ = ["Group", "new_group", "get_group", "destroy_process_group",
+           "is_available", "_set_default_group", "_get_default_group",
+           "_get_global_group"]
+
+_group_registry: dict[int, "Group"] = {}
+_default_group: "Group | None" = None
+_next_gid = 0
+
+
+class Group:
+    """A collective group = ordered rank list + (optionally) the mesh axis it
+    corresponds to."""
+
+    def __init__(self, ranks, gid=None, axis_name=None, mesh=None, pg=None):
+        global _next_gid
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.id = gid if gid is not None else _next_gid
+        _next_gid = max(_next_gid, self.id + 1)
+        self.axis_name = axis_name  # mesh axis this group spans (traced path)
+        self.mesh = mesh  # jax Mesh or ProcessMesh
+        self.pg = pg
+
+    @property
+    def rank(self):
+        import jax
+
+        pid = jax.process_index()
+        return self.ranks.index(pid) if pid in self.ranks else 0
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self.pg
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name})")
+
+
+def _set_default_group(group):
+    global _default_group
+    _default_group = group
+    _group_registry[group.id] = group
+
+
+def _get_default_group():
+    global _default_group
+    if _default_group is None:
+        n = jax.device_count()
+        _set_default_group(Group(list(range(n)), gid=0, axis_name=None))
+    return _default_group
+
+
+_get_global_group = _get_default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None,
+              mesh=None):
+    """Reference: collective.py new_group. With a mesh-axis view there is no
+    communicator bootstrap; the group is just registered."""
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    g = Group(sorted(ranks), axis_name=axis_name, mesh=mesh)
+    _group_registry[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _group_registry.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _group_registry.clear()
+        _default_group = None
+    else:
+        _group_registry.pop(group.id, None)
+
+
+def is_available():
+    return True

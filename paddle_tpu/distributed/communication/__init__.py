@@ -1,0 +1,263 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/ (all_reduce.py,
+all_gather.py, reduce_scatter.py, all_to_all.py, broadcast.py, send/recv,
+batch_isend_irecv.py, group.py, stream/).
+
+TPU-native semantics (SURVEY.md §5.8): these are *traced* collectives — used
+inside shard_map/pjit they lower to XLA ICI collectives (lax.psum /
+all_gather / psum_scatter / all_to_all / ppermute). Eagerly, on the
+single-controller model, every process sees the global array, so collectives
+are value-preserving no-ops (world view already reduced/gathered); this keeps
+metric-sync style call sites working. The `.wait()`-task object model is
+preserved as immediate-complete tasks (XLA schedules overlap itself — the
+reference's comm-stream tuning has no analog to expose).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..collective import Group, _get_default_group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "alltoall", "alltoall_single", "all_to_all", "broadcast",
+    "broadcast_object_list", "scatter", "scatter_object_list", "gather",
+    "send", "recv", "isend", "irecv", "barrier", "batch_isend_irecv", "P2POp",
+    "stream", "wait",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _Task:
+    """Completed-at-creation task (ProcessGroup::Task analog)."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def _axis(group):
+    g = group or _get_default_group()
+    return getattr(g, "axis_name", None)
+
+
+def _is_traced(t):
+    return isinstance(t._data, jax.core.Tracer)
+
+
+def _apply_inplace(tensor, arr):
+    tensor._data = arr
+    return tensor
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis(group)
+    if _is_traced(tensor) and axis is not None:
+        x = tensor._data
+        if op in (ReduceOp.SUM, "sum"):
+            out = jax.lax.psum(x, axis)
+        elif op in (ReduceOp.MAX, "max"):
+            out = jax.lax.pmax(x, axis)
+        elif op in (ReduceOp.MIN, "min"):
+            out = jax.lax.pmin(x, axis)
+        elif op in (ReduceOp.AVG, "avg"):
+            out = jax.lax.pmean(x, axis)
+        else:
+            out = jax.lax.psum(x, axis)
+        return _Task(_apply_inplace(tensor, out))
+    return _Task(tensor)  # eager single-controller: already the global value
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """paddle semantics: gather shards from all ranks into tensor_list."""
+    grp = group or _get_default_group()
+    ax = _axis(group)
+    if _is_traced(tensor) and ax is not None:
+        gathered = jax.lax.all_gather(tensor._data, ax)  # [n, ...]
+        for i in range(grp.nranks):
+            tensor_list.append(Tensor._wrap(gathered[i]))
+        return _Task()
+    for _ in range(grp.nranks):
+        tensor_list.append(tensor)
+    return _Task()
+
+
+def all_gather_object(object_list, obj, group=None):
+    grp = group or _get_default_group()
+    for _ in range(grp.nranks):
+        object_list.append(obj)
+    return _Task()
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    grp = group or _get_default_group()
+    ax = _axis(group)
+    inputs = tensor_or_tensor_list
+    if isinstance(inputs, (list, tuple)):
+        stacked = jnp.concatenate([t._data for t in inputs], axis=0)
+    else:
+        stacked = inputs._data
+    if isinstance(stacked, jax.core.Tracer) and ax is not None:
+        out = jax.lax.psum_scatter(stacked, ax, scatter_dimension=0,
+                                   tiled=True)
+        return _Task(_apply_inplace(tensor, out))
+    # eager: take this rank's slice of the (already-global) sum
+    n = grp.nranks
+    shard = stacked.shape[0] // n
+    r = grp.rank
+    return _Task(_apply_inplace(tensor, stacked[r * shard:(r + 1) * shard]))
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    grp = group or _get_default_group()
+    ax = _axis(group)
+    if in_tensor_list and _is_traced(in_tensor_list[0]) and ax is not None:
+        stacked = jnp.stack([t._data for t in in_tensor_list])  # [n, ...]
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        for i in range(grp.nranks):
+            out_tensor_list.append(Tensor._wrap(out[i]))
+        return _Task()
+    out_tensor_list.extend(in_tensor_list)
+    return _Task()
+
+
+all_to_all = alltoall
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis(group)
+    if _is_traced(in_tensor) and ax is not None:
+        grp = group or _get_default_group()
+        n = grp.nranks
+        x = in_tensor._data.reshape(n, -1, *in_tensor._data.shape[1:])
+        out = jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        return _Task(_apply_inplace(out_tensor,
+                                    out.reshape(in_tensor._data.shape)))
+    return _Task(_apply_inplace(out_tensor, in_tensor._data))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller: every process computes the same value — identity
+    return _Task(tensor)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return _Task()
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    grp = group or _get_default_group()
+    if tensor_list:
+        return _Task(_apply_inplace(tensor, tensor_list[grp.rank]._data))
+    return _Task(tensor)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    grp = group or _get_default_group()
+    if in_object_list:
+        out_object_list.append(in_object_list[grp.rank])
+    return _Task()
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    grp = group or _get_default_group()
+    if gather_list is not None:
+        for _ in range(grp.nranks):
+            gather_list.append(tensor)
+    return _Task()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send — traced path: ppermute in the pipeline engine handles stage
+    transfer; the eager API is a no-op in the single-controller model."""
+    return _Task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return _Task(tensor)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    return [_Task(op.tensor) for op in p2p_op_list]
+
+
+def barrier(group=None):
+    # block host until all queued device work completes
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    return _Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not _is_traced(tensor):
+        try:
+            tensor._data.block_until_ready()
+        except Exception:
+            pass
+    return None
+
+
+class _StreamNS:
+    """paddle.distributed.stream.* variants (reference communication/stream/):
+    same collectives; the sync/async distinction is XLA-scheduled."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    alltoall_single = staticmethod(alltoall_single)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

@@ -1,0 +1,59 @@
+"""paddle.distributed.fleet (reference: python/paddle/distributed/fleet/
+__init__.py — module-level functions delegate to the Fleet singleton)."""
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .fleet import Fleet, fleet_singleton as _fleet  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return _fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return _fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_scaler(scaler):
+    return _fleet.distributed_scaler(scaler)
+
+
+def get_hybrid_communicate_group():
+    return _fleet.get_hybrid_communicate_group()
+
+
+def worker_index():
+    return _fleet.worker_index()
+
+
+def worker_num():
+    return _fleet.worker_num()
+
+
+def is_first_worker():
+    return _fleet.is_first_worker()
+
+
+def barrier_worker():
+    return _fleet.barrier_worker()
+
+
+def init_worker():
+    return _fleet.init_worker()
+
+
+def init_server(*args, **kwargs):
+    return _fleet.init_server(*args, **kwargs)
+
+
+def stop_worker():
+    return _fleet.stop_worker()
+
+
+def get_strategy():
+    return _fleet.strategy

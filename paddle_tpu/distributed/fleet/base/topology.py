@@ -1,0 +1,223 @@
+"""Hybrid-parallel topology.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology (:61, axes ["data","pipe","sharding","sep","model"]) and
+HybridCommunicateGroup (:174) which creates NCCL comms per axis.
+
+TPU-native redesign: the topology IS a jax device Mesh with axes
+("dp", "pp", "sharding", "sep", "mp"); per-axis "groups" are axis views
+(collective.Group with axis_name) — no communicator creation, XLA compiles
+collectives onto ICI from the mesh. The paddle axis names data/pipe/model map
+to dp/pp/mp mesh axis names (shard_map axis names must match what the
+meta-parallel layers use).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+
+from ...collective import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_PADDLE2MESH = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                "sep": "sep", "model": "mp"}
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = None
+        self._world_size = int(np.prod(self._dims))
+        ranks = np.arange(self._world_size).reshape(self._dims)
+        self._rank_array = ranks
+        self._coord_of_rank = {
+            int(ranks[c]): c for c in itertools.product(
+                *[range(d) for d in self._dims])
+        }
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._rank_array[coord])
+
+    def get_coord(self, rank):
+        from collections import namedtuple
+
+        Coord = namedtuple("Coord", self._parallel_names)
+        return Coord(*self._coord_of_rank[rank])
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_array, axis, 0)
+        return moved[index].reshape(-1).tolist()
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along axis_name."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_array, axis, -1)
+        return moved.reshape(-1, self._dims[axis]).tolist()
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)._asdict()
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self.nranks = topology.world_size()
+        self.global_rank = jax.process_index() if jax.process_count() > 1 else 0
+
+        # Build the global device mesh with mesh-axis names (dp/pp/...)
+        mesh_names = tuple(_PADDLE2MESH.get(n, n) for n in names)
+        devs = jax.devices()
+        if len(devs) < self.nranks:
+            try:
+                cpus = jax.devices("cpu")
+                if len(cpus) >= self.nranks:
+                    devs = cpus
+            except RuntimeError:
+                pass
+        assert len(devs) >= self.nranks, (
+            f"topology needs {self.nranks} devices, have {len(devs)}")
+        mesh_devs = np.array(devs[: self.nranks], dtype=object).reshape(dims)
+        self._mesh = jax.sharding.Mesh(
+            mesh_devs, mesh_names,
+            axis_types=(jax.sharding.AxisType.Auto,) * len(mesh_names))
+
+        self._dp_degree = topology.get_dim("data")
+        self._mp_degree = topology.get_dim("model")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+
+        coord = topology.get_coord(self.global_rank)
+
+        def make_group(axis):
+            ranks = topology.get_axis_list(
+                axis, getattr(coord, axis) if False else 0)
+            # per-rank group membership: ranks sharing all other coords
+            my = coord._asdict()
+            groups = topology.get_comm_list(axis)
+            mine = next(g for g in groups if self.global_rank in g)
+            return new_group(mine, axis_name=_PADDLE2MESH.get(axis, axis),
+                             mesh=self._mesh)
+
+        self._dp_group = make_group("data")
+        self._mp_group = make_group("model")
+        self._pp_group = make_group("pipe")
+        self._sharding_group = make_group("sharding")
+        self._sep_group = make_group("sep") if "sep" in names else None
+        self._check_group = new_group(list(range(self.nranks)),
+                                      axis_name=None, mesh=self._mesh)
+
+    # ---- mesh access (TPU-native extension) ----
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_hybrid_communicate_group(self):
+        return self
+
+    # ---- data parallel ----
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).data
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    # ---- model (tensor) parallel ----
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).model
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # ---- pipeline ----
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return self._topo.get_coord(self.global_rank).pipe
+
+    def get_pipe_parallel_rank(self):
+        return self.get_stage_id()
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    # ---- sharding ----
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank).sharding
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    # ---- sep ----
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self):
+        c = self._topo.get_coord(self.global_rank)
+        return getattr(c, "sep", 0)
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    # ---- misc ----
+    def get_check_parallel_group(self, sharding=False):
+        return self._check_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id, **kwargs)

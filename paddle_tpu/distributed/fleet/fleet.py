@@ -1,0 +1,146 @@
+"""Fleet orchestration singleton.
+
+Reference: python/paddle/distributed/fleet/fleet.py — Fleet.init (:167),
+_init_hybrid_parallel_env (:603), distributed_optimizer (:1306);
+model wrapping in fleet/model.py:32.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .. import init_parallel_env
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["Fleet", "fleet_singleton"]
+
+
+class _RoleMaker:
+    """PaddleCloudRoleMaker analog: rank/size come from jax.distributed."""
+
+    def __init__(self):
+        self._rank = jax.process_index()
+        self._size = max(jax.process_count(), 1)
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg: HybridCommunicateGroup | None = None
+        self._user_defined_strategy = DistributedStrategy()
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        init_parallel_env()
+        self._role_maker = role_maker or _RoleMaker()
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        self._is_initialized = True
+        hc = self._user_defined_strategy.hybrid_configs
+        degrees = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                   hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                   hc.get("mp_degree", 1)]
+        # -1 => fill with remaining devices
+        total = jax.device_count()
+        try:
+            total = max(total, len(jax.devices("cpu")))
+        except RuntimeError:
+            pass
+        known = 1
+        for d in degrees:
+            if d > 0:
+                known *= d
+        degrees = [total // known if d == -1 else d for d in degrees]
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"], degrees)
+        self._hcg = HybridCommunicateGroup(topo)
+        return self
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return True
+
+    def barrier_worker(self):
+        pass
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        assert self._hcg is not None, "call fleet.init first"
+        return self._hcg
+
+    @property
+    def strategy(self):
+        return self._user_defined_strategy
+
+    # ---- wrapping ----
+    def distributed_model(self, model):
+        """Reference fleet/model.py:32 — picks the wrapper by strategy."""
+        from ..meta_parallel.meta_parallel_base import wrap_distributed_model
+
+        return wrap_distributed_model(model, self._hcg,
+                                      self._user_defined_strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Reference fleet.py:1306 → HybridParallelOptimizer."""
+        from ..meta_parallel.hybrid_parallel_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._user_defined_strategy)
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    # PS-mode entry points (recommendation path) — collective-only build
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise NotImplementedError(
+            "parameter-server mode: the sparse-embedding path is served by "
+            "sharded embeddings (incubate.sharded_embedding); brpc PS has no "
+            "TPU analog (SURVEY.md §7.3 item 4)")
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, *args, **kwargs):
+        raise NotImplementedError("use paddle.jit.save")
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        raise NotImplementedError("use paddle.save")
+
+
+fleet_singleton = Fleet()

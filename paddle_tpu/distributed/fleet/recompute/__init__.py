@@ -1,0 +1,147 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: fleet/recompute/recompute.py — RecomputeFunction (:108, PyLayer
+that reruns forward under saved RNG state), recompute() (:404),
+recompute_hybrid.py (PP variant with the mp RNG tracker).
+
+TPU-native: under tracing (to_static / program-level grad) this is
+``jax.checkpoint`` — XLA rematerializes inside the single program, which is
+both the idiomatic and the faster form (no Python re-entry). In pure eager
+mode the tape stores op *inputs* per node; recompute wraps the block so only
+the block inputs are retained and the inner tape is rebuilt at backward.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ....core import rng as rng_mod, state
+from ....core.engine import Edge, GradNode, run_backward
+from ....core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid",
+           "RecomputeFunction"]
+
+
+def _eager_recompute(function, args, kwargs, preserve_rng_state=True):
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    requires_grad = state.grad_enabled() and any(
+        not t.stop_gradient for t in tensor_args)
+    rng_before = rng_mod.DEFAULT_GENERATOR.get_state()
+    with state.no_grad_guard():
+        out = function(*args, **kwargs)
+    if not requires_grad:
+        return out
+    out_is_tuple = isinstance(out, (list, tuple))
+    outs = tuple(out) if out_is_tuple else (out,)
+    detached_args = [a.detach() if isinstance(a, Tensor) else a for a in args]
+
+    def bwd(primals, cts):
+        cts_list = list(cts) if isinstance(cts, tuple) else [cts]
+        if preserve_rng_state:
+            rng_now = rng_mod.DEFAULT_GENERATOR.get_state()
+            rng_mod.DEFAULT_GENERATOR.set_state(rng_before)
+        try:
+            inner_args = []
+            grad_inputs = []
+            for a in detached_args:
+                if isinstance(a, Tensor):
+                    t = Tensor._wrap(a._data)
+                    t.stop_gradient = False
+                    inner_args.append(t)
+                    grad_inputs.append(t)
+                else:
+                    inner_args.append(a)
+            with state.enable_grad_guard():
+                inner_out = function(*inner_args, **kwargs)
+            inner_outs = (tuple(inner_out) if isinstance(inner_out,
+                                                         (list, tuple))
+                          else (inner_out,))
+            capture = {id(t): t for t in grad_inputs}
+            captured = run_backward(
+                [o for o in inner_outs],
+                [Tensor._wrap(c) for c in cts_list],
+                capture=capture, accumulate_others=True)
+            # align captured grads with args order
+            gi = iter(grad_inputs)
+            out_grads = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    t = next(gi)
+                    g = captured.get(id(t))
+                    out_grads.append(g)
+                else:
+                    out_grads.append(None)
+            return tuple(out_grads)
+        finally:
+            if preserve_rng_state:
+                rng_mod.DEFAULT_GENERATOR.set_state(rng_now)
+
+    edges = [Edge.from_tensor(a) if isinstance(a, Tensor) else Edge(stop=True)
+             for a in args]
+    out_avals = [(tuple(o._data.shape), o._data.dtype) for o in outs]
+    node = GradNode("recompute", lambda primals, cts: bwd(primals, cts), (),
+                    edges, out_avals, out_is_tuple)
+    new_outs = []
+    for i, o in enumerate(outs):
+        t = Tensor._wrap(o._data)
+        t.stop_gradient = False
+        t._node = node
+        t._out_idx = i
+        new_outs.append(t)
+    return (type(out)(new_outs) if out_is_tuple else new_outs[0])
+
+
+def recompute(function, *args, **kwargs):
+    """Reference recompute.py:404."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if state.in_trace():
+        # inside to_static / program grad: use XLA remat
+        from ....utils.functional_call import functional_call
+
+        tensor_mask = [isinstance(a, Tensor) for a in args]
+        arrays = [a._data if isinstance(a, Tensor) else a for a in args]
+
+        @jax.checkpoint
+        def inner(*arrs):
+            rebuilt = [Tensor._wrap(a) if m else a
+                       for a, m in zip(arrs, tensor_mask)]
+            out = function(*rebuilt, **kwargs)
+            return jax.tree.map(
+                lambda o: o._data if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+
+        out = inner(*arrays)
+        return jax.tree.map(Tensor._wrap, out)
+    return _eager_recompute(function, args, kwargs, preserve)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_size = max(len(funcs) // max(segments, 1), 1)
+
+    def run_segment(fs):
+        def seg_fn(*a, **kw):
+            out = a[0] if len(a) == 1 else a
+            for f in fs:
+                out = f(out)
+            return out
+
+        return seg_fn
+
+    out = args[0] if len(args) == 1 else args
+    for start in range(0, len(funcs), seg_size):
+        fs = funcs[start : start + seg_size]
+        out = recompute(run_segment(fs), out, **kwargs)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """reference recompute_hybrid.py — PP variant; RNG-tracker handling is
+    subsumed by preserve_rng_state."""
+    return recompute(function, *args, **kwargs)
+
+
+RecomputeFunction = recompute

@@ -1,0 +1,10 @@
+"""fleet.utils (reference: fleet/utils/__init__.py)."""
+
+from . import sequence_parallel_utils  # noqa: F401
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+
+class HybridParallelInferenceHelper:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "distributed inference: use paddle.jit.save + sharded load")

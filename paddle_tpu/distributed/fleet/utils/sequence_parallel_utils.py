@@ -1,0 +1,161 @@
+"""Sequence-parallel utilities.
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp PyLayers (:38-145), mark_as_sequence_parallel_parameter,
+ColumnSequenceParallelLinear (:230), RowSequenceParallelLinear (:340).
+
+TPU-native: sequence parallelism = the sequence dim of activations sharded
+over the 'mp' mesh axis (Megatron-SP rides the TP group). The PyLayer pairs
+become sharding constraints; GSPMD emits the all_gather before the column
+matmul and the reduce_scatter after the row matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.initializer import XavierUniform
+from ....nn.layer.layers import Layer
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter", "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear", "GatherOp_backward",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _mesh_mp():
+    from ..fleet import fleet_singleton
+
+    try:
+        hcg = fleet_singleton.get_hybrid_communicate_group()
+        return hcg.mesh, hcg.get_model_parallel_world_size()
+    except Exception:
+        return None, 1
+
+
+def _constrain_seq(t, seq_axis, sharded):
+    mesh, mp = _mesh_mp()
+    if mesh is None or mp <= 1 or not isinstance(t._data, jax.core.Tracer):
+        return t
+    spec = [None] * t.ndim
+    if sharded:
+        spec[seq_axis] = "mp"
+    arr = jax.lax.with_sharding_constraint(t._data,
+                                           NamedSharding(mesh, P(*spec)))
+    out = Tensor._wrap(arr)
+    out.stop_gradient = t.stop_gradient
+    return out
+
+
+def scatter(input, seq_axis=0):
+    """sequence dim -> sharded over mp (reference ScatterOp fwd)."""
+    return _constrain_seq(input, seq_axis, sharded=True)
+
+
+def all_gather(input, seq_axis=0):
+    """sequence dim -> replicated (reference AllGatherOp fwd)."""
+    return _constrain_seq(input, seq_axis, sharded=False)
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(input, seq_axis=0):
+        return scatter(input, seq_axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(input, seq_axis=0):
+        return all_gather(input, seq_axis)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(input):
+        return all_gather(input, 0)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(input):
+        return scatter(input, 0)
+
+
+GatherOp_backward = ReduceScatterOp
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Grad sync of SP params over the mp group — automatic under GSPMD
+    (gradients of replicated params are psum'd by the compiler); kept for API
+    parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference :230 — input arrives sequence-sharded, all_gather(seq) then
+    column-parallel matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        from ...meta_parallel.parallel_layers.mp_layers import _shard_param
+
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        self.gather_output = gather_output
+        _shard_param(self.weight, (None, "mp"))
+
+    def forward(self, x):
+        x = all_gather(x, seq_axis=0)  # [s/mp, b, h] -> [s, b, h]
+        out = F.linear(x, self.weight, self.bias)
+        from ...meta_parallel.parallel_layers.mp_layers import _constrain
+
+        if not self.gather_output:
+            return _constrain(out, (None,) * (out.ndim - 1) + ("mp",))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """reference :340 — row-parallel matmul then reduce_scatter over the
+    sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        from ...meta_parallel.parallel_layers.mp_layers import _shard_param
+
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        self.input_is_parallel = input_is_parallel
+        _shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        from ...meta_parallel.parallel_layers.mp_layers import _constrain
+
+        if self.input_is_parallel:
+            x = _constrain(x, (None,) * (x.ndim - 1) + ("mp",))
+        out = F.linear(x, self.weight, self.bias)
+        return scatter(out, seq_axis=0)

@@ -1,0 +1,23 @@
+"""paddle.distributed.fleet.meta_parallel namespace
+(reference: fleet/meta_parallel/__init__.py)."""
+
+from .hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelClipGrad, HybridParallelOptimizer,
+)
+from .meta_parallel_base import (  # noqa: F401
+    MetaParallelBase, SegmentParallel, ShardingParallel, TensorParallel,
+)
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineLayerChunk, SegmentLayers,
+    SharedLayerDesc,
+)
+from .parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)

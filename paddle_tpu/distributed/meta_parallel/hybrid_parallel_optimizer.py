@@ -1,0 +1,54 @@
+"""HybridParallelOptimizer + cross-group grad clip.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py
+— HybridParallelOptimizer (:254) and HybridParallelClipGrad (:44, global-norm
+across tp/pp/sharding groups via allreduce of the local norm squares).
+
+TPU-native: gradients live as global (possibly sharded) arrays, so the global
+norm is already global — HybridParallelClipGrad degenerates to
+ClipGradByGlobalNorm over the full grad set, which is exactly what the
+reference's cross-group allreduce dance computes.
+"""
+
+from __future__ import annotations
+
+from ...nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    def __init__(self, clip, hcg):
+        clip_norm = getattr(clip, "clip_norm", 1.0)
+        super().__init__(clip_norm)
+        self._hcg = hcg
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and hcg is not None:
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *args, **kwargs):
+        self._inner_opt.clear_grad(*args, **kwargs)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        return self._inner_opt.minimize(loss, **kwargs)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

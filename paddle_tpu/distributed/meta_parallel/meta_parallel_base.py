@@ -1,0 +1,84 @@
+"""Model wrappers per strategy.
+
+Reference: fleet/model.py:32 distributed_model + fleet/meta_parallel/
+meta_parallel_base.py, tensor_parallel.py, sharding_parallel.py,
+segment_parallel.py. Wrapping mostly annotates/validates — gradient sync is
+by construction in the GSPMD world.
+"""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+__all__ = ["MetaParallelBase", "TensorParallel", "ShardingParallel",
+           "SegmentParallel", "wrap_distributed_model"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class TensorParallel(MetaParallelBase):
+    """reference tensor_parallel.py — broadcasts mp params at init (moot on
+    single controller) and syncs gradients (automatic)."""
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class SegmentParallel(MetaParallelBase):
+    """reference segment_parallel.py:26 — sequence split over the sep axis.
+    Inputs get their sequence dim annotated on 'sep' by the data loader or
+    shard_tensor; grads sync automatically."""
+    pass
+
+
+def wrap_distributed_model(model, hcg, strategy):
+    from ..parallel import DataParallel
+    from .parallel_layers.pp_layers import PipelineLayer
+    from .pipeline_parallel import PipelineParallel
+
+    if hcg is None:
+        return model
+    if hcg.get_pipe_parallel_world_size() > 1 or isinstance(model,
+                                                            PipelineLayer):
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    if hcg.get_sep_parallel_world_size() > 1:
+        return SegmentParallel(model, hcg, strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model

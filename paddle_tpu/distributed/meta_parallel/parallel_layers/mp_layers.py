@@ -1,0 +1,187 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding (:47),
+ColumnParallelLinear (:333), RowParallelLinear (:540), ParallelCrossEntropy
+(:741), built on collective PyLayers (mp_ops.py:27-364: c_identity/c_concat/
+mp_allreduce autograd pairs).
+
+TPU-native redesign (SURVEY.md §7.1): parameters keep their FULL logical shape
+and carry a NamedSharding over the 'mp' mesh axis — GSPMD partitions the
+matmuls and inserts the identity/allreduce pairs the reference hand-writes as
+PyLayers. ``gather_output=False`` / ``input_is_parallel=True`` become sharding
+constraints on activations. On a 1-wide mp axis everything degrades to the
+plain layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.initializer import Constant, Normal, XavierUniform
+from ....nn.layer.layers import Layer
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _hcg():
+    from ...fleet.fleet import fleet_singleton
+
+    try:
+        return fleet_singleton.get_hybrid_communicate_group()
+    except Exception:
+        return None
+
+
+def _mp_info():
+    hcg = _hcg()
+    if hcg is None:
+        return None, 1
+    return hcg.mesh, hcg.get_model_parallel_world_size()
+
+
+def _shard_param(param, spec):
+    """Annotate a parameter with a NamedSharding over the hybrid mesh."""
+    mesh, mp = _mp_info()
+    if mesh is None or mp <= 1:
+        return param
+    ok = all(s is None or param._data.shape[i] % mesh.shape[s] == 0
+             for i, s in enumerate(spec))
+    if not ok:
+        return param
+    sharding = NamedSharding(mesh, P(*spec))
+    param._data = jax.device_put(param._data, sharding)
+    param._placement = (mesh, spec)
+    return param
+
+
+def _constrain(t, spec):
+    """Sharding constraint on an activation (traced only)."""
+    mesh, mp = _mp_info()
+    if mesh is None or mp <= 1:
+        return t
+    if isinstance(t._data, jax.core.Tracer):
+        arr = jax.lax.with_sharding_constraint(
+            t._data, NamedSharding(mesh, P(*spec)))
+        out = Tensor._wrap(arr)
+        out.stop_gradient = t.stop_gradient
+        out._node, out._out_idx = t._node, t._out_idx
+        return out
+    return t
+
+
+class VocabParallelEmbedding(Layer):
+    """reference mp_layers.py:47 — embedding table sharded along vocab dim.
+    GSPMD form: table sharded on dim 0; the masked-lookup + allreduce the
+    reference does manually is produced by XLA from a one_hot-matmul
+    formulation (keeps the gather unambiguous under sharding)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.is_mp = _mp_info()[1] > 1
+        _shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        mesh, mp = _mp_info()
+        if mp > 1 and isinstance(x._data, jax.core.Tracer):
+            # one-hot matmul: shard-friendly (vocab-contracting dim on 'mp'
+            # => psum inserted by GSPMD, exactly the reference's allreduce)
+            from ....core.dispatch import OPS
+
+            return _vocab_parallel_lookup(x, self.weight)
+        return F.embedding(x, self.weight)
+
+
+from ....core.dispatch import op as _op
+
+
+@_op("vocab_parallel_lookup")
+def _vocab_parallel_lookup_fn(x, weight):
+    import jax.numpy as jnp
+
+    onehot = jax.nn.one_hot(x, weight.shape[0], dtype=weight.dtype)
+    return jnp.einsum("...v,vh->...h", onehot, weight)
+
+
+def _vocab_parallel_lookup(x, weight):
+    return _vocab_parallel_lookup_fn(x, weight)
+
+
+class ColumnParallelLinear(Layer):
+    """reference mp_layers.py:333. Weight [in, out] sharded on out ('mp');
+    gather_output=True constrains the output replicated (all_gather),
+    False leaves it mp-sharded for a following RowParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        _shard_param(self.weight, (None, "mp"))
+        if self.bias is not None:
+            _shard_param(self.bias, ("mp",))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            spec = (None,) * (out.ndim - 1) + (None,)
+            return _constrain(out, spec)
+        spec = (None,) * (out.ndim - 1) + ("mp",)
+        return _constrain(out, spec)
+
+
+class RowParallelLinear(Layer):
+    """reference mp_layers.py:540. Weight [in, out] sharded on in ('mp');
+    contracting a mp-sharded dim makes GSPMD insert the allreduce the
+    reference codes as mp_allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        _shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = (None,) * (x.ndim - 1) + ("mp",)
+            x = _constrain(x, spec)
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, (None,) * out.ndim)
+
+
+class ParallelCrossEntropy(Layer):
+    """reference mp_layers.py:741 (c_softmax_with_cross_entropy over the
+    vocab-sharded logits). GSPMD computes the sharded logsumexp reduction
+    automatically; the layer keeps the API."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        return loss.unsqueeze(-1) if loss.ndim < label.ndim + 1 else loss

@@ -1,0 +1,222 @@
+"""Pipeline-parallel layer description + segmentation.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc (:36),
+SharedLayerDesc (:76), SegmentLayers (:92), PipelineLayer (:237).
+
+TPU-native notes: segmentation logic is kept 1:1 (seg_method "uniform" or
+"layer:ClassName"); execution differs — on the single-controller model all
+stages live in one program, so PipelineLayer.forward can run straight through,
+and the pipeline engine (pipeline_parallel.py) schedules microbatches as a
+compiled loop. Stage-parallel execution over a 'pp' mesh axis uses the
+stage-stacked shard_map engine (pipeline_parallel.py PipelineParallel).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
+           "PipelineLayerChunk"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("layer_func must be a paddle.nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference pp_layers.py:92."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        if num_virtual_pipeline_stage is not None:
+            self.total_parts = num_parts * num_virtual_pipeline_stage
+        else:
+            self.total_parts = num_parts
+        assert self.num_items >= self.num_parts, (
+            "layer number should be greater than number of segments")
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.total_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":")[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                name = (d.layer_func.__name__ if isinstance(d, LayerDesc)
+                        else d.__class__.__name__)
+                if name == cls_name:
+                    weights[i] = 1
+            actual = sum(weights)
+            assert actual >= self.total_parts, (
+                f"need at least {self.total_parts} layers of {cls_name}, "
+                f"found {actual}")
+            # spread the weighted layers evenly over parts
+            result = [0] * (self.total_parts + 1)
+            memory_counter = 0
+            result_idx = 1
+            per_part = actual / self.total_parts
+            for i, w in enumerate(weights):
+                memory_counter += w
+                if memory_counter >= per_part * result_idx - 1e-6 and \
+                        result_idx <= self.total_parts:
+                    result[result_idx] = i + 1
+                    result_idx += 1
+            result[self.total_parts] = len(weights)
+            return result
+        raise ValueError(f"unknown seg method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            offset = 1 if i > (num_parts - extra) else 0
+            result[i] = result[i - 1] + part_size + offset
+        return result
+
+
+class PipelineLayerChunk(Layer):
+    def __init__(self):
+        super().__init__()
+        self.run_function = []
+
+    def append(self, sublayer):
+        if isinstance(sublayer, Layer):
+            self.add_sublayer(str(len(self.run_function)), sublayer)
+        self.run_function.append(sublayer)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            "chunks are executed by the pipeline engine, not called directly")
+
+
+class PipelineLayer(Layer):
+    """reference pp_layers.py:237. Builds ALL layers (single-controller owns
+    the whole model); records stage segmentation for the pipeline engine and
+    for stage-stacked compilation."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None,
+                 **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+
+        seg = SegmentLayers(
+            self._layers_desc, num_parts=self._num_stages, method=seg_method,
+            num_virtual_pipeline_stage=self._num_virtual_pipeline_stages)
+        self.segment_parts = seg.do_segment()
+
+        # build every layer; record shared layers once per key
+        self.shared_layers = {}
+        self._shared_fwd = {}
+        self.run_function = []
+        self._stage_of_idx = []
+        built = LayerList()
+        for idx, d in enumerate(self._layers_desc):
+            stage = self._stage_for_index(idx)
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self.shared_layers:
+                    self.shared_layers[d.layer_name] = d.build_layer()
+                    self._shared_fwd[d.layer_name] = d.forward_func
+                layer = self.shared_layers[d.layer_name]
+                if d.forward_func is not None:
+                    fwd = d.forward_func
+                    layer_ref = layer
+
+                    def shared_call(*args, _f=fwd, _l=layer_ref, **kw):
+                        return _f(_l, *args, **kw)
+
+                    self.run_function.append(shared_call)
+                    built.append(layer)
+                else:
+                    self.run_function.append(layer)
+                    built.append(layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.run_function.append(layer)
+                built.append(layer)
+            elif isinstance(d, Layer):
+                self.run_function.append(d)
+                built.append(d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"invalid layer desc {d!r}")
+            self._stage_of_idx.append(stage)
+        self._built = built
+
+    def _stage_for_index(self, idx):
+        parts = self.segment_parts
+        for s in range(len(parts) - 1):
+            if parts[s] <= idx < parts[s + 1]:
+                return s % self._num_stages
+        return self._num_stages - 1
+
+    def get_stage_from_index(self, layer_idx):
+        return self._stage_of_idx[layer_idx]
+
+    def get_num_virtual_stages(self):
+        return self._num_virtual_pipeline_stages
+
+    @property
+    def parameters_by_stage(self):
+        out = {}
+        for idx, fn in enumerate(self.run_function):
+            if isinstance(fn, Layer):
+                out.setdefault(self._stage_of_idx[idx], []).extend(
+                    fn.parameters())
+        return out
+
+    def forward(self, input, chunk_id=None):
+        """Straight-through execution (all stages in one program)."""
+        x = input
+        for fn in self.run_function:
+            if isinstance(x, tuple):
+                x = fn(*x) if not isinstance(fn, Layer) else fn(*x)
+            else:
+                x = fn(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)

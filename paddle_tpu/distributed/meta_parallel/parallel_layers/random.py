@@ -1,0 +1,76 @@
+"""Hybrid-parallel RNG state tracker.
+
+Reference: fleet/meta_parallel/parallel_layers/random.py
+(get_rng_state_tracker, model_parallel_rng contexts for dropout determinism
+across TP ranks). Here each named state is a separate Generator seed; in the
+GSPMD world tensor-parallel dropout determinism comes from the single global
+program, so the tracker mainly preserves the API + seed isolation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ....core import rng as rng_mod
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
+           "MODEL_PARALLEL_RNG"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        gen = rng_mod.Generator(seed)
+        self.states_[name] = gen
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        gen = self.states_[name]
+        orig = rng_mod.DEFAULT_GENERATOR
+        rng_mod.DEFAULT_GENERATOR = gen
+        try:
+            yield
+        finally:
+            rng_mod.DEFAULT_GENERATOR = orig
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random
+
+    from ... import get_rank
+
+    seed = seed or (100 + get_rank())
+    global_seed = seed
+    local_seed = seed + 1024
+    _RNG_STATE_TRACKER.reset()
+    rng_mod.seed(global_seed)
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)

@@ -1,0 +1,55 @@
+"""DataParallel.
+
+Reference: python/paddle/distributed/parallel.py (DataParallel class +
+C++ EagerReducer gradient bucketing, collective/reducer.h:88).
+
+TPU-native: in the single-controller model the batch is a global array
+sharded over 'dp'; gradients of replicated parameters are reduced by XLA
+inside the compiled step — there is no reducer, no buckets, no overlap hooks
+to manage (SURVEY.md §3.4 translation note). The wrapper preserves API:
+scale_loss, no_sync, find_unused_parameters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        # gradient sync happens inside the compiled step; nothing to defer
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)

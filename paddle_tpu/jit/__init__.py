@@ -327,11 +327,25 @@ def save(layer, path, input_spec=None, **configs):
         return [o._data if isinstance(o, Tensor) else jnp.asarray(o)
                 for o in out_flat]
 
-    example_inputs = [
-        jax.ShapeDtypeStruct(
-            tuple(1 if s == -1 else s for s in sp.shape), sp.dtype)
-        for sp in specs
-    ]
+    # dynamic (None/-1) dims become symbolic so the loaded program accepts
+    # any size there (reference InputSpec semantics)
+    scope = jax.export.SymbolicScope()
+    example_inputs = []
+    sym_counter = [0]
+
+    def dim_str(s):
+        if s == -1:
+            sym_counter[0] += 1
+            return f"_d{sym_counter[0]}"
+        return str(s)
+
+    for sp in specs:
+        if any(s == -1 for s in sp.shape):
+            shape = jax.export.symbolic_shape(
+                ",".join(dim_str(s) for s in sp.shape), scope=scope)
+        else:
+            shape = tuple(sp.shape)
+        example_inputs.append(jax.ShapeDtypeStruct(shape, sp.dtype))
     exported = jax.export.export(jax.jit(infer_fn))(
         [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in const_arrays],
         *example_inputs)

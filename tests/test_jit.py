@@ -1,0 +1,153 @@
+"""to_static tests (reference model: test/dygraph_to_static/)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit
+
+
+def r(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_parity_with_eager(self):
+        net = Net()
+        net.eval()
+        x = paddle.to_tensor(r(4, 8))
+        eager = net(x).numpy()
+        snet = jit.to_static(Net())
+        snet.set_state_dict(net.state_dict())
+        snet.eval()
+        np.testing.assert_allclose(snet(x).numpy(), eager, rtol=1e-6)
+
+    def test_training_and_grads(self):
+        net = jit.to_static(Net())
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        X, Y = r(32, 8), (np.random.rand(32) > 0.5).astype(np.int32)
+        losses = []
+        for _ in range(30):
+            loss = F.cross_entropy(net(paddle.to_tensor(X)),
+                                   paddle.to_tensor(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(loss.item())
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_grad_matches_eager(self):
+        net = Net()
+        snet = jit.to_static(Net())
+        snet.set_state_dict(net.state_dict())
+        x = paddle.to_tensor(r(4, 8))
+        net(x).sum().backward()
+        snet(x).sum().backward()
+        for p_e, p_s in zip(net.parameters(), snet.parameters()):
+            np.testing.assert_allclose(p_e.grad.numpy(), p_s.grad.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_cache_by_shape_and_mode(self):
+        net = jit.to_static(Net())
+        sf = net.forward
+        net(paddle.to_tensor(r(2, 8)))
+        net(paddle.to_tensor(r(2, 8)))
+        assert len(sf._cache) == 1
+        net(paddle.to_tensor(r(5, 8)))
+        assert len(sf._cache) == 2
+        net.eval()
+        net(paddle.to_tensor(r(5, 8)))
+        assert len(sf._cache) == 3
+
+    def test_python_control_flow_frozen_at_trace(self):
+        @jit.to_static
+        def f(x, flag=True):
+            if flag:  # evaluated at trace time (same as AST-transform result
+                # for static conditions)
+                return x * 2
+            return x * 3
+
+        out = f(paddle.to_tensor([1.0]), flag=True)
+        assert out.item() == 2.0
+        out = f(paddle.to_tensor([1.0]), flag=False)
+        assert out.item() == 3.0
+
+    def test_dropout_varies_across_calls(self):
+        class DNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.drop = nn.Dropout(0.5)
+
+            def forward(self, x):
+                return self.drop(x)
+
+        net = jit.to_static(DNet())
+        net.train()
+        x = paddle.to_tensor(np.ones((100,), np.float32))
+        a = net(x).numpy()
+        b = net(x).numpy()
+        assert not np.array_equal(a, b), "dropout mask should differ per call"
+
+    def test_save_load(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        net = Net()
+        net.eval()
+        x = paddle.to_tensor(r(3, 8))
+        ref = net(x).numpy()
+        jit.save(net, str(tmp_path / "m"),
+                 input_spec=[InputSpec([None, 8], "float32")])
+        loaded = jit.load(str(tmp_path / "m"))
+        np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestRecompute:
+    def test_eager_recompute_grads(self):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(r(4, 8))
+        x.stop_gradient = False
+        out = recompute(lambda t: F.relu(lin(t)), x)
+        out.sum().backward()
+        g_recompute = x.grad.numpy().copy()
+        gw = lin.weight.grad.numpy().copy()
+
+        x2 = paddle.to_tensor(x.numpy())
+        x2.stop_gradient = False
+        lin.clear_gradients()
+        F.relu(lin(x2)).sum().backward()
+        np.testing.assert_allclose(g_recompute, x2.grad.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(gw, lin.weight.grad.numpy(), rtol=1e-5)
+
+    def test_traced_recompute(self):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        class RNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 8)
+                self.fc2 = nn.Linear(8, 4)
+
+            def forward(self, x):
+                h = recompute(lambda t: F.relu(self.fc1(t)), x)
+                return self.fc2(h)
+
+        net = jit.to_static(RNet())
+        x = paddle.to_tensor(r(4, 8))
+        out = net(x)
+        out.sum().backward()
+        assert net.parameters()[0].grad is not None
