@@ -25,8 +25,9 @@ __all__ = ["flash_attention", "scaled_dot_product_attention",
 
 def _use_pallas(q):
     if jax.default_backend() in ("tpu", "axon"):
-        # pallas kernel needs head_dim and seq tiles; fall back for tiny shapes
-        return q.shape[1] >= 128 and q.shape[3] % 128 == 0
+        # pallas kernel needs MXU-friendly head_dim and enough seq to tile;
+        # fall back to the XLA path for tiny shapes
+        return q.shape[1] >= 128 and q.shape[3] % 64 == 0 and q.shape[3] >= 64
     return False
 
 

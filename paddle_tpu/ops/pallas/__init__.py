@@ -1,0 +1,4 @@
+"""Hand-written Pallas TPU kernels for the ops where XLA fusion isn't enough
+— the TPU-native replacement for the reference's fused CUDA ops
+(paddle/fluid/operators/fused/, paddle/phi/kernels/fusion/,
+third_party/flashattn)."""

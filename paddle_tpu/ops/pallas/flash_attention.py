@@ -1,0 +1,292 @@
+"""Flash attention — Pallas TPU kernels.
+
+Replaces the reference's CUDA flash-attention binding
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu + third_party/flashattn) with a
+TPU-native online-softmax kernel:
+
+* forward: one pass over KV blocks per Q block, fp32 accumulators in VMEM,
+  saves per-row logsumexp for the backward
+* backward: recompute-style — a dQ kernel (loop over KV) and a dKV kernel
+  (loop over Q), the standard FlashAttention-2 split
+* causal masking bounds the KV loop per Q block (traced fori_loop bound), so
+  causal attention does ~half the FLOPs — the analog of the CUDA kernel's
+  block early-exit
+
+Layout contract: [batch, seq, heads, head_dim] (paddle convention) at the API;
+kernels run on [batch*heads, seq, head_dim]. The nn.functional wrapper only
+routes here when head_dim % 128 == 0 and seq divides the block size — else it
+falls back to the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _block_sizes(seq_q, seq_k):
+    bq = min(512, seq_q)
+    bk = min(512, seq_k)
+    while seq_q % bq:
+        bq //= 2
+    while seq_k % bk:
+        bk //= 2
+    return max(bq, 8), bk
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k):
+    _, bq, d = q_ref.shape
+    sk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+
+    num_k = sk // block_k
+    if causal:
+        num_k_run = jnp.minimum(
+            num_k, ((qi + 1) * bq + block_k - 1) // block_k)
+    else:
+        num_k_run = num_k
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                            (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot(p, v,
+                                       preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, num_k_run, body, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (bh, sq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
+                   scale, causal, block_k):
+    _, bq, d = q_ref.shape
+    sk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = jnp.sum(do * o, axis=1, keepdims=True)
+
+    num_k = sk // block_k
+    if causal:
+        num_k_run = jnp.minimum(
+            num_k, ((qi + 1) * bq + block_k - 1) // block_k)
+    else:
+        num_k_run = num_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                            (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k_run, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
+                    dv_ref, *, scale, causal, block_q):
+    _, bk, d = k_ref.shape
+    sq = q_ref.shape[1]
+    kb = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    num_q = sq // block_q
+    if causal:
+        # first q block that sees this kv block
+        q_start = (kb * bk) // block_q
+    else:
+        q_start = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        q_start, num_q, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+    )(q, k, v, dout, out, lse)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+    )(q, k, v, dout, out, lse)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_mha(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_mha_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_mha_bwd(scale, causal, block_q, block_k, res, dout):
+    return _bwd(scale, causal, block_q, block_k, res, dout)
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+
+
+from ...core.dispatch import op as _op
+
+
+@_op("flash_attention_pallas")
+def _flash_attention_arrays(q, k, v, causal=True, scale=None):
+    """q/k/v: [B, S, H, D] (paddle layout). GQA: kv heads broadcast."""
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * hq, k.shape[1], d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * hq, v.shape[1], d)
+    bq, bk = _block_sizes(sq, kt.shape[1])
+    out = _flash_mha(qt, kt, vt, float(s), bool(causal), bq, bk)
+    return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
+
+
+def flash_attention_fwd(q, k, v, causal=True, scale=None):
+    """Tensor-level entry used by nn.functional (dispatch wraps autograd)."""
+    return _flash_attention_arrays(q, k, v, causal=bool(causal), scale=scale)
