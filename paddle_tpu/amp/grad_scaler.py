@@ -40,6 +40,10 @@ class AmpScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if getattr(self, "_unscaled", False):
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -50,6 +54,7 @@ class AmpScaler:
             found = found or not finite
             p.grad._rebind(g.astype(p.grad._data.dtype))
         self._found_inf = found
+        self._unscaled = True
 
     minimize_ops = None
 
@@ -64,6 +69,7 @@ class AmpScaler:
         self._unscaled = False
 
     def update(self):
+        self._unscaled = False
         if not self._enable or not self._use_dynamic:
             return
         if self._found_inf:

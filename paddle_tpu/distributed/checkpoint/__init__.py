@@ -5,13 +5,21 @@ Reference: python/paddle/distributed/checkpoint/ — save_state_dict
 load_state_dict (load_state_dict.py:365, reshards across changed meshes),
 metadata.py (tensor -> shard-index map).
 
-TPU-native: arrays already carry their sharding (NamedSharding). Save writes
-one file per *local shard set* (single-controller: per process) plus a
-metadata json describing each tensor's global shape, dtype and the shard
-layout; load reassembles the global tensor and device_puts onto the target
-placement — reshard-on-load across different meshes/degrees is therefore the
-same code path as same-mesh load. Layout matches what an Orbax-style
-TensorStore backend would need, without the dependency.
+TPU-native: arrays already carry their sharding (NamedSharding). Each
+process writes one ``rank{r}.npz`` payload with **rank-namespaced** shard
+keys plus a ``rank{r}.meta.json`` fragment describing its shards; load
+merges *all* fragments found under the path, so a multi-host save needs no
+cross-host metadata gather (the reference gathers to the coordinator; here
+the shared checkpoint directory is the rendezvous). The coordinator also
+writes its own fragment as ``metadata.json`` for API parity, but load never
+depends on it; stale higher-rank fragments from a previous larger-world
+save are removed by the coordinator. Reassembly + ``device_put`` onto the target placement makes
+reshard-on-load across different meshes/degrees the same code path as
+same-mesh load.
+
+Extended dtypes (bfloat16, float8_*) are stored as same-width unsigned
+integers — ``np.savez`` silently degrades ml_dtypes arrays to void — and
+reinterpreted on load via the dtype string recorded in the metadata.
 """
 
 from __future__ import annotations
@@ -26,20 +34,26 @@ from ...core.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
+_UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
-def _shard_infos(arr):
-    """List of (device_id, index-slices, shape) for every addressable shard."""
-    infos = []
-    if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
-        for sh in arr.addressable_shards:
-            idx = []
-            for s in sh.index:
-                start = 0 if s.start is None else int(s.start)
-                stop = None if s.stop is None else int(s.stop)
-                idx.append([start, stop])
-            infos.append({"device": sh.device.id, "index": idx,
-                          "replica_id": sh.replica_id})
-    return infos
+
+def _np_dtype(name):
+    """Resolve a dtype string incl. ml_dtypes extended types."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _storable(data):
+    """(array-as-native-dtype, true-dtype-string). np.savez only round-trips
+    builtin numpy dtypes; view extended dtypes as same-width uints."""
+    dt = data.dtype
+    if dt.kind in "biufc":  # native numpy types round-trip as-is
+        return data, dt.name
+    return data.view(_UINT_FOR_WIDTH[dt.itemsize]), dt.name
 
 
 def save_state_dict(state_dict, path, process_group=None,
@@ -47,61 +61,115 @@ def save_state_dict(state_dict, path, process_group=None,
     """Reference save_state_dict.py:104."""
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
-    metadata = {"state": {}, "version": 1}
+    nprocs = jax.process_count()
+    if rank == coordinator_rank:
+        # remove fragments from a previous save with more ranks — they are
+        # not overwritten below and _merged_metadata would read stale shards
+        import re
+
+        for fn in os.listdir(path):
+            m = re.match(r"rank(\d+)\.(npz|meta\.json)$", fn)
+            if m and int(m.group(1)) >= nprocs:
+                os.remove(os.path.join(path, fn))
+    fragment = {"state": {}, "version": 2, "rank": rank,
+                "world_size": nprocs}
     payload = {}
     for name, t in state_dict.items():
         arr = t._data if isinstance(t, Tensor) else np.asarray(t)
-        shards = _shard_infos(arr) if isinstance(arr, jax.Array) else []
-        # single-controller: save unique (replica 0) shards only
+        # single-controller: save unique (replica 0) shards only, reading
+        # each shard's device-local buffer directly (no cross-device gather)
         saved = []
-        if shards and any(s["replica_id"] == 0 for s in shards):
+        true_dtype = None
+        shards = (list(arr.addressable_shards)
+                  if isinstance(arr, jax.Array)
+                  and hasattr(arr, "addressable_shards") else [])
+        if shards and any(s.replica_id == 0 for s in shards):
             for i, sh in enumerate(
-                    s for s in shards if s["replica_id"] == 0):
-                key = f"{name}@shard{i}"
-                idx = tuple(slice(a, b) for a, b in sh["index"])
-                payload[key] = np.asarray(arr[idx])
-                saved.append({"key": key, "index": sh["index"]})
+                    s for s in shards if s.replica_id == 0):
+                key = f"{name}@r{rank}s{i}"
+                index = [[0 if s.start is None else int(s.start),
+                          None if s.stop is None else int(s.stop)]
+                         for s in sh.index]
+                data, true_dtype = _storable(np.asarray(sh.data))
+                payload[key] = data
+                saved.append({"key": key, "index": index})
         else:
-            key = f"{name}@full"
-            payload[key] = np.asarray(arr)
+            key = f"{name}@r{rank}full"
+            data, true_dtype = _storable(np.asarray(arr))
+            payload[key] = data
             saved.append({"key": key, "index": None})
-        metadata["state"][name] = {
+        fragment["state"][name] = {
             "global_shape": list(np.shape(arr)),
-            "dtype": str(np.asarray(payload[saved[0]["key"]]).dtype),
+            "dtype": true_dtype,
             "shards": saved,
         }
     np.savez(os.path.join(path, f"rank{rank}.npz"), **payload)
+    with open(os.path.join(path, f"rank{rank}.meta.json"), "w") as f:
+        json.dump(fragment, f)
     if rank == coordinator_rank:
+        # API-parity marker only (the coordinator's own fragment); load
+        # always merges the rank*.meta.json fragments and never reads this
         with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(metadata, f)
+            json.dump(fragment, f)
+
+
+def _merged_metadata(path):
+    """Union of every rank's metadata fragment (shard lists concatenated)."""
+    merged = {"state": {}}
+    names = sorted(fn for fn in os.listdir(path)
+                   if fn.endswith(".meta.json"))
+    if not names:
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        if meta.get("version", 1) >= 2:
+            # v2 metadata.json is one rank's fragment, not a merged view —
+            # loading from it alone would silently zero other ranks' shards
+            raise RuntimeError(
+                f"checkpoint at {path} is missing its rank*.meta.json "
+                "fragments (v2 layout); copy the full checkpoint directory")
+        return meta
+    for fn in names:
+        with open(os.path.join(path, fn)) as f:
+            frag = json.load(f)
+        for name, info in frag["state"].items():
+            if name not in merged["state"]:
+                merged["state"][name] = {
+                    "global_shape": info["global_shape"],
+                    "dtype": info["dtype"],
+                    "shards": [],
+                }
+            merged["state"][name]["shards"].extend(info["shards"])
+    return merged
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, offload=False):
     """Reference load_state_dict.py:365 — fills `state_dict` tensors in
     place, resharding to each tensor's current placement."""
-    with open(os.path.join(path, "metadata.json")) as f:
-        metadata = json.load(f)
+    metadata = _merged_metadata(path)
     files = [np.load(os.path.join(path, fn))
              for fn in sorted(os.listdir(path)) if fn.endswith(".npz")]
 
-    def find(key):
+    def find(key, dtype):
         for f in files:
             if key in f:
-                return f[key]
+                data = f[key]
+                if data.dtype != dtype:
+                    data = data.view(dtype)
+                return data
         raise KeyError(key)
 
     for name, t in state_dict.items():
         if name not in metadata["state"]:
             continue
         info = metadata["state"][name]
-        full = np.zeros(info["global_shape"],
-                        dtype=np.dtype(info["dtype"]))
+        dtype = _np_dtype(info["dtype"])
+        full = np.zeros(info["global_shape"], dtype=dtype)
         if full.ndim == 0:
-            full = np.asarray(find(info["shards"][0]["key"]))
+            full = np.asarray(find(info["shards"][0]["key"], dtype))
         else:
             for sh in info["shards"]:
-                data = find(sh["key"])
+                data = find(sh["key"], dtype)
                 if sh["index"] is None:
                     full = np.asarray(data)
                 else:
@@ -111,9 +179,9 @@ def load_state_dict(state_dict, path, process_group=None,
         target_sharding = getattr(arr, "sharding", None)
         import jax.numpy as jnp
 
-        new = jnp.asarray(full, arr.dtype)
+        new = jnp.asarray(full).astype(arr.dtype)
         if target_sharding is not None and isinstance(
                 target_sharding, jax.sharding.NamedSharding):
-            new = jax.device_put(new, target_sharding)
+            new = jax.device_put(new.reshape(arr.shape), target_sharding)
         t._rebind(new.reshape(arr.shape))
     return state_dict

@@ -23,10 +23,15 @@ __all__ = ["flash_attention", "scaled_dot_product_attention",
            "flash_attn_unpadded", "sdp_kernel"]
 
 
-def _use_pallas(q):
+def _use_pallas(q, k=None):
     if jax.default_backend() in ("tpu", "axon"):
-        # pallas kernel needs MXU-friendly head_dim and enough seq to tile;
-        # fall back to the XLA path for tiny shapes
+        # pallas kernel needs MXU-friendly head_dim (multiple of 64, >= 64)
+        # and enough seq to tile; fall back to the XLA path for tiny shapes.
+        # The kernel's causal mask is aligned for seq_q == seq_k only, so
+        # KV-cache prefill (seq_k > seq_q) takes the XLA path, whose tril
+        # mask is bottom-right aligned like the reference.
+        if k is not None and q.shape[1] != k.shape[1]:
+            return False
         return q.shape[1] >= 128 and q.shape[3] % 64 == 0 and q.shape[3] >= 64
     return False
 
@@ -74,7 +79,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     dk = None
     if dropout_p > 0.0 and training:
         dk = rng.next_key()
-    if _use_pallas(query) and attn_mask is None and dropout_p == 0.0:
+    if _use_pallas(query, key) and attn_mask is None and dropout_p == 0.0:
         try:
             from ...ops.pallas.flash_attention import flash_attention_fwd
 

@@ -3,7 +3,9 @@
 Reference: the pybind slice machinery in paddle/fluid/pybind/eager_method.cc
 (``__getitem__``) + set_value op. Static python indices (ints/slices/ellipsis/
 None) are baked into the jit cache key; Tensor indices are passed as dynamic
-args (XLA gather). Boolean-mask indexing is eager-only (dynamic output shape).
+args (XLA gather). Boolean-mask indexing concretizes the mask via np.nonzero
+(eager-only — dynamic output shape) and then rides the integer gather op, so
+the selected values stay on the autograd tape.
 """
 
 from __future__ import annotations
@@ -23,21 +25,93 @@ _TENSOR = "t"
 _ARRAY = "a"
 
 
-def _canon(idx):
+def _mask_to_int_indices(mask_data, x_shape, axis):
+    """Concretize a boolean mask into integer index arrays (numpy semantics:
+    x[mask] == x[np.nonzero(mask)]).  The mask itself carries no gradient, so
+    concretizing it is lossless; routing the result through the integer
+    gather op keeps the *selected values* on the autograd tape (the reference
+    propagates grads through bool-mask selection — eager_method.cc)."""
+    if isinstance(mask_data, jax.core.Tracer):
+        raise TypeError(
+            "boolean-mask indexing has a data-dependent output shape and "
+            "cannot be traced under to_static/jit; use paddle.where or "
+            "masked_select outside the traced region")
+    mask = np.asarray(mask_data)
+    if x_shape is not None:
+        covered = tuple(x_shape[axis:axis + mask.ndim])
+        if mask.shape != covered:
+            raise IndexError(
+                f"boolean mask shape {mask.shape} does not match indexed "
+                f"axes {covered} of array shape {tuple(x_shape)}")
+    nz = np.nonzero(mask)
+    return [jnp.asarray(ix) for ix in nz]
+
+
+def _bool_mask(it):
+    """The mask data if `it` is a non-scalar boolean mask, else None."""
+    if isinstance(it, Tensor) and it.dtype == np.dtype("bool"):
+        data = it._data
+    elif (isinstance(it, (jax.Array, np.ndarray))
+            and np.dtype(it.dtype) == np.dtype("bool")):
+        data = it
+    elif (isinstance(it, (list, tuple))
+            and np.asarray(it).dtype == np.dtype("bool")):
+        data = np.asarray(it)
+    else:
+        return None
+    if np.ndim(data) == 0:
+        return None  # 0-d mask behaves like a scalar bool (new axis)
+    return data
+
+
+def _axes_consumed(idx):
+    """How many axes of x each index element consumes (None/newaxis: 0,
+    bool mask of rank k: k, everything else: 1); Ellipsis resolved later."""
+    counts = []
+    for it in idx:
+        if it is None:
+            counts.append(0)
+        elif it is Ellipsis:
+            counts.append(-1)  # placeholder
+        else:
+            m = _bool_mask(it)
+            counts.append(np.ndim(m) if m is not None else 1)
+    return counts
+
+
+def _canon(idx, x_shape=None):
     """Split an index expr into a hashable static spec + dynamic tensor list."""
     if not isinstance(idx, tuple):
         idx = (idx,)
+    # scalar bool index (adds a size-0/1 axis) → numpy eager path
+    for it in idx:
+        if isinstance(it, (bool, np.bool_)):
+            return None, None
+        if (isinstance(it, (Tensor, jax.Array, np.ndarray))
+                and np.dtype(getattr(it, "dtype", None) or "V0")
+                == np.dtype("bool") and np.ndim(
+                    it._data if isinstance(it, Tensor) else it) == 0):
+            return None, None
+    counts = _axes_consumed(idx)
+    if -1 in counts and x_shape is not None:
+        rest = sum(c for c in counts if c > 0)
+        counts[counts.index(-1)] = max(len(x_shape) - rest, 0)
+    axis = 0
     spec = []
     tensors = []
-    for it in idx:
+    for it, consumed in zip(idx, counts):
+        mask = None if isinstance(it, (bool, np.bool_)) else _bool_mask(it)
+        if mask is not None:
+            data = mask._data if isinstance(mask, Tensor) else mask
+            for ix in _mask_to_int_indices(data, x_shape, axis):
+                spec.append((_TENSOR, len(tensors)))
+                tensors.append(Tensor._wrap(ix))
+            axis += consumed
+            continue
         if isinstance(it, Tensor):
-            if it.dtype == np.dtype("bool"):
-                return None, None  # boolean mask → eager path
             spec.append((_TENSOR, len(tensors)))
             tensors.append(it)
         elif isinstance(it, (jax.Array, np.ndarray)):
-            if np.dtype(it.dtype) == np.dtype("bool"):
-                return None, None
             spec.append((_TENSOR, len(tensors)))
             tensors.append(Tensor._wrap(jnp.asarray(it)))
         elif isinstance(it, slice):
@@ -49,15 +123,11 @@ def _canon(idx):
         elif isinstance(it, (int, np.integer)):
             spec.append((_INT, int(it)))
         elif isinstance(it, (list, tuple)):
-            arr = np.asarray(it)
-            if arr.dtype == np.dtype("bool"):
-                return None, None
             spec.append((_TENSOR, len(tensors)))
-            tensors.append(Tensor._wrap(jnp.asarray(arr)))
-        elif isinstance(it, (bool, np.bool_)):
-            return None, None
+            tensors.append(Tensor._wrap(jnp.asarray(np.asarray(it))))
         else:
             raise TypeError(f"unsupported index type {type(it)}")
+        axis += max(consumed, 0)
     return tuple(spec), tensors
 
 
@@ -89,7 +159,7 @@ def _setitem(x, value, *index_arrays, spec=()):
 
 
 def getitem(x, idx):
-    spec, tensors = _canon(idx)
+    spec, tensors = _canon(idx, x_shape=tuple(x._data.shape))
     if spec is None:
         # boolean mask: eager-only dynamic shape
         mask = idx if not isinstance(idx, tuple) else idx
@@ -99,7 +169,7 @@ def getitem(x, idx):
 
 
 def setitem_(x, idx, value):
-    spec, tensors = _canon(idx)
+    spec, tensors = _canon(idx, x_shape=tuple(x._data.shape))
     if not isinstance(value, Tensor):
         value = Tensor._wrap(jnp.asarray(np.asarray(value), x._data.dtype))
     if value.dtype != x.dtype:
