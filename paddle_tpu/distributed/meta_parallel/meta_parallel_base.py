@@ -65,13 +65,18 @@ class SegmentParallel(MetaParallelBase):
 def wrap_distributed_model(model, hcg, strategy):
     from ..parallel import DataParallel
     from .parallel_layers.pp_layers import PipelineLayer
-    from .pipeline_parallel import PipelineParallel
+    from .pipeline_parallel import (
+        PipelineParallel,
+        PipelineParallelWithInterleave,
+    )
 
     if hcg is None:
         return model
     if hcg.get_pipe_parallel_world_size() > 1 or isinstance(model,
                                                             PipelineLayer):
         if isinstance(model, PipelineLayer):
+            if model.get_num_virtual_stages() > 1:
+                return PipelineParallelWithInterleave(model, hcg, strategy)
             return PipelineParallel(model, hcg, strategy)
     if hcg.get_model_parallel_world_size() > 1:
         return TensorParallel(model, hcg, strategy)

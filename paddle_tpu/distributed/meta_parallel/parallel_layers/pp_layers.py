@@ -165,6 +165,9 @@ class PipelineLayer(Layer):
                     def shared_call(*args, _f=fwd, _l=layer_ref, **kw):
                         return _f(_l, *args, **kw)
 
+                    # let the compiled pp engine find the tied layer's
+                    # params behind the closure (pp_scan._chain_params)
+                    shared_call.__shared_layer__ = layer_ref
                     self.run_function.append(shared_call)
                     built.append(layer)
                 else:

@@ -5,14 +5,14 @@ PipelineParallel.forward_backward_pipeline (1F1B, :440),
 PipelineParallelWithInterleave (VPP, :906), p2p helpers
 (pp_utils/p2p_communication.py:313).
 
-TPU-native redesign: the reference drives 1F1B from host Python with NCCL
-isend/irecv. On the single-controller model all stages live in one XLA
-program, so the *semantics* of pipelined training (microbatch loop + grad
-accumulation) compile into one program per microbatch step; the host schedule
-loop disappears. Stage-parallel placement over a 'pp' mesh axis is expressed
-by sharding the stage-stacked weights (see models/gpt-style stage scan) —
-XLA's latency-hiding scheduler overlaps the inter-stage transfers, playing
-the role of the reference's comm/compute-overlap streams.
+TPU-native redesign: when the topology has a real 'pp' axis the engine
+compiles the whole pipeline into ONE XLA program — stage-stacked block
+weights sharded over 'pp', microbatch schedule as a `lax.scan` whose steps
+rotate activations between stages with `lax.ppermute`, and `jax.grad`
+through the scan as the reverse (1F1B-ordered) schedule. See pp_scan.py.
+With pp degree 1 (or a model with no uniform block stack) it falls back to
+the microbatch grad-accumulation loop, which is numerically GPipe-identical
+but has no stage placement.
 
 train_batch() keeps the reference API: splits the batch into accumulate_steps
 microbatches, accumulates grads, steps the optimizer once.
@@ -30,6 +30,8 @@ __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 
 class PipelineParallel(MetaParallelBase):
+    _num_virtual = 1  # overridden by the interleaved engine
+
     def __init__(self, layers, hcg, strategy):
         super().__init__(layers, hcg, strategy)
         if not isinstance(layers, PipelineLayer):
@@ -46,6 +48,39 @@ class PipelineParallel(MetaParallelBase):
                            if hcg is not None else 1)
         self.stage_id = hcg.get_stage_id() if hcg is not None else 0
         self.total_loss = None
+        self._scan_engine = None
+        self._scan_engine_failed = False
+
+    def _get_scan_engine(self):
+        """Build (once) the compiled stage-scan engine; None if the
+        topology has no pp axis or the model has no uniform block stack."""
+        if self._scan_engine is not None:
+            return self._scan_engine
+        if self._scan_engine_failed or self.num_stages <= 1:
+            return None
+        mesh = getattr(self._hcg, "mesh", None)
+        if mesh is None or "pp" not in mesh.shape:
+            self._scan_engine_failed = True
+            return None
+        from .pp_scan import PipelineScanUnsupported, PipelineStageScan
+
+        try:
+            self._scan_engine = PipelineStageScan(
+                self._layers, mesh, axis="pp",
+                num_micro=self.accumulate_steps,
+                num_virtual=self._num_virtual)
+        except PipelineScanUnsupported as e:
+            # legitimate fallback: no uniform block stack to pipeline.
+            # Config errors (ValueError) propagate — silently dropping the
+            # configured pipeline placement would hide real mistakes.
+            import warnings
+
+            warnings.warn(
+                f"pipeline stage-scan unavailable ({e}); falling back to "
+                "the grad-accumulation engine (no stage placement)")
+            self._scan_engine_failed = True
+            return None
+        return self._scan_engine
 
     def is_pipeline_first_stage(self):
         return self.stage_id == 0
@@ -63,8 +98,16 @@ class PipelineParallel(MetaParallelBase):
         return list(zip(ins, labs))
 
     def forward_backward_pipeline(self, data, scaler=None):
-        """Microbatched fwd+bwd with grad accumulation — numerically identical
-        to 1F1B (same partial order of accumulation); XLA owns the overlap."""
+        """Pipelined fwd+bwd. With a real pp axis: the compiled stage-scan
+        (one XLA program, ppermute handoff — pp_scan.py). Otherwise:
+        microbatch grad accumulation, numerically GPipe-identical."""
+        engine = self._get_scan_engine()
+        if engine is not None:
+            inputs, labels = data
+            scale = float(scaler._scale) if scaler is not None else 1.0
+            self.total_loss = engine.forward_backward(
+                inputs, labels, scale=scale)
+            return self.total_loss
         micro_batches = self._split_micro(data)
         total = None
         for x, y in micro_batches:
@@ -93,24 +136,41 @@ class PipelineParallel(MetaParallelBase):
 
     def eval_batch(self, data, compute_loss=True):
         self._layers.eval()
+        engine = self._get_scan_engine()
+        if engine is not None and compute_loss:
+            inputs, labels = data
+            return engine.eval_loss(inputs, labels)
         micro_batches = self._split_micro(data)
         total = None
         from ...core import state as _state
 
+        outs = []
         with _state.no_grad_guard():
             for x, y in micro_batches:
                 out = self._layers.forward(x)
-                loss = self._layers.loss(out, y) if compute_loss else out
-                total = loss if total is None else total + loss
+                if compute_loss:
+                    loss = self._layers.loss(out, y)
+                    total = loss if total is None else total + loss
+                else:
+                    outs.append(out)
         if compute_loss:
             return total * (1.0 / self.accumulate_steps)
-        return total
+        if len(outs) == 1:
+            return outs[0]
+        from ...ops.manipulation import concat
+
+        return concat(outs, axis=0)
 
     def forward(self, *args, **kwargs):
         return self._layers.forward(*args, **kwargs)
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """VPP (reference :906): virtual stages change placement, not semantics —
-    same engine here."""
-    pass
+    """Interleaved (VPP) schedule, reference :906. Each pp rank holds
+    `num_virtual_pipeline_stages` chunks (virtual stage k on device k % S);
+    the circular rotation in pp_scan.py implements the inter-chunk handoff,
+    shrinking the bubble from (S-1)/M to (S-1)/(M*V) steps."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        self._num_virtual = layers.get_num_virtual_stages()

@@ -1,0 +1,236 @@
+"""Compiled pipeline-parallel engine tests (stage-scan + ppermute over the
+'pp' mesh axis). Reference behaviors being matched:
+fleet/meta_parallel/pipeline_parallel.py:440 (1F1B) and :906 (interleave).
+
+Run on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+)
+from paddle_tpu.distributed.meta_parallel.pp_scan import PipelineStageScan
+
+
+class Block(nn.Layer):
+    def __init__(self, h):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x):
+        return x + paddle.tanh(self.fc(x))
+
+
+H = 16
+
+
+def make_descs(n_blocks=4):
+    return ([LayerDesc(nn.Linear, 8, H)]
+            + [LayerDesc(Block, H) for _ in range(n_blocks)]
+            + [LayerDesc(nn.Linear, H, 4)])
+
+
+def copy_params(src, dst):
+    for (_, p1), (_, p2) in zip(src.named_parameters(),
+                                dst.named_parameters()):
+        p2._rebind(p1._data)
+
+
+def eager_reference(pl, X, Y):
+    """Straight-through loss + grads with the same weights."""
+    ref = PipelineLayer(layers=make_descs(), num_stages=1,
+                        loss_fn=nn.CrossEntropyLoss())
+    copy_params(pl, ref)
+    loss = ref.loss(ref.forward(X), Y)
+    loss.backward()
+    return ref, loss
+
+
+def make_mesh(pp, rest):
+    import jax
+
+    return jax.make_mesh(
+        (pp, rest), ("pp", "dp"),
+        axis_types=(jax.sharding.AxisType.Auto,) * 2)
+
+
+def data():
+    X = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+    Y = paddle.to_tensor(np.random.randint(0, 4, (8,)).astype("int64"))
+    return X, Y
+
+
+class TestStageScan:
+    def test_loss_and_grad_parity_vs_single_stage(self):
+        paddle.seed(7)
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        eng = PipelineStageScan(pl, make_mesh(2, 4), axis="pp", num_micro=4)
+        X, Y = data()
+        loss = eng.forward_backward(X, Y)
+        ref, ref_loss = eager_reference(pl, X, Y)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(ref_loss.numpy()), rtol=1e-5)
+        for (n, p1), (_, p2) in zip(pl.named_parameters(),
+                                    ref.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p1.grad._data), np.asarray(p2.grad._data),
+                rtol=1e-4, atol=1e-5, err_msg=n)
+
+    def test_per_stage_parameter_placement(self):
+        """Each block's weights live ONLY on its pp rank's devices."""
+        paddle.seed(7)
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        eng = PipelineStageScan(pl, make_mesh(2, 4), axis="pp", num_micro=4)
+        place = eng.stage_placement()
+        # S=2, 4 blocks: blocks 0,1 -> stage 0; blocks 2,3 -> stage 1
+        assert place[0] == place[1]
+        assert place[2] == place[3]
+        assert place[0].isdisjoint(place[2])
+        assert len(place[0]) == 4 and len(place[2]) == 4
+
+    def test_four_stage_pipeline(self):
+        paddle.seed(8)
+        pl = PipelineLayer(layers=make_descs(), num_stages=4,
+                           loss_fn=nn.CrossEntropyLoss())
+        eng = PipelineStageScan(pl, make_mesh(4, 2), axis="pp", num_micro=8)
+        X, Y = data()
+        loss = eng.forward_backward(X, Y)
+        ref, ref_loss = eager_reference(pl, X, Y)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(ref_loss.numpy()), rtol=1e-5)
+        place = eng.stage_placement()
+        assert all(place[i].isdisjoint(place[j])
+                   for i in range(4) for j in range(4) if i != j)
+
+    def test_interleaved_vpp_parity_and_placement(self):
+        paddle.seed(9)
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss(),
+                           num_virtual_pipeline_stages=2)
+        eng = PipelineStageScan(pl, make_mesh(2, 4), axis="pp",
+                                num_micro=4, num_virtual=2)
+        X, Y = data()
+        loss = eng.forward_backward(X, Y)
+        ref, ref_loss = eager_reference(pl, X, Y)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(ref_loss.numpy()), rtol=1e-5)
+        for (n, p1), (_, p2) in zip(pl.named_parameters(),
+                                    ref.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p1.grad._data), np.asarray(p2.grad._data),
+                rtol=1e-4, atol=1e-5, err_msg=n)
+        # circular placement: virtual stage k on device k % S —
+        # blocks 0,2 together, 1,3 together, disjoint
+        place = eng.stage_placement()
+        assert place[0] == place[2]
+        assert place[1] == place[3]
+        assert place[0].isdisjoint(place[1])
+
+    def test_shared_layer_desc_tied_embeddings(self):
+        """SharedLayerDesc tied weights: grads from both uses accumulate
+        into the same Tensor (reference pp_layers.py:76 + the shared-
+        embedding allreduce in pipeline_parallel.py)."""
+        from paddle_tpu.distributed.meta_parallel import SharedLayerDesc
+
+        paddle.seed(13)
+        V_SZ = 12
+
+        def head_fwd(layer, x):
+            return paddle.matmul(x, layer.weight, transpose_y=True)
+
+        def make_tied_descs():
+            return ([SharedLayerDesc("emb", nn.Embedding, None, "weight",
+                                     V_SZ, H)]
+                    + [LayerDesc(Block, H) for _ in range(4)]
+                    + [SharedLayerDesc("emb", nn.Embedding, head_fwd,
+                                       "weight", V_SZ, H)])
+
+        pl = PipelineLayer(layers=make_tied_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        eng = PipelineStageScan(pl, make_mesh(2, 4), axis="pp", num_micro=2)
+        X = paddle.to_tensor(np.random.randint(0, V_SZ, (4, 6)).astype("int64"))
+        Y = paddle.to_tensor(np.random.randint(0, V_SZ, (4, 6)).astype("int64"))
+        loss = eng.forward_backward(X, Y)
+
+        ref = PipelineLayer(layers=make_tied_descs(), num_stages=1,
+                            loss_fn=nn.CrossEntropyLoss())
+        copy_params(pl, ref)
+        ref_loss = ref.loss(ref.forward(X), Y)
+        ref_loss.backward()
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(ref_loss.numpy()), rtol=1e-5)
+        emb_g = pl.shared_layers["emb"].weight.grad
+        ref_g = ref.shared_layers["emb"].weight.grad
+        assert emb_g is not None
+        np.testing.assert_allclose(np.asarray(emb_g._data),
+                                   np.asarray(ref_g._data),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_microbatch_not_divisible_raises(self):
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        with pytest.raises(ValueError):
+            PipelineStageScan(pl, make_mesh(2, 4), axis="pp",
+                              num_micro=3, num_virtual=2)
+
+
+class TestFleetPipelineIntegration:
+    @pytest.fixture(scope="class")
+    def pp_hcg(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            **strategy.hybrid_configs,
+            "dp_degree": 2, "mp_degree": 1, "pp_degree": 2,
+            "sharding_degree": 2, "sep_degree": 1,
+        }
+        fleet.init(is_collective=True, strategy=strategy)
+        return fleet.get_hybrid_communicate_group()
+
+    def test_train_batch_uses_scan_engine_and_learns(self, pp_hcg):
+        paddle.seed(11)
+        strategy = fleet.DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 4,
+                                     "micro_batch_size": 2}
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        model = fleet.distributed_model(pl)
+        assert isinstance(model, PipelineParallel)
+        engine = PipelineParallel(pl, pp_hcg, strategy)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=pl.parameters())
+        X, Y = data()
+        l0 = engine.train_batch([X, Y], opt)
+        assert engine._scan_engine is not None, "compiled engine not used"
+        for _ in range(15):
+            loss = engine.train_batch([X, Y], opt)
+        assert float(loss.item()) < float(l0.item())
+
+    def test_interleave_wrapper_selected(self, pp_hcg):
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss(),
+                           num_virtual_pipeline_stages=2)
+        model = fleet.distributed_model(pl)
+        assert isinstance(model, PipelineParallelWithInterleave)
+
+    def test_eval_batch_matches_eager(self, pp_hcg):
+        paddle.seed(12)
+        strategy = fleet.DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 4,
+                                     "micro_batch_size": 2}
+        pl = PipelineLayer(layers=make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        engine = PipelineParallel(pl, pp_hcg, strategy)
+        X, Y = data()
+        ev = engine.eval_batch([X, Y])
+        ref = pl.loss(pl.forward(X), Y)
+        np.testing.assert_allclose(float(ev.numpy()), float(ref.numpy()),
+                                   rtol=1e-5)
