@@ -1,26 +1,69 @@
 """Benchmark entry — prints ONE JSON line.
 
 Workload: Llama-125M-class causal-LM training step (BASELINE.md configs 2/5
-scaled to one chip): bf16 params, seq 1024, full fwd+bwd+AdamW through the
-public API (paddle.jit.to_static + paddle.optimizer.AdamW).
-Metric: steady-state training tokens/sec on the default backend.
+scaled to one chip): bf16 params, seq 1024, full fused fwd+bwd+AdamW in a
+single donated XLA executable (paddle.incubate.fused_train_step — the
+framework's perf path; the reference's analog is its fused CUDA optimizer +
+multi-stream executor).
+
+Metrics: steady-state training tokens/sec AND model-FLOPs-utilisation
+(MFU = model TFLOPs / chip peak bf16 TFLOPs; FLOPs/token = 6N + 12*L*h*s,
+the PaLM-appendix accounting).
+
 vs_baseline: the reference publishes no in-tree numbers (BASELINE.md —
-"published": {}); reported vs the run's own first-epoch warmup? No — fixed at
-1.0 until a reference measurement exists.
+"published": {}), so vs_baseline is measured against this framework's own
+round-1 result (78,701.7 tokens/s, BENCH_r01.json) — an honest
+self-referential trend, not a fabricated reference ratio.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
+ROUND1_TOKENS_PER_SEC = 78701.7
+
+# peak dense bf16 TFLOP/s per chip by generation
+_PEAK_BF16 = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,  # v6e / Trillium
+    "v6e": 918e12,
+}
+
+
+def _chip_peak_flops():
+    """Best-effort peak bf16 FLOP/s of the current chip (None if unknown)."""
+    kind = ""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        pass
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key in sorted(_PEAK_BF16, key=len, reverse=True):
+        if key in kind or key == gen:
+            return _PEAK_BF16[key]
+    return None
+
+
+def _train_flops_per_token(cfg, n_params, seq):
+    """PaLM-appendix accounting: 6*N (fwd+bwd matmuls) plus attention
+    score/value FLOPs 12*L*h*s per token."""
+    return 6.0 * n_params + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
+
 
 def main():
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
-    from paddle_tpu import jit
     from paddle_tpu.models import LlamaForCausalLM, llama_125m
 
     paddle.seed(0)
@@ -36,48 +79,77 @@ def main():
 
     if on_tpu:
         cfg = llama_125m()
-        bs, seq, steps, warmup = 8, 1024, 20, 3
+        seq, steps, warmup = 1024, 15, 3
+        batch_sizes = [8, 16, 32]
     else:  # CI / CPU smoke sizing
         from paddle_tpu.models import llama_tiny
 
         cfg = llama_tiny()
-        bs, seq, steps, warmup = 2, 64, 5, 1
+        seq, steps, warmup = 64, 4, 1
+        batch_sizes = [2]
 
-    model = LlamaForCausalLM(cfg)
-    model.bfloat16()
-    model = jit.to_static(model)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+    def loss_of(out):
+        return out[0] if isinstance(out, (tuple, list)) else out
 
-    ids = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
-    labels = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+    def build_step():
+        model = LlamaForCausalLM(cfg)
+        model.bfloat16()
+        model.train()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        n = sum(int(np.prod(p.shape)) for p in model.parameters())
+        return paddle.incubate.fused_train_step(model, opt,
+                                                loss_fn=loss_of), n
 
-    def step():
-        loss, _ = model(ids, labels)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    step, n_params = build_step()
 
-    for _ in range(warmup):
-        loss = step()
-    float(loss.item())  # sync
+    def measure(bs, n_steps, n_warmup):
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+        labels = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+        for _ in range(n_warmup):
+            loss = step(ids, labels)
+        float(loss.numpy())  # sync
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            loss = step(ids, labels)
+        float(loss.numpy())  # sync
+        dt = time.perf_counter() - t0
+        return bs * seq * n_steps / dt
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step()
-    float(loss.item())  # sync
-    dt = time.perf_counter() - t0
+    # batch-size sweep (short), then steady-state at the winner
+    best_bs, best_tps = batch_sizes[0], 0.0
+    for bs in batch_sizes:
+        try:
+            tps = measure(bs, max(steps // 3, 2), warmup)
+        except Exception:
+            # OOM at this size — a failed donated step invalidates the
+            # param buffers, so rebuild before the steady-state measure
+            step, n_params = build_step()
+            break
+        if tps > best_tps:
+            best_bs, best_tps = bs, tps
+    tokens_per_sec = measure(best_bs, steps, 1)
 
-    tokens_per_sec = bs * seq * steps / dt
+    flops_per_token = _train_flops_per_token(cfg, n_params, seq)
+    achieved = tokens_per_sec * flops_per_token
+    peak = _chip_peak_flops()
+    mfu = round(achieved / peak, 4) if peak else None
+
     print(json.dumps({
         "metric": "llama125m_train_tokens_per_sec" if on_tpu
                   else "llama_tiny_cpu_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(tokens_per_sec / ROUND1_TOKENS_PER_SEC, 3)
+                       if on_tpu else 1.0,
+        "mfu": mfu,
+        "model_tflops_per_sec": round(achieved / 1e12, 1),
+        "batch_size": best_bs,
+        "seq_len": seq,
+        "baseline_note": "vs_baseline is vs round-1 self-measurement "
+                         "(78701.7 tok/s); reference publishes no numbers",
     }))
 
 

@@ -40,6 +40,7 @@ from .regularizer import L1Decay, L2Decay  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import framework  # noqa: F401
+from . import incubate  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
 

@@ -104,7 +104,8 @@ class PipelineParallel(MetaParallelBase):
         engine = self._get_scan_engine()
         if engine is not None:
             inputs, labels = data
-            scale = float(scaler._scale) if scaler is not None else 1.0
+            scale = (float(scaler._scale)
+                     if scaler is not None and scaler._enable else 1.0)
             self.total_loss = engine.forward_backward(
                 inputs, labels, scale=scale)
             return self.total_loss

@@ -240,7 +240,8 @@ class PipelineStageScan:
 
     def write_grads(self, pro_g, stacked_g, epi_g, scale=1.0):
         def add_grad(t, g):
-            g = jnp.asarray(g, t._data.dtype) * scale
+            # scale in f32 first — scaling after the cast overflows fp16
+            g = (jnp.asarray(g, jnp.float32) * scale).astype(t._data.dtype)
             if t.grad is None:
                 t.grad = Tensor._wrap(g)
             else:

@@ -1,0 +1,10 @@
+"""paddle.incubate — experimental APIs.
+
+Reference: python/paddle/incubate/ (MoE layers, autotune, fused ops,
+DistributedFusedLamb). TPU-native contents: the fused single-dispatch
+train step and (distributed) the sparse all-to-all MoE layer.
+"""
+
+from .fused_train_step import FusedTrainStep, fused_train_step  # noqa: F401
+
+__all__ = ["FusedTrainStep", "fused_train_step"]

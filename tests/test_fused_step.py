@@ -1,0 +1,158 @@
+"""paddle.incubate.fused_train_step: single-dispatch donated train step
+must match the eager 3-dispatch step (forward / backward / optimizer)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def make_model():
+    paddle.seed(42)
+    return nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+
+
+def make_data():
+    X = np.random.randn(16, 8).astype("float32")
+    Y = np.random.randint(0, 4, (16,)).astype("int64")
+    return X, Y
+
+
+class WithLoss(nn.Layer):
+    def __init__(self, body):
+        super().__init__()
+        self.body = body
+        self.ce = nn.CrossEntropyLoss()
+
+    def forward(self, x, y):
+        return self.ce(self.body(x), y)
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Momentum", "Adam", "AdamW"])
+def test_fused_matches_eager(opt_name):
+    X, Y = make_data()
+
+    def build(model):
+        cls = getattr(paddle.optimizer, opt_name)
+        kwargs = {"learning_rate": 0.05,
+                  "parameters": model.parameters()}
+        return cls(**kwargs)
+
+    # eager reference
+    eager = WithLoss(make_model())
+    opt_e = build(eager)
+    for _ in range(5):
+        loss_e = eager(paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss_e.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    # fused
+    fused = WithLoss(make_model())
+    opt_f = build(fused)
+    step = paddle.incubate.fused_train_step(fused, opt_f)
+    for _ in range(5):
+        loss_f = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+    np.testing.assert_allclose(float(loss_f.numpy()), float(loss_e.numpy()),
+                               rtol=1e-4)
+    for (n, pe), (_, pf) in zip(eager.named_parameters(),
+                                fused.named_parameters()):
+        np.testing.assert_allclose(np.asarray(pe._data),
+                                   np.asarray(pf._data),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_fused_with_global_norm_clip():
+    X, Y = make_data()
+    clip = paddle.nn.ClipGradByGlobalNorm(0.1)
+
+    eager = WithLoss(make_model())
+    opt_e = paddle.optimizer.AdamW(learning_rate=0.05,
+                                   parameters=eager.parameters(),
+                                   grad_clip=clip)
+    for _ in range(3):
+        loss_e = eager(paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss_e.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    fused = WithLoss(make_model())
+    opt_f = paddle.optimizer.AdamW(learning_rate=0.05,
+                                   parameters=fused.parameters(),
+                                   grad_clip=clip)
+    step = paddle.incubate.fused_train_step(fused, opt_f)
+    for _ in range(3):
+        loss_f = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+    for (n, pe), (_, pf) in zip(eager.named_parameters(),
+                                fused.named_parameters()):
+        np.testing.assert_allclose(np.asarray(pe._data),
+                                   np.asarray(pf._data),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_fused_adamw_apply_decay_param_fun():
+    """no-decay-on-bias parity with the eager optimizer."""
+    X, Y = make_data()
+    fun = lambda name: "bias" not in name  # noqa: E731
+
+    eager = WithLoss(make_model())
+    opt_e = paddle.optimizer.AdamW(learning_rate=0.05, weight_decay=0.5,
+                                   parameters=eager.parameters(),
+                                   apply_decay_param_fun=fun)
+    for _ in range(3):
+        loss = eager(paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    fused = WithLoss(make_model())
+    opt_f = paddle.optimizer.AdamW(learning_rate=0.05, weight_decay=0.5,
+                                   parameters=fused.parameters(),
+                                   apply_decay_param_fun=fun)
+    step = paddle.incubate.fused_train_step(fused, opt_f)
+    for _ in range(3):
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+    for (n, pe), (_, pf) in zip(eager.named_parameters(),
+                                fused.named_parameters()):
+        np.testing.assert_allclose(np.asarray(pe._data),
+                                   np.asarray(pf._data),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_fused_rejects_unsupported_clip():
+    model = WithLoss(make_model())
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.05, parameters=model.parameters(),
+        grad_clip=paddle.nn.ClipGradByValue(1.0))
+    with pytest.raises(TypeError):
+        paddle.incubate.fused_train_step(model, opt)
+
+
+def test_fused_with_lr_scheduler():
+    X, Y = make_data()
+    fused = WithLoss(make_model())
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=fused.parameters())
+    step = paddle.incubate.fused_train_step(fused, opt)
+    for _ in range(2):
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+    assert sched.get_lr() == pytest.approx(0.05)
+
+
+def test_fused_learns_bf16():
+    X, Y = make_data()
+    model = WithLoss(make_model())
+    model.body.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=model.parameters())
+    step = paddle.incubate.fused_train_step(model, opt)
+    l0 = float(step(paddle.to_tensor(X), paddle.to_tensor(Y)).numpy())
+    for _ in range(30):
+        loss = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+    assert float(loss.numpy()) < l0
