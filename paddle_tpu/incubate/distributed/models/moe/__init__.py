@@ -1,0 +1,10 @@
+from .moe_layer import (  # noqa: F401
+    MoELayer,
+    combine_from_experts,
+    dispatch_to_experts,
+    moe_capacity,
+    top_k_capacity_gating,
+)
+
+__all__ = ["MoELayer", "combine_from_experts", "dispatch_to_experts",
+           "moe_capacity", "top_k_capacity_gating"]

@@ -1,0 +1,231 @@
+"""Sparse Mixture-of-Experts with capacity-based top-k dispatch.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py —
+MoELayer (:119) dispatching tokens to experts across the expert-parallel
+group with the global_scatter/global_gather all-to-all ops
+(paddle/fluid/operators/collective/global_scatter_op.*, :119-190).
+
+TPU-native redesign: the reference's dynamic per-rank token counts
+(global_scatter carries local_count/global_count) cannot compile under
+XLA's static shapes, so dispatch is GShard-style **capacity-based**: each
+expert processes at most C = ceil(top_k * T / E * capacity_factor) tokens
+per shard, encoded as one-hot dispatch/combine tensors. Per-token FLOPs
+are top_k * expert_FLOPs — independent of num_experts (the round-1
+dense-dispatch form computed every expert on every token).
+
+Two execution paths share the gate math:
+- expert-parallel: `shard_map` over the 'ep' mesh axis with TWO
+  `lax.all_to_all` collectives (the global_scatter / global_gather
+  equivalents) moving expert batches between ranks; expert weights are
+  stacked [E, ...] and split over 'ep'.
+- single-shard / GSPMD: the same dispatch expressed as einsums; under
+  pjit the expert dim shards over 'ep' and GSPMD inserts the all-to-alls.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .....core.dispatch import op
+from .....core.tensor import Tensor
+from .....nn.layer.layers import Layer
+from .....utils import functional_call, params_dict
+
+__all__ = ["MoELayer", "top_k_capacity_gating", "moe_capacity"]
+
+
+def top_k_capacity_gating(probs, top_k, capacity):
+    """GShard gating, scatter form. Returns
+    (expert_idx [T,k], slot_idx [T,k], keep [T,k], weights [T,k], aux).
+
+    Token t's kk-th choice goes to slot ``slot_idx[t,kk]`` of expert
+    ``expert_idx[t,kk]``; ``keep`` is False for choices beyond the
+    expert's capacity (dropped — standard GShard semantics; the
+    reference's global_scatter instead grows buffers dynamically).
+    ``weights`` are the renormalised top-k router probabilities. ``aux``
+    is the load-balancing loss E * sum(me * ce) (Switch/GShard).
+
+    Memory is O(T*E) (the per-round one-hot), NOT O(T*E*C): dispatch and
+    combine are done by scatter-add / gather on flat slot indices, so no
+    [T, E, C] tensor is ever materialised.
+    """
+    T, E = probs.shape
+    C = int(capacity)
+    topv, topi = jax.lax.top_k(probs, top_k)  # [T, k]
+    topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+
+    counts = jnp.zeros((E,), jnp.int32)
+    slots = []
+    keeps = []
+    for kk in range(top_k):
+        oh = jax.nn.one_hot(topi[:, kk], E, dtype=jnp.int32)  # [T, E]
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]
+        slot_k = jnp.take_along_axis(pos, topi[:, kk:kk + 1], axis=1)[:, 0]
+        keeps.append(slot_k < C)
+        slots.append(jnp.clip(slot_k, 0, C - 1))
+        counts = counts + jnp.sum(oh, axis=0)
+    slot_idx = jnp.stack(slots, axis=1)
+    keep = jnp.stack(keeps, axis=1)
+
+    # load-balance aux: fraction of tokens routed (top-1) vs mean prob
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(
+        jax.nn.one_hot(topi[:, 0], E, dtype=probs.dtype), axis=0)
+    aux = E * jnp.sum(me * ce)
+    return topi, slot_idx, keep, topv, aux
+
+
+def dispatch_to_experts(x, expert_idx, slot_idx, keep, num_experts,
+                        capacity):
+    """Scatter tokens into their expert slots: [T,h] -> [E,C,h]."""
+    T, h = x.shape
+    k = expert_idx.shape[1]
+    flat = expert_idx * capacity + slot_idx  # [T, k]
+    flat = jnp.where(keep, flat, num_experts * capacity)  # overflow row
+    buf = jnp.zeros((num_experts * capacity + 1, h), x.dtype)
+    xk = jnp.broadcast_to(x[:, None, :], (T, k, h)).reshape(T * k, h)
+    buf = buf.at[flat.reshape(-1)].add(xk)
+    return buf[:-1].reshape(num_experts, capacity, h)
+
+
+def combine_from_experts(expert_out, expert_idx, slot_idx, keep, weights):
+    """Gather expert outputs back to tokens: [E,C,h] -> [T,h]."""
+    E, C, h = expert_out.shape
+    T, k = expert_idx.shape
+    flat = expert_idx * C + slot_idx
+    gathered = expert_out.reshape(E * C, h)[flat.reshape(-1)]
+    gathered = gathered.reshape(T, k, h)
+    w = (weights * keep.astype(weights.dtype)).astype(expert_out.dtype)
+    return jnp.einsum("tkh,tk->th", gathered, w)
+
+
+def moe_capacity(num_tokens, num_experts, top_k, factor):
+    return max(int(math.ceil(top_k * num_tokens / num_experts * factor)), 1)
+
+
+def _expert_apply(template, names, stacked_leaves, expert_in):
+    """vmap the template expert over the (local) expert dim."""
+
+    def one(leaves, xs):
+        return functional_call(template, dict(zip(names, leaves)), xs)
+
+    return jax.vmap(one)(stacked_leaves, expert_in)
+
+
+@op("moe_sparse_dispatch")
+def _moe_sparse_op(x, logits, *stacked_leaves, names=(), top_k=2,
+                   capacity_factor=1.25, ep_axis=None, mesh=None,
+                   template=None):
+    """x: [T, h]; logits: [T, E]; stacked_leaves: expert params stacked on
+    a leading [E] dim. Returns (out [T, h], aux scalar)."""
+    num_experts = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    if ep_axis is None or mesh is None or mesh.shape.get(ep_axis, 1) == 1:
+        C = moe_capacity(x.shape[0], num_experts, top_k, capacity_factor)
+        ei, si, keep, w, aux = top_k_capacity_gating(probs, top_k, C)
+        expert_in = dispatch_to_experts(x, ei, si, keep, num_experts, C)
+        expert_out = _expert_apply(template, names, stacked_leaves,
+                                   expert_in)
+        out = combine_from_experts(expert_out, ei, si, keep, w)
+        return out, aux
+
+    n = mesh.shape[ep_axis]
+    assert num_experts % n == 0, (num_experts, n)
+    T = x.shape[0]
+    assert T % n == 0, f"token count {T} not divisible by ep degree {n}"
+    C = moe_capacity(T // n, num_experts, top_k, capacity_factor)
+
+    def local(x_l, logits_l, *leaves_l):
+        # x_l: [T/n, h] this rank's tokens; leaves_l: [E/n, ...] its experts
+        probs_l = jax.nn.softmax(logits_l.astype(jnp.float32), axis=-1)
+        ei, si, keep, w, aux = top_k_capacity_gating(probs_l, top_k, C)
+        expert_in = dispatch_to_experts(x_l, ei, si, keep, num_experts, C)
+        # global_scatter equivalent: exchange expert batches so each rank
+        # holds ALL ranks' tokens for ITS experts
+        expert_in = jax.lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True)
+        expert_out = _expert_apply(template, names, leaves_l, expert_in)
+        # global_gather equivalent: send results back to token owners
+        expert_out = jax.lax.all_to_all(
+            expert_out, ep_axis, split_axis=1, concat_axis=0, tiled=True)
+        out = combine_from_experts(expert_out, ei, si, keep, w)
+        return out, jax.lax.pmean(aux, ep_axis)
+
+    shmap = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(ep_axis), P(ep_axis))
+        + tuple(P(ep_axis) for _ in stacked_leaves),
+        out_specs=(P(ep_axis), P()),
+        axis_names={ep_axis},
+        check_vma=False)
+    return shmap(x, logits, *stacked_leaves)
+
+
+class MoELayer(Layer):
+    """Reference-parity MoELayer (moe_layer.py:119). `experts` is a list
+    of structurally identical Layers (one per expert, reference-style);
+    forward stacks their params on a leading expert dim (a taped `stack`,
+    so eager autograd reaches every expert) and — when `moe_group`
+    carries a mesh axis — executes expert-parallel via shard_map +
+    all_to_all.
+
+    Usage::
+
+        experts = [ExpertMLP(d) for _ in range(E)]
+        moe = MoELayer(d_model, experts, gate=nn.Linear(d, E),
+                       moe_group=group_with_ep_axis, top_k=2)
+        y = moe(x)                       # [B, S, d] or [T, d]
+        loss = task_loss + 0.01 * moe.l_aux
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=2,
+                 capacity_factor=1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = list(experts)
+        self.num_experts = len(self.experts)
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = gate
+        self.l_aux = None
+
+        self._axis = getattr(moe_group, "axis_name", None)
+        self._mesh = getattr(moe_group, "mesh", None)
+
+        assert all(isinstance(e, Layer) for e in self.experts)
+        names = sorted(params_dict(self.experts[0]))
+        for e in self.experts[1:]:
+            assert sorted(params_dict(e)) == names, \
+                "experts must be structurally identical"
+        self._names = tuple(names)
+        for i, e in enumerate(self.experts):
+            self.add_sublayer(f"expert_{i}", e)
+        if gate is not None:
+            self.add_sublayer("gate_layer", gate)
+
+    def forward(self, x):
+        from ..... import ops as _ops
+
+        shape = x.shape
+        flat = x.reshape([-1, shape[-1]])
+        if self.gate is not None:
+            logits = self.gate(flat)
+        else:
+            raise ValueError("MoELayer needs a gate layer")
+        per_expert = [dict(e.named_parameters()) for e in self.experts]
+        stacked = [
+            _ops.manipulation.stack([pe[n] for pe in per_expert], axis=0)
+            for n in self._names
+        ]
+        out, aux = _moe_sparse_op(
+            flat, logits, *stacked, names=self._names, top_k=self.top_k,
+            capacity_factor=self.capacity_factor, ep_axis=self._axis,
+            mesh=self._mesh, template=self.experts[0])
+        self.l_aux = aux
+        return out.reshape(shape)

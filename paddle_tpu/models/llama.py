@@ -51,6 +51,8 @@ class LlamaConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_every: int = 2  # every Nth layer is MoE when num_experts > 0
+    moe_capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self):
@@ -136,38 +138,55 @@ class LlamaMLP(Layer):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 
-@_op("moe_dense_topk")
-def _moe_dense_topk(x, logits, gate_w, up_w, down_w, top_k=2):
-    """Token-choice top-k MoE, dense-dispatch form: every expert computes all
-    tokens with per-token weights. Under GSPMD the expert dim shards over the
-    'ep' mesh axis and XLA turns the weighted combine into the all_to_all the
-    reference implements by hand (global_scatter/global_gather ops)."""
+@_op("moe_topk_capacity")
+def _moe_topk_capacity(x, logits, gate_w, up_w, down_w, top_k=2,
+                       capacity_factor=1.25):
+    """Token-choice top-k MoE, GShard capacity-based dispatch: each expert
+    computes at most C = ceil(k*T/E * factor) tokens, so per-token FLOPs
+    are k * expert_FLOPs, independent of num_experts (the reference's
+    global_scatter/global_gather semantics under static shapes). Dispatch/
+    combine are scatter-add/gather on flat slot indices (O(T) memory).
+    Under GSPMD the expert dim shards over the 'ep' mesh axis and XLA
+    inserts the all_to_all the reference's collective ops implement by
+    hand. Returns (out, aux) — aux is the load-balance loss."""
     import jax
     import jax.numpy as jnp
 
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    vals, idx = jax.lax.top_k(probs, top_k)  # [B,S,K]
-    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    from ..incubate.distributed.models.moe.moe_layer import (
+        combine_from_experts, dispatch_to_experts, moe_capacity,
+        top_k_capacity_gating)
+
+    b, s, h = x.shape
     e = gate_w.shape[0]
-    onehot = jax.nn.one_hot(idx, e, dtype=x.dtype)  # [B,S,K,E]
-    weights = jnp.einsum("bske,bsk->bse", onehot, vals.astype(x.dtype))
-    hidden = jnp.einsum("bsh,ehi->ebsi", x, gate_w)
-    hidden = jax.nn.silu(hidden) * jnp.einsum("bsh,ehi->ebsi", x, up_w)
-    out = jnp.einsum("ebsi,eih->ebsh", hidden, down_w)
-    return jnp.einsum("ebsh,bse->bsh", out, weights)
+    xf = x.reshape(b * s, h)
+    probs = jax.nn.softmax(
+        logits.reshape(b * s, e).astype(jnp.float32), axis=-1)
+    cap = moe_capacity(b * s, e, top_k, capacity_factor)
+    ei, si, keep, w, aux = top_k_capacity_gating(probs, top_k, cap)
+    expert_in = dispatch_to_experts(xf, ei, si, keep, e, cap)
+    hidden = jnp.einsum("ech,ehi->eci", expert_in, gate_w)
+    hidden = jax.nn.silu(hidden) * jnp.einsum("ech,ehi->eci", expert_in,
+                                              up_w)
+    expert_out = jnp.einsum("eci,eih->ech", hidden, down_w)
+    out = combine_from_experts(expert_out, ei, si, keep, w)
+    return out.reshape(b, s, h), aux
 
 
 class LlamaMoE(Layer):
     """Mixtral-style token-choice MoE (reference analog:
     incubate/distributed/models/moe/moe_layer.py via global_scatter/gather;
-    TPU-native: dense einsum over experts — under GSPMD the expert dimension
-    shards over the 'ep' mesh axis and XLA inserts the all_to_all)."""
+    TPU-native: GShard capacity-based dispatch — under GSPMD the expert
+    dimension shards over the 'ep' mesh axis and XLA inserts the
+    all_to_all; see incubate.distributed.models.moe for the explicit
+    shard_map form)."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
         c = config
         self.num_experts = c.num_experts
         self.top_k = c.num_experts_per_tok
+        self.capacity_factor = c.moe_capacity_factor
+        self.l_aux = None
         init = Normal(0.0, 0.02)
         self.router = Linear(c.hidden_size, c.num_experts, weight_attr=init,
                              bias_attr=False)
@@ -178,8 +197,10 @@ class LlamaMoE(Layer):
 
     def forward(self, x):
         logits = self.router(x)
-        return _moe_dense_topk(x, logits, self.gate_w, self.up_w, self.down_w,
-                               top_k=self.top_k)
+        out, self.l_aux = _moe_topk_capacity(
+            x, logits, self.gate_w, self.up_w, self.down_w,
+            top_k=self.top_k, capacity_factor=self.capacity_factor)
+        return out
 
 
 class LlamaDecoderLayer(Layer):
@@ -262,6 +283,14 @@ class LlamaForCausalLM(Layer):
             loss = F.cross_entropy(
                 M.reshape(logits, [-1, self.config.vocab_size]),
                 M.reshape(labels, [-1]))
+            if self.config.num_experts > 0:
+                # router load-balancing term (Switch/GShard); without it
+                # capacity dispatch lets the router collapse and drop tokens
+                coef = self.config.router_aux_loss_coef
+                for layer in self.llama.layers:
+                    aux = getattr(layer.mlp, "l_aux", None)
+                    if aux is not None and coef > 0:
+                        loss = loss + coef * aux
             return loss, logits
         return logits
 
