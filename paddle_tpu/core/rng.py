@@ -23,16 +23,27 @@ import numpy as np
 
 
 class Generator:
+    """Key creation is LAZY (first use, not __init__): building a PRNG key
+    initializes the XLA backend, and the module-level DEFAULT_GENERATOR
+    must not do that at import time — jax.distributed.initialize() has to
+    run first in multi-process jobs (launch/bootstrap.py)."""
+
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._base = jax.random.key(self._seed)
+        self._base_cache = None
         self._counter = 0
         # When tracing, a traced key injected by jit/to_static machinery.
         self._traced_base = None
 
+    @property
+    def _base(self):
+        if self._base_cache is None:
+            self._base_cache = jax.random.key(self._seed)
+        return self._base_cache
+
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._base = jax.random.key(self._seed)
+        self._base_cache = None
         self._counter = 0
         return self
 
@@ -51,7 +62,7 @@ class Generator:
 
     def set_state(self, st):
         self._seed = int(st["seed"])
-        self._base = jax.random.key(self._seed)
+        self._base_cache = None
         self._counter = int(st["counter"])
 
     @contextlib.contextmanager

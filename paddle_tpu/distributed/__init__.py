@@ -38,12 +38,13 @@ def init_parallel_env():
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if coord and nprocs > 1:
-        port = os.environ.get("MASTER_PORT", "8471")
-        jax.distributed.initialize(
-            coordinator_address=f"{coord}:{port}",
-            num_processes=nprocs,
-            process_id=pid,
-        )
+        if not jax.distributed.is_initialized():  # bootstrap.py may have
+            port = os.environ.get("MASTER_PORT", "8471")
+            jax.distributed.initialize(
+                coordinator_address=f"{coord}:{port}",
+                num_processes=nprocs,
+                process_id=pid,
+            )
     _initialized = True
     return ParallelEnv()
 
@@ -111,14 +112,89 @@ sys.modules[__name__ + ".fleet.meta_parallel"] = _meta_parallel
 fleet.meta_parallel = _meta_parallel
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """Reference: distributed/spawn.py. Single-controller JAX: the launcher
-    owns multi-process bring-up; in-process we just call func (world of 1
-    per-process semantics are preserved by the collective layer)."""
+def _spawn_worker(func, args, rank, nprocs, port, device):
+    os.environ.update({
+        "PADDLE_MASTER": "127.0.0.1",
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(port),
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_LOCAL_RANK": str(rank),
+    })
+    if device is not None:
+        os.environ["JAX_VISIBLE_DEVICES"] = str(device)
     func(*args)
 
 
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: distributed/spawn.py — start nprocs local worker
+    processes running ``func(*args)`` with the PADDLE_*/MASTER_* env
+    contract (each worker calls init_parallel_env itself).
+
+    nprocs=1 runs func inline (world of 1); nprocs=-1 means one worker
+    per entry of options['devices'] (comma list or sequence), falling
+    back to 1 — device discovery cannot happen here because importing the
+    backend in the parent would break the children's jax.distributed
+    ordering. Pass options['devices'] to partition local accelerators
+    (sets JAX_VISIBLE_DEVICES per rank); without it workers share the
+    parent's device visibility, which on a single-accelerator host only
+    works for CPU. `func` must be picklable (module-level) — workers use
+    the multiprocessing 'spawn' start method. For script-level launches
+    prefer ``python -m paddle_tpu.distributed.launch``."""
+    devices = options.get("devices")
+    if isinstance(devices, str):
+        devices = [d for d in devices.split(",") if d]
+    if nprocs == -1:
+        nprocs = len(devices) if devices else 1
+    if nprocs < 1:
+        raise ValueError(f"spawn: invalid nprocs={nprocs}")
+    if nprocs == 1:
+        func(*args)
+        return None
+    import multiprocessing as mp
+    import time as _time
+
+    from .launch.main import _free_port
+
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(
+            target=_spawn_worker,
+            args=(func, args, rank, nprocs, port,
+                  devices[rank % len(devices)] if devices else None),
+            daemon=daemon)
+        for rank in range(nprocs)
+    ]
+    for p in procs:
+        p.start()
+    if not join:
+        return procs
+    # watch loop: one worker dying (e.g. before the coordinator comes up)
+    # must kill the group, not leave the rest blocked in initialize()
+    try:
+        while True:
+            codes = [p.exitcode for p in procs]
+            if any(c is not None and c != 0 for c in codes):
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                for p in procs:
+                    p.join()
+                bad = [c for c in codes if c is not None and c != 0]
+                raise RuntimeError(
+                    f"spawn: worker(s) failed with exit codes {bad}")
+            if all(c == 0 for c in codes):
+                return None
+            _time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+
+
 def launch():
+    """CLI entry — see paddle_tpu/distributed/launch/main.py."""
     from .launch.main import main
 
     return main()

@@ -1,0 +1,17 @@
+"""Distributed launcher — ``python -m paddle_tpu.distributed.launch``.
+
+Reference: python/paddle/distributed/launch/main.py + controllers
+(launch/controllers/collective.py, master.py, watcher.py).
+
+TPU-native redesign: the reference rendezvous (HTTP master / etcd) is
+replaced by jax.distributed's coordination service — the launcher only
+has to (1) compute the coordinator address, (2) start one worker process
+per local device group with the PADDLE_* / MASTER_* env contract that
+``paddle_tpu.distributed.init_parallel_env`` consumes, and (3) watch the
+children (fault-tolerance = kill-all + relaunch, the reference's
+FAULT_TOLERANCE elastic level; checkpoint-resume does the rest).
+"""
+
+from .main import main  # noqa: F401
+
+__all__ = ["main"]

@@ -1,0 +1,37 @@
+"""Worker bootstrap: runs inside every launched worker BEFORE the user
+script, so jax.distributed is initialized before any code can touch the
+XLA backend (jax requires initialize() first). The reference trainers do
+the equivalent inside init_parallel_env from the launcher's env; here the
+ordering constraint is hard, so the launcher owns it."""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def main():
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nprocs > 1:
+        import jax
+
+        # sitecustomize-style PJRT plugins can override JAX_PLATFORMS;
+        # re-assert the env var through the config API
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        coord = (os.environ.get("PADDLE_MASTER")
+                 or os.environ.get("MASTER_ADDR", "127.0.0.1"))
+        port = os.environ.get("MASTER_PORT", "8471")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}",
+            num_processes=nprocs,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    script = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

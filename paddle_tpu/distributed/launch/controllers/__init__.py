@@ -1,0 +1,3 @@
+from .collective import CollectiveController  # noqa: F401
+
+__all__ = ["CollectiveController"]

@@ -1,0 +1,125 @@
+"""Collective job controller (reference launch/controllers/collective.py
++ watcher.py).
+
+Starts nproc_per_node local workers with the PADDLE_*/MASTER_* env
+contract, tails their exit codes, and on any nonzero exit kills the
+whole local group and (optionally) relaunches it — the reference's
+FAULT_TOLERANCE elastic level. Rendezvous is jax.distributed's
+coordination service at MASTER_ADDR:MASTER_PORT, so there is no HTTP/
+etcd master to run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["CollectiveController"]
+
+
+class CollectiveController:
+    def __init__(self, args):
+        self.args = args
+        self.nproc = args.nproc_per_node or 1
+        self.world_size = args.nnodes * self.nproc
+        self.procs: list[subprocess.Popen] = []
+        self._log_files = []
+
+    # -- env contract ----------------------------------------------------
+    def _worker_env(self, local_rank):
+        env = dict(os.environ)
+        addr, port = self.args.master.rsplit(":", 1)
+        global_rank = self.args.rank * self.nproc + local_rank
+        env.update({
+            "PADDLE_MASTER": addr,
+            "MASTER_ADDR": addr,
+            "MASTER_PORT": port,
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(self.world_size),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_LOCAL_SIZE": str(self.nproc),
+            "PADDLE_NNODES": str(self.args.nnodes),
+            "PADDLE_NODE_RANK": str(self.args.rank),
+        })
+        if self.args.devices:
+            devs = self.args.devices.split(",")
+            env["JAX_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+        return env
+
+    def _cmd(self):
+        script = self.args.training_script
+        rest = list(self.args.training_script_args)
+        if script.endswith(".py"):
+            # bootstrap initializes jax.distributed BEFORE the user script
+            # can touch the XLA backend (ordering is mandatory in jax)
+            return [sys.executable, "-u", "-m",
+                    "paddle_tpu.distributed.launch.bootstrap",
+                    script] + rest
+        return [script] + rest
+
+    # -- lifecycle -------------------------------------------------------
+    def _spawn_all(self):
+        self.procs = []
+        for lr in range(self.nproc):
+            out = None
+            if self.args.log_dir:
+                os.makedirs(self.args.log_dir, exist_ok=True)
+                out = open(os.path.join(self.args.log_dir,
+                                        f"workerlog.{lr}"), "ab")
+                self._log_files.append(out)
+            self.procs.append(subprocess.Popen(
+                self._cmd(), env=self._worker_env(lr),
+                stdout=out, stderr=(subprocess.STDOUT if out else None)))
+
+    def _kill_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def _watch(self):
+        """Block until the group finishes; return the first nonzero exit
+        code, or 0 when every worker succeeded."""
+        while True:
+            codes = [p.poll() for p in self.procs]
+            for rc in codes:
+                if rc is not None and rc != 0:
+                    self._kill_all()
+                    return rc
+            if all(rc == 0 for rc in codes):
+                return 0
+            time.sleep(0.2)
+
+    def run(self):
+        restarts = 0
+        while True:
+            self._spawn_all()
+            rc = self._watch()
+            if rc == 0:
+                self._close_logs()
+                return 0
+            if restarts < self.args.max_restart:
+                restarts += 1
+                print(f"[launch] worker failed rc={rc}; restart "
+                      f"{restarts}/{self.args.max_restart}",
+                      file=sys.stderr)
+                continue
+            self._close_logs()
+            return rc
+
+    def _close_logs(self):
+        for f in self._log_files:
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._log_files = []

@@ -1,0 +1,71 @@
+"""Launcher CLI (reference python/paddle/distributed/launch/main.py).
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch \
+        [--nnodes N] [--nproc_per_node M] [--master IP:PORT] \
+        [--rank NODE_RANK] [--log_dir DIR] [--max_restart K] \
+        script.py [script args...]
+
+Single node (default): picks a free local port as the jax.distributed
+coordinator and starts M workers. Multi-node: pass --master pointing at
+node 0 and --rank for this node; every node runs the same command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+
+from .controllers.collective import CollectiveController
+
+__all__ = ["main", "parse_args"]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed training job")
+    p.add_argument("--master", default=None,
+                   help="coordinator endpoint IP:PORT (node 0); "
+                        "auto-selected for single-node jobs")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="workers per node (default: one per local device "
+                        "group, i.e. 1 on a single-controller TPU host)")
+    p.add_argument("--rank", "--node_rank", type=int, default=0,
+                   dest="rank", help="this node's index in [0, nnodes)")
+    p.add_argument("--log_dir", default=None,
+                   help="write per-worker logs to DIR/workerlog.N")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="relaunch the whole local group up to K times if "
+                        "any worker exits nonzero (fault tolerance)")
+    p.add_argument("--devices", default=None,
+                   help="comma list of local device ids to expose "
+                        "(sets JAX_VISIBLE_DEVICES per worker)")
+    p.add_argument("training_script", help="script (or binary) to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.master is None:
+        if args.nnodes > 1:
+            raise SystemExit(
+                "--master IP:PORT is required for multi-node jobs "
+                "(point every node at node 0)")
+        args.master = f"127.0.0.1:{_free_port()}"
+    ctrl = CollectiveController(args)
+    return ctrl.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
