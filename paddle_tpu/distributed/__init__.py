@@ -122,7 +122,9 @@ def _spawn_worker(func, args, rank, nprocs, port, device):
         "PADDLE_LOCAL_RANK": str(rank),
     })
     if device is not None:
-        os.environ["JAX_VISIBLE_DEVICES"] = str(device)
+        # per-platform visibility vars (jax reads the vendor ones)
+        os.environ["CUDA_VISIBLE_DEVICES"] = str(device)
+        os.environ["TPU_VISIBLE_DEVICES"] = str(device)
     func(*args)
 
 

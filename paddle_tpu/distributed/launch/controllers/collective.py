@@ -46,7 +46,10 @@ class CollectiveController:
         })
         if self.args.devices:
             devs = self.args.devices.split(",")
-            env["JAX_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+            dev = devs[local_rank % len(devs)]
+            # per-platform visibility vars (jax reads the vendor ones)
+            env["CUDA_VISIBLE_DEVICES"] = dev
+            env["TPU_VISIBLE_DEVICES"] = dev
         return env
 
     def _cmd(self):
@@ -62,6 +65,7 @@ class CollectiveController:
 
     # -- lifecycle -------------------------------------------------------
     def _spawn_all(self):
+        self._close_logs()  # previous restart round's handles
         self.procs = []
         for lr in range(self.nproc):
             out = None

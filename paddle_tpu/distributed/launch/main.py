@@ -63,6 +63,9 @@ def main(argv=None):
                 "--master IP:PORT is required for multi-node jobs "
                 "(point every node at node 0)")
         args.master = f"127.0.0.1:{_free_port()}"
+    elif ":" not in args.master or not args.master.rsplit(":", 1)[1].isdigit():
+        raise SystemExit(
+            f"--master must be IP:PORT, got {args.master!r}")
     ctrl = CollectiveController(args)
     return ctrl.run()
 

@@ -1,0 +1,25 @@
+"""paddle.profiler — tracing + throughput monitoring.
+
+Reference: python/paddle/profiler/ (Profiler profiler.py:346,
+make_scheduler :117, export_chrome_tracing :215, RecordEvent utils.py,
+Benchmark timer.py:349). See module docstrings for the TPU-native
+design: host spans + jax.profiler (libtpu) device traces.
+"""
+
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    export_chrome_tracing,
+    load_profiler_result,
+    make_scheduler,
+)
+from .profiler_statistic import SortedKeys  # noqa: F401
+from .timer import Benchmark, benchmark  # noqa: F401
+from .utils import RecordEvent, in_profiler_mode  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+    "export_chrome_tracing", "load_profiler_result", "SortedKeys",
+    "RecordEvent", "in_profiler_mode", "Benchmark", "benchmark",
+]

@@ -1,0 +1,91 @@
+"""Host-side event recording.
+
+Reference: python/paddle/profiler/utils.py (RecordEvent) backed by the
+C++ HostTracer/HostEventRecorder (paddle/fluid/platform/profiler/
+host_tracer.cc, host_event_recorder.h). TPU-native: a process-local
+recorder list; device-side tracing is delegated to jax.profiler
+(libtpu/XLA) by profiler.py, and RecordEvent doubles as a
+jax.profiler.TraceAnnotation so host spans show up inside the device
+trace timeline too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["RecordEvent", "in_profiler_mode", "wrap_optimizers"]
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []  # (name, start_ns, end_ns, tid)
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+    def add(self, name, start_ns, end_ns):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append(
+                (name, start_ns, end_ns, threading.get_ident()))
+
+
+RECORDER = _Recorder()
+
+
+def in_profiler_mode():
+    return RECORDER.enabled
+
+
+class RecordEvent:
+    """User-facing span marker (reference utils.py RecordEvent).
+
+    Usage::
+
+        with profiler.RecordEvent("data_loading"):
+            batch = next(loader)
+    """
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+        if RECORDER.enabled:
+            try:
+                import jax
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        return self
+
+    def end(self):
+        if self._start is None:
+            return
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+        RECORDER.add(self.name, self._start, time.perf_counter_ns())
+        self._start = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def wrap_optimizers():
+    """Reference hooks optimizer.step into RecordEvent spans; our
+    optimizer layer emits ops through the dispatcher, which the device
+    trace captures — no wrapping needed."""
