@@ -118,8 +118,10 @@ def main():
         dt = time.perf_counter() - t0
         return bs * seq * n_steps / dt
 
-    # batch-size sweep (short), then steady-state at the winner
-    best_bs, best_tps = batch_sizes[0], 0.0
+    # batch-size sweep (short), then steady-state at the winner; only fall
+    # back to a size that actually succeeded (best_bs stays None until one
+    # measurement completes — if even the smallest OOMs, shrink it)
+    best_bs, best_tps = None, 0.0
     for bs in batch_sizes:
         try:
             tps = measure(bs, max(steps // 3, 2), warmup)
@@ -130,6 +132,8 @@ def main():
             break
         if tps > best_tps:
             best_bs, best_tps = bs, tps
+    if best_bs is None:
+        best_bs = max(batch_sizes[0] // 2, 1)
     tokens_per_sec = measure(best_bs, steps, 1)
 
     flops_per_token = _train_flops_per_token(cfg, n_params, seq)

@@ -45,15 +45,17 @@ class AmpScaler:
                 "unscale_() has already been called on this optimizer "
                 "since the last update()")
         inv = 1.0 / self._scale
-        found = False
+        # one fused finite-check: accumulate a per-grad all-finite scalar on
+        # device and sync the host exactly once at the end (the reference
+        # uses a single check_finite_and_unscale kernel over the grad list)
+        all_finite = jnp.bool_(True)
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            found = found or not finite
+            all_finite = jnp.logical_and(all_finite, jnp.all(jnp.isfinite(g)))
             p.grad._rebind(g.astype(p.grad._data.dtype))
-        self._found_inf = found
+        self._found_inf = not bool(all_finite)
         self._unscaled = True
 
     minimize_ops = None

@@ -125,6 +125,7 @@ def _spawn_worker(func, args, rank, nprocs, port, device):
         # per-platform visibility vars (jax reads the vendor ones)
         os.environ["CUDA_VISIBLE_DEVICES"] = str(device)
         os.environ["TPU_VISIBLE_DEVICES"] = str(device)
+        os.environ["JAX_VISIBLE_DEVICES"] = str(device)  # covers CPU backend
     func(*args)
 
 

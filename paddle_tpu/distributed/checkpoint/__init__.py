@@ -83,6 +83,12 @@ def save_state_dict(state_dict, path, process_group=None,
         shards = (list(arr.addressable_shards)
                   if isinstance(arr, jax.Array)
                   and hasattr(arr, "addressable_shards") else [])
+        if shards and not any(s.replica_id == 0 for s in shards):
+            # every addressable shard is a replica of data whose replica-0
+            # copy lives on another process (e.g. tp-sharded within hosts,
+            # replicated across the host axis): that rank saves it; writing
+            # the global array here would need a cross-host gather
+            continue
         if shards and any(s.replica_id == 0 for s in shards):
             for i, sh in enumerate(
                     s for s in shards if s.replica_id == 0):

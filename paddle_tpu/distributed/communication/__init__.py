@@ -197,12 +197,35 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """p2p send — traced path: ppermute in the pipeline engine handles stage
-    transfer; the eager API is a no-op in the single-controller model."""
+    """p2p send. Traced (inside shard_map with a group axis): lowers to a
+    single-pair ppermute. Eager multi-process: there is no XLA p2p outside a
+    compiled program — raise rather than silently return the local tensor
+    (reference semantics: process_group_nccl.cc:228 moves real bytes)."""
+    axis = _axis(group)
+    if _is_traced(tensor) and axis is not None:
+        out = jax.lax.ppermute(tensor._data, axis, [(0, dst)])
+        return _Task(Tensor._wrap(out))
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager send() has no TPU point-to-point path in a multi-process "
+            "run; use the pipeline engine (ppermute stage-scan) or a traced "
+            "shard_map collective instead")
     return _Task(tensor)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    """p2p recv — see send(). Traced: ppermute from src; eager multi-process:
+    raises instead of silently returning the caller's local tensor."""
+    axis = _axis(group)
+    if _is_traced(tensor) and axis is not None:
+        me = 0  # static single-pair permute: src -> this logical position
+        out = jax.lax.ppermute(tensor._data, axis, [(src, me)])
+        return _Task(Tensor._wrap(out))
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager recv() has no TPU point-to-point path in a multi-process "
+            "run; use the pipeline engine (ppermute stage-scan) or a traced "
+            "shard_map collective instead")
     return _Task(tensor)
 
 

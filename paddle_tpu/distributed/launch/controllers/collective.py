@@ -50,6 +50,7 @@ class CollectiveController:
             # per-platform visibility vars (jax reads the vendor ones)
             env["CUDA_VISIBLE_DEVICES"] = dev
             env["TPU_VISIBLE_DEVICES"] = dev
+            env["JAX_VISIBLE_DEVICES"] = dev  # covers CPU backend
         return env
 
     def _cmd(self):

@@ -31,12 +31,20 @@ def _f32(x):
 
 
 class FusedTrainStep:
-    def __init__(self, model, optimizer, loss_fn=None):
+    """``step_lr_scheduler=True`` (default) means the fused step OWNS
+    scheduler stepping: it calls ``optimizer._learning_rate.step()`` once per
+    invocation, and the caller must NOT also call ``lr_scheduler.step()`` in
+    the training loop (that would advance the schedule twice per step). Pass
+    ``step_lr_scheduler=False`` to keep the standard paddle pattern where the
+    loop steps the scheduler itself."""
+
+    def __init__(self, model, optimizer, loss_fn=None, step_lr_scheduler=True):
         from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
 
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self._step_lr_scheduler = step_lr_scheduler
         self._names = sorted(params_dict(model))
         self._tensors = dict(model.named_parameters())
         # trainable params only (stop_gradient=True params stay frozen)
@@ -190,13 +198,16 @@ class FusedTrainStep:
         # donation invalidated the old buffers — rebind the live Tensors
         for n in self._names:
             self._tensors[n]._rebind(self._params[n])
-        sched = getattr(self.optimizer, "_learning_rate", None)
-        if hasattr(sched, "step"):
-            sched.step()
+        if self._step_lr_scheduler:
+            sched = getattr(self.optimizer, "_learning_rate", None)
+            if hasattr(sched, "step"):
+                sched.step()
         return Tensor._wrap(loss)
 
 
-def fused_train_step(model, optimizer, loss_fn=None):
+def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True):
     """Build a fused (single-dispatch, donated) train step callable:
-    ``step(*inputs) -> loss``. See FusedTrainStep."""
-    return FusedTrainStep(model, optimizer, loss_fn)
+    ``step(*inputs) -> loss``. See FusedTrainStep — with the default
+    ``step_lr_scheduler=True`` the step owns LR-scheduler stepping; do not
+    also step it in the loop."""
+    return FusedTrainStep(model, optimizer, loss_fn, step_lr_scheduler)

@@ -3,9 +3,7 @@
 Reference: python/paddle/io/ (Dataset, DataLoader with multiprocess workers at
 io/dataloader/worker.py). TPU-native design: workers are threads feeding a
 bounded prefetch queue (numpy batches stay on host; device transfer happens at
-first op use, letting XLA overlap H2D with compute). A C++ prefetch core
-(csrc/) accelerates the hot path when built; the pure-python path is always
-available.
+first op use, letting XLA overlap H2D with compute).
 """
 
 from __future__ import annotations

@@ -179,6 +179,11 @@ class Profiler:
         if not recording_old and recording_new:
             RECORDER.enabled = True
             self._start_device_trace()
+        elif recording_old and not recording_new:
+            # a custom scheduler may go RECORD -> CLOSED/READY without ever
+            # returning RECORD_AND_RETURN; tear the window down here so the
+            # recorder and device trace never leak (reference state machine)
+            self._finish_window()
         self.current_state = new_state
 
     def _finish_window(self):

@@ -172,3 +172,73 @@ class TestFlashAttnGate:
         p = p / p.sum(-1, keepdims=True)
         ref = np.transpose(p @ vn, (0, 2, 1, 3))
         np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+class TestRound3AdviceFixes:
+    def test_grad_scaler_single_fused_finite_check(self):
+        """unscale_ must detect inf AND only sync the host once (fused
+        all-finite accumulator), not once per parameter."""
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = model(x).sum()
+        scaler.scale(loss).backward()
+        # poison one grad with inf
+        p = model.parameters()[0]
+        bad = np.array(p.grad.numpy())
+        bad[0, 0] = np.inf
+        p.grad._rebind(paddle.to_tensor(bad)._data)
+        before = model.parameters()[1].numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        # step skipped on inf
+        np.testing.assert_allclose(model.parameters()[1].numpy(), before)
+        assert scaler.get_loss_scaling().numpy() < 2.0
+
+    def test_profiler_transit_teardown_on_custom_scheduler(self):
+        """A scheduler that drops RECORD -> READY without RECORD_AND_RETURN
+        must still finish the window (recorder off, callback fired)."""
+        from paddle_tpu import profiler as prof
+        from paddle_tpu.profiler.profiler import RECORDER, ProfilerState
+
+        fired = []
+
+        def sched(step):
+            return (ProfilerState.RECORD if step < 2
+                    else ProfilerState.READY)
+
+        p = prof.Profiler(scheduler=sched,
+                          on_trace_ready=lambda pr: fired.append(1))
+        p.start()
+        p.step()
+        p.step()  # transition RECORD -> READY
+        assert RECORDER.enabled is False
+        assert fired == [1]
+        p.stop()
+
+    def test_eager_send_recv_raise_multiprocess(self, monkeypatch):
+        import jax
+        import paddle_tpu.distributed as dist
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        t = paddle.to_tensor([1.0])
+        with pytest.raises(NotImplementedError):
+            dist.send(t, dst=1)
+        with pytest.raises(NotImplementedError):
+            dist.recv(t, src=0)
+
+    def test_fused_step_scheduler_opt_out(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                              gamma=0.5)
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=model.parameters())
+        step = paddle.incubate.fused_train_step(
+            model, opt, loss_fn=lambda o: o.sum(), step_lr_scheduler=False)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        step(x)
+        assert sched.get_lr() == pytest.approx(0.1)  # untouched
+        sched.step()
+        assert sched.get_lr() == pytest.approx(0.05)
