@@ -42,6 +42,7 @@ from . import io  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
+from . import vision  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
 

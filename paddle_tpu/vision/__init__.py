@@ -1,0 +1,42 @@
+"""paddle.vision analog — models, transforms, ops, datasets.
+
+Reference: python/paddle/vision/__init__.py. The compute path (models, ops)
+is jax/XLA; the data path (transforms, datasets) is host-side numpy, which is
+the TPU idiom: CPU host prepares batches, the chip runs the compiled graph.
+"""
+
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, ResNet, VGG, MobileNetV2, resnet18, resnet34, resnet50, resnet101,
+    resnet152, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d, wide_resnet50_2,
+    wide_resnet101_2, vgg11, vgg13, vgg16, vgg19, mobilenet_v2,
+)
+
+__all__ = [
+    "datasets", "models", "ops", "transforms",
+]
+
+
+def get_image_backend():
+    return "numpy"
+
+
+def set_image_backend(backend):
+    if backend not in ("numpy", "pil", "cv2"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+
+
+def image_load(path, backend=None):
+    """Load an image file to an HWC uint8 numpy array (paddle.vision.image_load)."""
+    import numpy as np
+
+    try:
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError:  # pragma: no cover - PIL is present in the image
+        raise RuntimeError("image_load requires PIL")

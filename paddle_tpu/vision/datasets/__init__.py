@@ -1,0 +1,202 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No network egress in this environment, so the downloadable datasets (MNIST,
+Cifar) load from a user-supplied local path and never fetch; ``FakeData``
+provides a synthetic drop-in for pipelines and benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST", "Cifar10",
+           "Cifar100", "FakeData"]
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+             ".tiff", ".webp")
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx % 2 ** 31)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.array(rng.randint(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory image folder (ref datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or _IMG_EXTS
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(tuple(extensions)))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.array(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabeled) image folder (ref datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or _IMG_EXTS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return (sample,)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_loader(path):
+    from .. import image_load
+
+    return image_load(path)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx-format files (ref datasets/mnist.py; no download)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise RuntimeError(
+                f"{self.NAME} cannot be downloaded (no network egress); pass "
+                "image_path/label_path to local idx(.gz) files")
+        self.mode = mode
+        self.transform = transform
+        self.images = self._parse_idx(image_path, 3)
+        self.labels = self._parse_idx(label_path, 1)
+
+    @staticmethod
+    def _parse_idx(path, ndim):
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "rb") as f:
+            data = f.read()
+        magic = struct.unpack(">i", data[:4])[0]
+        dims = magic % 256
+        shape = struct.unpack(f">{dims}i", data[4:4 + 4 * dims])
+        arr = np.frombuffer(data, np.uint8, offset=4 + 4 * dims).reshape(shape)
+        return arr
+
+    def __getitem__(self, idx):
+        img = self.images[idx][:, :, None]  # HW -> HWC
+        label = np.array(self.labels[idx], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version tar.gz (ref datasets/cifar.py)."""
+
+    _batches = {"train": [f"data_batch_{i}" for i in range(1, 6)],
+                "test": ["test_batch"]}
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise RuntimeError(
+                "Cifar cannot be downloaded (no network egress); pass "
+                "data_file to a local cifar tar.gz")
+        self.mode = mode
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = {os.path.basename(m.name): m for m in tf.getmembers()}
+            for b in self._batches[mode]:
+                member = names[b]
+                d = pickle.load(tf.extractfile(member), encoding="bytes")
+                images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                labels.extend(d[self._label_key])
+        self.images = np.concatenate(images).transpose(0, 2, 3, 1)  # NHWC
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _batches = {"train": ["train"], "test": ["test"]}
+    _label_key = b"fine_labels"

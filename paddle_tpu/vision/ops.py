@@ -1,0 +1,411 @@
+"""Vision/detection ops (reference: python/paddle/vision/ops.py).
+
+TPU-first design notes:
+- ``roi_align``/``roi_pool``/``deform_conv2d`` are expressed as bilinear
+  gathers + contractions (vmap over boxes / kernel taps) — XLA lowers the
+  gathers onto the VPU and the contractions onto the MXU; there is no
+  hand-scheduled CUDA kernel to port (ref: paddle/phi/kernels/gpu/roi_align_kernel.cu,
+  deformable_conv_kernel.cu).
+- ``nms`` runs its O(N²) greedy suppression as a fixed-trip ``lax.fori_loop``
+  (static shapes for XLA); the final dynamic-size index extraction happens on
+  the host, which is where detection postprocessing lives anyway.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "deform_conv2d",
+    "DeformConv2D", "RoIAlign", "RoIPool",
+]
+
+
+def _iou_matrix(boxes):
+    """Pairwise IoU for [N,4] (x1,y1,x2,y2) boxes."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = areas[:, None] + areas[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@op("nms_mask", differentiable=False)
+def _nms_mask(boxes, scores, iou_threshold=0.3):
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes[order])
+
+    def body(i, keep):
+        # suppress j>i overlapping with i, only if i itself is kept
+        row = (iou[i] > iou_threshold) & (jnp.arange(keep.shape[0]) > i)
+        return jnp.where(keep[i], keep & ~row, keep)
+
+    keep = jax.lax.fori_loop(0, boxes.shape[0],
+                             body, jnp.ones(boxes.shape[0], bool))
+    return keep, order
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS returning kept indices sorted by score (ref ops.py nms)."""
+    b = jnp.asarray(getattr(boxes, "_data", boxes))
+    if scores is None:
+        s = jnp.arange(b.shape[0], 0, -1, dtype=jnp.float32)
+    else:
+        s = jnp.asarray(getattr(scores, "_data", scores)).astype(jnp.float32)
+    if category_idxs is not None:
+        # class-aware: offset boxes per category so cross-class boxes never
+        # overlap (standard batched-NMS trick; avoids a per-class loop)
+        c = jnp.asarray(getattr(category_idxs, "_data", category_idxs))
+        offset = c.astype(b.dtype) * (b.max() + 1.0)
+        b = b + offset[:, None]
+    keep, order = _nms_mask(Tensor(b), Tensor(s),
+                            iou_threshold=float(iou_threshold))
+    keep = np.asarray(keep._data)
+    order = np.asarray(order._data)
+    kept = order[np.nonzero(keep)[0]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(np.asarray(kept, np.int64))
+
+
+def _bilinear_sample(feat, y, x):
+    """Sample feat [C,H,W] at float coords y,x (same shape) with bilinear
+    interpolation, zero outside."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def tap(yi, xi):
+        inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = feat[:, yc, xc]  # [C, ...]
+        return v * inside.astype(feat.dtype)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wy = wy.astype(feat.dtype)
+    wx = wx.astype(feat.dtype)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@op("roi_align")
+def _roi_align(x, boxes, boxes_num, output_size=(1, 1), spatial_scale=1.0,
+               sampling_ratio=-1, aligned=True):
+    N, C, H, W = x.shape
+    K = boxes.shape[0]
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    batch_idx = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=K)
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(box, bi):
+        x1, y1, x2, y2 = box * spatial_scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        gy = (y1 + bin_h * (jnp.arange(ph)[:, None, None, None] +
+                            (jnp.arange(sr)[None, None, :, None] + 0.5) / sr))
+        gx = (x1 + bin_w * (jnp.arange(pw)[None, :, None, None] +
+                            (jnp.arange(sr)[None, None, None, :] + 0.5) / sr))
+        yy = jnp.broadcast_to(gy, (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(gx, (ph, pw, sr, sr))
+        vals = _bilinear_sample(x[bi], yy, xx)  # [C, ph, pw, sr, sr]
+        return vals.mean(axis=(-1, -2))  # [C, ph, pw]
+
+    return jax.vmap(one_roi)(boxes, batch_idx)  # [K, C, ph, pw]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align(x, boxes, boxes_num, output_size=tuple(output_size),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+@op("roi_pool")
+def _roi_pool(x, boxes, boxes_num, output_size=(1, 1), spatial_scale=1.0):
+    N, C, H, W = x.shape
+    K = boxes.shape[0]
+    ph, pw = output_size
+    batch_idx = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=K)
+
+    def one_roi(box, bi):
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # max-pool each bin by sampling a fixed grid and taking max (static
+        # shapes; the reference iterates the exact integer bin extent)
+        S = 4
+        gy = y1 + bin_h * (jnp.arange(ph)[:, None, None, None]
+                           + (jnp.arange(S)[None, None, :, None] + 0.5) / S)
+        gx = x1 + bin_w * (jnp.arange(pw)[None, :, None, None]
+                           + (jnp.arange(S)[None, None, None, :] + 0.5) / S)
+        yy = jnp.clip(jnp.broadcast_to(gy, (ph, pw, S, S)), 0, H - 1)
+        xx = jnp.clip(jnp.broadcast_to(gx, (ph, pw, S, S)), 0, W - 1)
+        feat = x[bi]
+        vals = feat[:, jnp.floor(yy).astype(jnp.int32),
+                    jnp.floor(xx).astype(jnp.int32)]
+        return vals.max(axis=(-1, -2))
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_pool(x, boxes, boxes_num, output_size=tuple(output_size),
+                     spatial_scale=float(spatial_scale))
+
+
+@op("box_coder")
+def _box_coder(prior_box, prior_box_var, target_box,
+               code_type="encode_center_size", box_normalized=True, axis=0):
+    norm = 1.0 if box_normalized else 0.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + (1 - norm)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (1 - norm)
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + (1 - norm)
+        th = target_box[:, 3] - target_box[:, 1] + (1 - norm)
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph[None, :]),
+        ], axis=-1)
+        if prior_box_var is not None:
+            out = out / prior_box_var[None, :, :]
+        return out
+    # decode_center_size: target_box [N, M, 4]
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+    else:
+        pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+    t = target_box
+    if prior_box_var is not None:
+        var = prior_box_var[None, :, :] if axis == 0 else \
+            prior_box_var[:, None, :]
+        t = t * var
+    ocx = t[..., 0] * pw_ + pcx_
+    ocy = t[..., 1] * ph_ + pcy_
+    ow = jnp.exp(t[..., 2]) * pw_
+    oh = jnp.exp(t[..., 3]) * ph_
+    return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                      ocx + ow * 0.5 - (1 - norm),
+                      ocy + oh * 0.5 - (1 - norm)], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    return _box_coder(prior_box, prior_box_var, target_box,
+                      code_type=code_type, box_normalized=box_normalized,
+                      axis=axis)
+
+
+@op("yolo_box")
+def _yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+              downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+              iou_aware=False, iou_aware_factor=0.5):
+    N, C, H, W = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, x.dtype).reshape(na, 2)
+    if iou_aware:
+        ioup = jax.nn.sigmoid(x[:, :na].reshape(N, na, 1, H, W))
+        x = x[:, na:]
+    p = x.reshape(N, na, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=x.dtype)
+    gy = jnp.arange(H, dtype=x.dtype)
+    bx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1) + gx[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1) + gy[None, None, :, None]) / H
+    bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * W)
+    bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * H)
+    conf = jax.nn.sigmoid(p[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * \
+            ioup[:, :, 0] ** iou_aware_factor
+    conf = jnp.where(conf < conf_thresh, 0.0, conf)
+    probs = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(x.dtype)[:, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None]
+    flat = lambda a: a.reshape(N, na * H * W)
+    x1 = (flat(bx) - flat(bw) / 2) * imw
+    y1 = (flat(by) - flat(bh) / 2) * imh
+    x2 = (flat(bx) + flat(bw) / 2) * imw
+    y2 = (flat(by) + flat(bh) / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, na * H * W, class_num)
+    mask = flat(conf) > 0
+    boxes = boxes * mask[..., None].astype(x.dtype)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    return _yolo_box(x, img_size, anchors=tuple(anchors),
+                     class_num=int(class_num), conf_thresh=float(conf_thresh),
+                     downsample_ratio=int(downsample_ratio),
+                     clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y),
+                     iou_aware=bool(iou_aware),
+                     iou_aware_factor=float(iou_aware_factor))
+
+
+@op("deform_conv2d")
+def _deform_conv2d(x, offset, weight, mask=None, bias=None, stride=(1, 1),
+                   padding=(0, 0), dilation=(1, 1), deformable_groups=1,
+                   groups=1):
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    Hout = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wout = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = deformable_groups
+    cpg = Cin // dg  # channels per deformable group
+
+    off = offset.reshape(N, dg, kh * kw, 2, Hout, Wout)
+    if mask is not None:
+        m = mask.reshape(N, dg, kh * kw, Hout, Wout)
+    base_y = (jnp.arange(Hout) * sh - ph).astype(x.dtype)
+    base_x = (jnp.arange(Wout) * sw - pw).astype(x.dtype)
+
+    def per_image(xi, oi, mi):
+        # xi [Cin,H,W]; oi [dg,kk,2,Hout,Wout]; mi [dg,kk,Hout,Wout] or None
+        cols = []
+        for g in range(dg):
+            feat = xi[g * cpg:(g + 1) * cpg]
+            taps = []
+            for k in range(kh * kw):
+                ky, kx = divmod(k, kw)
+                yy = base_y[:, None] + ky * dh + oi[g, k, 0]
+                xx = base_x[None, :] + kx * dw + oi[g, k, 1]
+                v = _bilinear_sample(feat, yy, xx)  # [cpg, Hout, Wout]
+                if mi is not None:
+                    v = v * mi[g, k]
+                taps.append(v)
+            cols.append(jnp.stack(taps, 1))  # [cpg, kk, Hout, Wout]
+        return jnp.concatenate(cols, 0)  # [Cin, kk, Hout, Wout]
+
+    col = jax.vmap(per_image)(x, off, m if mask is not None else
+                              jnp.ones((N, dg, kh * kw, Hout, Wout), x.dtype))
+    # contract: weight [Cout, Cin_g, kh*kw] x col [N, Cin, kk, Hout, Wout]
+    wf = weight.reshape(Cout, Cin_g, kh * kw)
+    if groups == 1:
+        out = jnp.einsum("ock,nckhw->nohw", wf, col)
+    else:
+        og = Cout // groups
+        outs = []
+        for g in range(groups):
+            outs.append(jnp.einsum(
+                "ock,nckhw->nohw", wf[g * og:(g + 1) * og],
+                col[:, g * Cin_g:(g + 1) * Cin_g]))
+        out = jnp.concatenate(outs, 1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    t2 = lambda v: tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 2
+    return _deform_conv2d(x, offset, weight, mask, bias, stride=t2(stride),
+                          padding=t2(padding), dilation=t2(dilation),
+                          deformable_groups=int(deformable_groups),
+                          groups=int(groups))
+
+
+class DeformConv2D(nn.Layer):
+    """Deformable conv v1/v2 layer (ref ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        t2 = lambda v: tuple(v) if isinstance(v, (list, tuple)) else \
+            (int(v),) * 2
+        self._kernel_size = t2(kernel_size)
+        self._stride = t2(stride)
+        self._padding = t2(padding)
+        self._dilation = t2(dilation)
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels // groups * int(np.prod(self._kernel_size))
+        from ..nn.initializer import Normal
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *self._kernel_size],
+            attr=weight_attr,
+            default_initializer=Normal(0.0, (2.0 / fan_in) ** 0.5))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)

@@ -1,0 +1,252 @@
+"""Functional image transforms on host-side numpy HWC arrays.
+
+Reference: python/paddle/vision/transforms/functional.py (+ functional_cv2.py).
+The reference dispatches to PIL/cv2 backends; here everything is numpy — the
+data pipeline runs on the host CPU and feeds device batches, so there is no
+reason to route through an image library for the core geometric/color ops.
+Images are HWC uint8 or float arrays; ``to_tensor`` produces CHW float32.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "resize", "pad", "crop", "center_crop", "hflip", "vflip",
+    "normalize", "adjust_brightness", "adjust_contrast", "adjust_saturation",
+    "adjust_hue", "rotate", "to_grayscale", "erase",
+]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(pic, data_format="CHW"):
+    """uint8 HWC [0,255] -> float32 tensor scaled to [0,1] (ref functional.py to_tensor)."""
+    from ...core.tensor import Tensor
+
+    img = _as_hwc(pic)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    else:
+        img = img.astype(np.float32)
+    if data_format == "CHW":
+        img = np.transpose(img, (2, 0, 1))
+    return Tensor(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Resize HWC image. ``size``: int (short side) or (h, w)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), size
+    else:
+        oh, ow = int(size[0]), int(size[1])
+    if (oh, ow) == (h, w):
+        return img
+    if interpolation == "nearest":
+        ys = (np.arange(oh) * (h / oh)).astype(np.int64).clip(0, h - 1)
+        xs = (np.arange(ow) * (w / ow)).astype(np.int64).clip(0, w - 1)
+        return img[ys][:, xs]
+    # bilinear with half-pixel centers
+    dtype = img.dtype
+    fimg = img.astype(np.float32)
+    ys = (np.arange(oh) + 0.5) * (h / oh) - 0.5
+    xs = (np.arange(ow) + 0.5) * (w / ow) - 0.5
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    y0c, y1c = y0.clip(0, h - 1), (y0 + 1).clip(0, h - 1)
+    x0c, x1c = x0.clip(0, w - 1), (x0 + 1).clip(0, w - 1)
+    top = fimg[y0c][:, x0c] * (1 - wx) + fimg[y0c][:, x1c] * wx
+    bot = fimg[y1c][:, x0c] * (1 - wx) + fimg[y1c][:, x1c] * wx
+    out = top * (1 - wy) + bot * wy
+    if np.issubdtype(dtype, np.integer):
+        out = np.round(out).clip(np.iinfo(dtype).min, np.iinfo(dtype).max)
+    return out.astype(dtype)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    pads = [(pt, pb), (pl, pr), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(img, pads, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, pads, mode=mode)
+
+
+def crop(img, top, left, height, width):
+    img = _as_hwc(img)
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _as_hwc(img)
+    dtype = img.dtype
+    out = img.astype(np.float32) * brightness_factor
+    if np.issubdtype(dtype, np.integer):
+        out = out.clip(0, 255)
+    return out.astype(dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _as_hwc(img)
+    dtype = img.dtype
+    fimg = img.astype(np.float32)
+    mean = fimg.mean(axis=(0, 1), keepdims=True).mean()
+    out = (fimg - mean) * contrast_factor + mean
+    if np.issubdtype(dtype, np.integer):
+        out = out.clip(0, 255)
+    return out.astype(dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    img = _as_hwc(img)
+    dtype = img.dtype
+    fimg = img.astype(np.float32)
+    gray = fimg @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if fimg.shape[-1] == 3 else fimg.mean(-1)
+    gray = gray[..., None]
+    out = (fimg - gray) * saturation_factor + gray
+    if np.issubdtype(dtype, np.integer):
+        out = out.clip(0, 255)
+    return out.astype(dtype)
+
+
+def adjust_hue(img, hue_factor):
+    if not (-0.5 <= hue_factor <= 0.5):
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = _as_hwc(img)
+    dtype = img.dtype
+    f = img.astype(np.float32) / (255.0 if np.issubdtype(dtype, np.integer)
+                                  else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f.max(-1)
+    minc = f.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rc = (maxc - r) / np.maximum(delta, 1e-12)
+        gc = (maxc - g) / np.maximum(delta, 1e-12)
+        bc = (maxc - b) / np.maximum(delta, 1e-12)
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * fr)
+    t = v * (1.0 - s * (1.0 - fr))
+    i = i.astype(np.int64) % 6
+    choices = [
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1),
+    ]
+    out = np.select([i[..., None] == k for k in range(6)], choices)
+    if np.issubdtype(dtype, np.integer):
+        out = (out * 255.0).clip(0, 255)
+    return out.astype(dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees (nearest sampling)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if center is None:
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    else:
+        cx, cy = center
+    if expand:
+        nh = int(round(abs(h * cos) + abs(w * sin)))
+        nw = int(round(abs(w * cos) + abs(h * sin)))
+    else:
+        nh, nw = h, w
+    ocy, ocx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    # inverse map: output coords -> input coords
+    ys = (yy - ocy) * cos - (xx - ocx) * sin + cy
+    xs = (yy - ocy) * sin + (xx - ocx) * cos + cx
+    yi = np.round(ys).astype(np.int64)
+    xi = np.round(xs).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full((nh, nw, img.shape[2]), fill, dtype=img.dtype)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img)
+    dtype = img.dtype
+    gray = img.astype(np.float32) @ np.array([0.299, 0.587, 0.114], np.float32)
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    if np.issubdtype(dtype, np.integer):
+        gray = gray.clip(0, 255)
+    return gray.astype(dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase rectangle (ref functional.py erase). Works on HWC or CHW arrays."""
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    if out.ndim == 3 and out.shape[0] in (1, 3) and out.shape[2] > 4:
+        out[:, i:i + h, j:j + w] = v  # CHW
+    else:
+        out[i:i + h, j:j + w] = v
+    return out
