@@ -1,10 +1,14 @@
 """Benchmark entry — prints ONE JSON line.
 
-Workload: Llama-125M-class causal-LM training step (BASELINE.md configs 2/5
-scaled to one chip): bf16 params, seq 1024, full fused fwd+bwd+AdamW in a
-single donated XLA executable (paddle.incubate.fused_train_step — the
-framework's perf path; the reference's analog is its fused CUDA optimizer +
-multi-stream executor).
+Default workload: Llama-125M-class causal-LM training step (BASELINE.md
+configs 2/5 scaled to one chip): bf16 params, seq 1024, full fused
+fwd+bwd+AdamW in a single donated XLA executable
+(paddle.incubate.fused_train_step — the framework's perf path; the
+reference's analog is its fused CUDA optimizer + multi-stream executor).
+
+Extra workloads (BASELINE configs 1 and 4), selected by argv[1] or
+BENCH_WORKLOAD env: ``resnet50`` (images/sec) and ``deepfm`` (examples/sec).
+The driver's default invocation still prints the flagship llama line.
 
 Metrics: steady-state training tokens/sec AND model-FLOPs-utilisation
 (MFU = model TFLOPs / chip peak bf16 TFLOPs; FLOPs/token = 6N + 12*L*h*s,
@@ -62,6 +66,133 @@ def _train_flops_per_token(cfg, n_params, seq):
     return 6.0 * n_params + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
 
 
+def _bench_loop(step, make_batch, batch_sizes, steps, warmup, rebuild):
+    """Shared sweep-then-measure loop; returns (items/sec, batch_size)."""
+    import time
+
+    def measure(bs, n_steps, n_warmup):
+        batch = make_batch(bs)
+        for _ in range(n_warmup):
+            loss = step(*batch)
+        float(loss.numpy())
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            loss = step(*batch)
+        float(loss.numpy())
+        return bs * n_steps / (time.perf_counter() - t0)
+
+    best_bs, best_ips = None, 0.0
+    for bs in batch_sizes:
+        try:
+            ips = measure(bs, max(steps // 3, 2), warmup)
+        except Exception:
+            step = rebuild()
+            break
+        if ips > best_ips:
+            best_bs, best_ips = bs, ips
+    if best_bs is None:
+        best_bs = max(batch_sizes[0] // 2, 1)
+    return measure(best_bs, steps, 1), best_bs
+
+
+def bench_resnet50(on_tpu):
+    """BASELINE config 1: ResNet-50 training images/sec, bf16, fused step."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision import models
+
+    paddle.seed(0)
+    np.random.seed(0)
+    if on_tpu:
+        depth, img, steps, warmup, batch_sizes = 50, 224, 12, 2, [64, 128, 256]
+    else:
+        depth, img, steps, warmup, batch_sizes = 18, 32, 3, 1, [4]
+
+    class WithLoss(paddle.nn.Layer):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, x, y):
+            return F.cross_entropy(self.inner(x), y)
+
+    def build():
+        m = models.ResNet(models.BottleneckBlock if depth == 50
+                          else models.BasicBlock, depth, num_classes=1000)
+        m.bfloat16()
+        m.train()
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=m.parameters())
+        return paddle.incubate.fused_train_step(WithLoss(m), opt)
+
+    step = build()
+
+    def make_batch(bs):
+        x = paddle.to_tensor(
+            np.random.randn(bs, 3, img, img).astype(np.float32)
+        ).astype("bfloat16")
+        y = paddle.to_tensor(np.random.randint(0, 1000, (bs,)))
+        return x, y
+
+    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec" if on_tpu
+                  else "resnet18_cpu_train_images_per_sec",
+        "value": round(ips, 1), "unit": "images/s", "vs_baseline": None,
+        "batch_size": bs, "image_size": img,
+        "baseline_note": "reference publishes no in-tree numbers",
+    }))
+
+
+def bench_deepfm(on_tpu):
+    """BASELINE config 4: DeepFM (criteo config) training examples/sec."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models import DeepFM
+
+    paddle.seed(0)
+    np.random.seed(0)
+    vocab, nfield, dense_dim = (1000001, 26, 13)
+    if on_tpu:
+        steps, warmup, batch_sizes = 20, 3, [4096, 8192, 16384]
+    else:
+        vocab, steps, warmup, batch_sizes = 10001, 4, 1, [256]
+
+    class WithLoss(paddle.nn.Layer):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, ids, dense, label):
+            return F.binary_cross_entropy(self.inner(ids, dense), label)
+
+    def build():
+        m = DeepFM(vocab, 9, dense_dim, nfield, layer_sizes=(512, 256, 128))
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        return paddle.incubate.fused_train_step(WithLoss(m), opt)
+
+    step = build()
+
+    def make_batch(bs):
+        ids = paddle.to_tensor(
+            np.random.randint(0, vocab, (bs, nfield)).astype(np.int32))
+        dense = paddle.to_tensor(
+            np.random.randn(bs, dense_dim).astype(np.float32))
+        label = paddle.to_tensor(
+            np.random.randint(0, 2, (bs, 1)).astype(np.float32))
+        return ids, dense, label
+
+    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
+    print(json.dumps({
+        "metric": "deepfm_train_examples_per_sec",
+        "value": round(ips, 1), "unit": "examples/s", "vs_baseline": None,
+        "batch_size": bs, "vocab": vocab,
+        "baseline_note": "reference publishes no in-tree numbers",
+    }))
+
+
 def main():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_125m
@@ -103,38 +234,22 @@ def main():
 
     step, n_params = build_step()
 
-    def measure(bs, n_steps, n_warmup):
+    def rebuild():
+        # OOM invalidates the donated param buffers — rebuild fresh
+        nonlocal n_params
+        s, n_params = build_step()
+        return s
+
+    def make_batch(bs):
         ids = paddle.to_tensor(
             np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
         labels = paddle.to_tensor(
             np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
-        for _ in range(n_warmup):
-            loss = step(ids, labels)
-        float(loss.numpy())  # sync
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            loss = step(ids, labels)
-        float(loss.numpy())  # sync
-        dt = time.perf_counter() - t0
-        return bs * seq * n_steps / dt
+        return ids, labels
 
-    # batch-size sweep (short), then steady-state at the winner; only fall
-    # back to a size that actually succeeded (best_bs stays None until one
-    # measurement completes — if even the smallest OOMs, shrink it)
-    best_bs, best_tps = None, 0.0
-    for bs in batch_sizes:
-        try:
-            tps = measure(bs, max(steps // 3, 2), warmup)
-        except Exception:
-            # OOM at this size — a failed donated step invalidates the
-            # param buffers, so rebuild before the steady-state measure
-            step, n_params = build_step()
-            break
-        if tps > best_tps:
-            best_bs, best_tps = bs, tps
-    if best_bs is None:
-        best_bs = max(batch_sizes[0] // 2, 1)
-    tokens_per_sec = measure(best_bs, steps, 1)
+    seqs_per_sec, best_bs = _bench_loop(step, make_batch, batch_sizes, steps,
+                                        warmup, rebuild)
+    tokens_per_sec = seqs_per_sec * seq
 
     flops_per_token = _train_flops_per_token(cfg, n_params, seq)
     achieved = tokens_per_sec * flops_per_token
@@ -158,4 +273,20 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    workload = (sys.argv[1] if len(sys.argv) > 1
+                else os.environ.get("BENCH_WORKLOAD", "llama"))
+    _on_tpu = True
+    try:
+        import jax
+
+        _on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        pass
+    if workload == "resnet50":
+        bench_resnet50(_on_tpu)
+    elif workload == "deepfm":
+        bench_deepfm(_on_tpu)
+    else:
+        main()

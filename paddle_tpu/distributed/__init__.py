@@ -102,6 +102,7 @@ from .auto_parallel import (  # noqa: E402,F401
 )
 from .parallel import DataParallel  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
+from . import ps  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
 

@@ -1,0 +1,97 @@
+"""Parameter-server equivalent: SPMD-sharded sparse embedding tables.
+
+Reference: the brpc parameter-server stack —
+``paddle/fluid/distributed/ps/service/brpc_ps_server.h:1`` (servers),
+``paddle/fluid/distributed/ps/table/memory_sparse_table.cc:1`` (sparse tables),
+``python/paddle/distributed/ps/the_one_ps.py:1`` (python orchestration),
+``python/paddle/static/nn/common.py`` ``sparse_embedding`` (user API).
+
+TPU-native redesign (SURVEY.md §7.1 "PS / sparse tables"): there are no
+separate server processes — the embedding table is a normal parameter
+row-sharded over a mesh axis (SparseCore-style). A lookup is a plain gather
+with the table sharded on dim 0; GSPMD compiles it to exactly the PS
+pull protocol: each device gathers the rows it owns (masked local gather) and
+an all-reduce combines partial rows across table shards — verified in
+tests/test_deepfm.py by inspecting the compiled HLO. The gradient transposes
+to a local scatter-add, which is the PS push. Sync/async/geo modes collapse:
+SPMD training is synchronous by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn import functional as F
+from ...nn.initializer import Uniform
+from ...nn.layer.layers import Layer
+
+__all__ = ["SparseEmbedding", "sparse_embedding"]
+
+
+def _table_mesh(mesh, axis):
+    """Resolve (mesh, axis-names tuple) for table sharding."""
+    if mesh is None:
+        from ..fleet.fleet import fleet_singleton
+
+        try:
+            mesh = fleet_singleton.get_hybrid_communicate_group().mesh
+        except Exception:
+            return None, ()
+    if isinstance(axis, str):
+        axis = (axis,)
+    axis = tuple(a for a in axis if a in mesh.shape and mesh.shape[a] > 1)
+    return mesh, axis
+
+
+class SparseEmbedding(Layer):
+    """Row-sharded embedding table — the ``sparse_embedding`` /
+    ``memory_sparse_table`` analog.
+
+    ``axis`` names the mesh axes the vocab dim shards over (default the data
+    axis: in PS deployments the table is partitioned across the same hosts
+    that hold the data shards). On a 1-wide axis or without a mesh this is a
+    plain Embedding.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, axis=("dp",),
+                 padding_idx=None, weight_attr=None, mesh=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        mesh, axes = _table_mesh(mesh, axis)
+        self._mesh = mesh
+        self._axes = axes
+        # pad the row count up to a shard multiple so arbitrary vocab sizes
+        # (criteo's 1000001) still shard; ids never index the pad rows, and
+        # their grads stay zero
+        rows = num_embeddings
+        nshards = 1
+        if mesh is not None and axes:
+            nshards = int(np.prod([mesh.shape[a] for a in axes]))
+            rows = -(-num_embeddings // nshards) * nshards
+        scale = 1.0 / np.sqrt(embedding_dim)
+        self.weight = self.create_parameter(
+            [rows, embedding_dim], attr=weight_attr,
+            default_initializer=Uniform(-scale, scale))
+        if nshards > 1:
+            spec = (axes if len(axes) > 1 else axes[0], None)
+            sharding = NamedSharding(mesh, P(*spec))
+            self.weight._data = jax.device_put(self.weight._data, sharding)
+            self.weight._placement = (mesh, spec)
+
+    def forward(self, x):
+        # plain gather; GSPMD turns it into masked local gather + all-reduce
+        # when the table is sharded (the PS pull)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", **kwargs):
+    """Functional facade matching paddle.static.nn.sparse_embedding's
+    signature shape: builds a SparseEmbedding and applies it."""
+    layer = SparseEmbedding(size[0], size[1], padding_idx=padding_idx,
+                            weight_attr=param_attr)
+    return layer(input)

@@ -2,6 +2,7 @@
 BASELINE workloads come from: PaddleNLP Llama/ERNIE, PaddleClas ResNet,
 PaddleRec DeepFM)."""
 
+from .deepfm import DeepFM, deepfm_criteo  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_1b, llama_7b, llama_13b,
     llama_125m, llama_small, llama_tiny,

@@ -1,0 +1,84 @@
+"""DeepFM — sparse recommendation model (BASELINE config 4).
+
+Capability analog of PaddleRec's DeepFM on the reference's parameter-server
+path (``python/paddle/distributed/ps/the_one_ps.py:1``; sparse tables
+``paddle/fluid/distributed/ps/table/memory_sparse_table.cc:1``). Here the
+sparse tables are ``distributed.ps.SparseEmbedding`` — mesh-sharded rows with
+GSPMD-compiled pull/push (see that module's docstring) — and the whole model
+trains as one SPMD program: the dense DNN is where the MXU FLOPs are, the
+embedding gathers ride the all-reduce.
+
+Structure (standard DeepFM):
+- first order: per-feature scalar weights, summed (+ dense linear term)
+- second order: FM pairwise interactions 0.5·((Σe)² − Σe²) over field embeddings
+- deep: MLP over concatenated field embeddings + dense features
+- output: sigmoid(first + second + deep)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..distributed.ps import SparseEmbedding
+
+__all__ = ["DeepFM", "deepfm_criteo"]
+
+
+class DeepFM(nn.Layer):
+    def __init__(self, sparse_feature_number, sparse_feature_dim,
+                 dense_feature_dim, sparse_num_field,
+                 layer_sizes=(512, 256, 128), table_axis=("dp",)):
+        super().__init__()
+        self.sparse_feature_number = sparse_feature_number
+        self.sparse_feature_dim = sparse_feature_dim
+        self.dense_feature_dim = dense_feature_dim
+        self.sparse_num_field = sparse_num_field
+
+        # sparse tables (PS analog)
+        self.embedding = SparseEmbedding(
+            sparse_feature_number, sparse_feature_dim, axis=table_axis)
+        self.first_order_weight = SparseEmbedding(
+            sparse_feature_number, 1, axis=table_axis)
+        # dense-side first order + projection of dense features into a
+        # pseudo-field embedding so they join the FM interaction
+        self.dense_linear = nn.Linear(dense_feature_dim, 1)
+        self.dense_emb = nn.Linear(dense_feature_dim, sparse_feature_dim)
+
+        mlp_in = (sparse_num_field + 1) * sparse_feature_dim
+        layers = []
+        for size in layer_sizes:
+            layers.append(nn.Linear(mlp_in, size))
+            layers.append(nn.ReLU())
+            mlp_in = size
+        layers.append(nn.Linear(mlp_in, 1))
+        self.dnn = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_x):
+        """sparse_ids int [B, F]; dense_x float [B, dense_feature_dim]."""
+        import paddle_tpu as paddle
+
+        B = sparse_ids.shape[0]
+        emb = self.embedding(sparse_ids)  # [B, F, D]
+        demb = self.dense_emb(dense_x).unsqueeze(1)  # [B, 1, D]
+        fields = paddle.concat([emb, demb], axis=1)  # [B, F+1, D]
+
+        # first order
+        first = (self.first_order_weight(sparse_ids).squeeze(-1).sum(-1,
+                                                                     keepdim=True)
+                 + self.dense_linear(dense_x))  # [B, 1]
+
+        # second order (FM identity)
+        sum_sq = fields.sum(1) ** 2  # [B, D]
+        sq_sum = (fields ** 2).sum(1)  # [B, D]
+        second = 0.5 * (sum_sq - sq_sum).sum(-1, keepdim=True)  # [B, 1]
+
+        deep = self.dnn(fields.reshape([B, -1]))  # [B, 1]
+        return paddle.nn.functional.sigmoid(first + second + deep)
+
+
+def deepfm_criteo(sparse_feature_number=1000001, sparse_feature_dim=9,
+                  dense_feature_dim=13, sparse_num_field=26, **kwargs):
+    """Criteo-config DeepFM (the PaddleRec benchmark config)."""
+    return DeepFM(sparse_feature_number, sparse_feature_dim,
+                  dense_feature_dim, sparse_num_field, **kwargs)

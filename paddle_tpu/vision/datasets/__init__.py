@@ -126,7 +126,7 @@ class MNIST(Dataset):
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=False, backend=None):
-        if download and (image_path is None or label_path is None):
+        if image_path is None or label_path is None:
             raise RuntimeError(
                 f"{self.NAME} cannot be downloaded (no network egress); pass "
                 "image_path/label_path to local idx(.gz) files")
@@ -170,7 +170,7 @@ class Cifar10(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
-        if download and data_file is None:
+        if data_file is None:
             raise RuntimeError(
                 "Cifar cannot be downloaded (no network egress); pass "
                 "data_file to a local cifar tar.gz")

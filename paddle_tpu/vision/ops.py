@@ -313,7 +313,9 @@ def _deform_conv2d(x, offset, weight, mask=None, bias=None, stride=(1, 1),
     base_y = (jnp.arange(Hout) * sh - ph).astype(x.dtype)
     base_x = (jnp.arange(Wout) * sw - pw).astype(x.dtype)
 
-    def per_image(xi, oi, mi):
+    has_mask = mask is not None
+
+    def per_image(xi, oi, mi=None):
         # xi [Cin,H,W]; oi [dg,kk,2,Hout,Wout]; mi [dg,kk,Hout,Wout] or None
         cols = []
         for g in range(dg):
@@ -330,8 +332,10 @@ def _deform_conv2d(x, offset, weight, mask=None, bias=None, stride=(1, 1),
             cols.append(jnp.stack(taps, 1))  # [cpg, kk, Hout, Wout]
         return jnp.concatenate(cols, 0)  # [Cin, kk, Hout, Wout]
 
-    col = jax.vmap(per_image)(x, off, m if mask is not None else
-                              jnp.ones((N, dg, kh * kw, Hout, Wout), x.dtype))
+    if has_mask:
+        col = jax.vmap(per_image)(x, off, m)
+    else:  # v1 path: no mask tensor, no wasted multiplies
+        col = jax.vmap(lambda xi, oi: per_image(xi, oi))(x, off)
     # contract: weight [Cout, Cin_g, kh*kw] x col [N, Cin, kk, Hout, Wout]
     wf = weight.reshape(Cout, Cin_g, kh * kw)
     if groups == 1:

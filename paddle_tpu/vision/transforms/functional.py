@@ -218,9 +218,10 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         nh, nw = h, w
     ocy, ocx = (nh - 1) / 2.0, (nw - 1) / 2.0
     yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
-    # inverse map: output coords -> input coords
-    ys = (yy - ocy) * cos - (xx - ocx) * sin + cy
-    xs = (yy - ocy) * sin + (xx - ocx) * cos + cx
+    # inverse map: output coords -> input coords. Counter-clockwise for
+    # positive angle in image coords (y down) = rotate output coords by +θ.
+    ys = (yy - ocy) * cos + (xx - ocx) * sin + cy
+    xs = -(yy - ocy) * sin + (xx - ocx) * cos + cx
     yi = np.round(ys).astype(np.int64)
     xi = np.round(xs).astype(np.int64)
     valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
@@ -241,12 +242,13 @@ def to_grayscale(img, num_output_channels=1):
     return gray.astype(dtype)
 
 
-def erase(img, i, j, h, w, v, inplace=False):
-    """Erase rectangle (ref functional.py erase). Works on HWC or CHW arrays."""
+def erase(img, i, j, h, w, v, inplace=False, data_format="HWC"):
+    """Erase rectangle (ref functional.py erase). ``data_format`` says where
+    the spatial dims live ("HWC" or "CHW") — no shape guessing."""
     arr = np.asarray(img)
     out = arr if inplace else arr.copy()
-    if out.ndim == 3 and out.shape[0] in (1, 3) and out.shape[2] > 4:
-        out[:, i:i + h, j:j + w] = v  # CHW
+    if data_format == "CHW":
+        out[..., i:i + h, j:j + w] = v
     else:
         out[i:i + h, j:j + w] = v
     return out

@@ -175,6 +175,8 @@ class Transpose(BaseTransform):
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
+        if value < 0:
+            raise ValueError("brightness value must be non-negative")
         self.value = float(value)
 
     def _apply_image(self, img):
@@ -201,6 +203,8 @@ class ContrastTransform(BaseTransform):
 class SaturationTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
         self.value = float(value)
 
     def _apply_image(self, img):
@@ -314,21 +318,22 @@ class Grayscale(BaseTransform):
 
 class RandomErasing(BaseTransform):
     def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
-                 value=0, inplace=False, keys=None):
+                 value=0, inplace=False, keys=None, data_format="HWC"):
         super().__init__(keys)
         self.prob = prob
         self.scale = scale
         self.ratio = ratio
         self.value = value
         self.inplace = inplace
+        self.data_format = data_format
 
     def _apply_image(self, img):
         arr = np.asarray(img)
         if random.random() >= self.prob:
             return arr
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] > 4
-        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0],
-                                                         arr.shape[1])
+        chw = self.data_format == "CHW"
+        h, w = (arr.shape[-2], arr.shape[-1]) if chw else (arr.shape[0],
+                                                           arr.shape[1])
         area = h * w
         for _ in range(10):
             target = random.uniform(*self.scale) * area
@@ -340,5 +345,5 @@ class RandomErasing(BaseTransform):
                 top = random.randint(0, h - eh)
                 left = random.randint(0, w - ew)
                 return F.erase(arr, top, left, eh, ew, self.value,
-                               self.inplace)
+                               self.inplace, data_format=self.data_format)
         return arr

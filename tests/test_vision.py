@@ -116,12 +116,14 @@ class TestTransforms:
         out = transforms.RandomErasing(prob=1.0)(img)
         assert out.min() == 0.0
 
-    def test_rotation_90(self):
+    def test_rotation_90_counter_clockwise(self):
         img = np.zeros((5, 5, 1), np.uint8)
         img[0, :, 0] = 7  # top row
         out = transforms.functional.rotate(img, 90)
         assert out.shape == (5, 5, 1)
         assert out.sum() == img.sum()
+        # CCW: top edge moves to the LEFT edge (paddle/PIL convention)
+        assert (out[:, 0, 0] == 7).all()
 
 
 class TestOps:
