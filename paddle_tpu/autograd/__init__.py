@@ -24,17 +24,16 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None, name=None):
     """paddle.grad analog (imperative partial-grad GeneralGrad,
-    paddle/fluid/eager/general_grad.h)."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported yet"
-        )
+    paddle/fluid/eager/general_grad.h). ``create_graph=True`` threads the
+    backward through dispatch so the returned grads are differentiable
+    (double grad); retain_graph then defaults to True like the reference."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     capture = {id(t): t for t in inputs}
-    retain = bool(retain_graph) if retain_graph is not None else False
+    retain = (bool(retain_graph) if retain_graph is not None
+              else bool(create_graph))
     captured = run_backward(list(outputs), grad_outputs, retain_graph=retain,
-                            capture=capture)
+                            capture=capture, create_graph=create_graph)
     results = []
     for t in inputs:
         g = captured.get(id(t))
@@ -46,7 +45,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 )
             results.append(None)
         else:
-            results.append(Tensor._wrap(g))
+            results.append(g if isinstance(g, Tensor) else Tensor._wrap(g))
     return results
 
 

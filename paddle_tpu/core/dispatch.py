@@ -137,7 +137,8 @@ def call_op(name: str, *args, **kwargs):
         else:
             edges = [Edge.from_tensor(t) if t is not None else Edge(stop=True)
                      for t in tensor_args]
-            node = GradNode(name, bwd, tuple(arrs), edges, out_avals, out_is_tuple)
+            node = GradNode(name, bwd, tuple(arrs), edges, out_avals,
+                            out_is_tuple, op_kwargs=kwargs_key)
     return _wrap_out(out, node, requires_grad)
 
 
@@ -145,6 +146,8 @@ def _wrap_out(out, node, requires_grad):
     from .tensor import Tensor
 
     def wrap(o, idx):
+        if o is None:
+            return None
         t = Tensor._wrap(o)
         t.stop_gradient = not requires_grad
         if node is not None:
@@ -155,6 +158,36 @@ def _wrap_out(out, node, requires_grad):
     if isinstance(out, (list, tuple)):
         return type(out)(wrap(o, i) for i, o in enumerate(out))
     return wrap(out, 0)
+
+
+def _op_vjp_fn(*arrs, op_name="", n_primals=0, op_kwargs=(), out_tuple=False):
+    """Generic VJP-as-an-op: running an op's backward THROUGH dispatch makes
+    the backward's ops land on the tape, which is what ``create_graph=True``
+    (double grad) needs. Analog of the reference's higher-order grad nodes
+    (paddle/fluid/eager/general_grad.h:1 + double-grad ops in backward.yaml).
+
+    Positional args: the node's primal inputs followed by the output
+    cotangents; statics identify the forward op. Returns one grad per primal
+    (dummy scalar zeros where jax reports float0 / None — those slots align
+    with stop edges and are never consumed).
+    """
+    opdef = OPS[op_name]
+    kw = {k: _unhash_dtype(v) for k, v in op_kwargs}
+    primals = arrs[:n_primals]
+    cts = arrs[n_primals:]
+
+    def f(*ps):
+        return opdef.fn(*ps, **kw)
+
+    _, vjp = jax.vjp(f, *primals)
+    grads = vjp(tuple(cts) if out_tuple else cts[0])
+    out = []
+    for g, p in zip(grads, primals):
+        if g is None or getattr(g, "dtype", None) == jax.dtypes.float0:
+            out.append(jnp.zeros((), jnp.float32))  # stop-edge slot
+        else:
+            out.append(g)
+    return tuple(out)
 
 
 def op(name=None, differentiable=True):
@@ -179,3 +212,7 @@ def op(name=None, differentiable=True):
         return wrapper
 
     return deco
+
+
+# generic VJP op used by the engine for create_graph=True backward
+OPS["__op_vjp__"] = OpDef("__op_vjp__", _op_vjp_fn, differentiable=True)

@@ -60,10 +60,12 @@ class GradNode:
         "n_out",
         "out_is_tuple",
         "output_hooks",
+        "op_kwargs",
         "__weakref__",
     )
 
-    def __init__(self, name, bwd, primals, edges, out_avals, out_is_tuple):
+    def __init__(self, name, bwd, primals, edges, out_avals, out_is_tuple,
+                 op_kwargs=None):
         self.id = next(_node_counter)
         self.name = name
         self.bwd = bwd
@@ -73,6 +75,9 @@ class GradNode:
         self.n_out = len(out_avals)
         self.out_is_tuple = out_is_tuple
         self.output_hooks = {}  # out_idx -> [fn]
+        # static-kwargs key of the forward op (dispatch ops only) — lets the
+        # engine replay this node's VJP through dispatch for create_graph=True
+        self.op_kwargs = op_kwargs
 
     def __repr__(self):
         return f"<GradNode {self.name}#{self.id}>"
@@ -91,8 +96,55 @@ def _accumulate(slot, g):
     return g if slot is None else slot + g
 
 
+def _node_vjp_through_dispatch(node, cts):
+    """Run a dispatch-op node's VJP as a dispatched op so the backward's own
+    ops are recorded on the tape (create_graph=True). Rebuilds tensor views of
+    the primals carrying their original graph links, so second-order paths
+    through the primals (e.g. d²(x²)/dx² via the saved x) stay connected."""
+    from . import dispatch
+    from .tensor import Tensor
+
+    prim_ts = []
+    stand_in_fix = []  # (arg index, original leaf) for mutated leaves
+    for i, (e, arr) in enumerate(zip(node.edges, node.primals)):
+        if arr is None:
+            prim_ts.append(None)
+            continue
+        if e.leaf_ref is not None:
+            t = e.leaf_ref()
+            if t is not None and t._data is arr:
+                prim_ts.append(t)
+                continue
+            # leaf mutated in place since forward (fill_/optimizer step):
+            # compute at the SAVED primal, then re-point the new node's edge
+            # at the original leaf so second-order grads still reach it
+            s = Tensor._wrap(arr)
+            if t is not None:
+                s.stop_gradient = t.stop_gradient
+                stand_in_fix.append((i, t))
+            prim_ts.append(s)
+            continue
+        t = Tensor._wrap(arr)
+        if e.node is not None and not e.stop:
+            t._node, t._out_idx = e.node, e.out_idx
+            t.stop_gradient = False
+        prim_ts.append(t)
+    out = dispatch.call_op(
+        "__op_vjp__", *prim_ts, *cts,
+        op_name=node.name, n_primals=len(prim_ts),
+        op_kwargs=node.op_kwargs, out_tuple=node.out_is_tuple)
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    if stand_in_fix:
+        new_node = next((o._node for o in outs
+                         if o is not None and o._node is not None), None)
+        if new_node is not None:
+            for i, t in stand_in_fix:
+                new_node.edges[i] = Edge.from_tensor(t)
+    return outs
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
-                 accumulate_others=False):
+                 accumulate_others=False, create_graph=False):
     """Backward pass from ``tensors``.
 
     capture: optional dict mapping ``id(tensor)`` -> tensor for which the
@@ -101,9 +153,16 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     ``capture`` is given (grad API semantics: don't touch .grad);
     accumulate_others=True restores .grad accumulation for non-captured
     leaves (recompute's inner backward needs both).
+
+    create_graph: cotangents are threaded as Tensors and each node's VJP runs
+    through dispatch, so the returned/captured grads are themselves
+    differentiable (reference: paddle/fluid/eager/general_grad.h double grad).
+    Implies retain_graph.
     """
     from .tensor import Tensor
 
+    if create_graph:
+        retain_graph = True
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
     if grad_tensors is None:
@@ -125,6 +184,16 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             else:
                 leaf_capture[id(t)] = tid
 
+    def as_ct(g):
+        """Normalize a cotangent to the walk's working form: Tensor when
+        create_graph (so it stays differentiable), raw array otherwise."""
+        if create_graph:
+            return g if isinstance(g, Tensor) else Tensor._wrap(jnp.asarray(g))
+        return g._data if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def raw(g):
+        return g._data if isinstance(g, Tensor) else g
+
     def seed(t, g):
         if g is None:
             if t.size != 1:
@@ -133,13 +202,12 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
                     f"got shape {t.shape}"
                 )
             g = jnp.ones(t._data.shape, t._data.dtype)
-        else:
-            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        g = as_ct(g)
         if t._node is None:
             # backward() on a leaf: its grad is just the seed
             if not t.stop_gradient:
                 if capture is None:
-                    t._accumulate_grad(g)
+                    t._accumulate_grad(raw(g))
                 elif id(t) in leaf_capture:
                     captured[leaf_capture[id(t)]] = g
             return
@@ -160,57 +228,82 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
                 nodes[e.node.id] = e.node
                 stack.append(e.node)
 
-    for nid in sorted(nodes.keys(), reverse=True):
-        node = nodes[nid]
-        cts = node_cts.get(nid)
-        if cts is None:
-            continue  # not actually on a path from the roots
-        # apply output hooks (registered via Tensor.register_hook on non-leafs)
-        for oi, fns in node.output_hooks.items():
-            if cts[oi] is not None:
-                for fn in fns:
-                    res = fn(Tensor._wrap(cts[oi]))
-                    if res is not None:
-                        cts[oi] = res._data if isinstance(res, Tensor) else jnp.asarray(res)
-        # captured non-leaf cotangents
-        for oi in range(node.n_out):
-            for tid in capture_nodes.get((nid, oi), ()):
+    from . import state as _state
+
+    grad_guard = _state.enable_grad_guard() if create_graph else None
+    if grad_guard is not None:
+        grad_guard.__enter__()
+    try:
+        for nid in sorted(nodes.keys(), reverse=True):
+            node = nodes[nid]
+            cts = node_cts.get(nid)
+            if cts is None:
+                continue  # not actually on a path from the roots
+            # apply output hooks (via Tensor.register_hook on non-leafs)
+            for oi, fns in node.output_hooks.items():
                 if cts[oi] is not None:
-                    captured[tid] = cts[oi]
-        if node.bwd is None:
-            continue
-        full_cts = [
-            c if c is not None else _zeros(node.out_avals[i]) for i, c in enumerate(cts)
-        ]
-        cts_struct = tuple(full_cts) if node.out_is_tuple else full_cts[0]
-        grads = node.bwd(node.primals, cts_struct)
-        if not isinstance(grads, (list, tuple)):
-            grads = (grads,)
-        for e, g in zip(node.edges, grads):
-            if e.stop or _is_float0(g):
+                    for fn in fns:
+                        res = fn(cts[oi] if isinstance(cts[oi], Tensor)
+                                 else Tensor._wrap(cts[oi]))
+                        if res is not None:
+                            cts[oi] = as_ct(res)
+            # captured non-leaf cotangents
+            for oi in range(node.n_out):
+                for tid in capture_nodes.get((nid, oi), ()):
+                    if cts[oi] is not None:
+                        captured[tid] = cts[oi]
+            if node.bwd is None:
                 continue
-            if e.node is not None:
-                tgt = node_cts.setdefault(e.node.id, [None] * e.node.n_out)
-                tgt[e.out_idx] = _accumulate(tgt[e.out_idx], g)
-            elif e.leaf_ref is not None:
-                t = e.leaf_ref()
-                if t is None or t.stop_gradient:
+            full_cts = [
+                c if c is not None else as_ct(_zeros(node.out_avals[i]))
+                for i, c in enumerate(cts)
+            ]
+            from .dispatch import OPS as _OPS
+
+            if (create_graph and node.op_kwargs is not None
+                    and node.name in _OPS):
+                grads = _node_vjp_through_dispatch(node, full_cts)
+            else:
+                raw_cts = [raw(c) for c in full_cts]
+                cts_struct = (tuple(raw_cts) if node.out_is_tuple
+                              else raw_cts[0])
+                grads = node.bwd(node.primals, cts_struct)
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                if create_graph:
+                    # not replayable through dispatch (PyLayer/program nodes):
+                    # grads are correct but constant w.r.t. further diff
+                    grads = tuple(None if g is None or _is_float0(g)
+                                  else as_ct(g) for g in grads)
+            for e, g in zip(node.edges, grads):
+                if e.stop or _is_float0(g):
                     continue
-                for fn in t._hooks:
-                    res = fn(Tensor._wrap(g))
-                    if res is not None:
-                        g = res._data if isinstance(res, Tensor) else jnp.asarray(res)
-                if capture is None:
-                    t._accumulate_grad(g)
-                elif id(t) in leaf_capture:
-                    captured[leaf_capture[id(t)]] = _accumulate(
-                        captured.get(leaf_capture[id(t)]), g
-                    )
-                elif accumulate_others:
-                    t._accumulate_grad(g)
-        node_cts[nid] = None  # free cotangent memory as we go
-        if not retain_graph:
-            node.primals = None
-            node.bwd = None
+                if e.node is not None:
+                    tgt = node_cts.setdefault(e.node.id, [None] * e.node.n_out)
+                    tgt[e.out_idx] = _accumulate(tgt[e.out_idx], g)
+                elif e.leaf_ref is not None:
+                    t = e.leaf_ref()
+                    if t is None or t.stop_gradient:
+                        continue
+                    for fn in t._hooks:
+                        res = fn(g if isinstance(g, Tensor)
+                                 else Tensor._wrap(g))
+                        if res is not None:
+                            g = as_ct(res)
+                    if capture is None:
+                        t._accumulate_grad(raw(g))
+                    elif id(t) in leaf_capture:
+                        captured[leaf_capture[id(t)]] = _accumulate(
+                            captured.get(leaf_capture[id(t)]), g
+                        )
+                    elif accumulate_others:
+                        t._accumulate_grad(raw(g))
+            node_cts[nid] = None  # free cotangent memory as we go
+            if not retain_graph:
+                node.primals = None
+                node.bwd = None
+    finally:
+        if grad_guard is not None:
+            grad_guard.__exit__(None, None, None)
 
     return captured

@@ -88,10 +88,30 @@ class SparseEmbedding(Layer):
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
 
 
+_FUNCTIONAL_TABLES: dict = {}
+
+
 def sparse_embedding(input, size, padding_idx=None, param_attr=None,
-                     dtype="float32", **kwargs):
+                     dtype="float32", name=None, **kwargs):
     """Functional facade matching paddle.static.nn.sparse_embedding's
-    signature shape: builds a SparseEmbedding and applies it."""
-    layer = SparseEmbedding(size[0], size[1], padding_idx=padding_idx,
-                            weight_attr=param_attr)
+    signature shape. The table persists across calls keyed by
+    ``(name, size)`` — the dygraph analog of the reference creating one
+    persistent parameter in the static program. Prefer the SparseEmbedding
+    layer (whose weight joins ``model.parameters()``); for this facade fetch
+    the table via ``sparse_embedding.get_table(name, size)`` and pass its
+    ``.weight`` to the optimizer explicitly."""
+    key = (name or "sparse_embedding", tuple(int(s) for s in size))
+    layer = _FUNCTIONAL_TABLES.get(key)
+    if layer is None:
+        layer = SparseEmbedding(size[0], size[1], padding_idx=padding_idx,
+                                weight_attr=param_attr)
+        _FUNCTIONAL_TABLES[key] = layer
     return layer(input)
+
+
+def _get_table(name, size):
+    return _FUNCTIONAL_TABLES.get((name or "sparse_embedding",
+                                   tuple(int(s) for s in size)))
+
+
+sparse_embedding.get_table = _get_table

@@ -318,20 +318,26 @@ class Grayscale(BaseTransform):
 
 class RandomErasing(BaseTransform):
     def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
-                 value=0, inplace=False, keys=None, data_format="HWC"):
+                 value=0, inplace=False, keys=None, data_format=None):
         super().__init__(keys)
         self.prob = prob
         self.scale = scale
         self.ratio = ratio
         self.value = value
         self.inplace = inplace
+        # None = infer: framework Tensor input means post-ToTensor (CHW, the
+        # reference's convention); ndarray input means HWC
         self.data_format = data_format
 
     def _apply_image(self, img):
-        arr = np.asarray(img)
+        from ...core.tensor import Tensor as _Tensor
+
+        is_tensor = isinstance(img, _Tensor)
+        arr = img.numpy() if is_tensor else np.asarray(img)
+        fmt = self.data_format or ("CHW" if is_tensor else "HWC")
         if random.random() >= self.prob:
-            return arr
-        chw = self.data_format == "CHW"
+            return img
+        chw = fmt == "CHW"
         h, w = (arr.shape[-2], arr.shape[-1]) if chw else (arr.shape[0],
                                                            arr.shape[1])
         area = h * w
@@ -344,6 +350,11 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w:
                 top = random.randint(0, h - eh)
                 left = random.randint(0, w - ew)
-                return F.erase(arr, top, left, eh, ew, self.value,
-                               self.inplace, data_format=self.data_format)
-        return arr
+                out = F.erase(arr, top, left, eh, ew, self.value,
+                              self.inplace, data_format=fmt)
+                if is_tensor:
+                    from ...core.tensor import Tensor as _T
+
+                    return _T(out)
+                return out
+        return img

@@ -165,3 +165,71 @@ class TestInplace:
         x.fill_(100.0)  # rebind after graph capture
         y.backward()
         np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+class TestDoubleGrad:
+    """create_graph=True — reference: paddle/fluid/eager/general_grad.h and
+    the double-grad op tests in test/legacy_test."""
+
+    def test_cubic_second_derivative(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x ** 3).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0, 27.0], rtol=1e-5)
+        (g2,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), [12.0, 18.0], rtol=1e-5)
+
+    def test_tanh_second_derivative(self):
+        x = paddle.to_tensor([0.5], stop_gradient=False)
+        (g,) = paddle.grad(paddle.tanh(x), x, create_graph=True)
+        (g2,) = paddle.grad(g, x)
+        t = float(np.tanh(0.5))
+        np.testing.assert_allclose(g2.numpy(), [-2 * t * (1 - t * t)],
+                                   rtol=1e-5)
+
+    def test_matmul_grad_of_grad(self):
+        a = paddle.to_tensor(np.random.randn(3, 4).astype("float32"),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.random.randn(4, 2).astype("float32"),
+                             stop_gradient=False)
+        y = paddle.matmul(a, b).sum()
+        (ga,) = paddle.grad(y, a, create_graph=True)
+        (gb,) = paddle.grad(ga.sum(), b)
+        np.testing.assert_allclose(gb.numpy(), np.full((4, 2), 3.0),
+                                   rtol=1e-5)
+
+    def test_grad_result_still_differentiable_chain(self):
+        # third derivative of x^4: 24x
+        x = paddle.to_tensor([1.5], stop_gradient=False)
+        y = (x ** 4).sum()
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x, create_graph=True)
+        (g3,) = paddle.grad(g2, x)
+        np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-5)
+
+    def test_gradient_penalty_training(self):
+        # WGAN-GP-style: loss includes ||dD/dx||^2; backward through the
+        # penalty updates the critic weights
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"),
+                             stop_gradient=False)
+        out = lin(x).sum()
+        (gx,) = paddle.grad(out, x, create_graph=True)
+        penalty = ((gx ** 2).sum(-1) - 1.0) ** 2
+        loss = penalty.mean()
+        loss.backward()
+        g = lin.weight.grad
+        assert g is not None
+        # analytic: penalty depends on w only; dL/dw = 4(||w||^2-1)*w
+        w = lin.weight.numpy().reshape(-1)
+        expect = 4 * (np.sum(w * w) - 1.0) * w
+        np.testing.assert_allclose(g.numpy().reshape(-1), expect, rtol=1e-4)
+
+    def test_create_graph_defaults_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x ** 2).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        # graph retained: a second grad through y still works
+        (g_again,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g_again.numpy(), g.numpy())
