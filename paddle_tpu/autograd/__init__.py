@@ -27,6 +27,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     paddle/fluid/eager/general_grad.h). ``create_graph=True`` threads the
     backward through dispatch so the returned grads are differentiable
     (double grad); retain_graph then defaults to True like the reference."""
+    if create_graph and retain_graph is False:
+        # the second-order graph's edges point INTO the first-order graph;
+        # freeing it would silently zero later derivatives — refuse loudly
+        raise ValueError(
+            "create_graph=True requires the graph to be retained; do not "
+            "pass retain_graph=False")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     capture = {id(t): t for t in inputs}

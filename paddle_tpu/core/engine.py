@@ -229,6 +229,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
                 stack.append(e.node)
 
     from . import state as _state
+    from .dispatch import OPS as _OPS
 
     grad_guard = _state.enable_grad_guard() if create_graph else None
     if grad_guard is not None:
@@ -258,8 +259,6 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
                 c if c is not None else as_ct(_zeros(node.out_avals[i]))
                 for i, c in enumerate(cts)
             ]
-            from .dispatch import OPS as _OPS
-
             if (create_graph and node.op_kwargs is not None
                     and node.name in _OPS):
                 grads = _node_vjp_through_dispatch(node, full_cts)

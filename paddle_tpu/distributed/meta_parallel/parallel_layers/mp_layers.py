@@ -76,9 +76,11 @@ def _constrain(t, spec):
 
 class VocabParallelEmbedding(Layer):
     """reference mp_layers.py:47 — embedding table sharded along vocab dim.
-    GSPMD form: table sharded on dim 0; the masked-lookup + allreduce the
-    reference does manually is produced by XLA from a one_hot-matmul
-    formulation (keeps the gather unambiguous under sharding)."""
+    The lookup is a plain gather with the table sharded on dim 0: GSPMD
+    compiles it to the reference's masked local gather + allreduce
+    (mp_layers.py:108-120 does this by hand); verified against compiled HLO
+    in tests/test_distributed.py (no table all-gather, an all-reduce on the
+    activations)."""
 
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
                  mp_group=None, name=None):
@@ -92,29 +94,7 @@ class VocabParallelEmbedding(Layer):
         _shard_param(self.weight, ("mp", None))
 
     def forward(self, x):
-        mesh, mp = _mp_info()
-        if mp > 1 and isinstance(x._data, jax.core.Tracer):
-            # one-hot matmul: shard-friendly (vocab-contracting dim on 'mp'
-            # => psum inserted by GSPMD, exactly the reference's allreduce)
-            from ....core.dispatch import OPS
-
-            return _vocab_parallel_lookup(x, self.weight)
         return F.embedding(x, self.weight)
-
-
-from ....core.dispatch import op as _op
-
-
-@_op("vocab_parallel_lookup")
-def _vocab_parallel_lookup_fn(x, weight):
-    import jax.numpy as jnp
-
-    onehot = jax.nn.one_hot(x, weight.shape[0], dtype=weight.dtype)
-    return jnp.einsum("...v,vh->...h", onehot, weight)
-
-
-def _vocab_parallel_lookup(x, weight):
-    return _vocab_parallel_lookup_fn(x, weight)
 
 
 class ColumnParallelLinear(Layer):

@@ -157,10 +157,15 @@ class PipelineStageScan:
     """
 
     def __init__(self, pipeline_layer, mesh, axis="pp", num_micro=1,
-                 num_virtual=1, remat=True):
+                 num_virtual=1, remat=True, block_param_spec=None):
         self.layer = pipeline_layer
         self.mesh = mesh
         self.axis = axis
+        # optional hybrid-parallel hook: name -> per-dim mesh-axis tuple for
+        # the UNSTACKED block param (e.g. Megatron tp plan). The stacked
+        # array is then sharded P(pp, *spec); shard_map keeps pp manual and
+        # GSPMD handles the tp axes inside the stage body.
+        self.block_param_spec = block_param_spec
         self.S = mesh.shape[axis]
         self.V = int(num_virtual)
         self.M = int(num_micro)
@@ -222,15 +227,24 @@ class PipelineStageScan:
         epi_p, epi_b, self._epi_tensors = _chain_params(self.epilogue, "epi")
         per_block = [params_dict(b, include_buffers=True)
                      for b in self.blocks]
-        spec = NamedSharding(self.mesh, P(self.axis))
+
+        def sharding_for(name, arr):
+            inner = (None,) * (arr.ndim - 1)
+            if self.block_param_spec is not None:
+                spec = tuple(self.block_param_spec(name) or inner)
+                if len(spec) == arr.ndim - 1 and all(
+                        s is None
+                        or arr.shape[i + 1] % self.mesh.shape[s] == 0
+                        for i, s in enumerate(spec)):
+                    inner = spec
+            return NamedSharding(self.mesh, P(self.axis, *inner))
 
         def stack(names):
-            return {
-                name: jax.device_put(
-                    jnp.stack([per_block[i][name] for i in self.order]),
-                    spec)
-                for name in names
-            }
+            out = {}
+            for name in names:
+                arr = jnp.stack([per_block[i][name] for i in self.order])
+                out[name] = jax.device_put(arr, sharding_for(name, arr))
+            return out
 
         stacked = stack(self._block_param_names)
         stacked_buf = stack(self._block_buffer_names)

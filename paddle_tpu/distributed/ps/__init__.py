@@ -91,16 +91,31 @@ class SparseEmbedding(Layer):
 _FUNCTIONAL_TABLES: dict = {}
 
 
+def _table_key(name, size, padding_idx):
+    """Unnamed calls key on the CALL SITE (filename:lineno), so two distinct
+    unnamed embeddings of the same size get distinct tables while the same
+    call site reuses its table across training steps — matching the
+    reference, where each static-graph sparse_embedding op owns a uniquely
+    named parameter."""
+    import sys
+
+    if name is None:
+        f = sys._getframe(2)
+        name = f"{f.f_code.co_filename}:{f.f_lineno}"
+    return (name, tuple(int(s) for s in size),
+            None if padding_idx is None else int(padding_idx))
+
+
 def sparse_embedding(input, size, padding_idx=None, param_attr=None,
                      dtype="float32", name=None, **kwargs):
     """Functional facade matching paddle.static.nn.sparse_embedding's
-    signature shape. The table persists across calls keyed by
-    ``(name, size)`` — the dygraph analog of the reference creating one
-    persistent parameter in the static program. Prefer the SparseEmbedding
-    layer (whose weight joins ``model.parameters()``); for this facade fetch
-    the table via ``sparse_embedding.get_table(name, size)`` and pass its
-    ``.weight`` to the optimizer explicitly."""
-    key = (name or "sparse_embedding", tuple(int(s) for s in size))
+    signature shape. The table persists across calls (see _table_key).
+    Prefer the SparseEmbedding layer (whose weight joins
+    ``model.parameters()``); for this facade fetch the table via
+    ``sparse_embedding.get_table(...)`` and pass its ``.weight`` to the
+    optimizer explicitly; ``sparse_embedding.reset()`` clears all tables
+    (fresh model)."""
+    key = _table_key(name, size, padding_idx)
     layer = _FUNCTIONAL_TABLES.get(key)
     if layer is None:
         layer = SparseEmbedding(size[0], size[1], padding_idx=padding_idx,
@@ -109,9 +124,9 @@ def sparse_embedding(input, size, padding_idx=None, param_attr=None,
     return layer(input)
 
 
-def _get_table(name, size):
-    return _FUNCTIONAL_TABLES.get((name or "sparse_embedding",
-                                   tuple(int(s) for s in size)))
+def _get_table(name, size, padding_idx=None):
+    return _FUNCTIONAL_TABLES.get(_table_key(name, size, padding_idx))
 
 
 sparse_embedding.get_table = _get_table
+sparse_embedding.reset = _FUNCTIONAL_TABLES.clear

@@ -63,8 +63,20 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            segment_size=2**20, sync_comm=False,
                            dp_group=None, exclude_layer=None):
     """Reference group_sharded.py group_sharded_parallel(level in
-    {'os', 'os_g', 'p_g_os'})."""
+    {'os', 'os_g', 'p_g_os'}).
+
+    ``offload`` (CPU-offloaded state) is not supported on the TPU backend —
+    XLA owns HBM and host offload would serialize every step on PCIe; a
+    warning is raised rather than silently ignoring it. ``segment_size`` /
+    ``buffer_max_size`` (the reference's comm bucketing knobs) have no
+    effect: XLA schedules and fuses the collectives itself."""
     assert level in ("os", "os_g", "p_g_os"), level
+    if offload:
+        import warnings
+
+        warnings.warn(
+            "group_sharded_parallel(offload=True) is unsupported on the TPU "
+            "backend; continuing without offload", stacklevel=2)
     mesh, axis = _sharding_mesh()
     if mesh is None:
         return model, optimizer, scaler

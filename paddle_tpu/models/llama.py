@@ -349,3 +349,73 @@ def llama_13b(**kw):
     return LlamaConfig(hidden_size=5120, intermediate_size=13824,
                        num_hidden_layers=40, num_attention_heads=40,
                        num_key_value_heads=40, **kw)
+
+
+# ---- pipeline-parallel variant --------------------------------------------
+# Capability analog of PaddleNLP's LlamaForCausalLMPipe: the model expressed
+# as a PipelineLayer (LayerDesc list) so the compiled stage-scan engine
+# (distributed/meta_parallel/pp_scan.py) can pipeline it. Each block carries
+# its own rope buffers so the per-stage forward is a pure x -> x map (the
+# activation shape the ppermute rotation requires).
+
+
+class LlamaEmbeddingPipe(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=Normal(0.0, 0.02))
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class LlamaDecoderLayerPipe(LlamaDecoderLayer):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
+        super().__init__(config, layer_idx)
+        cos, sin = _rope_cache(config.max_position_embeddings,
+                               config.head_dim, config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, x):
+        s = x.shape[1]
+        return super().forward(x, self.rope_cos[:s], self.rope_sin[:s])
+
+
+class LlamaHeadPipe(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              weight_attr=Normal(0.0, 0.02), bias_attr=False)
+
+    def forward(self, h):
+        return self.lm_head(self.norm(h))
+
+
+class LlamaCausalLoss(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.vocab_size = config.vocab_size
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(M.reshape(logits, [-1, self.vocab_size]),
+                               M.reshape(labels, [-1]))
+
+
+def LlamaForCausalLMPipe(config: LlamaConfig, num_stages: int, **pp_kwargs):
+    """Build the flagship model as a PipelineLayer for the stage-scan engine.
+    MoE layers are structurally distinct from dense blocks (breaks the
+    uniform-stack contract), so the pipe variant requires num_experts=0."""
+    from ..distributed.meta_parallel import LayerDesc, PipelineLayer
+
+    if config.num_experts > 0:
+        raise ValueError("LlamaForCausalLMPipe requires a dense config "
+                         "(num_experts=0); MoE layers break the uniform "
+                         "block stack the stage scan pipelines")
+    descs = ([LayerDesc(LlamaEmbeddingPipe, config)]
+             + [LayerDesc(LlamaDecoderLayerPipe, config, i)
+                for i in range(config.num_hidden_layers)]
+             + [LayerDesc(LlamaHeadPipe, config)])
+    return PipelineLayer(layers=descs, num_stages=num_stages,
+                         loss_fn=LlamaCausalLoss(config), **pp_kwargs)

@@ -336,7 +336,7 @@ class RandomErasing(BaseTransform):
         arr = img.numpy() if is_tensor else np.asarray(img)
         fmt = self.data_format or ("CHW" if is_tensor else "HWC")
         if random.random() >= self.prob:
-            return img
+            return img if is_tensor else arr
         chw = fmt == "CHW"
         h, w = (arr.shape[-2], arr.shape[-1]) if chw else (arr.shape[0],
                                                            arr.shape[1])
@@ -357,4 +357,4 @@ class RandomErasing(BaseTransform):
 
                     return _T(out)
                 return out
-        return img
+        return img if is_tensor else arr
