@@ -41,8 +41,15 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
+from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
 from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from . import fft  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from .hapi import Model, callbacks  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
 

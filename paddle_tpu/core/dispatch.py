@@ -132,7 +132,7 @@ def call_op(name: str, *args, **kwargs):
         out_is_tuple = isinstance(out, (list, tuple))
         outs = tuple(out) if out_is_tuple else (out,)
         out_avals = [(o.shape, o.dtype) for o in outs]
-        if not any(jnp.issubdtype(av[1], jnp.floating) for av in out_avals):
+        if not any(jnp.issubdtype(av[1], jnp.inexact) for av in out_avals):
             requires_grad = False
         else:
             edges = [Edge.from_tensor(t) if t is not None else Edge(stop=True)

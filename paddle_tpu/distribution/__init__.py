@@ -1,0 +1,407 @@
+"""paddle.distribution — probability distributions.
+
+Reference: python/paddle/distribution/ (~7.6K LoC: Distribution base,
+kl registry, the concrete families). Sampling uses the framework RNG
+(core.rng) so paddle.seed controls it; densities are dispatch ops (jit-cached,
+differentiable via the tape like any other op).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as _rng
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Laplace", "Gumbel", "kl_divergence", "register_kl"]
+
+
+def _t(x, dtype=np.float32):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x, dtype))
+
+
+def _key():
+    return _rng.next_key()
+
+
+class Distribution:
+    """Base (ref distribution/distribution.py Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return self.log_prob(value).exp()
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+@op("normal_sample", differentiable=False)
+def _normal_sample(loc, scale, key, shape=()):
+    eps = jax.random.normal(key, shape, dtype=loc.dtype)
+    return loc + scale * eps
+
+
+@op("std_normal", differentiable=False)
+def _std_normal(key, shape=()):
+    return jax.random.normal(key, shape)
+
+
+class Normal(Distribution):
+    """ref distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def _full_shape(self, shape):
+        return tuple(shape) + self.batch_shape
+
+    def sample(self, shape=()):
+        return _normal_sample(self.loc, self.scale, _key(),
+                              shape=self._full_shape(shape))
+
+    def rsample(self, shape=()):
+        # reparameterization: the noise is a stop-gradient constant; the
+        # affine map runs through dispatch so grads flow to loc/scale
+        eps = _std_normal(_key(), shape=self._full_shape(shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - self.scale.log() - math.log(math.sqrt(2 * math.pi)))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + self.scale.log()
+
+
+@op("uniform_sample", differentiable=False)
+def _uniform_sample(low, high, key, shape=()):
+    u = jax.random.uniform(key, shape, dtype=low.dtype)
+    return low + (high - low) * u
+
+
+class Uniform(Distribution):
+    """ref distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(np.broadcast_shapes(self.low.shape,
+                                                   self.high.shape)))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def sample(self, shape=()):
+        return _uniform_sample(self.low, self.high, _key(),
+                               shape=tuple(shape) + self.batch_shape)
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = (value >= self.low).astype("float32") * \
+            (value < self.high).astype("float32")
+        return (inside / (self.high - self.low)).log()
+
+    def entropy(self):
+        return (self.high - self.low).log()
+
+
+@op("categorical_sample", differentiable=False)
+def _categorical_sample(logits, key, shape=()):
+    return jax.random.categorical(key, logits, shape=shape + logits.shape[:-1])
+
+
+class Categorical(Distribution):
+    """ref distribution/categorical.py (logits parameterization)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        return _categorical_sample(self.logits, _key(), shape=tuple(shape))
+
+    def _log_norm(self):
+        from ..nn import functional as F
+
+        return F.log_softmax(self.logits, axis=-1)
+
+    def log_prob(self, value):
+        from .. import ops
+
+        logp = self._log_norm()
+        value = value if isinstance(value, Tensor) else Tensor(
+            np.asarray(value, np.int64))
+        # broadcast batch dims (scalar-batch logits vs batched values)
+        target = tuple(np.broadcast_shapes(tuple(logp.shape[:-1]),
+                                           tuple(value.shape)))
+        if tuple(logp.shape[:-1]) != target:
+            logp = ops.manipulation.broadcast_to(
+                logp, target + (logp.shape[-1],))
+        if tuple(value.shape) != target:
+            value = ops.manipulation.broadcast_to(value, target)
+        return ops.manipulation.take_along_axis(
+            logp, value.unsqueeze(-1), axis=-1).squeeze(-1)
+
+    def probs(self, value=None):
+        from ..nn import functional as F
+
+        p = F.softmax(self.logits, axis=-1)
+        if value is None:
+            return p
+        return self.log_prob(value).exp()
+
+    def entropy(self):
+        logp = self._log_norm()
+        return -(logp.exp() * logp).sum(-1)
+
+
+class Bernoulli(Distribution):
+    """ref distribution/bernoulli.py (probs parameterization)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=()):
+        u = _uniform_sample(Tensor(np.float32(0.0)), Tensor(np.float32(1.0)),
+                            _key(),
+                            shape=tuple(shape) + self.batch_shape)
+        return (u < self.probs).astype("float32")
+
+    def log_prob(self, value):
+        value = _t(value)
+        eps = 1e-8
+        p = self.probs
+        return value * (p + eps).log() + (1 - value) * (1 - p + eps).log()
+
+    def entropy(self):
+        eps = 1e-8
+        p = self.probs
+        return -(p * (p + eps).log() + (1 - p) * (1 - p + eps).log())
+
+
+@op("exponential_sample", differentiable=False)
+def _exponential_sample(rate, key, shape=()):
+    return jax.random.exponential(key, shape, dtype=rate.dtype) / rate
+
+
+class Exponential(Distribution):
+    """ref distribution/exponential.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1 / self.rate
+
+    @property
+    def variance(self):
+        return 1 / self.rate ** 2
+
+    def sample(self, shape=()):
+        return _exponential_sample(self.rate, _key(),
+                                   shape=tuple(shape) + self.batch_shape)
+
+    def log_prob(self, value):
+        return self.rate.log() - self.rate * _t(value)
+
+    def entropy(self):
+        return 1 - self.rate.log()
+
+
+class Laplace(Distribution):
+    """ref distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2 * self.scale ** 2
+
+    def sample(self, shape=()):
+        u = _uniform_sample(Tensor(np.float32(-0.5)),
+                            Tensor(np.float32(0.5)), _key(),
+                            shape=tuple(shape) + self.batch_shape)
+        return self.loc - self.scale * u.sign() * (1 - 2 * u.abs()).log()
+
+    def log_prob(self, value):
+        return -(_t(value) - self.loc).abs() / self.scale \
+            - self.scale.log() - math.log(2.0)
+
+    def entropy(self):
+        return 1 + math.log(2.0) + self.scale.log()
+
+
+class Gumbel(Distribution):
+    """ref distribution/gumbel.py."""
+
+    _EULER = 0.57721566490153286
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * self._EULER
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def sample(self, shape=()):
+        u = _uniform_sample(Tensor(np.float32(1e-8)),
+                            Tensor(np.float32(1.0)), _key(),
+                            shape=tuple(shape) + self.batch_shape)
+        return self.loc - self.scale * (-(u.log())).log()
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(z + (-z).exp()) - self.scale.log()
+
+    def entropy(self):
+        return self.scale.log() + 1 + self._EULER
+
+
+# ---- KL registry -----------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """ref distribution/kl.py register_kl decorator."""
+
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    # most-derived registered pair wins (ref kl.py _dispatch total-order)
+    best, best_score = None, None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = (type(p).__mro__.index(pc)
+                     + type(q).__mro__.index(qc))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return best(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - var_ratio.log())
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return ((q.high - q.low) / (p.high - p.low)).log()
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    logp = p._log_norm()
+    logq = q._log_norm()
+    return (logp.exp() * (logp - logq)).sum(-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    eps = 1e-8
+    a, b = p.probs, q.probs
+    return a * ((a + eps) / (b + eps)).log() + \
+        (1 - a) * ((1 - a + eps) / (1 - b + eps)).log()
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    r = q.rate / p.rate
+    return p.rate.log() - q.rate.log() + r - 1

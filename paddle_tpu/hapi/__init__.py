@@ -1,0 +1,8 @@
+"""paddle.hapi — high-level Keras-style API (reference: python/paddle/hapi/)."""
+
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau,
+)
+from .model import Model  # noqa: F401

@@ -1,0 +1,318 @@
+"""hapi Model — the Keras-style high-level loop.
+
+Reference: python/paddle/hapi/model.py (``Model`` :1054, ``fit`` :1756,
+``prepare`` :1676). The reference maintains parallel dygraph/static adapter
+classes; here there is one path — eager steps over the jit-cached dispatch
+layer — so train_batch is already a compiled XLA program after the first
+step. Data flows host numpy -> device per batch (the TPU input pipeline).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from .. import amp as _amp
+from ..core.tensor import Tensor
+from ..framework.io import load as _load, save as _save
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _tensorize(batch):
+    out = []
+    for b in _to_list(batch):
+        out.append(b if isinstance(b, Tensor) else Tensor(np.asarray(b)))
+    return out
+
+
+class Model:
+    """paddle.Model(network) -> prepare/fit/evaluate/predict/save/load."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._scaler = None
+        self.stop_training = False
+
+    # -- setup -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """ref model.py:1676."""
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), (
+                f"metrics must be paddle.metric.Metric, got {type(m)}")
+        if amp_configs:
+            level = (amp_configs.get("level", "O1")
+                     if isinstance(amp_configs, dict) else str(amp_configs))
+            self._amp_level = level
+            if level in ("O1", "O2"):
+                self._scaler = _amp.GradScaler()
+        else:
+            self._amp_level = None
+        return self
+
+    # -- single-batch APIs ----------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        """ref model.py train_batch — one fwd/bwd(/step); returns
+        ([loss], [metric results])."""
+        assert self._optimizer is not None, "call prepare() first"
+        self.network.train()
+        inputs = _tensorize(inputs)
+        labels = _tensorize(labels)
+
+        if self._amp_level in ("O1", "O2"):
+            with _amp.auto_cast(level=self._amp_level):
+                outs = self.network(*inputs)
+            loss = self._compute_loss(outs, labels)
+            scaled = self._scaler.scale(loss)
+            scaled.backward()
+            if update:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            outs = self.network(*inputs)
+            loss = self._compute_loss(outs, labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return [float(loss.numpy())], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _tensorize(inputs)
+        labels = _tensorize(labels)
+        outs = self.network(*inputs)
+        loss = self._compute_loss(outs, labels)
+        metrics = self._update_metrics(outs, labels)
+        return ([float(loss.numpy())] if loss is not None else [], metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outs = self.network(*_tensorize(inputs))
+        return [o.numpy() for o in _to_list(outs)]
+
+    def _compute_loss(self, outs, labels):
+        if self._loss is None:
+            out0 = _to_list(outs)[0]
+            return out0 if out0.ndim == 0 or out0.size == 1 else None
+        return self._loss(*(_to_list(outs) + labels))
+
+    def _update_metrics(self, outs, labels):
+        results = []
+        pred = _to_list(outs)[0]
+        for m in self._metrics:
+            inp = m.compute(pred, *labels)
+            if not isinstance(inp, (list, tuple)):
+                inp = (inp,)
+            m.update(*inp)
+            results.append(m.accumulate())
+        return results
+
+    def _metric_logs(self, prefix=""):
+        logs = {}
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, str):
+                names, vals = [names], [vals]
+            elif not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, v in zip(names, vals):
+                logs[prefix + n] = v
+        return logs
+
+    def _reset_metrics(self):
+        for m in self._metrics:
+            m.reset()
+
+    def _split_batch(self, batch):
+        """Split a collated batch into (inputs, labels) by the prepared
+        loss: the last element is the label. Raise clearly when a loss is
+        prepared but the dataset yields no label slot."""
+        if self._loss is None:
+            return batch, []
+        if len(batch) < 2:
+            raise ValueError(
+                "a loss was prepared, so each batch must be (inputs..., "
+                f"label); the dataset yielded {len(batch)} element(s)")
+        return batch[:-1], batch[-1:]
+
+    def _as_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    # -- loops -----------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """ref model.py:1756."""
+        assert self._optimizer is not None, "call prepare() first"
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 num_workers, drop_last)
+        eval_loader = self._as_loader(eval_data, batch_size, False,
+                                      num_workers, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=self._metrics)
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            self._reset_metrics()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                batch = _to_list(batch)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                losses, _ = self.train_batch(ins, labs, update=update)
+                logs = {"loss": losses[0], **self._metric_logs()}
+                cbks.set_params({**cbks.callbacks[0].params,
+                                 "last_step": step})
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+            if self.stop_training:
+                break
+            if num_iters is not None and it >= num_iters:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def _run_eval(self, loader, cbks):
+        self._reset_metrics()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            batch = _to_list(batch)
+            ins, labs = self._split_batch(batch)
+            l, _ = self.eval_batch(ins, labs)
+            losses.extend(l)
+            cbks.on_eval_batch_end(step)
+        logs = {**({"eval_loss": float(np.mean(losses))} if losses else {}),
+                **self._metric_logs("eval_")}
+        # EarlyStopping monitors unprefixed names too
+        logs.update({k[len("eval_"):]: v for k, v in logs.items()
+                     if k.startswith("eval_")})
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        """ref model.py evaluate — returns dict of eval metrics."""
+        loader = self._as_loader(eval_data, batch_size, False, num_workers,
+                                 False)
+        cbks = config_callbacks(callbacks, model=self, epochs=1,
+                                steps=None, verbose=verbose,
+                                metrics=self._metrics)
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """ref model.py predict — list (per output) of per-batch arrays."""
+        loader = self._as_loader(test_data, batch_size, False, num_workers,
+                                 False)
+        # datasets often yield (x, label) even for predict; feed only as many
+        # leading elements as the network's forward takes (the reference
+        # resolves this via its `inputs` specs)
+        import inspect
+
+        try:
+            sig = inspect.signature(self.network.forward)
+            npos = len([p for p in sig.parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD)
+                        and p.default is p.empty])
+        except (TypeError, ValueError):
+            npos = None
+        outputs = None
+        for batch in loader:
+            batch = _to_list(batch)
+            if npos:
+                batch = batch[:npos]
+            outs = self.predict_batch(batch)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for slot, o in zip(outputs, outs):
+                slot.append(o)
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(slot) for slot in outputs]
+        return outputs
+
+    # -- persistence / introspection -------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_load(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        trainable = sum(int(np.prod(p.shape))
+                        for p in self.network.parameters()
+                        if not p.stop_gradient)
+        lines = [f"{type(self.network).__name__}:"]
+        for name, sub in self.network.named_sublayers():
+            cnt = sum(int(np.prod(p.shape))
+                      for p in sub.parameters(include_sublayers=False))
+            if cnt:
+                lines.append(f"  {name} ({type(sub).__name__}): {cnt:,}")
+        lines.append(f"Total params: {n_params:,}")
+        lines.append(f"Trainable params: {trainable:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": n_params, "trainable_params": trainable}

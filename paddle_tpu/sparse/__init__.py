@@ -1,0 +1,260 @@
+"""paddle.sparse — COO/CSR sparse tensors (reference: python/paddle/sparse/).
+
+TPU-native design: COO wraps ``jax.experimental.sparse.BCOO`` — XLA's
+batched-COO format with native sparse-dense matmul lowering (scatter/gather
+on TPU) — rather than reimplementing the reference's SparseCooTensor C++
+class (paddle/phi/core/sparse_coo_tensor.h). CSR is stored as
+(crows, cols, values) and converts through COO for compute; on TPU the MXU
+wants dense tiles anyway, so CSR is an interchange format, not a compute one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "matmul", "add", "relu", "sin", "tanh", "sqrt",
+           "square", "abs", "pow", "multiply", "is_same_shape"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(np.asarray(x))
+
+
+class SparseCooTensor:
+    """COO sparse tensor (ref paddle/phi/core/sparse_coo_tensor.h:1, python
+    surface python/paddle/sparse/creation.py sparse_coo_tensor)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle Tensor-protocol surface --
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        dense = np.asarray(self._bcoo.todense())
+        return _dense_to_csr(dense)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (ref paddle/phi/core/sparse_csr_tensor.h:1)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _arr(crows).astype(jnp.int64)
+        self._cols = _arr(cols).astype(jnp.int64)
+        self._values = _arr(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_dense(self):
+        n_rows = self._shape[0]
+        crows = np.asarray(self._crows)
+        counts = np.diff(crows)
+        rows = np.repeat(np.arange(n_rows), counts)
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        dense = dense.at[rows, np.asarray(self._cols)].set(self._values)
+        return Tensor(dense)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        crows = np.asarray(self._crows)
+        counts = np.diff(crows)
+        rows = np.repeat(np.arange(self._shape[0]), counts)
+        idx = jnp.stack([jnp.asarray(rows), self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _dense_to_csr(dense):
+    rows, cols = np.nonzero(dense)
+    values = dense[rows, cols]
+    crows = np.zeros(dense.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols, values, dense.shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """ref python/paddle/sparse/creation.py — indices [ndim, nnz]."""
+    idx = np.asarray(_arr(indices)).astype(np.int64)
+    vals = _arr(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    return SparseCooTensor(jsparse.BCOO((vals, jnp.asarray(idx.T)),
+                                        shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _arr(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def _as_coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def matmul(x, y, name=None):
+    """Sparse @ dense (ref python/paddle/sparse/binary.py matmul).
+    Dense @ dense falls through to jnp."""
+    x = _as_coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        raise NotImplementedError(
+            "sparse.matmul supports sparse @ dense; for a sparse right "
+            "operand densify it first (y.to_dense())")
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ _arr(y)
+        return Tensor(out)
+    return Tensor(_arr(x) @ _arr(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense, output only at mask's nonzeros (ref sparse/binary.py)."""
+    mask = _as_coo(mask)
+    prod = _arr(x) @ _arr(y)
+    idx = mask._bcoo.indices
+    vals = prod[idx[:, 0], idx[:, 1]]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def add(x, y, name=None):
+    x = _as_coo(x)
+    y = _as_coo(y)
+    xs = isinstance(x, SparseCooTensor)
+    ys = isinstance(y, SparseCooTensor)
+    if xs and ys:
+        s = (x._bcoo + y._bcoo).sum_duplicates(nse=x._bcoo.nse + y._bcoo.nse)
+        return SparseCooTensor(s)
+    if xs or ys:  # mixed: densify (the result is dense anyway)
+        xd = x.to_dense()._data if xs else _arr(x)
+        yd = y.to_dense()._data if ys else _arr(y)
+        return Tensor(xd + yd)
+    return Tensor(_arr(x) + _arr(y))
+
+
+def multiply(x, y, name=None):
+    x = _as_coo(x)
+    y = _as_coo(y)
+    xs = isinstance(x, SparseCooTensor)
+    ys = isinstance(y, SparseCooTensor)
+    if xs and ys:
+        return SparseCooTensor(jsparse.bcoo_multiply_sparse(x._bcoo, y._bcoo))
+    if xs:  # sparse * dense/scalar broadcasts onto the nonzeros
+        yd = _arr(y)
+        if yd.ndim == 0:
+            return SparseCooTensor(jsparse.BCOO(
+                (x._bcoo.data * yd, x._bcoo.indices), shape=x._bcoo.shape))
+        return SparseCooTensor(jsparse.bcoo_multiply_dense(x._bcoo, yd))
+    if ys:
+        return multiply(y, x)
+    return Tensor(_arr(x) * _arr(y))
+
+
+def _unary(name, fn):
+    def api(x, name=None):
+        x = _as_coo(x)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(jsparse.BCOO(
+                (fn(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
+        return Tensor(fn(_arr(x)))
+
+    api.__name__ = name
+    api.__doc__ = f"paddle.sparse.{name} — applied to nonzero values only."
+    return api
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+
+
+def pow(x, factor, name=None):
+    x = _as_coo(x)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(jsparse.BCOO(
+            (jnp.power(x._bcoo.data, factor), x._bcoo.indices),
+            shape=x._bcoo.shape))
+    return Tensor(jnp.power(_arr(x), factor))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

@@ -1,0 +1,167 @@
+"""fft / distribution / sparse namespace tests (reference:
+python/paddle/fft.py, python/paddle/distribution/, python/paddle/sparse/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, sparse
+from paddle_tpu.distribution import (
+    Bernoulli, Categorical, Exponential, Gumbel, Laplace, Normal, Uniform,
+    kl_divergence, register_kl,
+)
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        xr = fft.ifft(fft.fft(x))
+        np.testing.assert_allclose(xr.numpy().real, x.numpy(), atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.randn(16).astype("float32")
+        out = fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.rfft(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = paddle.to_tensor(np.random.randn(3, 4, 4).astype("float32"))
+        X = fft.fft2(x)
+        assert tuple(X.shape) == (3, 4, 4)
+        sh = fft.fftshift(X)
+        un = fft.ifftshift(sh)
+        np.testing.assert_allclose(un.numpy(), X.numpy())
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5))
+
+    def test_norm_ortho(self):
+        x = np.random.randn(8).astype("float32")
+        out = fft.fft(paddle.to_tensor(x), norm="ortho")
+        np.testing.assert_allclose(out.numpy(), np.fft.fft(x, norm="ortho"),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fft_differentiable(self):
+        x = paddle.to_tensor(np.random.randn(8).astype("float32"),
+                             stop_gradient=False)
+        y = fft.rfft(x).abs().sum()
+        y.backward()
+        assert x.grad is not None
+
+
+class TestDistribution:
+    def setup_method(self):
+        paddle.seed(0)
+
+    def test_normal_stats_and_logprob(self):
+        n = Normal(0.0, 1.0)
+        s = n.sample((20000,)).numpy()
+        assert abs(s.mean()) < 0.05 and abs(s.std() - 1) < 0.05
+        lp = float(n.log_prob(paddle.to_tensor(0.0)).numpy())
+        assert abs(lp + 0.9189385) < 1e-5
+        assert abs(float(n.entropy().numpy()) - 1.4189385) < 1e-5
+
+    def test_kl_normal(self):
+        kl = float(kl_divergence(Normal(0.0, 1.0), Normal(1.0, 2.0)).numpy())
+        expect = np.log(2) + (1 + 1) / (2 * 4) - 0.5
+        assert abs(kl - expect) < 1e-5
+
+    def test_categorical(self):
+        c = Categorical(paddle.to_tensor(
+            np.log(np.array([0.2, 0.3, 0.5], np.float32))))
+        s = c.sample((20000,)).numpy()
+        assert abs((s == 2).mean() - 0.5) < 0.02
+        lp = c.log_prob(paddle.to_tensor(np.array([1], np.int64)))
+        assert abs(float(lp.numpy()[0]) - np.log(0.3)) < 1e-5
+
+    def test_bernoulli_uniform_exponential(self):
+        assert abs(Bernoulli(0.3).sample((20000,)).numpy().mean()
+                   - 0.3) < 0.02
+        su = Uniform(1.0, 3.0).sample((20000,)).numpy()
+        assert abs(su.mean() - 2) < 0.03 and su.min() >= 1 and su.max() < 3
+        assert abs(Exponential(2.0).sample((20000,)).numpy().mean()
+                   - 0.5) < 0.02
+
+    def test_laplace_gumbel(self):
+        s = Laplace(0.0, 1.0).sample((20000,)).numpy()
+        assert abs(s.mean()) < 0.05 and abs(s.var() - 2.0) < 0.2
+        g = Gumbel(0.0, 1.0).sample((20000,)).numpy()
+        assert abs(g.mean() - 0.5772) < 0.05
+
+    def test_logprob_differentiable(self):
+        mu = paddle.to_tensor(0.5, stop_gradient=False)
+        (-Normal(mu, 1.0).log_prob(paddle.to_tensor(1.0))).backward()
+        assert abs(float(mu.grad.numpy()) + 0.5) < 1e-5
+
+    def test_register_kl(self):
+        class MyDist(Normal):
+            pass
+
+        @register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return paddle.to_tensor(42.0)
+
+        assert float(kl_divergence(MyDist(0., 1.), MyDist(0., 1.))
+                     .numpy()) == 42.0
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        idx = [[0, 1, 2], [1, 2, 0]]
+        vals = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(idx, vals, shape=(3, 3))
+        assert s.nnz == 3
+        d = s.to_dense().numpy()
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(d, expect)
+        np.testing.assert_allclose(s.indices().numpy(), idx)
+
+    def test_csr_roundtrip_and_convert(self):
+        dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        s = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [1., 2., 3.],
+                                     (2, 3))
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+        coo = s.to_sparse_coo()
+        np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+        back = coo.to_sparse_csr()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    def test_matmul_sparse_dense(self):
+        rng = np.random.RandomState(0)
+        dense = rng.randn(4, 5).astype(np.float32)
+        dense[dense < 0.5] = 0
+        rows, cols = np.nonzero(dense)
+        s = sparse.sparse_coo_tensor(np.stack([rows, cols]),
+                                     dense[rows, cols], shape=dense.shape)
+        y = rng.randn(5, 3).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_add_and_unary(self):
+        idx = np.array([[0, 1], [1, 0]])
+        a = sparse.sparse_coo_tensor(idx, [1.0, -2.0], shape=(2, 2))
+        b = sparse.sparse_coo_tensor(idx, [3.0, 4.0], shape=(2, 2))
+        c = sparse.add(a, b)
+        np.testing.assert_allclose(c.to_dense().numpy(),
+                                   [[0, 4], [2, 0]])
+        r = sparse.relu(a)
+        np.testing.assert_allclose(r.to_dense().numpy(), [[0, 1], [0, 0]])
+        sq = sparse.square(a)
+        np.testing.assert_allclose(sq.to_dense().numpy(), [[0, 1], [4, 0]])
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        mask = sparse.sparse_coo_tensor([[0, 2], [1, 2]], [1.0, 1.0],
+                                        shape=(3, 3))
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        prod = x @ y
+        d = out.to_dense().numpy()
+        assert abs(d[0, 1] - prod[0, 1]) < 1e-5
+        assert abs(d[2, 2] - prod[2, 2]) < 1e-5
+        assert d[1, 1] == 0

@@ -1,0 +1,187 @@
+"""hapi Model.fit + paddle.metric tests (reference model:
+python/paddle/hapi/model.py Model.fit :1756, metric/metrics.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import EarlyStopping, ModelCheckpoint, ProgBarLogger
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+class ToyDataset(Dataset):
+    """Linearly separable 2-class problem (MNIST-style fit target)."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype("float32")
+        self.y = (self.x.sum(1) > 0).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(np.array([[0.1, 0.9, 0.0],
+                                          [0.8, 0.1, 0.1]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [2]], np.int64))
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.5) < 1e-6
+        assert abs(top2 - 0.5) < 1e-6  # sample2 label 2 is 3rd
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.6])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+        assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+    def test_auc_perfect_and_random(self):
+        auc = Auc()
+        preds = np.array([0.9, 0.8, 0.1, 0.2])
+        labels = np.array([1, 1, 0, 0])
+        auc.update(preds, labels)
+        assert auc.accumulate() > 0.99
+        auc.reset()
+        rng = np.random.RandomState(0)
+        auc.update(rng.rand(2000), rng.randint(0, 2, 2000))
+        assert abs(auc.accumulate() - 0.5) < 0.05
+
+
+class TestModelFit:
+    def test_fit_matches_eager_training(self):
+        """Model.fit must produce the same weights as a hand-written eager
+        loop given identical init/data order."""
+        paddle.seed(42)
+        net1 = _mlp()
+        paddle.seed(42)
+        net2 = _mlp()
+        for (n1, p1), (n2, p2) in zip(net1.named_parameters(),
+                                      net2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+        ds = ToyDataset(64)
+        loss_fn = nn.CrossEntropyLoss()
+
+        # hand loop
+        opt1 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net1.parameters())
+        loader = paddle.io.DataLoader(ds, batch_size=16, shuffle=False)
+        for _ in range(2):
+            for xb, yb in loader:
+                loss = loss_fn(net1(xb), yb)
+                loss.backward()
+                opt1.step()
+                opt1.clear_grad()
+
+        # hapi
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net2.parameters())
+        model = paddle.Model(net2)
+        model.prepare(opt2, loss_fn, metrics=Accuracy())
+        model.fit(ds, batch_size=16, epochs=2, shuffle=False, verbose=0)
+
+        for (n1, p1), (_, p2) in zip(net1.named_parameters(),
+                                     net2.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_fit_improves_accuracy_and_evaluate(self):
+        paddle.seed(0)
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), metrics=Accuracy())
+        train, test = ToyDataset(128, seed=1), ToyDataset(64, seed=2)
+        model.fit(train, batch_size=32, epochs=5, verbose=0)
+        res = model.evaluate(test, batch_size=32, verbose=0)
+        assert res["eval_acc"] > 0.8
+        assert "eval_loss" in res
+
+    def test_predict(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare()
+        outs = model.predict(ToyDataset(20), batch_size=8,
+                             stack_outputs=True)
+        assert outs[0].shape == (20, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()),
+            nn.CrossEntropyLoss())
+        model.fit(ToyDataset(32), batch_size=16, epochs=1, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+
+        net2 = _mlp()
+        model2 = paddle.Model(net2)
+        model2.prepare(paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net2.parameters()),
+            nn.CrossEntropyLoss())
+        model2.load(path)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_early_stopping(self):
+        paddle.seed(0)
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.SGD(learning_rate=0.0,  # never improves
+                                 parameters=net.parameters()),
+            nn.CrossEntropyLoss(), metrics=Accuracy())
+        es = EarlyStopping(monitor="eval_loss", patience=0, verbose=0)
+        model.fit(ToyDataset(32), eval_data=ToyDataset(16), batch_size=16,
+                  epochs=10, verbose=0, callbacks=[es])
+        assert model.stop_training
+
+    def test_model_checkpoint_callback(self, tmp_path):
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            nn.CrossEntropyLoss())
+        model.fit(ToyDataset(16), batch_size=8, epochs=2, verbose=0,
+                  save_dir=str(tmp_path))
+        assert (tmp_path / "final.pdparams").exists()
+        assert (tmp_path / "0.pdparams").exists()
+
+    def test_lr_scheduler_stepped_by_fit(self):
+        paddle.seed(0)
+        net = _mlp()
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=2, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        model = paddle.Model(net)
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(ToyDataset(32), batch_size=16, epochs=1, verbose=0)
+        # 2 steps/epoch with step_size 2 -> one decay
+        assert abs(opt.get_lr() - 0.05) < 1e-8
+
+    def test_summary(self, capsys):
+        model = paddle.Model(_mlp())
+        info = model.summary()
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2

@@ -1,0 +1,150 @@
+"""RNN/LSTM/GRU tests — parity vs torch (same math as the reference:
+python/paddle/nn/layer/rnn.py; torch shares the gate conventions)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+B, T, I, H = 3, 5, 4, 6
+
+
+def _copy_to_torch(pd_layer, t_layer, layers, directions):
+    for layer in range(layers):
+        for d in range(directions):
+            sfx = f"l{layer}" + ("_reverse" if d else "")
+            for part in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                p = getattr(pd_layer, f"{part}_{sfx}")
+                getattr(t_layer, f"{part}_{sfx}").data = \
+                    torch.tensor(p.numpy())
+
+
+@pytest.fixture(autouse=True)
+def _highest_precision():
+    import jax
+
+    old = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "highest")
+    yield
+    jax.config.update("jax_default_matmul_precision", old)
+
+
+def _x():
+    return np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+
+
+class TestLSTM:
+    def test_parity_vs_torch_bidirectional_2layer(self):
+        lstm = nn.LSTM(I, H, num_layers=2, direction="bidirectional")
+        tl = torch.nn.LSTM(I, H, num_layers=2, bidirectional=True,
+                           batch_first=True)
+        _copy_to_torch(lstm, tl, 2, 2)
+        x = _x()
+        y, (h, c) = lstm(paddle.to_tensor(x))
+        ty, (th, tc) = tl(torch.tensor(x))
+        np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+    def test_shapes_and_grads(self):
+        lstm = nn.LSTM(I, H)
+        y, (h, c) = lstm(paddle.to_tensor(_x()))
+        assert tuple(y.shape) == (B, T, H)
+        assert tuple(h.shape) == (1, B, H)
+        y.sum().backward()
+        assert lstm.weight_ih_l0.grad is not None
+        assert lstm.bias_hh_l0.grad is not None
+
+    def test_initial_states_respected(self):
+        lstm = nn.LSTM(I, H)
+        x = paddle.to_tensor(_x())
+        h0 = paddle.to_tensor(np.ones((1, B, H), np.float32))
+        c0 = paddle.to_tensor(np.ones((1, B, H), np.float32))
+        y1, _ = lstm(x)
+        y2, _ = lstm(x, (h0, c0))
+        assert not np.allclose(y1.numpy(), y2.numpy())
+
+    def test_time_major(self):
+        lstm = nn.LSTM(I, H, time_major=True)
+        x = _x().transpose(1, 0, 2)
+        y, _ = lstm(paddle.to_tensor(x))
+        assert tuple(y.shape) == (T, B, H)
+
+
+class TestGRU:
+    def test_parity_vs_torch(self):
+        gru = nn.GRU(I, H)
+        tg = torch.nn.GRU(I, H, batch_first=True)
+        _copy_to_torch(gru, tg, 1, 1)
+        x = _x()
+        y, h = gru(paddle.to_tensor(x))
+        ty, th = tg(torch.tensor(x))
+        np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+
+
+class TestSimpleRNN:
+    def test_parity_vs_torch_relu(self):
+        rnn = nn.SimpleRNN(I, H, activation="relu")
+        tr = torch.nn.RNN(I, H, nonlinearity="relu", batch_first=True)
+        _copy_to_torch(rnn, tr, 1, 1)
+        x = _x()
+        y, h = rnn(paddle.to_tensor(x))
+        ty, th = tr(torch.tensor(x))
+        np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+
+
+class TestCellsAndWrappers:
+    def test_lstm_cell_single_step(self):
+        cell = nn.LSTMCell(I, H)
+        x = paddle.to_tensor(_x()[:, 0])
+        out, (h, c) = cell(x)
+        assert tuple(out.shape) == (B, H)
+        assert tuple(c.shape) == (B, H)
+
+    def test_rnn_wrapper_matches_fused(self):
+        """Generic RNN(cell) unrolled loop == fused-scan SimpleRNN given the
+        same weights."""
+        fused = nn.SimpleRNN(I, H)
+        cell = nn.SimpleRNNCell(I, H)
+        cell.weight_ih._rebind(fused.weight_ih_l0._data)
+        cell.weight_hh._rebind(fused.weight_hh_l0._data)
+        cell.bias_ih._rebind(fused.bias_ih_l0._data)
+        cell.bias_hh._rebind(fused.bias_hh_l0._data)
+        x = paddle.to_tensor(_x())
+        y1, _ = fused(x)
+        y2, _ = nn.RNN(cell)(x)
+        np.testing.assert_allclose(y1.numpy(), y2.numpy(), atol=1e-5)
+
+    def test_birnn(self):
+        bi = nn.BiRNN(nn.GRUCell(I, H), nn.GRUCell(I, H))
+        y, (sf, sb) = bi(paddle.to_tensor(_x()))
+        assert tuple(y.shape) == (B, T, 2 * H)
+
+    def test_lstm_under_to_static(self):
+        lstm = nn.LSTM(I, H)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lstm = lstm
+
+            def forward(self, x):
+                y, _ = self.lstm(x)
+                return y
+
+        m = M()
+        x = paddle.to_tensor(_x())
+        eager = m(x).numpy()
+        sm = paddle.jit.to_static(m)
+        np.testing.assert_allclose(sm(x).numpy(), eager, atol=1e-5)
+
+    def test_dropout_between_layers_only_in_train(self):
+        rnn = nn.LSTM(I, H, num_layers=2, dropout=0.5)
+        x = paddle.to_tensor(_x())
+        rnn.eval()
+        y1, _ = rnn(x)
+        y2, _ = rnn(x)
+        np.testing.assert_allclose(y1.numpy(), y2.numpy())
