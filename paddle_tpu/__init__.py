@@ -23,6 +23,8 @@ from .core.device import (  # noqa: F401
 )
 from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
 from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+from . import device  # noqa: F401
 
 from .ops import *  # noqa: F401,F403  (installs Tensor methods)
 from . import ops as _ops_pkg

@@ -124,7 +124,22 @@ def call_op(name: str, *args, **kwargs):
 
     kwargs_key = tuple(sorted((k, _hashable(v)) for k, v in kwargs.items()))
     fwd, bwd = _build_execs(name, kwargs_key)
-    out = fwd(*arrs)
+    # FLAGS_check_nan_inf: post-op output scan (analog of
+    # nan_inf_utils_detail.cc wired behind paddle/phi/core/flags.cc:74)
+    from . import flags as _flags
+
+    if _flags.flag_value("check_nan_inf"):
+        try:
+            out = fwd(*arrs)
+        except FloatingPointError as e:  # jax_debug_nans tripped inside
+            raise RuntimeError(
+                f"op {name!r} produced NaN values "
+                "(FLAGS_check_nan_inf)") from e
+        _scan_nan_inf(name, out)
+    else:
+        out = fwd(*arrs)
+        if _flags.flag_value("benchmark"):
+            jax.block_until_ready(out)
 
     requires_grad = requires_grad and state.grad_enabled() and opdef.differentiable
     node = None
@@ -140,6 +155,28 @@ def call_op(name: str, *args, **kwargs):
             node = GradNode(name, bwd, tuple(arrs), edges, out_avals,
                             out_is_tuple, op_kwargs=kwargs_key)
     return _wrap_out(out, node, requires_grad)
+
+
+def _scan_nan_inf(op_name, out):
+    """Raise (level 0) or warn (level 1) when an op output holds NaN/Inf."""
+    from . import flags as _flags
+
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    for i, o in enumerate(outs):
+        if o is None or not jnp.issubdtype(o.dtype, jnp.inexact):
+            continue
+        if not bool(jnp.all(jnp.isfinite(o))):
+            n_nan = int(jnp.sum(jnp.isnan(o)))
+            n_inf = int(jnp.sum(jnp.isinf(o)))
+            msg = (f"op {op_name!r} output {i} (shape {tuple(o.shape)}, "
+                   f"dtype {o.dtype}) contains {n_nan} NaN / {n_inf} Inf "
+                   "values (FLAGS_check_nan_inf)")
+            if int(_flags.flag_value("check_nan_inf_level", 0)) >= 1:
+                import warnings
+
+                warnings.warn(msg)
+            else:
+                raise RuntimeError(msg)
 
 
 def _wrap_out(out, node, requires_grad):

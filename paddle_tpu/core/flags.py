@@ -1,0 +1,148 @@
+"""Runtime flag registry — ``paddle.set_flags`` / ``paddle.get_flags``.
+
+Reference: the self-hosted flag registry ``paddle/utils/flags_native.h:112``
+(``PD_DEFINE_VARIABLE``) with ~120 exported flags in
+``paddle/phi/core/flags.cc``, env-overridable as ``FLAGS_*`` and settable via
+``paddle.set_flags``.
+
+Here flags are plain Python state consulted by the dispatch layer and
+subsystems. Registered flags are the ones with real effect in this framework;
+reference flags that govern machinery XLA owns (allocator strategy, cudnn
+knobs, executor toggles) are registered as accepted-but-inert so reference
+scripts keep running, and marked ``inert=True`` for honesty.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["set_flags", "get_flags", "register_flag", "flag_value"]
+
+
+class _Flag:
+    __slots__ = ("name", "default", "type", "help", "inert", "on_change",
+                 "value")
+
+    def __init__(self, name, default, help="", inert=False, on_change=None):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help
+        self.inert = inert
+        self.on_change = on_change
+        self.value = self._from_env()
+
+    def _from_env(self):
+        env = os.environ.get(f"FLAGS_{self.name}")
+        if env is None:
+            return self.default
+        return self._coerce(env)
+
+    def _coerce(self, v):
+        if self.type is bool:
+            if isinstance(v, str):
+                return v.lower() in ("1", "true", "yes", "on")
+            return bool(v)
+        return self.type(v)
+
+    def set(self, v):
+        self.value = self._coerce(v)
+        if self.on_change is not None:
+            self.on_change(self.value)
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def register_flag(name, default, help="", inert=False, on_change=None):
+    """Register a flag (PD_DEFINE_VARIABLE analog). Env FLAGS_<name>
+    overrides the default at registration time (and fires on_change, so
+    env-set flags get the same side effects as paddle.set_flags)."""
+    name = name.removeprefix("FLAGS_")
+    f = _Flag(name, default, help, inert, on_change)
+    _REGISTRY[name] = f
+    if on_change is not None and os.environ.get(f"FLAGS_{name}") is not None:
+        on_change(f.value)
+    return f
+
+
+def _lookup(name):
+    key = name.removeprefix("FLAGS_")
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown flag {name!r}; registered flags: "
+            f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def set_flags(flags):
+    """paddle.set_flags({'FLAGS_check_nan_inf': 1})."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags takes a dict of {flag_name: value}")
+    for k, v in flags.items():
+        _lookup(k).set(v)
+
+
+def get_flags(flags):
+    """paddle.get_flags('FLAGS_x') or (['FLAGS_x', ...]) -> dict."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        f = _lookup(k)
+        key = k if k.startswith("FLAGS_") else f"FLAGS_{f.name}"
+        out[key] = f.value
+    return out
+
+
+def flag_value(name, default=None):
+    """Internal fast read used by dispatch/subsystems."""
+    f = _REGISTRY.get(name.removeprefix("FLAGS_"))
+    return f.value if f is not None else default
+
+
+# ---- flags with real effect ------------------------------------------------
+
+def _sync_debug_nans(_):
+    # bridge into jax for traced/jit code (covers to_static + fused steps);
+    # only in raise mode (level 0) — debug_nans cannot warn-and-continue
+    import jax
+
+    enabled = bool(flag_value("check_nan_inf", False)) and \
+        int(flag_value("check_nan_inf_level", 0)) == 0
+    try:
+        jax.config.update("jax_debug_nans", enabled)
+    except Exception:
+        pass
+
+
+register_flag(
+    "check_nan_inf", False,
+    help="scan every eager op's outputs for NaN/Inf and raise with the op "
+         "name (ref paddle/phi/core/flags.cc:74); also enables "
+         "jax_debug_nans for compiled code",
+    on_change=_sync_debug_nans)
+register_flag(
+    "check_nan_inf_level", 0,
+    help="0: raise on NaN/Inf; 1: warn only (ref flags.cc:88 levels)",
+    on_change=_sync_debug_nans)
+register_flag(
+    "benchmark", False,
+    help="block on every eager op (device sync) for accurate per-op timing")
+
+# ---- accepted-but-inert reference flags (XLA owns this machinery) ----------
+
+for _name, _default in [
+    ("allocator_strategy", "auto_growth"),
+    ("fraction_of_gpu_memory_to_use", 0.92),
+    ("cudnn_deterministic", False),
+    ("embedding_deterministic", 0),
+    ("conv_workspace_size_limit", 512),
+    ("cudnn_exhaustive_search", False),
+    ("use_pinned_memory", True),
+    ("init_allocated_mem", False),
+    ("eager_delete_tensor_gb", 0.0),
+]:
+    register_flag(_name, _default, inert=True,
+                  help="accepted for reference-script compatibility; the "
+                       "equivalent machinery is owned by XLA on TPU")

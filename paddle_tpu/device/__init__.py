@@ -1,0 +1,101 @@
+"""paddle.device — device UX + memory stats.
+
+Reference: python/paddle/device/ (set_device, cuda submodule with
+max_memory_allocated etc., backed by paddle/fluid/memory/stats.h). On TPU the
+allocator is XLA's; stats come from ``jax.Device.memory_stats()`` (PJRT),
+which reports bytes_in_use / peak_bytes_in_use / bytes_limit.
+"""
+
+from __future__ import annotations
+
+import types
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    device_count, get_device, is_compiled_with_cuda, is_compiled_with_xpu,
+    set_device,
+)
+
+__all__ = ["set_device", "get_device", "device_count", "cuda", "xpu",
+           "memory_stats", "memory_allocated", "memory_reserved",
+           "max_memory_allocated", "max_memory_reserved", "empty_cache",
+           "synchronize", "is_compiled_with_cuda", "is_compiled_with_xpu"]
+
+
+def _device(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, int):
+        return jax.devices()[device]
+    name = str(device)
+    _, _, idx = name.partition(":")
+    return jax.devices()[int(idx) if idx else 0]
+
+
+def memory_stats(device=None):
+    """Raw PJRT allocator stats dict ({} if the backend reports none)."""
+    try:
+        return _device(device).memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    """Current live bytes (ref device/cuda memory_allocated)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    """Peak live bytes (ref device/cuda max_memory_allocated)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    """Bytes reserved by the allocator pool; XLA reports the usable limit."""
+    s = memory_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_reserved", 0)))
+
+
+def max_memory_reserved(device=None):
+    # only a true peak statistic; 0 when the backend doesn't report one
+    # (bytes_reservable_limit is device CAPACITY, not a peak)
+    return int(memory_stats(device).get("peak_pool_bytes", 0))
+
+
+def empty_cache():
+    """ref device/cuda empty_cache — XLA owns its pool; nothing to drop."""
+    return None
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device is done."""
+    arr = jax.device_put(0, _device(device))
+    arr.block_until_ready()
+    return None
+
+
+# paddle.device.cuda / paddle.device.xpu compatibility namespaces: on TPU
+# they report the same PJRT stats (scripts use them for logging)
+def _accel_ns(name):
+    ns = types.ModuleType(f"{__name__}.{name}")
+    ns.memory_stats = memory_stats
+    ns.memory_allocated = memory_allocated
+    ns.max_memory_allocated = max_memory_allocated
+    ns.memory_reserved = memory_reserved
+    ns.max_memory_reserved = max_memory_reserved
+    ns.empty_cache = empty_cache
+    ns.synchronize = synchronize
+    ns.device_count = device_count
+    return ns
+
+
+cuda = _accel_ns("cuda")
+xpu = _accel_ns("xpu")
+
+import sys
+
+sys.modules[f"{__name__}.cuda"] = cuda
+sys.modules[f"{__name__}.xpu"] = xpu

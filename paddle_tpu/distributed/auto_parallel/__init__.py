@@ -15,6 +15,7 @@ r→s, ...) that the reference implements by hand per case.
 
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .engine import Engine, Strategy  # noqa: F401
 from .api import (  # noqa: F401
     dtensor_from_fn, reshard, shard_layer, shard_optimizer, shard_tensor,
     unshard_dtensor,

@@ -1,0 +1,275 @@
+"""Auto-parallel Engine — the `auto.Engine(model, loss, opt).fit()` surface.
+
+Reference: python/paddle/distributed/auto_parallel/static/engine.py (Engine
+:59, .fit :911) driving the Completer -> Partitioner -> Resharder -> passes
+pipeline (SURVEY §3.5).
+
+TPU-native collapse: that whole pipeline IS GSPMD. The user marks tensors
+with ``shard_tensor`` / ``shard_layer`` (sharding annotations); the Engine
+builds ONE donated, fused train-step executable
+(incubate.FusedTrainStep) and feeds it mesh-sharded batches — XLA performs
+completion (sharding propagation), partitioning (SPMD lowering), and
+resharding (collective insertion) during compilation. Completer/Partitioner/
+Resharder have no runtime object to expose because they run inside the
+compiler.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+__all__ = ["Engine", "Strategy"]
+
+
+class Strategy:
+    """auto_parallel Strategy (ref auto_parallel/strategy.py): a dataclass-ish
+    config bag; the toggles that matter on TPU are consumed here (amp ->
+    bf16 params, gradient_merge -> accumulate steps)."""
+
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.seed = None
+        self.amp = _Bag(enable=False, dtype="bfloat16", level="O2")
+        self.recompute = _Bag(enable=False)
+        self.gradient_merge = _Bag(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Bag(enable=False)
+        self.sharding = _Bag(enable=False, stage=1, degree=-1)
+        if config:
+            for k, v in config.items():
+                if isinstance(v, dict):
+                    # merge into the sub-config bag (attribute access form)
+                    bag = getattr(self, k, None)
+                    if isinstance(bag, _Bag):
+                        bag.__dict__.update(v)
+                    else:
+                        setattr(self, k, _Bag(**v))
+                else:
+                    setattr(self, k, v)
+
+
+class _Bag:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class Engine:
+    """ref engine.py:59 — Engine(model, loss, optimizer, metrics, strategy)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = (metrics if isinstance(metrics, (list, tuple))
+                         else [metrics]) if metrics else []
+        self._strategy = strategy or Strategy()
+        self._step = None
+        self._mesh = None
+        self.history = {}
+
+    # ---- mesh / data placement ----------------------------------------
+    def _resolve_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        from .process_mesh import get_mesh
+
+        mesh = None
+        try:
+            mesh = get_mesh()
+        except Exception:
+            mesh = None
+        if mesh is None:
+            # every addressable device on one data axis
+            n = jax.device_count()
+            mesh = ProcessMesh(np.arange(n).tolist(), dim_names=["dp"])
+        self._mesh = mesh
+        return mesh
+
+    def _shard_batch(self, arrs):
+        """dp-shard the batch dim over the mesh's first axis."""
+        mesh = self._resolve_mesh()
+        axis = mesh.dim_names[0]
+        out = []
+        for a in arrs:
+            arr = a._data if isinstance(a, Tensor) else np.asarray(a)
+            spec = [None] * arr.ndim
+            if arr.ndim and arr.shape[0] % mesh.jax_mesh.shape[axis] == 0:
+                spec[0] = axis
+            out.append(Tensor(jax.device_put(
+                np.asarray(arr),
+                NamedSharding(mesh.jax_mesh, P(*spec)))))
+        return out
+
+    # ---- build ---------------------------------------------------------
+    def _build_step(self):
+        if self._step is not None:
+            return self._step
+        from ... import nn
+        from ...incubate import FusedTrainStep
+
+        model, loss = self._model, self._loss
+
+        class WithLoss(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.inner = model
+
+            def forward(self, *args):
+                *ins, label = args
+                out = self.inner(*ins)
+                return loss(out, label)
+
+        if self._strategy.amp.enable and \
+                self._strategy.amp.dtype == "bfloat16":
+            model.bfloat16()
+        self._with_loss = WithLoss() if loss is not None else model
+        gm = self._strategy.gradient_merge
+        if getattr(gm, "enable", False) and int(gm.k_steps) > 1:
+            # gradient merge needs grads to live across micro-steps, which
+            # the donated fused step doesn't do — run the eager accumulate
+            # loop (still jit-cached per op) and apply every k_steps
+            k = int(gm.k_steps)
+            avg = bool(getattr(gm, "avg", True))
+            opt = self._optimizer
+            counter = {"n": 0}
+
+            def eager_step(*batch):
+                loss = self._with_loss(*batch)
+                loss.backward()
+                counter["n"] += 1
+                if counter["n"] % k == 0:
+                    if avg:
+                        for p in opt._parameter_list:
+                            if p.grad is not None:
+                                p.grad._rebind(p.grad._data / k)
+                    opt.step()
+                    opt.clear_grad()
+                return loss
+
+            self._step = eager_step
+        else:
+            self._step = FusedTrainStep(self._with_loss, self._optimizer)
+        return self._step
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                init_parameters=True):
+        """ref engine.py prepare — here compilation is lazy (first batch
+        fixes the shapes), so prepare only resolves the mesh."""
+        self._resolve_mesh()
+        return self
+
+    # ---- loops ---------------------------------------------------------
+    def _loader(self, data, batch_size):
+        from ...io import DataLoader
+
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=False,
+                          drop_last=True)
+
+    def fit(self, train_data=None, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_sample_split=None,
+            valid_freq=1, valid_steps=None, collate_fn=None, callbacks=None,
+            verbose=1, nvprof_range=None):
+        """ref engine.py:911. Returns a history dict of per-epoch losses."""
+        assert self._optimizer is not None, "Engine needs an optimizer"
+        step = self._build_step()
+        loader = self._loader(train_data, batch_size)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            losses = []
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                sharded = self._shard_batch(batch)
+                loss = step(*sharded)
+                losses.append(float(loss.numpy()))
+                if verbose and log_freq and (i + 1) % log_freq == 0:
+                    print(f"epoch {epoch} step {i + 1} "
+                          f"loss {np.mean(losses[-log_freq:]):.5f}")
+            history["loss"].append(float(np.mean(losses)) if losses
+                                   else None)
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                history.setdefault("valid_loss", []).append(
+                    self.evaluate(valid_data, batch_size=batch_size,
+                                  verbose=0)["loss"])
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch{epoch}")
+        self.history = history
+        return history
+
+    def evaluate(self, valid_data=None, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, callbacks=None,
+                 verbose=1):
+        loader = self._loader(valid_data, batch_size)
+        self._model.eval()
+        losses = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            sharded = self._shard_batch(batch)
+            *ins, label = sharded
+            out = self._model(*ins)
+            loss = self._loss(out, label) if self._loss is not None else out
+            losses.append(float(loss.numpy()))
+        self._model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data=None, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=1):
+        loader = self._loader(test_data, batch_size)
+        self._model.eval()
+        outs = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            sharded = self._shard_batch(batch)
+            if self._loss is not None and len(sharded) >= 2:
+                sharded = sharded[:-1]  # drop the label slot like fit/eval
+            outs.append(self._model(*sharded).numpy())
+        self._model.train()
+        return outs
+
+    # ---- persistence ----------------------------------------------------
+    def save(self, path, training=True):
+        import os
+
+        from ...framework.io import save
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import os
+
+        from ...framework.io import load
+
+        self._model.set_state_dict(load(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    # ---- introspection (reference parity) -------------------------------
+    def main_program(self, mode="train"):
+        """The reference returns the partitioned Program; the analog here is
+        the compiled step's HLO (one program, all ranks)."""
+        if self._step is None:
+            raise RuntimeError("call fit()/prepare() first")
+        return "<compiled XLA executable (GSPMD-partitioned)>"
+
+    @property
+    def mesh(self):
+        return self._resolve_mesh()

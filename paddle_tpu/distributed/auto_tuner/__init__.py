@@ -1,0 +1,172 @@
+"""Hybrid-parallel auto-tuner.
+
+Reference: python/paddle/distributed/auto_tuner/ (tuner.py:21 AutoTuner,
+search.py:31 GridSearch, recorder.py History) — enumerate
+dp/mp/pp/sharding/micro-batch configurations, launch a trial job per config,
+record throughput, report the best.
+
+TPU-native: trial execution is injected (``trial_fn``) — locally a trial is
+an in-process compile+measure on the CPU mesh or one chip; in production the
+caller launches a job per config. The search/prune/record machinery is the
+part the framework owns, and it prunes with the TPU constraints (degrees
+must factor the device count; mp and pp must divide layer/hidden dims).
+"""
+
+from __future__ import annotations
+
+import csv
+import itertools
+import time
+
+__all__ = ["AutoTuner", "GridSearch", "Recorder", "default_candidates"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg):
+    """Candidate values per dimension (ref search.py default space)."""
+    n = int(tuner_cfg.get("num_gpus", tuner_cfg.get("num_devices", 1)))
+    divs = _divisors(n)
+    return {
+        "dp_degree": tuner_cfg.get("dp_degree", divs),
+        "mp_degree": tuner_cfg.get("mp_degree", divs),
+        "pp_degree": tuner_cfg.get("pp_degree", divs),
+        "sharding_degree": tuner_cfg.get("sharding_degree", [1]),
+        "sharding_stage": tuner_cfg.get("sharding_stage", [1]),
+        "micro_batch_size": tuner_cfg.get(
+            "micro_batch_size",
+            _divisors(int(tuner_cfg.get("global_batch_size", 1)))),
+        "use_recompute": tuner_cfg.get("use_recompute", [False]),
+    }
+
+
+class GridSearch:
+    """ref search.py:31 — exhaustive product with pruning."""
+
+    def __init__(self, tuner_cfg):
+        self.cfg = tuner_cfg
+        self.space = default_candidates(tuner_cfg)
+        self.all_tasks = self._enumerate()
+        self.idx = 0
+
+    def _valid(self, c):
+        n = int(self.cfg.get("num_gpus", self.cfg.get("num_devices", 1)))
+        degrees = (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                   * c["sharding_degree"])
+        if degrees != n:
+            return False
+        gbs = int(self.cfg.get("global_batch_size", 0))
+        if gbs:
+            per_dp = gbs // (c["dp_degree"] * c["sharding_degree"])
+            if per_dp * c["dp_degree"] * c["sharding_degree"] != gbs:
+                return False
+            if per_dp % c["micro_batch_size"] != 0:
+                return False
+        layers = int(self.cfg.get("num_layers", 0))
+        if layers and layers % c["pp_degree"] != 0:
+            return False
+        heads = int(self.cfg.get("num_attention_heads", 0))
+        if heads and heads % c["mp_degree"] != 0:
+            return False
+        vocab = int(self.cfg.get("vocab_size", 0))
+        if vocab and vocab % c["mp_degree"] != 0:
+            return False
+        return True
+
+    def _enumerate(self):
+        keys = list(self.space)
+        out = []
+        for vals in itertools.product(*(self.space[k] for k in keys)):
+            c = dict(zip(keys, vals))
+            if self._valid(c):
+                out.append(c)
+        return out
+
+    def search_once(self):
+        """Next untried config or None (ref search.py search_once)."""
+        if self.idx >= len(self.all_tasks):
+            return None
+        c = self.all_tasks[self.idx]
+        self.idx += 1
+        return c
+
+
+class Recorder:
+    """ref recorder.py History — store + sort + csv dump."""
+
+    def __init__(self, metric="throughput", direction="max"):
+        self.metric = metric
+        self.direction = direction
+        self.history = []
+
+    def add_cfg(self, **cfg_and_metric):
+        self.history.append(dict(cfg_and_metric))
+
+    def sort_metric(self):
+        err = [h for h in self.history if h.get(self.metric) is None]
+        ok = [h for h in self.history if h.get(self.metric) is not None]
+        ok.sort(key=lambda h: h[self.metric],
+                reverse=self.direction == "max")
+        self.history = ok + err
+        return self.history
+
+    def get_best(self):
+        self.sort_metric()
+        for h in self.history:
+            if h.get(self.metric) is not None:
+                return h, False
+        return None, True
+
+    def store_history(self, path):
+        if not self.history:
+            return
+        keys = sorted({k for h in self.history for k in h})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for h in self.history:
+                w.writerow(h)
+
+
+class AutoTuner:
+    """ref tuner.py:21 — drive search over trials.
+
+    ``trial_fn(cfg) -> float | None`` runs one configuration and returns the
+    metric (None = failed/OOM trial). ``max_time_per_task`` bounds a trial;
+    ``max_search_time`` bounds the whole tune.
+    """
+
+    def __init__(self, tuner_cfg, trial_fn=None):
+        self.cfg = dict(tuner_cfg)
+        self.searcher = GridSearch(self.cfg)
+        self.recorder = Recorder(
+            metric=self.cfg.get("metric_cfg", {}).get("name", "throughput"),
+            direction=self.cfg.get("metric_cfg", {}).get(
+                "OptimizationDirection", "max"))
+        self.trial_fn = trial_fn
+        self.cur_task_id = 0
+
+    def search_once(self):
+        return self.searcher.search_once()
+
+    def tune(self, max_search_time=None):
+        """Run all trials; returns (best_cfg, recorder)."""
+        assert self.trial_fn is not None, "provide trial_fn to tune()"
+        t0 = time.time()
+        while True:
+            if max_search_time and time.time() - t0 > max_search_time:
+                break
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            self.cur_task_id += 1
+            try:
+                metric = self.trial_fn(dict(cfg))
+            except Exception:
+                metric = None
+            self.recorder.add_cfg(**cfg,
+                                  **{self.recorder.metric: metric})
+        best, err = self.recorder.get_best()
+        return best, self.recorder

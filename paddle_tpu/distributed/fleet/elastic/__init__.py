@@ -1,0 +1,188 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py (ElasticManager
+:126; fault-tolerance levels :176-186) — hosts register in etcd, and on
+membership change the manager rewrites the endpoint env and relaunches
+trainers; two levels: FAULT_TOLERANCE (fixed np, restart) and ELASTIC
+(np range "min:max", scale up/down).
+
+TPU-native redesign: there is no etcd in a TPU deployment — membership is
+owned by the cluster scheduler + ``jax.distributed``'s coordination service
+(SURVEY §5.3). What the framework must supply is the DECISION layer: given
+membership events, decide restart vs rescale and produce the new env. That
+logic lives here against a pluggable ``Store`` (an in-memory/file store
+locally; the scheduler's API in production), which keeps it unit-testable
+without a cluster, exactly like the reference's unit tests fake etcd.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
+           "MemoryStore", "FileStore"]
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class MemoryStore:
+    """In-process host registry (test double for the coordination service)."""
+
+    def __init__(self):
+        self._hosts = {}
+
+    def register(self, host, ttl=None):
+        self._hosts[host] = time.time()
+
+    def deregister(self, host):
+        self._hosts.pop(host, None)
+
+    def hosts(self):
+        return sorted(self._hosts)
+
+
+class FileStore:
+    """Shared-filesystem host registry (works across local processes).
+    Read-modify-write sequences hold an fcntl lock on a sidecar lockfile so
+    concurrent registrations cannot drop each other."""
+
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock_path = path + ".lock"
+        if not os.path.exists(path):
+            with self._locked():
+                if not os.path.exists(path):
+                    self._write({})
+
+    def _locked(self):
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def cm():
+            with open(self._lock_path, "a+") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+        return cm()
+
+    def _read(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _write(self, d):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, self.path)
+
+    def register(self, host, ttl=None):
+        with self._locked():
+            d = self._read()
+            d[host] = time.time()
+            self._write(d)
+
+    def deregister(self, host):
+        with self._locked():
+            d = self._read()
+            d.pop(host, None)
+            self._write(d)
+
+    def hosts(self):
+        return sorted(self._read())
+
+
+def _parse_np(np_spec):
+    """'4' -> (4, 4); '2:6' -> (2, 6) (ref manager.py np range parsing)."""
+    s = str(np_spec)
+    if ":" in s:
+        lo, hi = s.split(":")
+        return int(lo), int(hi)
+    n = int(s)
+    return n, n
+
+
+class ElasticManager:
+    """Membership -> decision engine (ref manager.py:126)."""
+
+    def __init__(self, np_spec, host=None, store=None, scale_interval=5):
+        self.min_np, self.max_np = _parse_np(np_spec)
+        self.elastic = self.min_np != self.max_np  # level 2 vs FAULT_TOLERANCE
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.store = store or MemoryStore()
+        self.scale_interval = scale_interval
+        self.np = self.max_np if not self.elastic else self.min_np
+        self._last_hosts = None
+
+    # ---- membership -----------------------------------------------------
+    def register(self):
+        self.store.register(self.host)
+
+    def exit(self, completed=True):
+        self.store.deregister(self.host)
+
+    def hosts(self):
+        return self.store.hosts()
+
+    # ---- decisions ------------------------------------------------------
+    def ready(self):
+        """Enough hosts to launch? (ref manager.py wait for np hosts)."""
+        return len(self.hosts()) >= self.min_np
+
+    def watch(self):
+        """One membership poll -> ElasticStatus. RESTART means the caller
+        must rewrite env (``new_env``) and relaunch trainers."""
+        hosts = self.hosts()
+        n = len(hosts)
+        if self._last_hosts is None:
+            self._last_hosts = hosts
+        if hosts == self._last_hosts:
+            return ElasticStatus.HOLD
+        if n < self.min_np:
+            # below quorum: hold for FT level (host may come back), error
+            # for a shrink below the floor in elastic mode
+            self._last_hosts = hosts
+            return (ElasticStatus.HOLD if not self.elastic
+                    else ElasticStatus.ERROR)
+        if not self.elastic:
+            # fixed np: a replaced host is a plain restart at the same np
+            self._last_hosts = hosts
+            return ElasticStatus.RESTART
+        # elastic: rescale into [min, max]
+        self.np = min(n, self.max_np)
+        self._last_hosts = hosts
+        return ElasticStatus.RESTART
+
+    def new_env(self, base_env=None, port=8471):
+        """Env block for the relaunch at the current membership (the
+        reference rewrites PADDLE_TRAINERS / DISTRIBUTED_TRAINER_ENDPOINTS)."""
+        hosts = self.hosts()[:self.np]
+        env = dict(base_env or {})
+        env.update({
+            "PADDLE_TRAINERS_NUM": str(len(hosts)),
+            "PADDLE_TRAINERS": ",".join(hosts),
+            "DISTRIBUTED_TRAINER_ENDPOINTS": ",".join(
+                f"{h}:{port}" for h in hosts),
+            "PADDLE_MASTER": hosts[0] if hosts else "",
+            "MASTER_ADDR": hosts[0] if hosts else "",
+            "MASTER_PORT": str(port),
+        })
+        return env

@@ -25,6 +25,18 @@ class HybridParallelClipGrad(ClipGradByGlobalNorm):
 
 
 class HybridParallelOptimizer:
+    """Consumes the DistributedStrategy toggles that are meaningful on TPU:
+
+    - ``gradient_merge``: accumulate ``k_steps`` micro-steps of gradients
+      before the inner optimizer applies (grads accumulate in ``.grad`` by
+      construction; the wrapper just defers/averages the apply) — the
+      dygraph analog of the reference's gradient_merge meta-optimizer.
+    - ``dgc`` / ``localsgd`` / ``a_sync``: communication-compression and
+      async tricks for bandwidth-starved clusters; on ICI with XLA-scheduled
+      collectives they don't apply — warn loudly instead of silently
+      ignoring.
+    """
+
     def __init__(self, optimizer, hcg, strategy):
         self._inner_opt = optimizer
         self._hcg = hcg
@@ -32,14 +44,42 @@ class HybridParallelOptimizer:
         if optimizer._grad_clip is not None and hcg is not None:
             optimizer._grad_clip = HybridParallelClipGrad(
                 optimizer._grad_clip, hcg)
+        self._gm_steps = 0
+        self._gm_k = 1
+        if strategy is not None:
+            if getattr(strategy, "gradient_merge", False):
+                cfg = getattr(strategy, "gradient_merge_configs", {})
+                self._gm_k = int(cfg.get("k_steps", 1))
+                self._gm_avg = bool(cfg.get("avg", True))
+            import warnings
+
+            for toggle in ("dgc", "localsgd", "a_sync"):
+                if getattr(strategy, toggle, False):
+                    warnings.warn(
+                        f"DistributedStrategy.{toggle} targets "
+                        "bandwidth-limited NCCL/PS clusters; on TPU the "
+                        "XLA-scheduled ICI collectives make it moot — "
+                        "ignored", stacklevel=3)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
     def step(self):
+        if self._gm_k > 1:
+            self._gm_steps += 1
+            if self._gm_steps % self._gm_k != 0:
+                return  # keep accumulating into .grad
+            if self._gm_avg:
+                for p in self._inner_opt._parameter_list:
+                    if p.grad is not None:
+                        p.grad._rebind(p.grad._data / self._gm_k)
         self._inner_opt.step()
 
     def clear_grad(self, *args, **kwargs):
+        # mid-accumulation clears would destroy the merged grads the next
+        # micro-steps build on — no-op until the boundary step applied
+        if self._gm_k > 1 and self._gm_steps % self._gm_k != 0:
+            return
         self._inner_opt.clear_grad(*args, **kwargs)
 
     clear_gradients = clear_grad

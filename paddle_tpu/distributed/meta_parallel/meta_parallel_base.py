@@ -57,9 +57,36 @@ class ShardingParallel(MetaParallelBase):
 
 class SegmentParallel(MetaParallelBase):
     """reference segment_parallel.py:26 — sequence split over the sep axis.
-    Inputs get their sequence dim annotated on 'sep' by the data loader or
-    shard_tensor; grads sync automatically."""
-    pass
+    The wrapper annotates each input's sequence dim (dim 1) with a 'sep'
+    sharding constraint, so under trace GSPMD splits the sequence across the
+    sep group (the reference scatters explicitly in the wrapper); grads sync
+    automatically over the fused data+sep groups (topology.py:246)."""
+
+    def forward(self, *inputs, **kwargs):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ...core.tensor import Tensor
+
+        mesh = self._hcg.mesh
+        sep = mesh.shape.get("sep", 1)
+        if sep > 1:
+            new_inputs = []
+            for t in inputs:
+                if (isinstance(t, Tensor) and t.ndim >= 2
+                        and isinstance(t._data, jax.core.Tracer)
+                        and t.shape[1] % sep == 0):
+                    spec = [None] * t.ndim
+                    spec[1] = "sep"
+                    arr = jax.lax.with_sharding_constraint(
+                        t._data, NamedSharding(mesh, P(*spec)))
+                    nt = Tensor._wrap(arr)
+                    nt.stop_gradient = t.stop_gradient
+                    nt._node, nt._out_idx = t._node, t._out_idx
+                    t = nt
+                new_inputs.append(t)
+            inputs = tuple(new_inputs)
+        return self._layers(*inputs, **kwargs)
 
 
 def wrap_distributed_model(model, hcg, strategy):

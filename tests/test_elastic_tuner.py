@@ -1,0 +1,184 @@
+"""Elastic manager + auto-tuner tests (reference:
+fleet/elastic/manager.py:126, distributed/auto_tuner/tuner.py:21)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_tuner import AutoTuner, GridSearch, Recorder
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, FileStore, MemoryStore,
+)
+
+
+class TestElasticManager:
+    def test_np_range_parsing(self):
+        m = ElasticManager("4")
+        assert (m.min_np, m.max_np, m.elastic) == (4, 4, False)
+        m = ElasticManager("2:6")
+        assert (m.min_np, m.max_np, m.elastic) == (2, 6, True)
+
+    def test_fault_tolerance_restart_same_np(self):
+        store = MemoryStore()
+        for h in ("a", "b", "c", "d"):
+            store.register(h)
+        m = ElasticManager("4", host="a", store=store)
+        assert m.ready()
+        assert m.watch() == ElasticStatus.HOLD  # steady state
+        # host d dies and is replaced by e -> restart at same np
+        store.deregister("d")
+        store.register("e")
+        assert m.watch() == ElasticStatus.RESTART
+        assert m.np == 4
+
+    def test_fault_tolerance_holds_below_quorum(self):
+        store = MemoryStore()
+        for h in ("a", "b"):
+            store.register(h)
+        m = ElasticManager("2", host="a", store=store)
+        m.watch()
+        store.deregister("b")
+        assert m.watch() == ElasticStatus.HOLD  # wait for it to come back
+
+    def test_elastic_scale_up_and_down(self):
+        store = MemoryStore()
+        for h in ("h0", "h1"):
+            store.register(h)
+        m = ElasticManager("2:4", host="h0", store=store)
+        assert m.ready() and m.np == 2
+        m.watch()
+        store.register("h2")
+        assert m.watch() == ElasticStatus.RESTART
+        assert m.np == 3  # scaled up
+        store.register("h3")
+        store.register("h4")  # beyond max
+        assert m.watch() == ElasticStatus.RESTART
+        assert m.np == 4  # clamped to max
+        store.deregister("h2")
+        store.deregister("h3")
+        store.deregister("h4")
+        store.deregister("h1")
+        assert m.watch() == ElasticStatus.ERROR  # below floor in elastic
+
+    def test_new_env_rewrites_endpoints(self):
+        store = MemoryStore()
+        for h in ("n0", "n1", "n2"):
+            store.register(h)
+        m = ElasticManager("2:4", host="n0", store=store)
+        m.watch()
+        env = m.new_env(port=9000)
+        assert env["PADDLE_TRAINERS_NUM"] == str(m.np)
+        assert env["MASTER_ADDR"] == "n0"
+        assert "n0:9000" in env["DISTRIBUTED_TRAINER_ENDPOINTS"]
+
+    def test_file_store(self, tmp_path):
+        path = str(tmp_path / "hosts.json")
+        s1 = FileStore(path)
+        s2 = FileStore(path)
+        s1.register("a")
+        s2.register("b")
+        assert s1.hosts() == ["a", "b"]
+        s2.deregister("a")
+        assert s1.hosts() == ["b"]
+
+
+class TestAutoTuner:
+    CFG = {
+        "num_gpus": 8,
+        "global_batch_size": 16,
+        "num_layers": 4,
+        "num_attention_heads": 8,
+        "metric_cfg": {"name": "throughput",
+                       "OptimizationDirection": "max"},
+    }
+
+    def test_grid_prunes_invalid(self):
+        g = GridSearch(self.CFG)
+        assert g.all_tasks, "search space empty"
+        for c in g.all_tasks:
+            assert (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                    * c["sharding_degree"]) == 8
+            assert 4 % c["pp_degree"] == 0
+            assert 8 % c["mp_degree"] == 0
+
+    def test_tune_picks_best(self):
+        # synthetic cost model: dp-heavy configs are "fastest"
+        def trial(cfg):
+            if cfg["mp_degree"] == 8:
+                return None  # pretend OOM
+            return (cfg["dp_degree"] * 100
+                    + cfg["micro_batch_size"])
+
+        tuner = AutoTuner(self.CFG, trial_fn=trial)
+        best, rec = tuner.tune()
+        assert best["dp_degree"] == 8
+        assert best["throughput"] == max(
+            h["throughput"] for h in rec.history
+            if h["throughput"] is not None)
+
+    def test_recorder_sort_and_csv(self, tmp_path):
+        r = Recorder()
+        r.add_cfg(dp_degree=2, throughput=10.0)
+        r.add_cfg(dp_degree=4, throughput=None)
+        r.add_cfg(dp_degree=8, throughput=30.0)
+        best, err = r.get_best()
+        assert not err and best["dp_degree"] == 8
+        path = str(tmp_path / "history.csv")
+        r.store_history(path)
+        import csv
+
+        rows = list(csv.DictReader(open(path)))
+        assert len(rows) == 3
+
+    def test_tuner_real_trials_on_mesh(self):
+        """End-to-end: trial = one real fused train step per config on the
+        8-device CPU mesh, metric = measured step rate."""
+        import time
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import paddle_tpu.nn as nn
+
+        mesh_devices = np.array(jax.devices()[:8])
+
+        def trial(cfg):
+            dp, mp = cfg["dp_degree"], cfg["mp_degree"]
+            if cfg["pp_degree"] != 1 or cfg["sharding_degree"] != 1:
+                return None
+            mesh = jax.sharding.Mesh(mesh_devices.reshape(dp, mp),
+                                     ("dp", "mp"))
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                  nn.Linear(32, 4))
+            model[0].weight._data = jax.device_put(
+                model[0].weight._data, NamedSharding(mesh, P(None, "mp")))
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+
+            class WithLoss(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.m = model
+
+                def forward(self, x, y):
+                    return nn.CrossEntropyLoss()(self.m(x), y)
+
+            step = paddle.incubate.fused_train_step(WithLoss(), opt)
+            x = paddle.Tensor(jax.device_put(
+                np.random.randn(16, 16).astype("float32"),
+                NamedSharding(mesh, P("dp", None))))
+            y = paddle.Tensor(jax.device_put(
+                np.random.randint(0, 4, 16),
+                NamedSharding(mesh, P("dp"))))
+            step(x, y)  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                loss = step(x, y)
+            float(loss.numpy())
+            return 3 / (time.perf_counter() - t0)
+
+        cfg = dict(self.CFG)
+        tuner = AutoTuner(cfg, trial_fn=trial)
+        best, rec = tuner.tune()
+        assert best is not None and best["throughput"] > 0

@@ -1,0 +1,98 @@
+"""Flag registry + check_nan_inf + memory stats tests (reference:
+paddle/phi/core/flags.cc:74, paddle/utils/flags_native.h:112,
+paddle/fluid/memory/stats.h)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf": False,
+                      "FLAGS_check_nan_inf_level": 0,
+                      "FLAGS_benchmark": False})
+
+
+class TestFlags:
+    def test_set_get_roundtrip(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf") == {
+            "FLAGS_check_nan_inf": True}
+        paddle.set_flags({"FLAGS_check_nan_inf": 0})
+        assert not paddle.get_flags(["FLAGS_check_nan_inf"])[
+            "FLAGS_check_nan_inf"]
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError):
+            paddle.set_flags({"FLAGS_not_a_real_flag": 1})
+        with pytest.raises(ValueError):
+            paddle.get_flags("FLAGS_not_a_real_flag")
+
+    def test_inert_reference_flags_accepted(self):
+        paddle.set_flags({"FLAGS_allocator_strategy": "naive_best_fit",
+                          "FLAGS_cudnn_deterministic": True})
+        got = paddle.get_flags(["FLAGS_allocator_strategy"])
+        assert got["FLAGS_allocator_strategy"] == "naive_best_fit"
+
+    def test_env_override(self, monkeypatch):
+        from paddle_tpu.core import flags as F
+
+        monkeypatch.setenv("FLAGS_test_env_flag", "1")
+        f = F.register_flag("test_env_flag", False)
+        assert f.value is True
+
+    def test_type_coercion(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": "true"})
+        assert paddle.get_flags("FLAGS_check_nan_inf")[
+            "FLAGS_check_nan_inf"] is True
+
+
+class TestCheckNanInf:
+    def test_raises_on_nan(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        with pytest.raises(RuntimeError, match="NaN"):
+            _ = x / paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+
+    def test_clean_ops_pass(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = (x * 2 + 1).sum()
+        assert float(y.numpy()) == 8.0
+
+    def test_warn_level(self):
+        import warnings
+
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_level": 1})
+        x = paddle.to_tensor(np.array([np.inf], np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _ = x + 1
+        assert any("Inf" in str(x.message) for x in w)
+
+    def test_off_by_default(self):
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        out = x / x  # NaN, but no flag -> no raise
+        assert np.isnan(out.numpy()).all()
+
+
+class TestMemoryStats:
+    def test_stats_shape(self):
+        s = paddle.device.memory_stats()
+        assert isinstance(s, dict)
+        assert paddle.device.memory_allocated() >= 0
+        assert paddle.device.max_memory_allocated() >= \
+            paddle.device.memory_allocated() or \
+            paddle.device.max_memory_allocated() == 0
+
+    def test_cuda_namespace_alias(self):
+        assert paddle.device.cuda.memory_allocated() == \
+            paddle.device.memory_allocated()
+        paddle.device.cuda.empty_cache()
+
+    def test_synchronize(self):
+        paddle.device.synchronize()
