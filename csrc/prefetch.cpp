@@ -1,0 +1,187 @@
+// Native data-pipeline core for paddle_tpu.io.
+//
+// TPU-native equivalent of the reference's C++ ingestion machinery
+// (paddle/fluid/framework/data_feed.cc DataFeed, io/dataloader worker
+// processes): the host-side hot loops of the input pipeline — batch
+// collation (gather N sample buffers into one contiguous batch) and image
+// decode-normalize (HWC uint8 -> CHW float32 with mean/std) — run here in
+// C++ threads. Python calls in via ctypes, which drops the GIL for the
+// duration of the call, so these run truly parallel to the training loop
+// and to each other (the Python-thread workers in io/__init__.py would
+// otherwise serialize on the GIL for exactly these loops).
+//
+// Also provides a small blocking MPMC ring buffer of opaque 64-bit tokens
+// used as the prefetch queue between producer workers and the consumer
+// (paddle/fluid/operators/reader/buffered_reader analog).
+//
+// Build: make -C csrc (emits libpaddle_tpu_native.so); the Python side
+// builds on demand via paddle_tpu.io.native.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- collate
+
+// Copy n sample buffers (each sample_bytes) into dst back-to-back.
+// Threads split the samples; each memcpy is GIL-free and NUMA-friendly
+// (sequential writes).
+void pt_collate(const void **srcs, int64_t n, int64_t sample_bytes,
+                void *dst, int n_threads) {
+  if (n <= 0) return;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = static_cast<int>(n);
+  auto worker = [&](int64_t lo, int64_t hi) {
+    char *out = static_cast<char *>(dst);
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(out + i * sample_bytes, srcs[i], sample_bytes);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto &t : ts) t.join();
+}
+
+// ------------------------------------------------- image normalize (NCHW)
+
+// HWC uint8 [h, w, c] -> CHW float32 normalized ((x/255 - mean[ch])/std[ch]).
+// The single hottest transform in an ImageNet-style pipeline
+// (vision/transforms ToTensor+Normalize fused).
+void pt_img_normalize(const uint8_t *src, float *dst, int64_t h, int64_t w,
+                      int64_t c, const float *mean, const float *stdv) {
+  const float inv255 = 1.0f / 255.0f;
+  for (int64_t ch = 0; ch < c; ++ch) {
+    const float m = mean[ch];
+    const float inv_s = 1.0f / stdv[ch];
+    float *out = dst + ch * h * w;
+    const uint8_t *in = src + ch;
+    for (int64_t i = 0; i < h * w; ++i) {
+      out[i] = (static_cast<float>(in[i * c]) * inv255 - m) * inv_s;
+    }
+  }
+}
+
+// Batched variant over n images, parallel across images.
+void pt_img_normalize_batch(const uint8_t **srcs, float *dst, int64_t n,
+                            int64_t h, int64_t w, int64_t c,
+                            const float *mean, const float *stdv,
+                            int n_threads) {
+  if (n <= 0) return;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = static_cast<int>(n);
+  int64_t img_elems = c * h * w;
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      pt_img_normalize(srcs[i], dst + i * img_elems, h, w, c, mean, stdv);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto &t : ts) t.join();
+}
+
+// ------------------------------------------------------------------ ring
+
+struct PtRing {
+  std::vector<uint64_t> buf;
+  size_t cap;
+  size_t head = 0;  // pop side
+  size_t tail = 0;  // push side
+  size_t count = 0;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+};
+
+void *pt_ring_new(int64_t capacity) {
+  auto *r = new PtRing();
+  r->cap = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  r->buf.resize(r->cap);
+  return r;
+}
+
+// 1 on success, 0 on closed, -1 on timeout.
+int pt_ring_push(void *ring, uint64_t token, int64_t timeout_ms) {
+  auto *r = static_cast<PtRing *>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->count < r->cap || r->closed; };
+  if (timeout_ms < 0) {
+    r->not_full.wait(lk, pred);
+  } else if (!r->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return -1;
+  }
+  if (r->closed) return 0;
+  r->buf[r->tail] = token;
+  r->tail = (r->tail + 1) % r->cap;
+  ++r->count;
+  r->not_empty.notify_one();
+  return 1;
+}
+
+// 1 on success (token written), 0 on closed-and-drained, -1 on timeout.
+int pt_ring_pop(void *ring, uint64_t *token, int64_t timeout_ms) {
+  auto *r = static_cast<PtRing *>(ring);
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->count > 0 || r->closed; };
+  if (timeout_ms < 0) {
+    r->not_empty.wait(lk, pred);
+  } else if (!r->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return -1;
+  }
+  if (r->count == 0) return 0;  // closed and drained
+  *token = r->buf[r->head];
+  r->head = (r->head + 1) % r->cap;
+  --r->count;
+  r->not_full.notify_one();
+  return 1;
+}
+
+int64_t pt_ring_size(void *ring) {
+  auto *r = static_cast<PtRing *>(ring);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int64_t>(r->count);
+}
+
+void pt_ring_close(void *ring) {
+  auto *r = static_cast<PtRing *>(ring);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+}
+
+void pt_ring_free(void *ring) { delete static_cast<PtRing *>(ring); }
+
+}  // extern "C"

@@ -3,7 +3,11 @@
 Reference: python/paddle/io/ (Dataset, DataLoader with multiprocess workers at
 io/dataloader/worker.py). TPU-native design: workers are threads feeding a
 bounded prefetch queue (numpy batches stay on host; device transfer happens at
-first op use, letting XLA overlap H2D with compute).
+first op use, letting XLA overlap H2D with compute). The GIL-bound hot loops
+— batch collation and image normalize — run in the C++ core
+(csrc/prefetch.cpp via io/native.py, ctypes calls release the GIL), so the
+thread workers parallelize where it matters; see native.py for the
+data_feed.cc analogy.
 """
 
 from __future__ import annotations
@@ -262,6 +266,16 @@ def default_collate_fn(batch):
 
         return Tensor._wrap(jnp.stack([b._data for b in batch]))
     if isinstance(sample, np.ndarray):
+        # native parallel-memcpy collator, only when the batch is big
+        # enough to amortize thread spawn and ONLY if the library is
+        # already loaded (never build on the hot path; DataLoader warms it)
+        if len(batch) > 1 and len(batch) * sample.nbytes >= 1 << 20:
+            from . import native
+
+            if native.lib_ready() is not None:
+                out = native.collate_samples(batch)
+                if out is not None:
+                    return Tensor(out)
         return Tensor(np.stack(batch))
     if isinstance(sample, (int, float)):
         return Tensor(np.asarray(batch))
@@ -287,6 +301,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        from . import native as _native
+
+        _native.warm()  # background-build the C++ core; no blocking here
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -341,7 +358,15 @@ class DataLoader:
         yield from self._threaded_iter()
 
     def _threaded_iter(self):
-        """Thread-pool prefetch pipeline preserving batch order."""
+        """Thread-pool prefetch pipeline preserving batch order, with
+        bounded in-flight batches (prefetch_factor * num_workers credits):
+        workers take a credit before building, the consumer returns it
+        after yielding — backpressure so a slow training loop can't let
+        the workers buffer the whole epoch (buffered_reader semantics).
+        The credit queue is the native C++ ring when built (blocking waits
+        happen in C, off the GIL), queue.Queue otherwise."""
+        from . import native as _native
+
         idx_q: queue.Queue = queue.Queue()
         out: dict[int, object] = {}
         done = threading.Event()
@@ -350,14 +375,43 @@ class DataLoader:
         for i, b in enumerate(batches):
             idx_q.put((i, b))
 
+        cap = max(1, self.prefetch_factor * self.num_workers)
+        ring = None
+        if _native.lib_ready() is not None:
+            try:
+                ring = _native.Ring(cap)
+            except RuntimeError:
+                ring = None
+        if ring is not None:
+            for _ in range(cap):
+                ring.push(1)
+            take_credit = lambda: ring.pop(timeout_ms=200)[0] == 1
+            give_credit = lambda: ring.push(1, timeout_ms=0)
+        else:
+            credits: queue.Queue = queue.Queue()
+            for _ in range(cap):
+                credits.put(1)
+
+            def take_credit():
+                try:
+                    credits.get(timeout=0.2)
+                    return True
+                except queue.Empty:
+                    return False
+
+            give_credit = lambda: credits.put(1)
+
         def worker(wid):
             _worker_info.info = _WorkerInfo(wid, self.num_workers, self.dataset)
             if self.worker_init_fn:
                 self.worker_init_fn(wid)
             while not done.is_set():
+                if not take_credit():
+                    continue  # backpressure; re-check done
                 try:
                     i, indices = idx_q.get_nowait()
                 except queue.Empty:
+                    give_credit()
                     return
                 batch = self._make_batch(indices)
                 with lock:
@@ -374,8 +428,11 @@ class DataLoader:
                     while i not in out:
                         lock.wait(timeout=60.0)
                     yield out.pop(i)
+                give_credit()
         finally:
             done.set()
+            if ring is not None:
+                ring.close()
 
     def __call__(self):
         return iter(self)

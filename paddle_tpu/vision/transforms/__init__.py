@@ -11,4 +11,5 @@ from .transforms import (  # noqa: F401
     ContrastTransform, Grayscale, HueTransform, Normalize, Pad, RandomCrop,
     RandomErasing, RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
     RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+    normalize_collate,
 )

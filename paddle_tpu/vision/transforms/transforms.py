@@ -358,3 +358,43 @@ class RandomErasing(BaseTransform):
                     return _T(out)
                 return out
         return img if is_tensor else arr
+
+
+def normalize_collate(mean, std, data_format="CHW"):
+    """Collate-fn factory fusing ToTensor+Normalize into the batch step.
+
+    Use as ``DataLoader(ds, collate_fn=normalize_collate(mean, std))`` on
+    datasets yielding raw HWC uint8 images (optionally ``(img, label)``):
+    the whole batch is decoded to normalized NCHW float32 in the C++ core
+    (csrc/prefetch.cpp pt_img_normalize_batch — GIL-free, parallel across
+    images; the data_feed.cc role), with a numpy fallback when the native
+    library isn't available.
+    """
+    from ...core.tensor import Tensor
+    from ...io import default_collate_fn, native
+
+    mean_a = np.asarray(mean, np.float32).reshape(-1)
+    std_a = np.asarray(std, np.float32).reshape(-1)
+
+    def _normalize(imgs):
+        out = None
+        if native.lib_ready() is not None:
+            out = native.normalize_image_batch(imgs, mean_a, std_a)
+        if out is None:  # numpy fallback, same math
+            out = np.stack([
+                (im.astype(np.float32) / 255.0 - mean_a) / std_a
+                for im in imgs
+            ]).transpose(0, 3, 1, 2)
+        return Tensor(out)
+
+    def collate(batch):
+        native.warm()
+        first = batch[0]
+        if isinstance(first, tuple):
+            imgs = [b[0] for b in batch]
+            rest = [default_collate_fn([b[i] for b in batch])
+                    for i in range(1, len(first))]
+            return [_normalize(imgs)] + rest
+        return _normalize(list(batch))
+
+    return collate

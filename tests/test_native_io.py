@@ -1,0 +1,169 @@
+"""csrc/ native data-pipeline core tests (reference analogs:
+paddle/fluid/framework/data_feed.cc, io/dataloader/worker.py)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset, default_collate_fn, native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+class TestCollate:
+    def test_matches_np_stack(self):
+        rng = np.random.RandomState(0)
+        samples = [rng.randn(3, 32, 32).astype("float32") for _ in range(16)]
+        out = native.collate_samples(samples)
+        np.testing.assert_array_equal(out, np.stack(samples))
+
+    def test_dtype_preserved(self):
+        samples = [np.arange(100, dtype=np.int64) + i for i in range(4)]
+        out = native.collate_samples(samples)
+        assert out.dtype == np.int64
+        np.testing.assert_array_equal(out, np.stack(samples))
+
+    def test_mismatched_shapes_fall_back(self):
+        assert native.collate_samples(
+            [np.zeros(3), np.zeros(4)]) is None
+
+    def test_collate_in_dataloader(self):
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return (np.full((64, 64), i, np.float32),
+                        np.int64(i))
+
+            def __len__(self):
+                return 8
+
+        loader = DataLoader(DS(), batch_size=4)
+        x, y = next(iter(loader))
+        assert tuple(x.shape) == (4, 64, 64)
+        np.testing.assert_array_equal(x.numpy()[2], np.full((64, 64), 2))
+
+
+class TestImageNormalize:
+    def test_matches_numpy_pipeline(self):
+        rng = np.random.RandomState(0)
+        imgs = [rng.randint(0, 255, (16, 20, 3), np.uint8)
+                for _ in range(8)]
+        mean = [0.485, 0.456, 0.406]
+        std = [0.229, 0.224, 0.225]
+        out = native.normalize_image_batch(imgs, mean, std)
+        ref = np.stack([
+            (im.astype(np.float32) / 255.0 - np.asarray(mean, np.float32))
+            / np.asarray(std, np.float32) for im in imgs
+        ]).transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+    def test_wrong_dtype_falls_back(self):
+        assert native.normalize_image_batch(
+            [np.zeros((4, 4, 3), np.float32)], [0.5] * 3, [0.5] * 3) is None
+
+
+class TestRing:
+    def test_fifo_order(self):
+        r = native.Ring(4)
+        for t in (10, 20, 30):
+            assert r.push(t) == 1
+        assert len(r) == 3
+        assert [r.pop()[1] for _ in range(3)] == [10, 20, 30]
+
+    def test_blocking_push_timeout(self):
+        r = native.Ring(1)
+        assert r.push(1) == 1
+        assert r.push(2, timeout_ms=50) == -1  # full
+
+    def test_close_drains(self):
+        r = native.Ring(4)
+        r.push(7)
+        r.close()
+        rc, tok = r.pop()
+        assert (rc, tok) == (1, 7)
+        rc, _ = r.pop()
+        assert rc == 0  # closed and drained
+
+    def test_producer_consumer_threads(self):
+        r = native.Ring(8)
+        N = 200
+        got = []
+
+        def producer():
+            for i in range(N):
+                assert r.push(i) == 1
+            r.close()
+
+        def consumer():
+            while True:
+                rc, tok = r.pop()
+                if rc == 0:
+                    return
+                got.append(tok)
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tp.start(); tc.start()
+        tp.join(10); tc.join(10)
+        assert got == list(range(N))
+
+    def test_pop_timeout_on_empty(self):
+        r = native.Ring(2)
+        rc, _ = r.pop(timeout_ms=50)
+        assert rc == -1
+
+
+class TestBoundedPrefetchAndNormalizeCollate:
+    def test_threaded_loader_order_preserved(self):
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.full((8,), i, np.float32)
+
+            def __len__(self):
+                return 40
+
+        native.warm(background=False)
+        loader = DataLoader(DS(), batch_size=4, num_workers=3,
+                            prefetch_factor=2)
+        batches = [b.numpy()[:, 0].tolist() for b in loader]
+        flat = [v for b in batches for v in b]
+        assert flat == [float(i) for i in range(40)]
+
+    def test_normalize_collate_native_and_fallback_agree(self):
+        from paddle_tpu.vision.transforms import normalize_collate
+
+        rng = np.random.RandomState(0)
+        batch = [(rng.randint(0, 255, (8, 8, 3), np.uint8), np.int64(i))
+                 for i in range(4)]
+        native.warm(background=False)
+        fn = normalize_collate([0.5, 0.5, 0.5], [0.25, 0.25, 0.25])
+        x, y = fn(batch)
+        assert tuple(x.shape) == (4, 3, 8, 8)
+        ref = np.stack([
+            (im.astype(np.float32) / 255 - 0.5) / 0.25
+            for im, _ in batch]).transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(x.numpy(), ref, rtol=1e-6, atol=1e-6)
+        assert y.numpy().tolist() == [0, 1, 2, 3]
+
+    def test_normalize_collate_in_dataloader(self):
+        from paddle_tpu.vision.transforms import normalize_collate
+
+        class ImgDS(Dataset):
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return (rng.randint(0, 255, (16, 16, 3), np.uint8),
+                        np.int64(i % 2))
+
+            def __len__(self):
+                return 8
+
+        loader = DataLoader(
+            ImgDS(), batch_size=4,
+            collate_fn=normalize_collate([0.485, 0.456, 0.406],
+                                         [0.229, 0.224, 0.225]))
+        x, y = next(iter(loader))
+        assert tuple(x.shape) == (4, 3, 16, 16)
+        assert x.numpy().dtype == np.float32
