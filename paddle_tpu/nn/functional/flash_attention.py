@@ -69,11 +69,18 @@ def _sdpa_ref(q, k, v, attn_mask=None, dropout_key=None, causal=False,
     return jnp.swapaxes(out, 1, 2)
 
 
+#: which kernel the last scaled_dot_product_attention call used
+#: ("pallas" | "xla") — observability so benches/tests can assert the fast
+#: path is actually taken instead of trusting the silent fallback
+LAST_PATH = None
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """paddle.nn.functional.scaled_dot_product_attention
     (reference flash_attention.py:441)."""
+    global LAST_PATH
     from ...core import rng
 
     dk = None
@@ -83,9 +90,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         try:
             from ...ops.pallas.flash_attention import flash_attention_fwd
 
-            return flash_attention_fwd(query, key, value, causal=bool(is_causal))
+            out = flash_attention_fwd(query, key, value,
+                                      causal=bool(is_causal))
+            LAST_PATH = "pallas"
+            return out
         except Exception:
-            pass
+            import warnings
+
+            warnings.warn("Pallas flash-attention kernel failed; using the "
+                          "XLA path", stacklevel=2)
+    LAST_PATH = "xla"
     return _sdpa_ref(query, key, value, attn_mask, dk, causal=bool(is_causal),
                      dropout=float(dropout_p))
 

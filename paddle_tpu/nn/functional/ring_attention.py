@@ -1,0 +1,120 @@
+"""Ring attention — context parallelism over a mesh axis.
+
+Beyond-reference TPU extension (SURVEY §5.7: the reference's long-context
+story stops at Megatron-SP + sep-axis sharding; ring attention is the natural
+ICI idiom). The sequence is sharded over a mesh axis; each step every device
+computes attention of its local Q block against the K/V block it currently
+holds, accumulates with the online-softmax (flash) recurrence, and rotates
+K/V one hop around the ring with ``lax.ppermute`` — seq_len/N memory per
+device, N steps, compute/communication overlapped by XLA's scheduler.
+
+Autodiff: the loop is a ``lax.scan`` (reverse-differentiable); ppermute
+transposes to the reverse rotation, so ``jax.grad`` of the ring forward IS
+the ring backward.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+__all__ = ["ring_flash_attention"]
+
+
+def _ring_local(q, k, v, axis_name, causal, scale):
+    """Local shard body: q/k/v [B, S_loc, H, D] (this device's seq chunk)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B, H, Sq, D]
+    sq = qt.shape[2]
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    m0 = jnp.full(qt.shape[:3], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(qt.shape[:3], jnp.float32)
+    acc0 = jnp.zeros(qt.shape, jnp.float32)
+
+    def step(carry, i):
+        m, l, acc, kc, vc = carry
+        src = (idx - i) % n  # rank that produced the chunk we now hold
+        kt = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
+        vt = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if causal:
+            sk = s.shape[-1]
+            tril = jnp.tril(jnp.ones((sq, sk), bool))
+            chunk_mask = jnp.where(src > idx, False,
+                                   jnp.where(src == idx, tril, True))
+            s = jnp.where(chunk_mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(-1))
+        # fully-masked rows keep m=-inf; guard the exp
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(jnp.where(jnp.isneginf(s), -jnp.inf,
+                              s - m_safe[..., None]))
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (m_new, l, acc, kc, vc), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v),
+                                        jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+from ...core.dispatch import op as _op
+
+
+@_op("ring_flash_attention")
+def _ring_op(q, k, v, mesh=None, axis="sep", causal=False, scale=1.0):
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        lambda q_, k_, v_: _ring_local(q_, k_, v_, axis_name=axis,
+                                       causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis}, check_vma=False)(q, k, v)
+
+
+def ring_flash_attention(query, key, value, mesh=None, axis="sep",
+                         causal=False, scale=None):
+    """Context-parallel attention: [B, S, H, D] with S sharded over
+    ``axis``. Falls back to single-device flash/SDPA when no mesh axis is
+    available (so models can call it unconditionally)."""
+    from .flash_attention import scaled_dot_product_attention
+
+    if mesh is None:
+        from ...distributed.fleet.fleet import fleet_singleton
+
+        try:
+            mesh = fleet_singleton.get_hybrid_communicate_group().mesh
+        except Exception:
+            mesh = None
+    if mesh is None or axis not in getattr(mesh, "shape", {}) \
+            or mesh.shape[axis] <= 1:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    s = float(scale if scale is not None
+              else 1.0 / math.sqrt(query.shape[-1]))
+
+    def place(t):
+        if isinstance(t, Tensor) and not isinstance(t._data,
+                                                    jax.core.Tracer):
+            sharding = NamedSharding(mesh, P(None, axis, None, None))
+            nt = Tensor._wrap(jax.device_put(t._data, sharding))
+            nt.stop_gradient = t.stop_gradient
+            nt._node, nt._out_idx = t._node, t._out_idx
+            return nt
+        return t
+
+    # dispatch op: jit-cached, tape-recorded (grads ring backward via the
+    # ppermute transpose inside jax.vjp)
+    return _ring_op(place(query), place(key), place(value), mesh=mesh,
+                    axis=axis, causal=bool(causal), scale=s)
