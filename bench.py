@@ -72,9 +72,11 @@ def _bench_loop(step, make_batch, batch_sizes, steps, warmup, rebuild):
 
     def measure(bs, n_steps, n_warmup):
         batch = make_batch(bs)
+        loss = None
         for _ in range(n_warmup):
             loss = step(*batch)
-        float(loss.numpy())
+        if loss is not None:  # sync: drain compile + warmup steps
+            float(loss.numpy())
         t0 = time.perf_counter()
         for _ in range(n_steps):
             loss = step(*batch)
@@ -274,9 +276,10 @@ def main():
 
 if __name__ == "__main__":
     import sys
+    import traceback
 
     workload = (sys.argv[1] if len(sys.argv) > 1
-                else os.environ.get("BENCH_WORKLOAD", "llama"))
+                else os.environ.get("BENCH_WORKLOAD", "all"))
     _on_tpu = True
     try:
         import jax
@@ -288,5 +291,18 @@ if __name__ == "__main__":
         bench_resnet50(_on_tpu)
     elif workload == "deepfm":
         bench_deepfm(_on_tpu)
-    else:
+    elif workload == "llama":
         main()
+    elif workload == "all":
+        # default: ALL BASELINE workloads, one JSON line each; the flagship
+        # llama line prints LAST (the driver parses the tail line)
+        for fn in (lambda: bench_resnet50(_on_tpu),
+                   lambda: bench_deepfm(_on_tpu)):
+            try:
+                fn()
+            except Exception:
+                traceback.print_exc()
+        main()
+    else:
+        sys.exit(f"unknown workload {workload!r}; "
+                 "expected llama | resnet50 | deepfm | all")

@@ -204,9 +204,9 @@ def _op_vjp_fn(*arrs, op_name="", n_primals=0, op_kwargs=(), out_tuple=False):
     (paddle/fluid/eager/general_grad.h:1 + double-grad ops in backward.yaml).
 
     Positional args: the node's primal inputs followed by the output
-    cotangents; statics identify the forward op. Returns one grad per primal
-    (dummy scalar zeros where jax reports float0 / None — those slots align
-    with stop edges and are never consumed).
+    cotangents; statics identify the forward op. Returns one grad per primal;
+    where jax reports float0 / None (typically stop edges) the slot carries
+    primal-shaped zeros so it still composes if consumed downstream.
     """
     opdef = OPS[op_name]
     kw = {k: _unhash_dtype(v) for k, v in op_kwargs}
@@ -221,7 +221,12 @@ def _op_vjp_fn(*arrs, op_name="", n_primals=0, op_kwargs=(), out_tuple=False):
     out = []
     for g, p in zip(grads, primals):
         if g is None or getattr(g, "dtype", None) == jax.dtypes.float0:
-            out.append(jnp.zeros((), jnp.float32))  # stop-edge slot
+            # match the primal's shape/dtype so that if this slot is ever a
+            # real (non-stop) edge the cotangent still composes downstream
+            dt = getattr(p, "dtype", jnp.float32)
+            if not jnp.issubdtype(dt, jnp.floating):
+                dt = jnp.float32
+            out.append(jnp.zeros(jnp.shape(p), dt))
         else:
             out.append(g)
     return tuple(out)

@@ -223,18 +223,38 @@ class Engine:
         self._model.train()
         return {"loss": float(np.mean(losses)) if losses else None}
 
+    def _forward_arity(self):
+        """Required positional-arg count of the network forward, or None."""
+        import inspect
+
+        try:
+            sig = inspect.signature(self._model.forward)
+            return len([p for p in sig.parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD)
+                        and p.default is p.empty])
+        except (TypeError, ValueError):
+            return None
+
     def predict(self, test_data=None, test_sample_split=None, batch_size=1,
                 steps=None, collate_fn=None, callbacks=None, verbose=1):
         loader = self._loader(test_data, batch_size)
         self._model.eval()
         outs = []
+        npos = self._forward_arity()
         for i, batch in enumerate(loader):
             if steps is not None and i >= steps:
                 break
             batch = batch if isinstance(batch, (list, tuple)) else [batch]
             sharded = self._shard_batch(batch)
-            if self._loss is not None and len(sharded) >= 2:
-                sharded = sharded[:-1]  # drop the label slot like fit/eval
+            if test_sample_split is not None:
+                sharded = sharded[:int(test_sample_split)]
+            elif self._loss is not None and len(sharded) >= 2:
+                # drop trailing label slots only when the batch is wider than
+                # the network forward's positional arity (a multi-input
+                # unlabeled dataset must keep every element)
+                if npos is None or len(sharded) > npos:
+                    sharded = sharded[:-1]
             outs.append(self._model(*sharded).numpy())
         self._model.train()
         return outs

@@ -94,7 +94,15 @@ class VocabParallelEmbedding(Layer):
         _shard_param(self.weight, ("mp", None))
 
     def forward(self, x):
-        return F.embedding(x, self.weight)
+        out = F.embedding(x, self.weight)
+        if self.is_mp:
+            # constrain the activations mp-replicated (batch dims left
+            # unconstrained so dp/sep sharding flows through): this pins
+            # GSPMD to the masked-gather + allreduce strategy and forbids
+            # all-gathering the [V, D] table
+            spec = (P.UNCONSTRAINED,) * (out.ndim - 1) + (None,)
+            out = _constrain(out, spec)
+        return out
 
 
 class ColumnParallelLinear(Layer):

@@ -52,12 +52,24 @@ def _complex_ok():
 
 
 def _eager_array(x):
-    """The host value for the numpy fallback, or None if x is traced."""
+    """The host value for the numpy fallback, or None if x is traced.
+
+    The fallback is a host-side detour: it cannot carry gradients. Rather
+    than let them vanish silently, refuse when the input participates in a
+    live tape (stop_gradient=False under grad-enabled eager mode)."""
     import jax
+
+    from .core import state as _state
 
     data = x._data if isinstance(x, Tensor) else x
     if isinstance(data, jax.core.Tracer):
         return None
+    if (isinstance(x, Tensor) and not x.stop_gradient
+            and _state.grad_enabled()):
+        raise RuntimeError(
+            "fft numpy fallback (complex-incapable backend) cannot "
+            "differentiate: input has stop_gradient=False. Detach the input "
+            "or wrap the call in paddle.no_grad().")
     return np.asarray(data)
 
 
@@ -156,8 +168,16 @@ def _ifftshift(x, axes=None):
     return jnp.fft.ifftshift(x, axes=axes)
 
 
+def _is_complex(x):
+    data = x._data if isinstance(x, Tensor) else x
+    return np.issubdtype(np.dtype(str(getattr(data, "dtype", "float32"))),
+                         np.complexfloating)
+
+
 def fftshift(x, axes=None, name=None):
-    if not _complex_ok():
+    # shift is a pure roll — only complex INPUTS need the host detour, so a
+    # real differentiable input keeps the (differentiable) device path
+    if not _complex_ok() and _is_complex(x):
         host = _eager_array(x)
         if host is not None:
             return Tensor._wrap(np.fft.fftshift(host, axes=axes))
@@ -166,7 +186,7 @@ def fftshift(x, axes=None, name=None):
 
 
 def ifftshift(x, axes=None, name=None):
-    if not _complex_ok():
+    if not _complex_ok() and _is_complex(x):
         host = _eager_array(x)
         if host is not None:
             return Tensor._wrap(np.fft.ifftshift(host, axes=axes))

@@ -242,3 +242,93 @@ class TestRound3AdviceFixes:
         assert sched.get_lr() == pytest.approx(0.1)  # untouched
         sched.step()
         assert sched.get_lr() == pytest.approx(0.05)
+
+
+class TestRound4AdviceFixes:
+    def test_engine_predict_multi_input_unlabeled(self):
+        """ADVICE r3: Engine.predict must not drop a real input of a
+        multi-input unlabeled dataset (e.g. DeepFM's (ids, dense))."""
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.io import Dataset
+
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 1)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        class DS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return (np.ones(4, "float32") * i,
+                        np.ones(4, "float32"))
+
+        m = TwoIn()
+        eng = Engine(model=m, loss=nn.MSELoss(),
+                     optimizer=paddle.optimizer.SGD(
+                         learning_rate=0.1, parameters=m.parameters()))
+        outs = eng.predict(DS(), batch_size=2)
+        assert len(outs) == 2 and outs[0].shape == (2, 1)
+
+    def test_engine_predict_labeled_still_drops_label(self):
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.io import Dataset
+
+        class OneIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 1)
+
+            def forward(self, a):
+                return self.fc(a)
+
+        class DS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return (np.ones(4, "float32"), np.float32(1.0))
+
+        m = OneIn()
+        eng = Engine(model=m, loss=nn.MSELoss(),
+                     optimizer=paddle.optimizer.SGD(
+                         learning_rate=0.1, parameters=m.parameters()))
+        outs = eng.predict(DS(), batch_size=2)
+        assert len(outs) == 2 and outs[0].shape == (2, 1)
+
+    def test_fft_numpy_fallback_refuses_live_grad(self, monkeypatch):
+        """ADVICE r3: the host fft fallback must raise instead of silently
+        detaching a grad-requiring input."""
+        import paddle_tpu.fft as pfft
+
+        monkeypatch.setattr(pfft, "_COMPLEX_OK", False)
+        x = paddle.to_tensor(np.random.randn(8).astype("float32"))
+        x.stop_gradient = False
+        with pytest.raises(RuntimeError, match="fallback"):
+            pfft.fft(x)
+        # detached input still works
+        y = paddle.to_tensor(np.random.randn(8).astype("float32"))
+        out = pfft.fft(y)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.fft.fft(np.asarray(y._data)),
+                                   rtol=1e-5)
+
+    def test_vjp_none_grad_slot_matches_primal_shape(self):
+        """ADVICE r3: float0/None grad slots must carry primal-shaped zeros,
+        not 0-d scalars."""
+        from paddle_tpu.core.dispatch import _op_vjp_fn
+        import jax.numpy as jnp
+
+        # where(cond, a, b): cond is boolean -> float0 grad slot
+        cond = jnp.array([True, False, True])
+        a = jnp.ones(3, jnp.float32)
+        b = jnp.zeros(3, jnp.float32)
+        ct = jnp.ones(3, jnp.float32)
+        grads = _op_vjp_fn(cond, a, b, ct, op_name="where", n_primals=3,
+                           op_kwargs=(), out_tuple=False)
+        assert grads[0].shape == cond.shape  # not a 0-d scalar
+        assert grads[1].shape == a.shape

@@ -126,17 +126,26 @@ class TestTPLayers:
 
         mesh = hcg.mesh
         V, D = 64, 32
-        table = jax.device_put(np.random.randn(V, D).astype(np.float32),
-                               NamedSharding(mesh, P("mp", None)))
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.meta_parallel.parallel_layers import (
+            VocabParallelEmbedding)
+
+        emb = VocabParallelEmbedding(V, D)
+        assert emb.is_mp
+        table = emb.weight._data  # already mp-sharded by the layer
         ids = jax.device_put(np.random.randint(0, V, (4, 10)),
                              NamedSharding(mesh, P("dp", None)))
 
-        def f(ids, table):
-            out = jnp.take(table, ids, axis=0)
-            return jax.lax.with_sharding_constraint(
-                out, NamedSharding(mesh, P("dp", None, None)))
+        def f(ids_arr, table_arr):
+            # run the ACTUAL layer under trace (advisor r3: the old test
+            # compiled a hand-written analog, not the layer)
+            emb.weight._data = table_arr
+            return emb(Tensor._wrap(ids_arr))._data
 
-        txt = jax.jit(f).lower(ids, table).compile().as_text()
+        try:
+            txt = jax.jit(f).lower(ids, table).compile().as_text()
+        finally:
+            emb.weight._data = table  # don't leak the trace-time tracer
         assert "all-reduce" in txt
         for line in txt.splitlines():
             if "all-gather" in line:
