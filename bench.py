@@ -253,6 +253,18 @@ def main():
                                         warmup, rebuild)
     tokens_per_sec = seqs_per_sec * seq
 
+    # which attention kernel actually ran (VERDICT r3: don't trust the
+    # silent fallback) — tracing the step records the path taken
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+    attn_path = fa.LAST_PATH
+    if on_tpu and attn_path not in ("pallas", "pallas_rope"):
+        import sys
+
+        print(f"WARNING: flagship bench ran on attn path {attn_path!r}, "
+              "not the Pallas kernel", file=sys.stderr)
+
     flops_per_token = _train_flops_per_token(cfg, n_params, seq)
     achieved = tokens_per_sec * flops_per_token
     peak = _chip_peak_flops()
@@ -269,6 +281,7 @@ def main():
         "model_tflops_per_sec": round(achieved / 1e12, 1),
         "batch_size": best_bs,
         "seq_len": seq,
+        "attn_path": attn_path,
         "baseline_note": "vs_baseline is vs round-1 self-measurement "
                          "(78701.7 tok/s); reference publishes no numbers",
     }))

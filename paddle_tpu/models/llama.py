@@ -47,6 +47,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dropout: float = 0.0
+    # context parallelism: attention over a seq shard per device, K/V
+    # rotated around the 'sep' mesh axis (nn/functional/ring_attention.py)
+    use_ring_attention: bool = False
     # MoE (expert-parallel axis); 0 = dense
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -93,6 +96,8 @@ class LlamaAttention(Layer):
         self.num_heads = c.num_attention_heads
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.head_dim
+        self.use_ring_attention = c.use_ring_attention
+        self._ring_mesh = None  # optional explicit mesh (else fleet hcg)
         std = 0.02
         init = Normal(0.0, std)
         self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
@@ -118,8 +123,33 @@ class LlamaAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=False)
             return self.o_proj(M.reshape(out, [b, s, -1])), new_cache
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=attn_mask is None)
+        if self.use_ring_attention and attn_mask is None:
+            from ..nn.functional.ring_attention import ring_flash_attention
+
+            out = ring_flash_attention(q, k, v, mesh=self._ring_mesh,
+                                       axis="sep", causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=attn_mask is None)
+        return self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
+
+    def forward_pre_rope(self, x, cos, sin, attn_mask=None):
+        """Projection + rope-fused flash attention (rope applied inside the
+        Pallas kernel); returns None when the fused path is unavailable."""
+        if attn_mask is not None or self.use_ring_attention:
+            return None
+        b, s = x.shape[0], x.shape[1]
+        # gate BEFORE the projections: otherwise the eager fallback pays the
+        # qkv matmuls twice (advisor r4)
+        if not F.fused_rope_attention_enabled(b, s, self.num_heads,
+                                              self.head_dim):
+            return None
+        q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        out = F.fused_rope_attention(q, k, v, cos, sin, is_causal=True)
+        if out is None:
+            return None
         return self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
 
 
@@ -221,7 +251,11 @@ class LlamaDecoderLayer(Layer):
             x = x + attn_out
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        h = self.input_layernorm(x)
+        attn_out = self.self_attn.forward_pre_rope(h, cos, sin, attn_mask)
+        if attn_out is None:
+            attn_out = self.self_attn(h, cos, sin, attn_mask)
+        x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 

@@ -7,8 +7,8 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .flash_attention import (  # noqa: F401
-    flash_attention, flash_attn_unpadded, scaled_dot_product_attention,
-    sdp_kernel,
+    flash_attention, flash_attn_unpadded, fused_rope_attention,
+    fused_rope_attention_enabled, scaled_dot_product_attention, sdp_kernel,
 )
 from . import flash_attention as flash_attention_mod  # noqa: F401
 from .ring_attention import ring_flash_attention  # noqa: F401

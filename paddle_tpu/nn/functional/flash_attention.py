@@ -20,7 +20,7 @@ from ...core.dispatch import op
 from ...core.tensor import Tensor
 
 __all__ = ["flash_attention", "scaled_dot_product_attention",
-           "flash_attn_unpadded", "sdp_kernel"]
+           "flash_attn_unpadded", "sdp_kernel", "fused_rope_attention"]
 
 
 def _use_pallas(q, k=None):
@@ -102,6 +102,58 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     LAST_PATH = "xla"
     return _sdpa_ref(query, key, value, attn_mask, dk, causal=bool(is_causal),
                      dropout=float(dropout_p))
+
+
+def fused_rope_attention_enabled(batch, seq, heads, head_dim):
+    """Cheap pre-projection gate so callers can skip building q/k/v for the
+    fused path when it will not be taken (the shapes alone decide)."""
+    import os
+
+    if os.environ.get("PT_FUSED_ROPE", "0") != "1":
+        return False
+
+    class _S:
+        shape = (batch, seq, heads, head_dim)
+
+    return _use_pallas(_S(), _S()) and head_dim % 2 == 0
+
+
+def fused_rope_attention(query, key, value, cos, sin, is_causal=True,
+                         training=True):
+    """Rope-fused flash attention: q/k arrive PRE-rotary and the rotation
+    runs inside the Pallas kernels (ops/pallas/flash_attention.py), saving
+    one HBM round-trip per q/k per layer in forward AND backward. Returns
+    None when the fused path is unavailable (caller applies rope + sdpa).
+
+    Analog: the reference's fused rope kernels
+    (paddle/phi/kernels/fusion/gpu/fused_rope_grad_kernel.cu,
+    fused_multi_transformer_op.cu) bound via incubate.nn.functional."""
+    global LAST_PATH
+    import os
+
+    # default OFF: on v5e the in-kernel rotation recomputes rope on every
+    # (q-block, kv-block) pair in backward, and the measured extra VPU work
+    # outweighs the saved HBM round-trips (PERF.md r4 ablation: 120.4k vs
+    # 124.9k tok/s on the llama-125m bench). Opt in with PT_FUSED_ROPE=1 —
+    # profitable when attention is DMA-bound rather than VPU-bound.
+    if os.environ.get("PT_FUSED_ROPE", "0") != "1":
+        return None
+    if not (_use_pallas(query, key) and query.shape[3] % 2 == 0
+            and cos.shape[0] == query.shape[1]):
+        return None
+    try:
+        from ...ops.pallas.flash_attention import flash_attention_rope_fwd
+
+        out = flash_attention_rope_fwd(query, key, value, cos, sin,
+                                       causal=bool(is_causal))
+        LAST_PATH = "pallas_rope"
+        return out
+    except Exception:
+        import warnings
+
+        warnings.warn("rope-fused Pallas attention failed; using the "
+                      "unfused path", stacklevel=2)
+        return None
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

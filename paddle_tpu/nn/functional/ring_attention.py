@@ -45,6 +45,12 @@ def _ring_local(q, k, v, axis_name, causal, scale):
         src = (idx - i) % n  # rank that produced the chunk we now hold
         kt = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
         vt = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+        # GQA: the ring rotates the NARROW kv chunks (that is the memory/ICI
+        # saving GQA exists for); heads broadcast only here, at use
+        if kt.shape[1] != qt.shape[1]:
+            rep = qt.shape[1] // kt.shape[1]
+            kt = jnp.repeat(kt, rep, axis=1)
+            vt = jnp.repeat(vt, rep, axis=1)
         s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
         if causal:
             sk = s.shape[-1]
@@ -105,13 +111,13 @@ def ring_flash_attention(query, key, value, mesh=None, axis="sep",
               else 1.0 / math.sqrt(query.shape[-1]))
 
     def place(t):
+        # re-layout IN PLACE (same value, sharded over the sep axis) so the
+        # autograd tape identity is preserved — a wrapped copy would receive
+        # the leaf gradients instead of the caller's tensor
         if isinstance(t, Tensor) and not isinstance(t._data,
                                                     jax.core.Tracer):
             sharding = NamedSharding(mesh, P(None, axis, None, None))
-            nt = Tensor._wrap(jax.device_put(t._data, sharding))
-            nt.stop_gradient = t.stop_gradient
-            nt._node, nt._out_idx = t._node, t._out_idx
-            return nt
+            t._data = jax.device_put(t._data, sharding)
         return t
 
     # dispatch op: jit-cached, tape-recorded (grads ring backward via the

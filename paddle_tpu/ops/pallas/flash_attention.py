@@ -32,9 +32,17 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+import os
+
+
+def _interpret():
+    """Run the kernels in Pallas interpret mode (CPU parity tests)."""
+    return os.environ.get("PT_PALLAS_INTERPRET", "0") == "1"
+
+
 def _block_sizes(seq_q, seq_k):
-    bq = min(512, seq_q)
-    bk = min(512, seq_k)
+    bq = min(int(os.environ.get("PT_FA_BQ", 512)), seq_q)
+    bk = min(int(os.environ.get("PT_FA_BK", 512)), seq_k)
     while seq_q % bq:
         bq //= 2
     while seq_k % bk:
@@ -46,12 +54,28 @@ def _block_sizes(seq_q, seq_k):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k):
+def _rot_f32(x, c, s):
+    """Apply rotary embedding in-register: x (n, d) f32, c/s full-width
+    (n, d) cos/sin tables. rot(x) = [-x2, x1]; rope(x) = x*c + rot(x)*s.
+    The inverse rotation (used on gradients) is the same with s negated."""
+    d2 = x.shape[-1] // 2
+    rot = jnp.concatenate([-x[:, d2:], x[:, :d2]], axis=-1)
+    return x * c + rot * s
+
+
+def _fwd_kernel(*refs, scale, causal, block_k, rope=False):
+    if rope:
+        q_ref, k_ref, v_ref, cs_ref, sn_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
     _, bq, d = q_ref.shape
     sk = k_ref.shape[1]
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0].astype(jnp.float32)
+    if rope:
+        qsl = pl.ds(qi * bq, bq)
+        q = _rot_f32(q, cs_ref[qsl, :], sn_ref[qsl, :])
+    q = q * scale
 
     acc = jnp.zeros((bq, d), jnp.float32)
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -66,8 +90,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(kb, carry):
         acc, m, l = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        ksl = pl.ds(kb * block_k, block_k)
+        k = k_ref[0, ksl, :].astype(jnp.float32)
+        v = v_ref[0, ksl, :].astype(jnp.float32)
+        if rope:
+            k = _rot_f32(k, cs_ref[ksl, :], sn_ref[ksl, :])
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -89,19 +116,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, scale, causal, block_q, block_k, rope_cs=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     grid = (bh, sq // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if rope_cs is not None:
+        in_specs += [pl.BlockSpec((sk, d), lambda b, i: (0, 0))] * 2
+        args += list(rope_cs)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, rope=rope_cs is not None),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
@@ -110,7 +142,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
-    )(q, k, v)
+        interpret=_interpret(),
+    )(*args)
     return out, lse
 
 
@@ -118,12 +151,19 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
-                   scale, causal, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_k, rope=False):
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, cs_ref, sn_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref = refs
     _, bq, d = q_ref.shape
     sk = k_ref.shape[1]
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
+    if rope:
+        qsl = pl.ds(qi * bq, bq)
+        q = _rot_f32(q, cs_ref[qsl, :], sn_ref[qsl, :])
     do = do_ref[0].astype(jnp.float32)
     o = o_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0][:, None]
@@ -137,8 +177,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
         num_k_run = num_k
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        ksl = pl.ds(kb * block_k, block_k)
+        k = k_ref[0, ksl, :].astype(jnp.float32)
+        v = v_ref[0, ksl, :].astype(jnp.float32)
+        if rope:
+            k = _rot_f32(k, cs_ref[ksl, :], sn_ref[ksl, :])
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -155,15 +198,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
 
     dq = jax.lax.fori_loop(0, num_k_run, body,
                            jnp.zeros((bq, d), jnp.float32))
+    if rope:
+        # grads rotate back through the q rope (inverse = negated sin)
+        qsl = pl.ds(qi * bq, bq)
+        dq = _rot_f32(dq, cs_ref[qsl, :], -sn_ref[qsl, :])
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
-                    dv_ref, *, scale, causal, block_q):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, rope=False):
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, cs_ref, sn_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref = refs
     _, bk, d = k_ref.shape
     sq = q_ref.shape[1]
     kb = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)
+    if rope:
+        kvsl = pl.ds(kb * bk, bk)
+        k = _rot_f32(k, cs_ref[kvsl, :], sn_ref[kvsl, :])
     v = v_ref[0].astype(jnp.float32)
 
     num_q = sq // block_q
@@ -176,6 +230,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
     def body(qi, carry):
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        if rope:
+            qsl = pl.ds(qi * block_q, block_q)
+            q = _rot_f32(q, cs_ref[qsl, :], sn_ref[qsl, :])
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         o = o_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
@@ -201,17 +258,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
     dk, dv = jax.lax.fori_loop(
         q_start, num_q, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    if rope:
+        kvsl = pl.ds(kb * bk, bk)
+        dk = _rot_f32(dk, cs_ref[kvsl, :], -sn_ref[kvsl, :])
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, dout):
+def _bwd(scale, causal, block_q, block_k, res, dout, rope_cs=None):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
+    rope = rope_cs is not None
+    rope_specs = ([pl.BlockSpec((sk, d), lambda b, i: (0, 0))] * 2
+                  if rope else [])
+    rope_args = list(rope_cs) if rope else []
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, rope=rope),
         grid=(bh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -220,13 +284,14 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-        ],
+        ] + rope_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-    )(q, k, v, dout, out, lse)
+        interpret=_interpret(),
+    )(q, k, v, dout, out, lse, *rope_args)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, rope=rope),
         grid=(bh, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
@@ -235,7 +300,7 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0)),
-        ],
+        ] + rope_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -244,7 +309,8 @@ def _bwd(scale, causal, block_q, block_k, res, dout):
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-    )(q, k, v, dout, out, lse)
+        interpret=_interpret(),
+    )(q, k, v, dout, out, lse, *rope_args)
     return dq, dk, dv
 
 
@@ -264,6 +330,38 @@ def _flash_mha_bwd(scale, causal, block_q, block_k, res, dout):
 
 
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+
+
+# ---------------------------------------------------------------------------
+# rope-fused variant: q/k arrive PRE-rotary; the rotation happens in VMEM
+# inside every kernel (and its transpose on the dq/dk gradients), so the
+# roped q/k never round-trip through HBM. Analog of the reference's fused
+# rope + attention ops (paddle/phi/kernels/fusion/gpu/fused_rope_*.cu,
+# fused_multi_transformer_op.cu) — here it also shrinks the custom-vjp
+# residuals to the raw projection outputs.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_mha_rope(q, k, v, c2, s2, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k,
+                  rope_cs=(c2, s2))
+    return out
+
+
+def _flash_mha_rope_fwd(q, k, v, c2, s2, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k,
+                    rope_cs=(c2, s2))
+    return out, (q, k, v, out, lse, c2, s2)
+
+
+def _flash_mha_rope_bwd(scale, causal, block_q, block_k, res, dout):
+    q, k, v, out, lse, c2, s2 = res
+    dq, dk, dv = _bwd(scale, causal, block_q, block_k,
+                      (q, k, v, out, lse), dout, rope_cs=(c2, s2))
+    return dq, dk, dv, jnp.zeros_like(c2), jnp.zeros_like(s2)
+
+
+_flash_mha_rope.defvjp(_flash_mha_rope_fwd, _flash_mha_rope_bwd)
 
 
 from ...core.dispatch import op as _op
@@ -290,3 +388,30 @@ def _flash_attention_arrays(q, k, v, causal=True, scale=None):
 def flash_attention_fwd(q, k, v, causal=True, scale=None):
     """Tensor-level entry used by nn.functional (dispatch wraps autograd)."""
     return _flash_attention_arrays(q, k, v, causal=bool(causal), scale=scale)
+
+
+@_op("flash_attention_rope_pallas")
+def _flash_attention_rope_arrays(q, k, v, cos, sin, causal=True, scale=None):
+    """Rope-fused flash attention. q/k/v: [B, S, H, D] PRE-rotary;
+    cos/sin: [S, D/2] rope tables (models/llama.py:_rope_cache layout)."""
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    c2 = jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32)
+    s2 = jnp.concatenate([sin, sin], axis=-1).astype(jnp.float32)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * hq, k.shape[1], d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * hq, v.shape[1], d)
+    bq, bk = _block_sizes(sq, kt.shape[1])
+    out = _flash_mha_rope(qt, kt, vt, c2, s2, float(s), bool(causal), bq, bk)
+    return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
+
+
+def flash_attention_rope_fwd(q, k, v, cos, sin, causal=True, scale=None):
+    """Tensor-level rope-fused entry used by nn.functional."""
+    return _flash_attention_rope_arrays(q, k, v, cos, sin,
+                                        causal=bool(causal), scale=scale)

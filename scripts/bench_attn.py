@@ -1,0 +1,112 @@
+"""Attention kernel iteration bench: correctness vs sdpa_ref + timing.
+
+Chains calls with a data dependency so the device can't elide repeated work.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+BS, SEQ, H, D = 16, 1024, 12, 64
+REPS = 20
+
+
+def timeit(fn, *args, reps=REPS, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000.0
+
+
+def main():
+    np.random.seed(0)
+    from paddle_tpu.ops.pallas.flash_attention import _flash_attention_arrays
+    from paddle_tpu.nn.functional.flash_attention import _sdpa_ref
+
+    q = jnp.asarray(np.random.randn(BS, SEQ, H, D) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(np.random.randn(BS, SEQ, H, D) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(np.random.randn(BS, SEQ, H, D) * 0.3, jnp.bfloat16)
+
+    # correctness fwd
+    out_p = jax.jit(lambda q, k, v: _flash_attention_arrays.raw_fn(
+        q, k, v, causal=True))(q, k, v)
+    out_x = jax.jit(lambda q, k, v: _sdpa_ref.raw_fn(
+        q, k, v, causal=True))(q, k, v)
+    err = float(jnp.max(jnp.abs(out_p.astype(jnp.float32)
+                                - out_x.astype(jnp.float32))))
+    print(f"fwd max abs err vs sdpa_ref: {err:.5f}")
+
+    # correctness bwd
+    def lp(q, k, v):
+        return (_flash_attention_arrays.raw_fn(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    def lx(q, k, v):
+        return (_sdpa_ref.raw_fn(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    gp = jax.jit(jax.grad(lp, argnums=(0, 1, 2)))(q, k, v)
+    gx = jax.jit(jax.grad(lx, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gp, gx):
+        e = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b.astype(jnp.float32))))
+        r = float(jnp.max(jnp.abs(b.astype(jnp.float32))))
+        print(f"d{name} max abs err: {e:.4f} (ref max {r:.1f})")
+
+    # timing with data dependency: q_next = normalize(out)
+    @jax.jit
+    def chain_fwd(q, k, v, n):
+        def body(_, q):
+            o = _flash_attention_arrays.raw_fn(q, k, v, causal=True)
+            return (o * jax.lax.rsqrt(
+                jnp.mean(o.astype(jnp.float32) ** 2) + 1e-6).astype(o.dtype))
+        return jax.lax.fori_loop(0, n, body, q)
+
+    @jax.jit
+    def chain_fwdbwd(q, k, v, n):
+        def body(_, q):
+            g = jax.grad(lambda q: (
+                _flash_attention_arrays.raw_fn(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum())(q)
+            return (g * jax.lax.rsqrt(
+                jnp.mean(g.astype(jnp.float32) ** 2) + 1e-6)).astype(q.dtype)
+        return jax.lax.fori_loop(0, n, body, q)
+
+    @jax.jit
+    def chain_fwdbwd_xla(q, k, v, n):
+        def body(_, q):
+            g = jax.grad(lambda q: (
+                _sdpa_ref.raw_fn(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum())(q)
+            return (g * jax.lax.rsqrt(
+                jnp.mean(g.astype(jnp.float32) ** 2) + 1e-6)).astype(q.dtype)
+        return jax.lax.fori_loop(0, n, body, q)
+
+    n = jnp.int32(10)
+    t = timeit(chain_fwd, q, k, v, n, reps=3)
+    print(f"pallas fwd (chained):     {t / 10:8.3f} ms/call")
+    t = timeit(chain_fwdbwd, q, k, v, n, reps=3)
+    print(f"pallas fwd+bwd (chained): {t / 10:8.3f} ms/call")
+    t = timeit(chain_fwdbwd_xla, q, k, v, n, reps=3)
+    print(f"xla fwd+bwd (chained):    {t / 10:8.3f} ms/call")
+
+    # causal ideal: fwd 2*bh*s^2*d*2/2 ; fwd+bwd ~3.5x fwd
+    fwd_flops = 2 * BS * H * SEQ * SEQ * D * 2 / 2
+    print(f"[info] causal fwd matmul flops: {fwd_flops/1e9:.1f} GF; "
+          f"ideal @197TF: {fwd_flops/197e12*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Ablation profile of the flagship llama-125m bench step on the real chip.
+
+The axon tunnel has no trace viewer, so this measures where the time goes by
+ablation: jit each variant, warm up, time steady state, and attribute the
+deltas. Writes the table consumed by PERF.md.
+
+Usage: python scripts/profile_llama.py [quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_125m
+from paddle_tpu.utils import functional_call
+
+BS, SEQ = 16, 1024
+REPS = 20 if len(sys.argv) <= 1 else 5
+
+
+def timeit(fn, *args, reps=REPS, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000.0  # ms
+
+
+def main():
+    paddle.seed(0)
+    np.random.seed(0)
+    cfg = llama_125m()
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    model.train()
+
+    params = {n: p._data for n, p in model.named_parameters()}
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    ids = jnp.asarray(np.random.randint(0, cfg.vocab_size, (BS, SEQ)),
+                      jnp.int32)
+    labels = jnp.asarray(np.random.randint(0, cfg.vocab_size, (BS, SEQ)),
+                         jnp.int32)
+
+    def loss_fn(params, ids, labels):
+        out = functional_call(model, params, ids, labels)
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    def hidden_loss(params, ids):
+        # skip lm_head + CE: loss on the final hidden states
+        h = functional_call(model.llama, params, ids)
+        return h.astype(jnp.float32).mean()
+
+    results = {}
+
+    # full fwd+bwd
+    g_full = jax.jit(jax.value_and_grad(loss_fn))
+    results["fwd_bwd_full"] = timeit(g_full, params, ids, labels)
+
+    # fwd only
+    f_full = jax.jit(loss_fn)
+    results["fwd_full"] = timeit(f_full, params, ids, labels)
+
+    # fwd+bwd without lm_head + cross-entropy
+    body_params = {n[len("llama."):]: v for n, v in params.items()
+                   if n.startswith("llama.")}
+    g_body = jax.jit(jax.value_and_grad(hidden_loss))
+    results["fwd_bwd_no_head_ce"] = timeit(g_body, body_params, ids)
+
+    # adamw update only (fp32 moments over all params)
+    m1 = {n: jnp.zeros(p.shape, jnp.float32) for n, p in params.items()}
+    m2 = {n: jnp.zeros(p.shape, jnp.float32) for n, p in params.items()}
+
+    @jax.jit
+    def adamw_only(params, grads, m1, m2):
+        def upd(p, g, a, b):
+            gf, pf = g.astype(jnp.float32), p.astype(jnp.float32)
+            an = 0.9 * a + 0.1 * gf
+            bn = 0.999 * b + 0.001 * gf * gf
+            new = pf - 1e-4 * an / (jnp.sqrt(bn) + 1e-8) - 1e-4 * 0.01 * pf
+            return new.astype(p.dtype), an, bn
+        out = {n: upd(params[n], params[n], m1[n], m2[n]) for n in params}
+        return ({n: v[0] for n, v in out.items()},
+                {n: v[1] for n, v in out.items()},
+                {n: v[2] for n, v in out.items()})
+
+    results["adamw_update_only"] = timeit(adamw_only, params, params, m1, m2)
+
+    # attention microbench: pallas vs xla, fwd+bwd, bench shapes
+    h, d = cfg.num_attention_heads, cfg.head_dim
+    q = jnp.asarray(np.random.randn(BS, SEQ, h, d), jnp.bfloat16)
+    k = jnp.asarray(np.random.randn(BS, SEQ, h, d), jnp.bfloat16)
+    v = jnp.asarray(np.random.randn(BS, SEQ, h, d), jnp.bfloat16)
+
+    from paddle_tpu.ops.pallas.flash_attention import _flash_attention_arrays
+    from paddle_tpu.nn.functional.flash_attention import _sdpa_ref
+
+    def attn_pallas(q, k, v):
+        return _flash_attention_arrays.raw_fn(q, k, v, causal=True).sum()
+
+    def attn_xla(q, k, v):
+        return _sdpa_ref.raw_fn(q, k, v, causal=True).sum()
+
+    n_layers_factor = cfg.num_hidden_layers
+    gp = jax.jit(jax.grad(attn_pallas, argnums=(0, 1, 2)))
+    gx = jax.jit(jax.grad(attn_xla, argnums=(0, 1, 2)))
+    results["attn_pallas_fwdbwd_1layer"] = timeit(gp, q, k, v)
+    results["attn_xla_fwdbwd_1layer"] = timeit(gx, q, k, v)
+    results["attn_fwdbwd_alllayers_pallas"] = (
+        results["attn_pallas_fwdbwd_1layer"] * n_layers_factor)
+    results["attn_fwdbwd_alllayers_xla"] = (
+        results["attn_xla_fwdbwd_1layer"] * n_layers_factor)
+
+    # rmsnorm + residual microbench (per layer there are 2, plus final norm)
+    x = jnp.asarray(np.random.randn(BS, SEQ, cfg.hidden_size), jnp.bfloat16)
+    w = jnp.ones((cfg.hidden_size,), jnp.bfloat16)
+
+    def rms_residual(x, w):
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-5)
+        return (x + (y * w.astype(jnp.float32)).astype(x.dtype)).sum()
+
+    gr = jax.jit(jax.grad(rms_residual, argnums=(0, 1)))
+    results["rmsnorm_res_fwdbwd_1"] = timeit(gr, x, w)
+
+    # rope microbench
+    from paddle_tpu.models.llama import _rope_cache, _rope_apply
+    cos_np, sin_np = _rope_cache(SEQ, d, cfg.rope_theta)
+    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+
+    def rope(qq, cos, sin):
+        return _rope_apply.raw_fn(qq, cos, sin).sum()
+
+    gro = jax.jit(jax.grad(rope))
+    results["rope_fwdbwd_1"] = timeit(gro, q, cos, sin)
+
+    # lm_head + CE contribution (by subtraction)
+    results["head_ce_fwd_bwd_delta"] = (results["fwd_bwd_full"]
+                                        - results["fwd_bwd_no_head_ce"])
+
+    # tokens/sec implied by fwd_bwd + adamw
+    step_ms = results["fwd_bwd_full"] + results["adamw_update_only"]
+    results["_implied_tokens_per_sec"] = BS * SEQ / step_ms * 1000.0
+    results["_n_params"] = n_params
+
+    for k_, v_ in results.items():
+        print(f"{k_:36s} {v_:12.3f}")
+    with open("scripts/profile_llama_results.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Second-pass profile: dispatch-overhead control + in-model ablations.
+
+Per-call dispatch overhead through the axon tunnel inflates standalone
+microbenchmarks; in-model ablations (swap a component for identity inside the
+SAME jitted step) attribute time without that bias.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_125m
+from paddle_tpu.utils import functional_call
+
+BS, SEQ = 16, 1024
+REPS = 30
+
+
+def timeit(fn, *args, reps=REPS, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000.0
+
+
+def main():
+    paddle.seed(0)
+    np.random.seed(0)
+    results = {}
+
+    # 0) pure dispatch overhead: trivial jitted fn
+    tiny = jnp.zeros((8, 128), jnp.float32)
+    f_tiny = jax.jit(lambda x: x + 1.0)
+    results["dispatch_overhead_tiny"] = timeit(f_tiny, tiny)
+
+    # 0b) big-matmul achievable TFLOP/s (what "peak" means on this chip)
+    a = jnp.asarray(np.random.randn(8192, 8192), jnp.bfloat16)
+    b = jnp.asarray(np.random.randn(8192, 8192), jnp.bfloat16)
+    f_mm = jax.jit(lambda a, b: a @ b)
+    ms = timeit(f_mm, a, b)
+    results["matmul8k_ms"] = ms
+    results["matmul8k_tflops"] = 2 * 8192**3 / (ms / 1e3) / 1e12
+
+    cfg = llama_125m()
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    model.train()
+    params = {n: p._data for n, p in model.named_parameters()}
+    ids = jnp.asarray(np.random.randint(0, cfg.vocab_size, (BS, SEQ)),
+                      jnp.int32)
+    labels = jnp.asarray(np.random.randint(0, cfg.vocab_size, (BS, SEQ)),
+                         jnp.int32)
+
+    def loss_fn(params, ids, labels):
+        out = functional_call(model, params, ids, labels)
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    g_full = jax.jit(jax.value_and_grad(loss_fn))
+    results["fwd_bwd_full"] = timeit(g_full, params, ids, labels)
+
+    # ablation: attention -> identity (keeps projections, drops sdpa)
+    import importlib
+    fa = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+    orig_sdpa = fa.scaled_dot_product_attention
+
+    def fake_sdpa(q, k, v, *a, **kw):
+        return q
+
+    fa.scaled_dot_product_attention = fake_sdpa
+    try:
+        import paddle_tpu.nn.functional as F
+        orig_F = F.scaled_dot_product_attention
+        F.scaled_dot_product_attention = fake_sdpa
+        g_noattn = jax.jit(jax.value_and_grad(loss_fn))
+        results["fwd_bwd_attn_identity"] = timeit(g_noattn, params, ids,
+                                                  labels)
+    finally:
+        fa.scaled_dot_product_attention = orig_sdpa
+        F.scaled_dot_product_attention = orig_F
+
+    # ablation: force the XLA sdpa path instead of pallas
+    orig_use = fa._use_pallas
+    fa._use_pallas = lambda *a, **k: False
+    try:
+        g_xlaattn = jax.jit(jax.value_and_grad(loss_fn))
+        results["fwd_bwd_attn_xla"] = timeit(g_xlaattn, params, ids, labels)
+    finally:
+        fa._use_pallas = orig_use
+
+    # ablation: rope -> identity
+    import paddle_tpu.models.llama as lm
+    orig_rope = lm.apply_rope
+    lm.apply_rope = lambda x, c, s: x
+    try:
+        g_norope = jax.jit(jax.value_and_grad(loss_fn))
+        results["fwd_bwd_rope_identity"] = timeit(g_norope, params, ids,
+                                                  labels)
+    finally:
+        lm.apply_rope = orig_rope
+
+    # ablation: CE loss -> mean of logits (keeps lm_head matmul)
+    def loss_mean_logits(params, ids, labels):
+        h = functional_call(model.llama,
+                            {n[len("llama."):]: v for n, v in params.items()
+                             if n.startswith("llama.")}, ids)
+        w = params["lm_head.weight"]
+        logits = h @ w
+        return logits.astype(jnp.float32).mean()
+
+    g_noce = jax.jit(jax.value_and_grad(loss_mean_logits))
+    results["fwd_bwd_ce_as_mean"] = timeit(g_noce, params, ids, labels)
+
+    results["attn_total_in_model"] = (results["fwd_bwd_full"]
+                                      - results["fwd_bwd_attn_identity"])
+    results["rope_total_in_model"] = (results["fwd_bwd_full"]
+                                      - results["fwd_bwd_rope_identity"])
+    results["ce_cost_in_model"] = (results["fwd_bwd_full"]
+                                   - results["fwd_bwd_ce_as_mean"])
+
+    for k_, v_ in results.items():
+        print(f"{k_:32s} {v_:12.3f}")
+    with open("scripts/profile_llama2_results.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
