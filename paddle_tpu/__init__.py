@@ -51,6 +51,9 @@ from . import hapi  # noqa: F401
 from . import fft  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
+from . import signal  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 

@@ -31,6 +31,10 @@ from .engine import Edge, GradNode
 
 OPS: dict[str, "OpDef"] = {}
 
+#: live op-stats sink (dict op_name -> [fp16, bf16, fp32, other] call
+#: counts) while amp.debugging collection is enabled; None = off
+OP_STATS: dict | None = None
+
 
 class OpDef:
     __slots__ = ("name", "fn", "differentiable", "wrapper")
@@ -117,6 +121,19 @@ def call_op(name: str, *args, **kwargs):
         from ..amp import amp_lists
 
         arrs = amp_lists.maybe_cast(name, arrs)
+
+    # --- amp.debugging operator-stats collection (reference
+    # python/paddle/amp/debugging.py:459: per-op dtype call histogram) ---
+    if OP_STATS is not None:
+        dt = None
+        for a in arrs:
+            adt = getattr(a, "dtype", None)
+            if adt is not None and jnp.issubdtype(adt, jnp.floating):
+                dt = str(adt)
+                break
+        key = {"float16": 0, "bfloat16": 1, "float32": 2}.get(dt, 3)
+        counts = OP_STATS.setdefault(name, [0, 0, 0, 0])
+        counts[key] += 1
 
     if any_tracer:
         out = opdef.fn(*arrs, **kwargs)

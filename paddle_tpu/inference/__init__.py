@@ -1,0 +1,231 @@
+"""paddle.inference — deployment facade over exported StableHLO programs.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:100
+(AnalysisPredictor) + python/paddle/inference/__init__.py (Config,
+create_predictor, Predictor/Tensor handles). The reference deserializes a
+Program and runs it through the analysis/IR-pass pipeline; TPU-native, the
+artifact IS a compiled-ready serialized StableHLO module (jit.save), XLA is
+the IR-pass pipeline, and a Predictor is a thin handle-based session around
+``jax.export.deserialize(...).call``. Graph-level config knobs
+(switch_ir_optim, enable_memory_optim, …) are accepted for API parity and
+recorded; XLA performs those optimizations unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"  # accepted; maps to the default accelerator
+    XPU = "xpu"
+    CUSTOM = "custom"
+    TPU = "tpu"
+
+
+def get_version():
+    from .. import __version__
+
+    return __version__
+
+
+class Config:
+    """reference analysis_config — model path + device/precision options."""
+
+    def __init__(self, prog_file=None, params_file=None, model_dir=None):
+        if model_dir is not None and prog_file is None:
+            prog_file = os.path.join(model_dir, "model")
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._device = None  # None = default backend
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_math_threads = 1
+        self._enable_profile = False
+
+    # ---- model paths ----------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        self._prog_file = prog_file
+        self._params_file = params_file
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    # ---- device ---------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        # "gpu" in reference terms = the accelerator; here: default backend
+        self._device = None
+        self._device_id = device_id
+        self._precision = precision
+
+    def enable_xpu(self, *a, **k):
+        self._device = None
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device = device_type
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # ---- optimization knobs (XLA does these; recorded for parity) -------
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = bool(x)
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def summary(self):
+        return (f"prog_file: {self._prog_file}\n"
+                f"device: {self._device or 'default'}\n"
+                f"precision: {self._precision}\n"
+                f"ir_optim: {self._ir_optim} (performed by XLA)")
+
+
+class Tensor:
+    """In/out handle (reference paddle_infer::Tensor)."""
+
+    def __init__(self, name, spec=None):
+        self._name = name
+        self._spec = spec
+        self._value = None
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data):
+        self._value = np.asarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def share_external_data(self, data):
+        self._value = data
+
+    def shape(self):
+        if self._value is not None:
+            return list(np.asarray(self._value).shape)
+        return list(self._spec[0]) if self._spec else None
+
+    def reshape(self, shape):
+        pass  # shapes are taken from the bound data
+
+    def type(self):
+        return self._spec[1] if self._spec else None
+
+
+class Predictor:
+    """reference analysis_predictor.h:100 — handle-based run session over
+    the deserialized StableHLO executable."""
+
+    def __init__(self, config):
+        import jax
+
+        from ..jit import load as jit_load
+
+        self._config = config
+        if config.prog_file() is None:
+            raise ValueError("Config has no model path; use "
+                             "Config(prog_file) or set_model()")
+        path = config.prog_file()
+        if path.endswith(".pdmodel"):
+            path = path[: -len(".pdmodel")]
+        self._layer = jit_load(path)
+        if config._device == "cpu":
+            cpu = jax.devices("cpu")[0]
+            self._layer._consts = [jax.device_put(np.asarray(c), cpu)
+                                   for c in self._layer._consts]
+        specs = self._layer._specs
+        self._inputs = {}
+        for i, (shape, dtype, name) in enumerate(specs):
+            name = name or f"x{i}"
+            self._inputs[name] = Tensor(name, (shape, dtype))
+        self._outputs = {}
+        self._lock = threading.Lock()
+
+    # ---- handles --------------------------------------------------------
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs) or ["out0"]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    # ---- execution ------------------------------------------------------
+    def run(self, inputs=None):
+        """Execute; returns the list of output numpy arrays (and fills the
+        output handles). ``inputs`` may be passed positionally like the
+        reference's ``predictor.run([x, y])``."""
+        import jax.numpy as jnp
+
+        if inputs is not None:
+            for h, arr in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(arr))
+        args = [jnp.asarray(h._value) for h in self._inputs.values()]
+        with self._lock:
+            out = self._layer._exported.call(self._layer._consts, *args)
+        outs = [np.asarray(o) for o in out]
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            t = Tensor(f"out{i}")
+            t._value = o
+            self._outputs[f"out{i}"] = t
+        return outs
+
+    def clone(self):
+        """Per-thread clone sharing the loaded program + weights (the
+        reference clones the executor, sharing the program)."""
+        import copy
+
+        c = copy.copy(self)
+        c._inputs = {n: Tensor(n, h._spec) for n, h in self._inputs.items()}
+        c._outputs = {}
+        c._lock = threading.Lock()
+        return c
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

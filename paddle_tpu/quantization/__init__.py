@@ -1,0 +1,31 @@
+"""paddle.quantization — QAT + PTQ over the layer tree.
+
+Reference package: python/paddle/quantization/ (config.py, qat.py, ptq.py,
+quanters/, observers/, wrapper.py). The imperative pre-2.0 API
+(quantization/imperative/qat.py) collapses into the same wrappers here.
+"""
+
+from .base import BaseObserver, BaseQuanter  # noqa: F401
+from .config import (  # noqa: F401
+    DEFAULT_QAT_LAYER_MAPPINGS,
+    QuantConfig,
+    SingleLayerConfig,
+)
+from .factory import ObserverFactory, QuanterFactory  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .quantize import Quantization  # noqa: F401
+from .wrapper import (  # noqa: F401
+    Int8InferenceLinear,
+    ObserveWrapper,
+    QuantedConv2D,
+    QuantedLinear,
+)
+from . import observers, quanters  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "QAT", "PTQ", "Quantization",
+    "BaseQuanter", "BaseObserver", "QuanterFactory", "ObserverFactory",
+    "ObserveWrapper", "QuantedLinear", "QuantedConv2D",
+    "Int8InferenceLinear", "observers", "quanters",
+]

@@ -1,0 +1,77 @@
+"""Quantization base classes.
+
+Reference: python/paddle/quantization/base_quanter.py:1 and
+base_observer.py:1 — abstract Layer subclasses exposing ``scales()``,
+``zero_points()``, ``bit_length`` and ``quant_axis``. TPU-native design:
+fake-quantization is a pure jax op with a straight-through estimator
+(x + stop_gradient(fq(x) - x)), so QAT trains through XLA with zero custom
+gradients; the int8 conversion produces jnp int8 weights with a dequant
+epilogue fused by XLA into the following matmul.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..nn.layer.layers import Layer
+
+__all__ = ["BaseQuanter", "BaseObserver", "fake_quant", "quant_dequant_ste"]
+
+
+@op("fake_quant_dequant")
+def fake_quant(x, scale, qmax=127.0):
+    """Simulated int quantization: round(clip(x/scale*qmax)) * scale/qmax."""
+    s = jnp.maximum(scale, 1e-9).astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / s * qmax), -qmax, qmax)
+    return (q * (s / qmax)).astype(x.dtype)
+
+
+@op("fake_quant_ste")
+def quant_dequant_ste(x, scale, qmax=127.0):
+    """Fake quant with a straight-through gradient (d out/d x = 1)."""
+    import jax
+
+    return x + jax.lax.stop_gradient(
+        fake_quant.raw_fn(x, scale, qmax=qmax) - x)
+
+
+class _QBase(Layer):
+    def __init__(self, quant_bits=8, quant_axis=None):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        self._quant_axis = quant_axis
+
+    @property
+    def bit_length(self):
+        return self._quant_bits
+
+    @property
+    def quant_axis(self):
+        return self._quant_axis if self._quant_axis is not None else -1
+
+    @property
+    def qmax(self):
+        return float(2 ** (self._quant_bits - 1) - 1)
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    def zero_points(self):
+        return None  # symmetric schemes only (abs-max family)
+
+
+class BaseQuanter(_QBase):
+    """reference base_quanter.py:24 — trains/simulates quantization."""
+
+
+class BaseObserver(_QBase):
+    """reference base_observer.py:20 — collects statistics only."""
+
+    @abc.abstractmethod
+    def cal_thresholds(self):
+        ...

@@ -1,0 +1,22 @@
+"""Quanter/Observer factories (reference factory.py:1 — a QuanterFactory is
+a picklable recipe; ``_instance(layer)`` builds the concrete quanter Layer
+for one host layer)."""
+
+from __future__ import annotations
+
+__all__ = ["QuanterFactory", "ObserverFactory"]
+
+
+class ObserverFactory:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def _get_class(self):
+        raise NotImplementedError
+
+    def _instance(self, layer):
+        return self._get_class()(layer, **self._kwargs)
+
+
+class QuanterFactory(ObserverFactory):
+    pass

@@ -1,0 +1,3 @@
+from .abs_max import AbsmaxObserver, AbsmaxObserverLayer  # noqa: F401
+
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]

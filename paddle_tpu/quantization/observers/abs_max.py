@@ -1,0 +1,62 @@
+"""Abs-max observer (PTQ).
+
+Reference: python/paddle/quantization/observers/abs_max.py:22 —
+AbsmaxObserver collects the running max(|x|) during calibration forwards;
+``cal_thresholds`` freezes it into the quantization scale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..base import BaseObserver, fake_quant
+from ..factory import ObserverFactory
+
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]
+
+
+class AbsmaxObserver(ObserverFactory):
+    """reference observers/abs_max.py:22."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits=quant_bits)
+
+    def _get_class(self):
+        return AbsmaxObserverLayer
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    """reference observers/abs_max.py:48: forward records abs-max and
+    passes the input through untouched (observation, not simulation)."""
+
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__(quant_bits=quant_bits)
+        self._max = 1e-9
+        self._scale = None
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else x
+        self._max = max(self._max,
+                        float(jnp.max(jnp.abs(data.astype(jnp.float32)))))
+        return x
+
+    def cal_thresholds(self):
+        self._scale = self._max
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return Tensor(np.asarray(self._scale, np.float32))
+
+    def quantize_weight(self, w):
+        """int8 weight + f32 scale for the converted inference model."""
+        scale = self.scales().numpy()
+        arr = w._data if isinstance(w, Tensor) else w
+        q = jnp.clip(jnp.round(arr.astype(jnp.float32) / max(scale, 1e-9)
+                               * self.qmax), -self.qmax, self.qmax)
+        return q.astype(jnp.int8), float(scale)
+
+    def fake_quant(self, x):
+        return fake_quant(x, self.scales(), qmax=self.qmax)

@@ -1,0 +1,22 @@
+"""Post-training quantization (reference ptq.py:24 — PTQ.quantize inserts
+observers; calibration forwards collect abs-max; convert freezes scales)."""
+
+from __future__ import annotations
+
+from .quantize import Quantization
+
+__all__ = ["PTQ"]
+
+
+class PTQ(Quantization):
+    def __init__(self, config):
+        super().__init__(config)
+
+    def convert(self, model, inplace=False):
+        # freeze observer thresholds before conversion
+        from .base import BaseObserver
+
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, BaseObserver):
+                layer.cal_thresholds()
+        return super().convert(model, inplace=inplace)

@@ -1,0 +1,13 @@
+"""Quantization-aware training (reference qat.py:23 — QAT.quantize inserts
+fake quanters; training then runs with the straight-through estimator)."""
+
+from __future__ import annotations
+
+from .quantize import Quantization
+
+__all__ = ["QAT"]
+
+
+class QAT(Quantization):
+    def __init__(self, config):
+        super().__init__(config)

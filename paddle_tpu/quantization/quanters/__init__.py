@@ -1,0 +1,7 @@
+from .abs_max import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserverLayer,
+)
+
+__all__ = ["FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer"]

@@ -1,0 +1,80 @@
+"""Fake quanter with moving-average abs-max observer (QAT).
+
+Reference: python/paddle/quantization/quanters/abs_max.py:27
+(FakeQuanterWithAbsMaxObserver, moving_rate ema of abs-max; dynamic_forward
+updates state in training, static_forward uses the frozen scale).
+
+TPU-native: the quant-dequant runs as one fused jax op with a
+straight-through estimator, so QAT backprop is ordinary XLA; the ema scale
+is host state updated from the (eager) forward — under ``jit``/to_static
+the frozen scale is traced as a constant, matching the reference's
+static_forward semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..base import BaseQuanter, fake_quant, quant_dequant_ste
+from ..factory import QuanterFactory
+
+__all__ = ["FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer"]
+
+
+class FakeQuanterWithAbsMaxObserver(QuanterFactory):
+    """reference quanters/abs_max.py:27."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__(moving_rate=moving_rate, bit_length=bit_length)
+
+    def _get_class(self):
+        return FakeQuanterWithAbsMaxObserverLayer
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """reference quanters/abs_max.py:96."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8):
+        super().__init__(quant_bits=bit_length)
+        self._moving_rate = float(moving_rate)
+        self._state = 1.0
+        self._accum = 1.0
+        self._scale = 1e-9
+
+    def _update(self, x):
+        data = x._data if isinstance(x, Tensor) else x
+        cur = float(jnp.max(jnp.abs(data.astype(jnp.float32))))
+        r = self._moving_rate
+        # reference dynamic_forward accumulator form: scale is a bias-
+        # corrected ema of the per-batch abs-max
+        self._state = r * self._state + 1.0
+        self._accum = r * self._accum + cur
+        self._scale = max(self._accum / self._state, 1e-9)
+
+    def forward(self, x):
+        import jax
+
+        data = x._data if isinstance(x, Tensor) else x
+        if self.training and not isinstance(data, jax.core.Tracer):
+            self._update(x)
+        scale = Tensor(np.asarray(self._scale, np.float32))
+        if self.training:
+            return quant_dequant_ste(x, scale, qmax=self.qmax)
+        return fake_quant(x, scale, qmax=self.qmax)
+
+    def scales(self):
+        return Tensor(np.asarray(self._scale, np.float32))
+
+    def cal_thresholds(self):
+        pass
+
+    def quantize_weight(self, w):
+        scale = float(self._scale)
+        arr = w._data if isinstance(w, Tensor) else w
+        q = jnp.clip(jnp.round(arr.astype(jnp.float32) / max(scale, 1e-9)
+                               * self.qmax), -self.qmax, self.qmax)
+        return q.astype(jnp.int8), scale

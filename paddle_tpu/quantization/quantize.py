@@ -1,0 +1,49 @@
+"""Quantization driver: walk the model, wrap quantizable layers.
+
+Reference: python/paddle/quantization/quantize.py:1 (Quantization base —
+quantize()/convert() over the layer tree).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .wrapper import QuantedConv2D, QuantedLinear
+
+__all__ = ["Quantization"]
+
+
+class Quantization:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._wrap_children(model)
+        return model
+
+    def _wrap_children(self, module: Layer):
+        for name, child in list(module._sub_layers.items()):
+            target = self._config.quanted_layer_for(child)
+            cfg = self._config._config_for(child)
+            if target is not None and cfg is not None:
+                module._sub_layers[name] = target(child, cfg)
+            else:
+                self._wrap_children(child)
+
+    def convert(self, model: Layer, inplace=False):
+        """Freeze simulated quantization into int8 inference layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert_children(model)
+        return model
+
+    def _convert_children(self, module: Layer):
+        for name, child in list(module._sub_layers.items()):
+            if isinstance(child, (QuantedLinear,)) and \
+                    child.weight_quanter is not None:
+                module._sub_layers[name] = child.convert()
+            else:
+                self._convert_children(child)

@@ -1,0 +1,123 @@
+"""Quantized layer wrappers.
+
+Reference: python/paddle/quantization/wrapper.py:1 (ObserveWrapper) and
+python/paddle/nn/quant/qat/ (QuantedLinear / QuantedConv2D — the QAT
+simulation layers referenced by DEFAULT_QAT_LAYER_MAPPINGS in config.py:33).
+
+TPU-native convert path: ``QuantedLinear.convert()`` re-expresses the layer
+as int8 storage + a dequant epilogue (``(x_q · w_q) * (sx·sw/qmax²)``);
+XLA fuses the dequant into the matmul consumer, which is the analog of the
+reference's fused int8 gemm + dequant kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+
+__all__ = ["ObserveWrapper", "QuantedLinear", "QuantedConv2D",
+           "Int8InferenceLinear"]
+
+
+class ObserveWrapper(Layer):
+    """reference wrapper.py:23 — observes the output of a leaf layer."""
+
+    def __init__(self, observer, observed, observe_input=False):
+        super().__init__()
+        self._observer = observer
+        self._observed = observed
+        self._observe_input = observe_input
+
+    def forward(self, *args, **kwargs):
+        if self._observe_input and args:
+            args = (self._observer(args[0]),) + args[1:]
+            return self._observed(*args, **kwargs)
+        out = self._observed(*args, **kwargs)
+        return self._observer(out)
+
+
+class QuantedLinear(Layer):
+    """Simulated-quantization Linear (reference nn/quant/qat/linear)."""
+
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._inner = layer
+        self.weight_quanter = (q_config.weight._instance(layer)
+                               if q_config.weight is not None else None)
+        self.activation_quanter = (q_config.activation._instance(layer)
+                                   if q_config.activation is not None
+                                   else None)
+
+    # QAT/PTQ simulation forward
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self._inner.bias)
+
+    def convert(self):
+        """Freeze into an int8-weight inference layer."""
+        wq, wscale = self.weight_quanter.quantize_weight(self._inner.weight)
+        ascale = (float(self.activation_quanter.scales().numpy())
+                  if self.activation_quanter is not None else None)
+        return Int8InferenceLinear(wq, wscale, self._inner.bias, ascale,
+                                   qmax=self.weight_quanter.qmax)
+
+
+@op("int8_linear_dequant")
+def _int8_linear(x, wq, bias=None, wscale=1.0, qmax=127.0):
+    """int8-weight matmul with dequant epilogue; accumulation in f32/int32
+    is XLA's choice — the dequant scale folds into the epilogue."""
+    xf = x.astype(jnp.float32)
+    wf = wq.astype(jnp.float32)  # int8 storage; MXU consumes the upcast
+    out = jnp.matmul(xf, wf) * (wscale / qmax)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+class Int8InferenceLinear(Layer):
+    """Converted inference layer: int8 weights resident in HBM (4x smaller
+    than f32), dequant fused into the matmul epilogue."""
+
+    def __init__(self, wq, wscale, bias, ascale=None, qmax=127.0):
+        super().__init__()
+        self.register_buffer("weight_q", Tensor._wrap(wq))
+        self._wscale = float(wscale)
+        self._ascale = ascale
+        self._qmax = float(qmax)
+        self.bias = bias
+
+    def forward(self, x):
+        return _int8_linear(x, self.weight_q, self.bias,
+                            wscale=self._wscale, qmax=self._qmax)
+
+
+class QuantedConv2D(Layer):
+    """Simulated-quantization Conv2D (reference nn/quant/qat/conv)."""
+
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._inner = layer
+        self.weight_quanter = (q_config.weight._instance(layer)
+                               if q_config.weight is not None else None)
+        self.activation_quanter = (q_config.activation._instance(layer)
+                                   if q_config.activation is not None
+                                   else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        inner = self._inner
+        w = inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups, inner._data_format)

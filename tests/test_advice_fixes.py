@@ -332,3 +332,37 @@ class TestRound4AdviceFixes:
                            op_kwargs=(), out_tuple=False)
         assert grads[0].shape == cond.shape  # not a 0-d scalar
         assert grads[1].shape == a.shape
+
+
+class TestAmpDebugging:
+    def test_operator_stats_collection(self, capsys):
+        """amp.debugging collects a per-op dtype histogram from dispatch
+        (VERDICT r4 item 8; reference amp/debugging.py:459)."""
+        with paddle.amp.debugging.collect_operator_stats():
+            a = paddle.to_tensor(np.ones((4, 4), "float32"))
+            b = a.astype("bfloat16")
+            _ = b @ b
+            _ = a + a
+        out = capsys.readouterr().out
+        assert "Op Name" in out and "BF16 Calls" in out
+        stats = paddle.amp.debugging.operator_stats()
+        assert any(v[1] > 0 for v in stats.values())  # a bf16 call counted
+        assert any(v[2] > 0 for v in stats.values())  # an fp32 call counted
+        # collection is off again
+        from paddle_tpu.core import dispatch
+        assert dispatch.OP_STATS is None
+
+    def test_compare_accuracy(self, tmp_path):
+        model = nn.Linear(8, 8)
+
+        def fn(x):
+            return model(x)
+
+        x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        csvf = str(tmp_path / "cmp.csv")
+        report = paddle.amp.debugging.compare_accuracy(
+            fn, [x], amp_level="O1", dtype="bfloat16", output_filename=csvf)
+        assert report[0]["max_rel_err"] < 0.2
+        assert report[0]["max_abs_err"] > 0.0  # bf16 really differs
+        import os
+        assert os.path.exists(csvf)

@@ -1,0 +1,106 @@
+"""paddle.inference Predictor tests: save in training, load and serve
+through the Config/create_predictor facade (VERDICT r4 item 7).
+
+Reference parity: paddle/fluid/inference/api/analysis_predictor.h:100 and
+python/paddle/inference (Config, create_predictor, handle API).
+"""
+
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture(scope="module")
+def saved_model():
+    paddle.seed(11)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "model")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([-1, 8], "float32", "x")])
+    x = np.random.randn(4, 8).astype("float32")
+    ref = model(paddle.to_tensor(x)).numpy()
+    return path, x, ref
+
+
+class TestPredictor:
+    def test_load_and_serve(self, saved_model):
+        path, x, ref = saved_model
+        config = Config(path)
+        predictor = create_predictor(config)
+
+        names = predictor.get_input_names()
+        assert names == ["x"]
+        h = predictor.get_input_handle("x")
+        h.copy_from_cpu(x)
+        outs = predictor.run()
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+        out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+        np.testing.assert_allclose(out_h.copy_to_cpu(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_run_positional(self, saved_model):
+        path, x, ref = saved_model
+        predictor = create_predictor(Config(path))
+        outs = predictor.run([x])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_dynamic_batch(self, saved_model):
+        path, x, ref = saved_model
+        predictor = create_predictor(Config(path))
+        big = np.random.randn(32, 8).astype("float32")
+        outs = predictor.run([big])
+        assert outs[0].shape == (32, 4)
+
+    def test_clone_per_thread(self, saved_model):
+        path, x, ref = saved_model
+        predictor = create_predictor(Config(path))
+        results = {}
+
+        def worker(i):
+            p = predictor.clone()
+            xi = np.random.randn(2 + i, 8).astype("float32")
+            results[i] = (xi, p.run([xi])[0])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 4
+        for i, (xi, out) in results.items():
+            assert out.shape == (2 + i, 4)
+
+    def test_config_surface(self, saved_model):
+        path, _, _ = saved_model
+        config = Config(path)
+        config.enable_use_gpu(100, 0, PrecisionType.Bfloat16)
+        config.switch_ir_optim(True)
+        config.enable_memory_optim()
+        config.set_cpu_math_library_num_threads(4)
+        assert config.ir_optim()
+        assert "XLA" in config.summary()
+        predictor = create_predictor(config)
+        assert predictor.run([np.zeros((1, 8), "float32")])[0].shape == (1, 4)
+
+    def test_cpu_device_pick(self, saved_model):
+        path, x, ref = saved_model
+        config = Config(path)
+        config.disable_gpu()
+        predictor = create_predictor(config)
+        np.testing.assert_allclose(predictor.run([x])[0], ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_missing_model_errors(self):
+        with pytest.raises(ValueError):
+            create_predictor(Config())

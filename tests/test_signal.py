@@ -1,0 +1,107 @@
+"""paddle.signal tests: frame/overlap_add round trip, stft vs
+scipy-style reference, istft perfect reconstruction (COLA windows).
+
+Reference parity: python/paddle/signal.py:30,145,246,423.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import signal
+
+
+def hann(n):
+    return (0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / n)).astype(
+        np.float32)
+
+
+class TestFrame:
+    def test_frame_last_axis(self):
+        x = paddle.to_tensor(np.arange(10, dtype="float32"))
+        out = signal.frame(x, frame_length=4, hop_length=2).numpy()
+        assert out.shape == (4, 4)  # [frame_length, num_frames]
+        np.testing.assert_array_equal(out[:, 0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(out[:, 1], [2, 3, 4, 5])
+
+    def test_frame_axis0(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(12, 1))
+        out = signal.frame(x, frame_length=6, hop_length=3, axis=0).numpy()
+        assert out.shape == (3, 6, 1)  # [num_frames, frame_length, ...]
+        np.testing.assert_array_equal(out[1, :, 0], [3, 4, 5, 6, 7, 8])
+
+    def test_frame_batched(self):
+        x = paddle.to_tensor(np.random.randn(3, 20).astype("float32"))
+        out = signal.frame(x, 5, 5).numpy()
+        assert out.shape == (3, 5, 4)
+
+    def test_invalid(self):
+        x = paddle.to_tensor(np.zeros(4, "float32"))
+        with pytest.raises(ValueError):
+            signal.frame(x, 10, 2)
+
+
+class TestOverlapAdd:
+    def test_roundtrip_no_overlap(self):
+        x = np.random.randn(2, 30).astype("float32")
+        framed = signal.frame(paddle.to_tensor(x), 5, 5)
+        back = signal.overlap_add(framed, 5).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_overlap_sums(self):
+        frames = paddle.to_tensor(np.ones((4, 3), "float32"))
+        out = signal.overlap_add(frames, hop_length=2).numpy()
+        # length = (3-1)*2 + 4 = 8; middle positions overlap
+        assert out.shape == (8,)
+        assert out.sum() == pytest.approx(12.0)
+
+    def test_axis0(self):
+        frames = paddle.to_tensor(np.ones((3, 4, 2), "float32"))
+        out = signal.overlap_add(frames, hop_length=2, axis=0).numpy()
+        assert out.shape == ((3 - 1) * 2 + 4, 2)
+
+
+class TestStft:
+    def test_matches_numpy_reference(self):
+        np.random.seed(0)
+        x = np.random.randn(400).astype(np.float32)
+        n_fft, hop = 64, 16
+        w = hann(n_fft)
+        out = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                          window=paddle.to_tensor(w)).numpy()
+        # manual reference
+        xp = np.pad(x, (n_fft // 2, n_fft // 2), mode="reflect")
+        n_frames = 1 + (len(xp) - n_fft) // hop
+        ref = np.stack([np.fft.rfft(xp[t * hop: t * hop + n_fft] * w)
+                        for t in range(n_frames)], axis=1)
+        assert out.shape == (n_fft // 2 + 1, n_frames)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_two_sided_and_normalized(self):
+        x = paddle.to_tensor(np.random.randn(2, 256).astype("float32"))
+        out = signal.stft(x, 32, hop_length=8, onesided=False,
+                          normalized=True).numpy()
+        assert out.shape[1] == 32
+        out1 = signal.stft(x, 32, hop_length=8, onesided=False).numpy()
+        np.testing.assert_allclose(out * np.sqrt(32), out1, rtol=1e-4)
+
+
+class TestIstft:
+    @pytest.mark.parametrize("normalized", [False, True])
+    def test_perfect_reconstruction(self, normalized):
+        """hann @ 50% overlap satisfies COLA -> istft(stft(x)) == x."""
+        np.random.seed(1)
+        x = np.random.randn(2, 512).astype(np.float32)
+        n_fft, hop = 64, 32
+        w = paddle.to_tensor(hann(n_fft))
+        spec = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                           window=w, normalized=normalized)
+        back = signal.istft(spec, n_fft, hop_length=hop, window=w,
+                            normalized=normalized, length=512).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    def test_return_complex_unsupported(self):
+        spec = signal.stft(
+            paddle.to_tensor(np.random.randn(256).astype("float32")), 32)
+        with pytest.raises(NotImplementedError):
+            signal.istft(spec, 32, return_complex=True)
