@@ -54,6 +54,10 @@ from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import signal  # noqa: F401
+from . import onnx  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 

@@ -1,0 +1,10 @@
+"""paddle.audio — windows, mel/DSP helpers, feature layers, WAV IO.
+
+Reference package: python/paddle/audio/ (functional/, features/, backends/;
+datasets/ are download-based and out of scope for an offline image).
+"""
+
+from . import backends, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "load", "save", "info"]

@@ -1,0 +1,25 @@
+"""Audio IO backends (reference audio/backends/__init__.py). Only the
+no-dependency wave backend ships (the reference's soundfile backend is an
+optional extra, not present in this image)."""
+
+from . import wave_backend  # noqa: F401
+from .wave_backend import info, load, save  # noqa: F401
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave":
+        raise NotImplementedError(
+            "only the stdlib 'wave' backend is available (soundfile is an "
+            "optional dependency not present in this image)")
+
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend", "set_backend"]

@@ -1,0 +1,71 @@
+"""WAV file IO over the stdlib ``wave`` module.
+
+Reference: python/paddle/audio/backends/wave_backend.py:1 — the reference
+also ships a pure wave-module backend as the no-dependency default (its
+soundfile backend is an optional install, absent in this image)."""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["load", "save", "info"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels, bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath):
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dt).reshape(-1, ch)
+    if width == 1:
+        data = data.astype(np.int16) - 128  # 8-bit wav is unsigned
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * width - 1))
+    if normalize:
+        data = data.astype(np.float32) / scale
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    if bits_per_sample != 16:
+        raise ValueError("wave backend writes 16-bit PCM only")
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

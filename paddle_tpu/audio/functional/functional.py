@@ -1,0 +1,120 @@
+"""Audio DSP helpers (reference audio/functional/functional.py: hz_to_mel
+:24, mel_to_hz :80, mel_frequencies :125, fft_frequencies :165,
+compute_fbank_matrix :188, power_to_db :261, create_dct :305).
+
+Slaney mel scale by default (htk=False), matching the reference/librosa.
+Scalar math runs in numpy; Tensor inputs go through dispatch ops.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct"]
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x,
+                      dtype=np.float64)
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, Tensor)
+    f = _np(freq)
+    if htk:
+        mels = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_sp = 200.0 / 3
+        mels = f / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = min_log_hz / f_sp
+        logstep = math.log(6.4) / 27.0
+        log_t = min_log_mel + np.log(f / min_log_hz + 1e-10) / logstep
+        mels = np.where(f >= min_log_hz, log_t, mels)
+    return float(mels) if scalar and mels.ndim == 0 else Tensor(
+        mels.astype(np.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = _np(mel)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_sp = 200.0 / 3
+        f = f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = min_log_hz / f_sp
+        logstep = math.log(6.4) / 27.0
+        log_t = min_log_hz * np.exp(logstep * (m - min_log_mel))
+        f = np.where(m >= min_log_mel, log_t, f)
+    return float(f) if scalar and f.ndim == 0 else Tensor(
+        f.astype(np.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    return Tensor(_np(mel_to_hz(Tensor(mels.astype(np.float32)),
+                                htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = _np(fft_frequencies(sr, n_fft))
+    mel_f = _np(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+@op("power_to_db_op")
+def _power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    log_spec = 10.0 * (jnp.log10(jnp.maximum(amin, x))
+                       - jnp.log10(jnp.maximum(amin, ref_value)))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference functional.py:261."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+    return _power_to_db(x, ref_value=float(ref_value), amin=float(amin),
+                        top_db=None if top_db is None else float(top_db))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference functional.py:305)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return Tensor(dct.astype(dtype))

@@ -1,0 +1,189 @@
+"""Window functions (reference python/paddle/audio/functional/window.py:335
+``get_window`` and the private per-window builders). Pure numpy — windows
+are tiny host-side constants baked into the graph."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["get_window"]
+
+
+def _extend(M, sym):
+    return (M, False) if sym else (M + 1, True)
+
+
+def _truncate(w, needs_trunc):
+    return w[:-1] if needs_trunc else w
+
+
+def _general_cosine(M, a, sym):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    fac = np.linspace(-np.pi, np.pi, M)
+    w = np.zeros(M)
+    for k, ak in enumerate(a):
+        w += ak * np.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+def _general_hamming(M, alpha, sym):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym)
+
+
+def _hamming(M, sym=True):
+    return _general_hamming(M, 0.54, sym)
+
+
+def _hann(M, sym=True):
+    return _general_hamming(M, 0.5, sym)
+
+
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _nuttall(M, sym=True):
+    return _general_cosine(M, [0.3635819, 0.4891775, 0.1365995, 0.0106411],
+                           sym)
+
+
+def _gaussian(M, std, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = np.arange(M) - (M - 1) / 2
+    w = np.exp(-(n ** 2) / (2 * std * std))
+    return _truncate(w, trunc)
+
+
+def _exponential(M, center=None, tau=1.0, sym=True):
+    if sym and center is not None:
+        raise ValueError("symmetric exponential window takes no center")
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = np.arange(M)
+    w = np.exp(-np.abs(n - center) / tau)
+    return _truncate(w, trunc)
+
+
+def _triang(M, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    n = np.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = np.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = np.concatenate([w, w[-2::-1]])
+    return _truncate(w, trunc)
+
+
+def _bohman(M, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    fac = np.abs(np.linspace(-1, 1, M)[1:-1])
+    w = (1 - fac) * np.cos(np.pi * fac) + 1.0 / np.pi * np.sin(np.pi * fac)
+    w = np.concatenate([[0], w, [0]])
+    return _truncate(w, trunc)
+
+
+def _cosine(M, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    w = np.sin(np.pi / M * (np.arange(M) + 0.5))
+    return _truncate(w, trunc)
+
+
+def _tukey(M, alpha=0.5, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    if alpha <= 0:
+        return np.ones(M)
+    if alpha >= 1.0:
+        return _hann(M, sym)
+    M, trunc = _extend(M, sym)
+    n = np.arange(M)
+    width = int(alpha * (M - 1) / 2.0)
+    n1 = n[: width + 1]
+    n2 = n[width + 1: M - width - 1]
+    n3 = n[M - width - 1:]
+    w1 = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w2 = np.ones(n2.shape[0])
+    w3 = 0.5 * (1 + np.cos(np.pi * (-2.0 / alpha + 1
+                                    + 2.0 * n3 / alpha / (M - 1))))
+    w = np.concatenate([w1, w2, w3])
+    return _truncate(w, trunc)
+
+
+def _taylor(M, nbar=4, sll=30, norm=True, sym=True):
+    if M <= 1:
+        return np.ones(max(M, 0))
+    M, trunc = _extend(M, sym)
+    B = 10 ** (sll / 20)
+    A = math.acosh(B) / np.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = np.arange(1, nbar)
+    Fm = np.empty(nbar - 1)
+    signs = np.empty_like(ma)
+    signs[::2] = 1
+    signs[1::2] = -1
+    m2 = ma * ma
+    for mi, _ in enumerate(ma):
+        numer = signs[mi] * np.prod(
+            1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+        denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(
+            1 - m2[mi] / m2[mi + 1:])
+        Fm[mi] = numer / denom
+
+    def W(n):
+        return 1 + 2 * np.dot(
+            Fm, np.cos(2 * np.pi * ma[:, None] * (n - M / 2.0 + 0.5) / M))
+
+    w = W(np.arange(M))
+    if norm:
+        w = w / W((M - 1) / 2)
+    return _truncate(w, trunc)
+
+
+_WINDOWS = {
+    "hamming": _hamming,
+    "hann": _hann,
+    "blackman": _blackman,
+    "nuttall": _nuttall,
+    "gaussian": _gaussian,
+    "exponential": _exponential,
+    "triang": _triang,
+    "bohman": _bohman,
+    "cosine": _cosine,
+    "tukey": _tukey,
+    "taylor": _taylor,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """reference window.py:335 — window can be a name or (name, *params)."""
+    sym = not fftbins
+    if isinstance(window, (str,)):
+        name, args = window, ()
+    elif isinstance(window, tuple):
+        name, args = window[0], window[1:]
+    else:
+        raise ValueError(f"invalid window spec {window!r}")
+    if name not in _WINDOWS:
+        raise ValueError(f"unknown window {name!r}; "
+                         f"supported: {sorted(_WINDOWS)}")
+    w = _WINDOWS[name](win_length, *args, sym=sym)
+    return Tensor(np.asarray(w, dtype))

@@ -1,0 +1,134 @@
+"""paddle.geometric — graph message passing + segment ops.
+
+Reference: python/paddle/geometric/ (math.py segment_* :23-197,
+message_passing/send_recv.py send_u_recv :36, send_ue_recv :187,
+send_uv :392). TPU-native: every primitive is a jax segment reduction
+(``jax.ops.segment_*``) or gather — XLA lowers both to fused
+scatter/gather, the same kernels the reference's graph_send_recv CUDA ops
+hand-write. Static ``num_segments`` comes from ``out_size`` when given
+(required under jit; eager infers it from the data).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # sum/count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None and int(out_size) > 0:
+        return int(out_size)
+    data = ids._data if isinstance(ids, Tensor) else ids
+    if isinstance(data, jax.core.Tracer):
+        raise ValueError(
+            "segment/send_recv ops need out_size under jit (the output "
+            "shape must be static); pass out_size=max(dst)+1")
+    return int(np.max(np.asarray(data))) + 1 if np.size(data) else 0
+
+
+def _segment(reduce_op, data, ids, n):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype), ids,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (data.ndim - 1))
+    out = _REDUCERS[reduce_op](data, ids, num_segments=n)
+    if reduce_op in ("max", "min"):
+        # empty segments produce +-inf in jax; the reference fills 0
+        return jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+@op("segment_reduce")
+def _segment_op(data, ids, reduce_op="sum", n=0):
+    return _segment(reduce_op, data, ids.astype(jnp.int32), n)
+
+
+def _make_segment(name):
+    def fn(data, segment_ids, name_=None):
+        n = _num_segments(segment_ids, None)
+        return _segment_op(data, segment_ids, reduce_op=name, n=n)
+
+    fn.__name__ = f"segment_{name}"
+    fn.__doc__ = f"reference geometric/math.py segment_{name}."
+    return fn
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_min = _make_segment("min")
+segment_max = _make_segment("max")
+
+
+@op("send_u_recv_op")
+def _send_u_recv(x, src, dst, reduce_op="sum", n=0):
+    msgs = jnp.take(x, src.astype(jnp.int32), axis=0)
+    return _segment(reduce_op, msgs, dst.astype(jnp.int32), n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """reference send_recv.py:36 — gather x[src], segment-reduce at dst."""
+    n = _num_segments(dst_index, out_size)
+    return _send_u_recv(x, src_index, dst_index, reduce_op=str(reduce_op),
+                        n=n)
+
+
+@op("send_ue_recv_op")
+def _send_ue_recv(x, y, src, dst, message_op="add", reduce_op="sum", n=0):
+    msgs = jnp.take(x, src.astype(jnp.int32), axis=0)
+    if message_op == "add":
+        msgs = msgs + y
+    elif message_op == "sub":
+        msgs = msgs - y
+    elif message_op == "mul":
+        msgs = msgs * y
+    elif message_op == "div":
+        msgs = msgs / y
+    else:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    return _segment(reduce_op, msgs, dst.astype(jnp.int32), n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """reference send_recv.py:187 — node-edge compute then reduce."""
+    n = _num_segments(dst_index, out_size)
+    return _send_ue_recv(x, y, src_index, dst_index,
+                         message_op=str(message_op),
+                         reduce_op=str(reduce_op), n=n)
+
+
+@op("send_uv_op")
+def _send_uv(x, y, src, dst, message_op="add"):
+    xs = jnp.take(x, src.astype(jnp.int32), axis=0)
+    yd = jnp.take(y, dst.astype(jnp.int32), axis=0)
+    if message_op == "add":
+        return xs + yd
+    if message_op == "sub":
+        return xs - yd
+    if message_op == "mul":
+        return xs * yd
+    if message_op == "div":
+        return xs / yd
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """reference send_recv.py:392 — per-edge message from both endpoints."""
+    return _send_uv(x, y, src_index, dst_index, message_op=str(message_op))

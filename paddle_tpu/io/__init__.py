@@ -259,6 +259,90 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+def _np_collate(batch):
+    """Numpy-only mirror of default_collate_fn for process workers: child
+    processes must not build Tensors (that would initialize an accelerator
+    backend per worker); the parent tensorizes the stacked arrays."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b._data) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return [_np_collate(list(t)) for t in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, str):
+        return list(batch)
+    return batch
+
+
+def _tensorize(tree):
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    if isinstance(tree, list):
+        return [_tensorize(t) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _tensorize(v) for k, v in tree.items()}
+    return tree
+
+
+_PROC_BUILDER = None  # per-worker-process task state (set by initializer)
+
+
+def _proc_worker_init(builder):
+    """Spawn-process initializer: pins the child to CPU before anything
+    imports jax, receives the builder ONCE (one dataset pickle per worker,
+    not per batch) and runs worker_init_fn once — the reference's
+    once-per-worker contract (io/dataloader/worker.py:_worker_loop)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    global _PROC_BUILDER
+    _PROC_BUILDER = builder
+    builder._lazy_init()
+
+
+def _proc_run_batch(indices):
+    return _PROC_BUILDER(indices)
+
+
+class _ProcBatchBuilder:
+    """Picklable per-batch task for process workers (reference analog:
+    python/paddle/io/dataloader/worker.py:1 _worker_loop — the reference
+    forks long-lived workers fed by index queues; spawn + Pool.imap gives
+    the same pipeline with order preservation on all platforms)."""
+
+    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers):
+        self.dataset = dataset
+        self.collate_fn = collate_fn  # None = numpy default collate
+        self.worker_init_fn = worker_init_fn
+        self.num_workers = num_workers
+        self._inited = False
+
+    def _lazy_init(self):
+        if self._inited:
+            return
+        self._inited = True
+        import multiprocessing as mp
+
+        ident = mp.current_process()._identity
+        wid = (ident[0] - 1) % self.num_workers if ident else 0
+        _worker_info.info = _WorkerInfo(wid, self.num_workers, self.dataset)
+        if self.worker_init_fn is not None:
+            self.worker_init_fn(wid)
+
+    def __call__(self, indices):
+        self._lazy_init()
+        samples = [self.dataset[i] for i in indices]
+        if self.collate_fn is None:
+            return _np_collate(samples)
+        return self.collate_fn(samples)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
@@ -295,12 +379,18 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=False):
         self.dataset = dataset
+        self._custom_collate = collate_fn
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        # process workers (reference worker.py uses processes always);
+        # threads stay the default here because the C++ collate/prefetch
+        # core already de-GILs the common path — processes pay pickling but
+        # scale arbitrary Python __getitem__/transforms
+        self.use_process_workers = bool(use_process_workers)
         from . import native as _native
 
         _native.warm()  # background-build the C++ core; no blocking here
@@ -355,7 +445,51 @@ class DataLoader:
             for indices in self._index_batches():
                 yield self._make_batch(indices)
             return
+        if self.use_process_workers:
+            yield from self._process_iter()
+            return
         yield from self._threaded_iter()
+
+    def _process_iter(self):
+        """Process-pool pipeline: spawn workers (pinned to CPU) run
+        ``dataset[i]`` + collate off the parent's GIL; ``imap`` preserves
+        batch order and a semaphore bounds in-flight batches to
+        prefetch_factor * num_workers (buffered_reader backpressure).
+        The dataset, collate_fn and worker_init_fn must be picklable —
+        the same contract as the reference's process workers
+        (python/paddle/io/dataloader/worker.py:1)."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        batches = list(self._index_batches())
+        cap = max(1, self.prefetch_factor * self.num_workers)
+        sem = threading.Semaphore(cap)
+        stop = threading.Event()
+
+        def feed():
+            # the pool's task-handler thread runs this generator; it must
+            # never block indefinitely, or Pool teardown (early consumer
+            # exit, worker exception) would join it forever
+            for b in batches:
+                while not sem.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                yield b
+
+        builder = _ProcBatchBuilder(self.dataset, self._custom_collate,
+                                    self.worker_init_fn, self.num_workers)
+        with ctx.Pool(self.num_workers, initializer=_proc_worker_init,
+                      initargs=(builder,)) as pool:
+            try:
+                for res in pool.imap(_proc_run_batch, feed(), chunksize=1):
+                    sem.release()
+                    yield (_tensorize(res) if self._custom_collate is None
+                           else res)
+            finally:
+                stop.set()
+                sem.release()  # unblock a feed() waiting on backpressure
 
     def _threaded_iter(self):
         """Thread-pool prefetch pipeline preserving batch order, with

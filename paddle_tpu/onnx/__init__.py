@@ -1,0 +1,9 @@
+"""paddle.onnx — ONNX model export (reference python/paddle/onnx/export.py).
+
+Dependency-free: the wire bytes are written directly (the image has no
+``onnx``/``paddle2onnx``); see wire.py / export.py.
+"""
+
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

@@ -1,0 +1,505 @@
+"""ONNX export: tape slice -> ONNX ModelProto bytes.
+
+Reference: python/paddle/onnx/export.py:1 (delegates to paddle2onnx over a
+static Program). TPU-native: the eager tape (core/engine.py GradNode DAG —
+the same graph paddle.static.Executor replays) is converted node-by-node to
+ONNX operators and serialized with the dependency-free wire writer. Layer
+parameters become named initializers; unmapped ops raise listing the op, so
+an unsupported model fails loudly instead of exporting garbage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .wire import Msg, TensorDtype
+
+__all__ = ["export"]
+
+_OPSET = 17
+
+
+def _tensor_proto(name, arr):
+    arr = np.ascontiguousarray(arr)
+    dt = TensorDtype.from_numpy(arr.dtype)
+    if str(arr.dtype) == "bfloat16":  # raw little-endian u16 payload
+        arr = arr.view(np.uint16)
+    t = Msg()
+    t.ints(1, arr.shape)
+    t.int(2, dt)
+    t.str(8, name)
+    t.bytes(9, arr.tobytes())
+    return t
+
+
+def _value_info(name, shape, dtype, dynamic_batch=False):
+    shp = Msg()
+    for i, s in enumerate(shape):
+        d = Msg()
+        if dynamic_batch and i == 0:
+            d.str(2, "batch")
+        else:
+            d.int(1, int(s))
+        shp.msg(1, d)
+    tt = Msg().int(1, TensorDtype.from_numpy(np.dtype(dtype))).msg(2, shp)
+    return Msg().str(1, name).msg(2, Msg().msg(1, tt))
+
+
+def _attr_i(name, v):
+    return Msg().str(1, name).int(3, int(v)).int(20, 2)
+
+
+def _attr_f(name, v):
+    return Msg().str(1, name).float(2, float(v)).int(20, 1)
+
+
+def _attr_ints(name, vs):
+    return Msg().str(1, name).ints(8, [int(v) for v in vs]).int(20, 7)
+
+
+def _node(op_type, inputs, outputs, attrs=(), name=""):
+    n = Msg()
+    for i in inputs:
+        n.str(1, i)
+    for o in outputs:
+        n.str(2, o)
+    if name:
+        n.str(3, name)
+    n.str(4, op_type)
+    for a in attrs:
+        n.msg(5, a)
+    return n
+
+
+class _Ctx:
+    """Conversion state: value names, shapes, collected nodes/initializers."""
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.shapes = {}  # value name -> shape tuple
+        self._const_cache = {}  # id(arr) -> name
+        self._tmp = 0
+        self.param_names = {}  # id(arr) -> friendly name
+
+    def tmp(self, hint="t"):
+        self._tmp += 1
+        return f"{hint}_{self._tmp}"
+
+    def const(self, arr, hint="const"):
+        key = id(arr)
+        if key in self._const_cache:
+            return self._const_cache[key]
+        name = self.param_names.get(key) or self.tmp(hint)
+        self.initializers.append(_tensor_proto(name, np.asarray(arr)))
+        self._const_cache[key] = name
+        self.shapes[name] = tuple(np.asarray(arr).shape)
+        return name
+
+    def const_i64(self, values, hint="shape"):
+        return self.const(np.asarray(values, np.int64), hint)
+
+    def emit(self, op_type, inputs, n_out=1, attrs=(), hint=None):
+        outs = [self.tmp(hint or op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(_node(op_type, inputs, outs, attrs))
+        return outs[0] if n_out == 1 else outs
+
+
+def _perm_swap_last(rank):
+    p = list(range(rank))
+    p[-1], p[-2] = p[-2], p[-1]
+    return p
+
+
+# --- op emitters: (ctx, in_names, kwargs, node) -> output value name -------
+
+def _e_linear(ctx, ins, kw, node):
+    out = ctx.emit("MatMul", [ins[0], ins[1]])
+    if len(ins) > 2 and ins[2] is not None:
+        out = ctx.emit("Add", [out, ins[2]])
+    return out
+
+
+def _e_matmul(ctx, ins, kw, node):
+    x, y = ins[0], ins[1]
+    if kw.get("transpose_x"):
+        x = ctx.emit("Transpose", [x],
+                     attrs=[_attr_ints("perm",
+                                       _perm_swap_last(len(ctx.shapes[x])))])
+        ctx.shapes[x] = ctx.shapes[ins[0]][:-2] + ctx.shapes[ins[0]][-1:] \
+            + ctx.shapes[ins[0]][-2:-1]
+    if kw.get("transpose_y"):
+        y0 = y
+        y = ctx.emit("Transpose", [y],
+                     attrs=[_attr_ints("perm",
+                                       _perm_swap_last(len(ctx.shapes[y0])))])
+    return ctx.emit("MatMul", [x, y])
+
+
+def _e_binary(onnx_op):
+    def e(ctx, ins, kw, node):
+        return ctx.emit(onnx_op, [ins[0], ins[1]])
+    return e
+
+
+def _e_unary(onnx_op):
+    def e(ctx, ins, kw, node):
+        return ctx.emit(onnx_op, [ins[0]])
+    return e
+
+
+def _e_softmax(onnx_op):
+    def e(ctx, ins, kw, node):
+        return ctx.emit(onnx_op, [ins[0]],
+                        attrs=[_attr_i("axis", kw.get("axis", -1))])
+    return e
+
+
+def _reshape_target(ctx, in_name, kw, node):
+    """Batch-safe Reshape target: a leading dim the op preserves becomes 0
+    (ONNX 'copy input dim'), so a symbolic batch survives export instead of
+    being baked to the traced batch=1."""
+    shape = list(kw.get("shape") or node.out_avals[0][0])
+    shape = [-1 if s in (None, -1) else int(s) for s in shape]
+    in_shape = ctx.shapes.get(in_name)
+    if (in_shape and shape and -1 not in shape
+            and shape[0] == in_shape[0]):
+        shape[0] = 0
+    return shape
+
+
+def _e_reshape(ctx, ins, kw, node):
+    return ctx.emit("Reshape",
+                    [ins[0],
+                     ctx.const_i64(_reshape_target(ctx, ins[0], kw, node))])
+
+
+def _e_flatten(ctx, ins, kw, node):
+    start = kw.get("start_axis", 1)
+    stop = kw.get("stop_axis", -1)
+    ndim = len(ctx.shapes.get(ins[0], node.out_avals[0][0]))
+    if stop in (-1, ndim - 1):
+        # [0]*start + [-1]: copies every leading dim, infers the rest
+        target = [0] * int(start) + [-1]
+    else:
+        target = _reshape_target(ctx, ins[0], {}, node)
+    return ctx.emit("Reshape", [ins[0], ctx.const_i64(target)])
+
+
+def _e_transpose(ctx, ins, kw, node):
+    return ctx.emit("Transpose", [ins[0]],
+                    attrs=[_attr_ints("perm", kw["perm"])])
+
+
+def _e_concat(ctx, ins, kw, node):
+    return ctx.emit("Concat", [i for i in ins if i is not None],
+                    attrs=[_attr_i("axis", kw.get("axis", 0))])
+
+
+def _e_embedding(ctx, ins, kw, node):
+    # ONNX Gather(data=weight, indices=ids)
+    return ctx.emit("Gather", [ins[1], ins[0]], attrs=[_attr_i("axis", 0)])
+
+
+def _e_cast(ctx, ins, kw, node):
+    to = TensorDtype.from_numpy(np.dtype(str(node.out_avals[0][1])))
+    return ctx.emit("Cast", [ins[0]], attrs=[_attr_i("to", to)])
+
+
+def _e_scale(ctx, ins, kw, node):
+    dt = np.dtype(str(node.out_avals[0][1]))
+    s = kw.get("scale", 1.0)
+    b = kw.get("bias", 0.0)
+    out = ins[0]
+    if not kw.get("bias_after_scale", True):
+        out = ctx.emit("Add", [out, ctx.const(np.asarray(b, dt))])
+        return ctx.emit("Mul", [out, ctx.const(np.asarray(s, dt))])
+    out = ctx.emit("Mul", [out, ctx.const(np.asarray(s, dt))])
+    if b:
+        out = ctx.emit("Add", [out, ctx.const(np.asarray(b, dt))])
+    return out
+
+
+def _e_reduce(onnx_op):
+    def e(ctx, ins, kw, node):
+        axis = kw.get("axis")
+        keep = 1 if kw.get("keepdim") else 0
+        if axis is None:
+            return ctx.emit(onnx_op, [ins[0]],
+                            attrs=[_attr_i("keepdims", keep)])
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        return ctx.emit(onnx_op, [ins[0], ctx.const_i64(axes, "axes")],
+                        attrs=[_attr_i("keepdims", keep)])
+    return e
+
+
+def _e_conv(ctx, ins, kw, node):
+    if kw.get("channel_last"):
+        raise NotImplementedError("ONNX export supports NCHW conv only")
+    w_shape = ctx.shapes[ins[1]]
+    nd = len(w_shape) - 2
+    stride = list(kw.get("stride", (1,) * nd))
+    dil = list(kw.get("dilation", (1,) * nd))
+    padding = kw.get("padding", "VALID")
+    attrs = [_attr_ints("strides", stride), _attr_ints("dilations", dil),
+             _attr_i("group", kw.get("groups", 1)),
+             _attr_ints("kernel_shape", w_shape[2:])]
+    if isinstance(padding, str):
+        attrs.append(Msg().str(1, "auto_pad").bytes(
+            4, (b"SAME_UPPER" if padding.upper() == "SAME"
+                else b"VALID")).int(20, 3))
+    else:
+        begins = [p[0] for p in padding]
+        ends = [p[1] for p in padding]
+        attrs.append(_attr_ints("pads", begins + ends))
+    inputs = [ins[0], ins[1]]
+    if len(ins) > 2 and ins[2] is not None:
+        inputs.append(ins[2])
+    return ctx.emit("Conv", inputs, attrs=attrs)
+
+
+def _e_pool(onnx_op):
+    def e(ctx, ins, kw, node):
+        ksize = list(kw.get("ksize", (2, 2)))
+        stride = list(kw.get("stride", ksize))
+        padding = kw.get("padding", ((0, 0),) * len(ksize))
+        attrs = [_attr_ints("kernel_shape", ksize),
+                 _attr_ints("strides", stride)]
+        if isinstance(padding, str):
+            attrs.append(Msg().str(1, "auto_pad").bytes(
+                4, (b"SAME_UPPER" if padding.upper() == "SAME"
+                    else b"VALID")).int(20, 3))
+        else:
+            begins = [p[0] for p in padding]
+            ends = [p[1] for p in padding]
+            attrs.append(_attr_ints("pads", begins + ends))
+        if kw.get("ceil_mode"):
+            attrs.append(_attr_i("ceil_mode", 1))
+        return ctx.emit(onnx_op, [ins[0]], attrs=attrs)
+    return e
+
+
+def _e_batch_norm(ctx, ins, kw, node):
+    x, mean, var = ins[0], ins[1], ins[2]
+    ch = ctx.shapes[mean][0]
+    dt = np.dtype(str(node.out_avals[0][1]))
+    scale = (ins[3] if len(ins) > 3 and ins[3] is not None
+             else ctx.const(np.ones(ch, dt), "bn_scale"))
+    bias = (ins[4] if len(ins) > 4 and ins[4] is not None
+            else ctx.const(np.zeros(ch, dt), "bn_bias"))
+    return ctx.emit("BatchNormalization", [x, scale, bias, mean, var],
+                    attrs=[_attr_f("epsilon", kw.get("epsilon", 1e-5))])
+
+
+def _e_layer_norm(ctx, ins, kw, node):
+    dt = np.dtype(str(node.out_avals[0][1]))
+    axis = kw.get("begin_norm_axis", -1)
+    norm_shape = node.out_avals[0][0][axis:] if axis != -1 \
+        else node.out_avals[0][0][-1:]
+    scale = (ins[1] if len(ins) > 1 and ins[1] is not None
+             else ctx.const(np.ones(norm_shape, dt), "ln_scale"))
+    inputs = [ins[0], scale]
+    if len(ins) > 2 and ins[2] is not None:
+        inputs.append(ins[2])
+    return ctx.emit("LayerNormalization", inputs,
+                    attrs=[_attr_i("axis", axis),
+                           _attr_f("epsilon", kw.get("epsilon", 1e-5))])
+
+
+def _e_rms_norm(ctx, ins, kw, node):
+    # decompose: x * rsqrt(mean(x^2) + eps) * w
+    dt = np.dtype(str(node.out_avals[0][1]))
+    sq = ctx.emit("Mul", [ins[0], ins[0]])
+    mean = ctx.emit("ReduceMean", [sq, ctx.const_i64([-1], "axes")],
+                    attrs=[_attr_i("keepdims", 1)])
+    eps = ctx.const(np.asarray(kw.get("epsilon", 1e-6), dt), "eps")
+    denom = ctx.emit("Sqrt", [ctx.emit("Add", [mean, eps])])
+    out = ctx.emit("Div", [ins[0], denom])
+    if len(ins) > 1 and ins[1] is not None:
+        out = ctx.emit("Mul", [out, ins[1]])
+    return out
+
+
+def _e_dropout(ctx, ins, kw, node):
+    if kw.get("training", False) and kw.get("p", 0.0) > 0:
+        raise NotImplementedError(
+            "export the model in eval() mode (dropout was traced training)")
+    return ctx.emit("Identity", [ins[0]])
+
+
+def _e_squeeze(onnx_op):
+    def e(ctx, ins, kw, node):
+        axis = kw.get("axis")
+        if axis is None:
+            return ctx.emit(onnx_op, [ins[0]])
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        return ctx.emit(onnx_op, [ins[0], ctx.const_i64(axes, "axes")])
+    return e
+
+
+_EMITTERS = {
+    "linear_op": _e_linear,
+    "matmul": _e_matmul,
+    "add": _e_binary("Add"),
+    "subtract": _e_binary("Sub"),
+    "multiply": _e_binary("Mul"),
+    "divide": _e_binary("Div"),
+    "elementwise_pow": _e_binary("Pow"),
+    "maximum": _e_binary("Max"),
+    "minimum": _e_binary("Min"),
+    "relu": _e_unary("Relu"),
+    "sigmoid": _e_unary("Sigmoid"),
+    "tanh": _e_unary("Tanh"),
+    "gelu": _e_unary("Gelu"),
+    "exp": _e_unary("Exp"),
+    "log": _e_unary("Log"),
+    "sqrt": _e_unary("Sqrt"),
+    "abs": _e_unary("Abs"),
+    "neg": _e_unary("Neg"),
+    "floor": _e_unary("Floor"),
+    "ceil": _e_unary("Ceil"),
+    "erf": _e_unary("Erf"),
+    "reciprocal": _e_unary("Reciprocal"),
+    "sign": _e_unary("Sign"),
+    "softplus": _e_unary("Softplus"),
+    "leaky_relu": _e_unary("LeakyRelu"),
+    "softmax_f": _e_softmax("Softmax"),
+    "log_softmax_f": _e_softmax("LogSoftmax"),
+    "reshape": _e_reshape,
+    "flatten": _e_flatten,
+    "transpose": _e_transpose,
+    "concat_n": _e_concat,
+    "embedding_op": _e_embedding,
+    "cast": _e_cast,
+    "scale": _e_scale,
+    "mean": _e_reduce("ReduceMean"),
+    "sum": _e_reduce("ReduceSum"),
+    "max": _e_reduce("ReduceMax"),
+    "min": _e_reduce("ReduceMin"),
+    "conv_nd": _e_conv,
+    "max_pool_nd": _e_pool("MaxPool"),
+    "avg_pool_nd": _e_pool("AveragePool"),
+    "batch_norm_infer": _e_batch_norm,
+    "layer_norm_op": _e_layer_norm,
+    "rms_norm_op": _e_rms_norm,
+    "dropout_op": _e_dropout,
+    "squeeze": _e_squeeze("Squeeze"),
+    "unsqueeze": _e_squeeze("Unsqueeze"),
+}
+
+
+def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
+    """reference onnx/export.py:export — write ``path + '.onnx'``.
+
+    Traces ``layer`` with placeholders from ``input_spec`` (InputSpec or
+    example Tensors; dynamic dims become a symbolic 'batch' dimension in the
+    ONNX graph), converts the tape to ONNX nodes, and serializes."""
+    from ..core import state as _state
+    from ..static import _collect_nodes
+    from ..static.input_spec import InputSpec
+
+    assert input_spec, "onnx.export requires input_spec"
+    placeholders = []
+    dynamic = []
+    for i, sp in enumerate(input_spec):
+        if isinstance(sp, Tensor):
+            sp = InputSpec.from_tensor(sp)
+        shape = [1 if (s is None or s == -1) else int(s) for s in sp.shape]
+        dynamic.append(any(s is None or s == -1 for s in sp.shape))
+        t = Tensor(np.zeros(shape, sp.dtype.name if hasattr(sp.dtype, "name")
+                            else str(sp.dtype)))
+        t.stop_gradient = False
+        t.name = getattr(sp, "name", None) or f"x{i}"
+        placeholders.append(t)
+
+    # plain eager forward: the ops land on the autograd tape, which is the
+    # graph being exported (NOT a jax trace — arrays must stay concrete so
+    # constants become initializers)
+    was_training = layer.training
+    layer.eval()
+    try:
+        out = layer(*placeholders)
+    finally:
+        if was_training:
+            layer.train()
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    outs = [o for o in outs if isinstance(o, Tensor)]
+
+    ctx = _Ctx()
+    for pname, p in layer.named_parameters():
+        ctx.param_names[id(p._data)] = pname
+    for bname, b in layer.named_buffers():
+        ctx.param_names[id(b._data)] = bname
+
+    feed_ids = {id(t._data): t.name for t in placeholders}
+    nodes = _collect_nodes(outs)
+    if not nodes:
+        raise ValueError("the traced forward recorded no differentiable ops "
+                         "— nothing to export")
+    value_of = {}  # (node_id, out_idx) -> onnx value name
+    for t in placeholders:
+        ctx.shapes[t.name] = tuple(t._data.shape)
+
+    for n in nodes:
+        from ..core.dispatch import _unhash_dtype
+
+        kw = {k: _unhash_dtype(v) for k, v in (n.op_kwargs or ())}
+        ins = []
+        for p, e in zip(n.primals, n.edges):
+            if e.node is not None:
+                ins.append(value_of[(e.node.id, e.out_idx)])
+            elif p is None:
+                ins.append(None)
+            elif id(p) in feed_ids:
+                ins.append(feed_ids[id(p)])
+            else:
+                ins.append(ctx.const(p, "w"))
+        if n.name not in _EMITTERS:
+            raise NotImplementedError(
+                f"ONNX export has no emitter for op {n.name!r} (supported: "
+                f"{sorted(_EMITTERS)})")
+        if n.n_out > 1:
+            raise NotImplementedError(
+                f"multi-output op {n.name!r} in ONNX export")
+        out_name = _EMITTERS[n.name](ctx, ins, kw, n)
+        value_of[(n.id, 0)] = out_name
+        ctx.shapes[out_name] = tuple(n.out_avals[0][0])
+
+    graph = Msg()
+    for nd in ctx.nodes:
+        graph.msg(1, nd)
+    graph.str(2, "paddle_tpu_graph")
+    for init in ctx.initializers:
+        graph.msg(5, init)
+    for i, t in enumerate(placeholders):
+        graph.msg(11, _value_info(t.name, t._data.shape,
+                                  str(t._data.dtype), dynamic[i]))
+    out_names = []
+    for i, t in enumerate(outs):
+        name = (value_of[(t._node.id, t._out_idx)]
+                if t._node is not None else feed_ids.get(id(t._data)))
+        out_names.append(name)
+        graph.msg(12, _value_info(name, t._data.shape, str(t._data.dtype)))
+
+    model = Msg()
+    model.int(1, 8)  # ir_version
+    model.str(2, "paddle_tpu")
+    model.msg(7, graph)
+    model.msg(8, Msg().str(1, "").int(2, int(opset_version)))
+
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    import os
+
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(model.tobytes())
+    return out_path
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

@@ -1,0 +1,145 @@
+"""Minimal protobuf wire-format writer/reader for ONNX serialization.
+
+The image ships no ``onnx`` package and the local protoc's gencode is
+rejected by the installed protobuf runtime, so the exporter writes the ONNX
+``ModelProto`` wire bytes directly. Only the message fields ONNX needs are
+modeled (the ONNX IR spec, onnx/onnx.proto): varint, length-delimited and
+fixed32 wire types.
+
+The generic reader exists for tests (and debugging): it parses any wire
+stream back into {field_number: [values]} dicts without a schema.
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = ["Msg", "parse", "TensorDtype"]
+
+
+class TensorDtype:
+    """ONNX TensorProto.DataType values."""
+
+    FLOAT = 1
+    UINT8 = 2
+    INT8 = 3
+    INT16 = 5
+    INT32 = 6
+    INT64 = 7
+    BOOL = 9
+    FLOAT16 = 10
+    DOUBLE = 11
+    UINT32 = 12
+    UINT64 = 13
+    BFLOAT16 = 16
+
+    _NP = {
+        "float32": FLOAT, "uint8": UINT8, "int8": INT8, "int16": INT16,
+        "int32": INT32, "int64": INT64, "bool": BOOL, "float16": FLOAT16,
+        "float64": DOUBLE, "uint32": UINT32, "uint64": UINT64,
+        "bfloat16": BFLOAT16,
+    }
+
+    @classmethod
+    def from_numpy(cls, dtype):
+        name = str(dtype)
+        if name not in cls._NP:
+            raise ValueError(f"no ONNX dtype for {name}")
+        return cls._NP[name]
+
+
+def _varint(v: int) -> bytes:
+    if v < 0:
+        v &= (1 << 64) - 1  # two's complement, 64-bit
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Msg:
+    """A protobuf message under construction."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def _tag(self, field: int, wire: int):
+        self._buf += _varint((field << 3) | wire)
+
+    def int(self, field: int, v: int):
+        self._tag(field, 0)
+        self._buf += _varint(int(v))
+        return self
+
+    def ints(self, field: int, vs):
+        for v in vs:
+            self.int(field, v)
+        return self
+
+    def float(self, field: int, v: float):
+        self._tag(field, 5)
+        self._buf += struct.pack("<f", float(v))
+        return self
+
+    def bytes(self, field: int, v: bytes):
+        self._tag(field, 2)
+        self._buf += _varint(len(v))
+        self._buf += v
+        return self
+
+    def str(self, field: int, v: str):
+        return self.bytes(field, v.encode("utf-8"))
+
+    def msg(self, field: int, m: "Msg"):
+        return self.bytes(field, m.tobytes())
+
+    def msgs(self, field: int, ms):
+        for m in ms:
+            self.msg(field, m)
+        return self
+
+    def tobytes(self) -> bytes:
+        return bytes(self._buf)
+
+
+def parse(data: bytes):
+    """Schema-less decode: {field: [raw values]}; length-delimited values
+    stay bytes (recurse with parse() where a submessage is expected)."""
+    out: dict[int, list] = {}
+    i, n = 0, len(data)
+
+    def rv():
+        nonlocal i
+        shift, val = 0, 0
+        while True:
+            b = data[i]
+            i += 1
+            val |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return val
+            shift += 7
+
+    while i < n:
+        key = rv()
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val = rv()
+        elif wire == 2:
+            ln = rv()
+            val = data[i: i + ln]
+            i += ln
+        elif wire == 5:
+            val = struct.unpack("<f", data[i: i + 4])[0]
+            i += 4
+        elif wire == 1:
+            val = struct.unpack("<d", data[i: i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out

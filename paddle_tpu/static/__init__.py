@@ -1,28 +1,42 @@
-"""paddle.static — minimal compatibility facade.
+"""paddle.static — legacy static-graph entry points over the eager tape.
 
-Reference: python/paddle/static/ + python/paddle/base/executor.py. The
-reference's Program/Executor machinery collapses into jax.jit (SURVEY.md §7.1:
-"StandaloneExecutor/streams/GC → XLA runtime; nothing to build"); this module
-keeps the legacy entry points importable for code that guards on them.
+Reference: python/paddle/static/ + python/paddle/base/executor.py:1608
+(Executor.run feed/fetch loop over a Program). TPU-native redesign: there is
+no separate graph-building mode — ops on ``static.data`` placeholders run
+eagerly and land on the autograd tape (core/engine.py GradNode DAG), and
+``Executor.run`` REPLAYS the tape slice from the feed placeholders to the
+fetch vars as one ``jax.jit``-compiled function. The reference's
+StandaloneExecutor/streams/GC collapse into the XLA runtime (SURVEY.md
+§7.1); the Program here is the feed registry + compiled-replay cache.
+
+Known honest limitation (raised, never silent): a feed can only be
+substituted where its array is used directly by a differentiable op. If a
+feed only reaches the fetch through non-differentiable (e.g. all-integer)
+ops, the tape has no node for it and ``run`` raises
+``feed 'name' does not reach the fetch graph``.
 """
 
 from __future__ import annotations
 
 import contextlib
 
+import numpy as np
+
 from .input_spec import InputSpec  # noqa: F401
 
 __all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "Executor", "global_scope", "name_scope",
-           "save_inference_model", "load_inference_model"]
+           "save_inference_model", "load_inference_model", "data"]
 
 
 class Program:
-    """Placeholder Program (reference base/framework.py:5736). Real compiled
-    execution goes through paddle.jit.to_static."""
+    """Feed registry + compiled-replay cache (reference
+    base/framework.py:5736 Program)."""
 
     def __init__(self):
         self.random_seed = 0
+        self._feeds = {}  # name -> placeholder Tensor
+        self._replay_cache = {}  # fetch ids key -> compiled replay
 
     def global_block(self):
         return self
@@ -45,7 +59,15 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
-    yield
+    """Route ``static.data`` registrations into ``main_program`` for the
+    duration of the block (reference base/framework.py program_guard)."""
+    global _main_program
+    prev = _main_program
+    _main_program = main_program
+    try:
+        yield
+    finally:
+        _main_program = prev
 
 
 @contextlib.contextmanager
@@ -68,24 +90,233 @@ def global_scope():
     return _scope
 
 
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference static/input.py:data). Dynamic (None/-1)
+    dims materialize as 1 in the placeholder; ``Executor.run`` re-traces per
+    concrete feed shape."""
+    from ..core.tensor import Tensor
+
+    concrete = [1 if (s is None or s == -1) else int(s) for s in shape]
+    t = Tensor(np.zeros(concrete, dtype))
+    t.stop_gradient = False  # ops on placeholders must land on the tape
+    t.name = name
+    t._static_spec = list(shape)  # None/-1 preserved for export
+    _main_program._feeds[name] = t
+    return t
+
+
+def _collect_nodes(fetch_tensors):
+    """All GradNodes reachable from the fetches, ascending id (a valid
+    topological order — see core/engine.py)."""
+    seen = {}
+    stack = [t._node for t in fetch_tensors if t._node is not None]
+    while stack:
+        n = stack.pop()
+        if n.id in seen:
+            continue
+        seen[n.id] = n
+        for e in n.edges:
+            if e.node is not None and e.node.id not in seen:
+                stack.append(e.node)
+    return [seen[i] for i in sorted(seen)]
+
+
+def _compile_replay(fetch_tensors, feeds):
+    """Build a jitted fn(feed_arrays_dict) -> [fetch arrays] replaying the
+    tape slice. Non-feed primals (parameters, constants) are baked in as
+    jit constants — the legacy Executor contract (params change => rebuild
+    the program)."""
+    import jax
+
+    from ..core.dispatch import OPS, _unhash_dtype
+
+    nodes = _collect_nodes(fetch_tensors)
+    feed_ids = {id(t._data): name for name, t in feeds.items()}
+    used = set()
+    for n in nodes:
+        for p in n.primals:
+            nm = feed_ids.get(id(p))
+            if nm is not None:
+                used.add(nm)
+    for t in fetch_tensors:
+        nm = feed_ids.get(id(t._data))
+        if nm is not None:
+            used.add(nm)
+    missing = set(feeds) - used
+    if missing:
+        raise ValueError(
+            f"feed {sorted(missing)} does not reach the fetch graph: the "
+            "placeholder is only used through non-differentiable ops, or "
+            "the graph was built under amp.auto_cast (the tape records the "
+            "post-cast arrays — build the static graph without auto_cast "
+            "and let Executor-side AMP handle precision)")
+
+    def replay(feed_arrays):
+        env = {}
+        for n in nodes:
+            kw = {k: _unhash_dtype(v) for k, v in (n.op_kwargs or ())}
+            args = []
+            for p, e in zip(n.primals, n.edges):
+                if e.node is not None:
+                    args.append(env[(e.node.id, e.out_idx)])
+                else:
+                    nm = feed_ids.get(id(p))
+                    args.append(feed_arrays[nm] if nm is not None else p)
+            out = OPS[n.name].fn(*args, **kw)
+            outs = tuple(out) if n.out_is_tuple else (out,)
+            for i, o in enumerate(outs):
+                env[(n.id, i)] = o
+        res = []
+        for t in fetch_tensors:
+            if t._node is not None:
+                res.append(env[(t._node.id, t._out_idx)])
+            else:
+                nm = feed_ids.get(id(t._data))
+                res.append(feed_arrays[nm] if nm is not None else t._data)
+        return res
+
+    return jax.jit(replay)
+
+
 class Executor:
-    """Facade: .run on a to_static-compiled callable (reference
-    base/executor.py:1152)."""
+    """Replay-based executor (reference base/executor.py:1608 run loop).
+    ``run(program, feed={name: array}, fetch_list=[vars])`` compiles the
+    tape slice once per (fetch set, feed shapes) and executes it."""
 
     def __init__(self, place=None):
         self.place = place
 
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "paddle_tpu is dygraph+jit-first: use paddle.jit.to_static to "
-            "compile models (the reference's static Program path maps onto "
-            "jax.jit; see SURVEY.md §3.3)")
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        from ..core.tensor import Tensor
+
+        program = program or _main_program
+        feed = feed or {}
+        if hasattr(program, "_run_loaded"):
+            out = program._run_loaded(feed)
+            return ([np.asarray(o) for o in out] if return_numpy
+                    else [Tensor._wrap(o) for o in out])
+        if fetch_list is None:
+            return []  # startup-program run: eager init already happened
+        fetch_list = (fetch_list if isinstance(fetch_list, (list, tuple))
+                      else [fetch_list])
+        fetches = [t for t in fetch_list]
+        unknown = [n for n in feed if n not in program._feeds]
+        if unknown:
+            raise KeyError(f"feed names {unknown} were never declared via "
+                           "paddle.static.data")
+        active = {n: program._feeds[n] for n in feed}
+        key = tuple(id(t) for t in fetches) + tuple(sorted(feed))
+        fn = program._replay_cache.get(key)
+        if fn is None:
+            fn = _compile_replay(fetches, active)
+            program._replay_cache[key] = fn
+        import jax.numpy as jnp
+
+        arrays = {n: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                  for n, v in feed.items()}
+        out = fn(arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in out]
+        return [Tensor._wrap(o) for o in out]
+
+    def close(self):
+        pass
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          **kwargs):
-    raise NotImplementedError("use paddle.jit.save (jax.export-backed)")
+    """reference static/io.py:save_inference_model — here: export the
+    replayed feed->fetch slice via jax.export (same artifact as jit.save),
+    loadable by load_inference_model or paddle.inference.Predictor."""
+    import pickle
+
+    import jax
+
+    feed_vars = (feed_vars if isinstance(feed_vars, (list, tuple))
+                 else [feed_vars])
+    fetch_vars = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                  else [fetch_vars])
+    feeds = {getattr(t, "name", f"x{i}") or f"x{i}": t
+             for i, t in enumerate(feed_vars)}
+    fn = _compile_replay(fetch_vars, feeds)
+
+    def flat(*arrays):
+        return fn(dict(zip(feeds, arrays)))
+
+    # dynamic dims declared at static.data become symbolic in the export
+    # (same mechanism as jit.save, paddle_tpu/jit/__init__.py)
+    scope = jax.export.SymbolicScope()
+    specs = []
+    n_sym = 0
+    for t in feeds.values():
+        declared = getattr(t, "_static_spec", None)
+        if declared is not None and any(s in (None, -1) for s in declared):
+            dims = []
+            for s, concrete in zip(declared, t._data.shape):
+                if s in (None, -1):
+                    n_sym += 1
+                    dims.append(f"_d{n_sym}")
+                else:
+                    dims.append(str(concrete))
+            shape = jax.export.symbolic_shape(",".join(dims), scope=scope)
+        else:
+            shape = t._data.shape
+        specs.append(jax.ShapeDtypeStruct(shape, t._data.dtype))
+    exported = jax.export.export(jax.jit(flat))(*specs)
+    payload = {
+        "stablehlo": exported.serialize(),
+        "consts": [],
+        "const_names": [],
+        "specs": [(list(getattr(t, "_static_spec", None)
+                        or t._data.shape), str(t._data.dtype), n)
+                  for n, t in feeds.items()],
+        "static_io": True,
+    }
+    import os
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError("use paddle.jit.load")
+    """Returns (program, feed_names, fetch_holder) executable via
+    ``executor.run(program, feed=..., fetch_list=fetch_holder)`` like the
+    reference, where the program wraps the deserialized executable."""
+    import pickle
+
+    import jax
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    exported = jax.export.deserialize(payload["stablehlo"])
+    # jit.save artifacts may carry unnamed InputSpecs — synthesize stable
+    # positional names so the returned program is actually runnable
+    feed_names = [n or f"x{i}"
+                  for i, (_, _, n) in enumerate(payload["specs"])]
+
+    class _LoadedProgram(Program):
+        def __init__(self, exported, feed_names, has_consts):
+            super().__init__()
+            self._exported = exported
+            self._feed_names = feed_names
+            self._has_consts = has_consts
+
+    prog = _LoadedProgram(exported, feed_names,
+                          not payload.get("static_io", False))
+
+    class _FetchToken:
+        pass
+
+    def run(feed):
+        import jax.numpy as jnp
+
+        args = [jnp.asarray(feed[n]) for n in feed_names]
+        if prog._has_consts:
+            return exported.call(payload["consts"], *args)
+        return exported.call(*args)
+
+    prog._run_loaded = run
+    return prog, feed_names, [_FetchToken()]

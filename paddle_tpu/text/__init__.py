@@ -1,0 +1,95 @@
+"""paddle.text — Viterbi decoding (reference python/paddle/text/
+viterbi_decode.py:25 viterbi_decode + :100 ViterbiDecoder; the datasets/
+subpackage is download-based and out of scope offline).
+
+TPU-native: the DP forward pass is a ``lax.scan`` over time carrying the
+per-tag best score, with argmax backpointers stacked by the scan; the
+backtrace is a reverse scan over the backpointers — no data-dependent
+Python control flow, fully jittable (the reference binds a CUDA kernel,
+_C_ops.viterbi_decode).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+@op("viterbi_decode_op", differentiable=False)
+def _viterbi(potentials, trans, lengths, include_bos_eos_tag=True):
+    b, t_max, n = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+    pot = potentials.astype(jnp.float32)
+    tr = trans.astype(jnp.float32)
+
+    if include_bos_eos_tag:
+        # last row/col = start tag, second-to-last = stop tag (reference)
+        start_idx, stop_idx = n - 1, n - 2
+        alpha0 = pot[:, 0, :] + tr[start_idx][None, :]
+    else:
+        alpha0 = pot[:, 0, :]
+
+    def step(carry, inputs):
+        alpha, t = carry
+        emit = inputs  # [b, n]
+        # scores[b, i, j] = alpha[b, i] + tr[i, j] + emit[b, j]
+        scores = alpha[:, :, None] + tr[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)  # [b, n]
+        best_score = jnp.max(scores, axis=1) + emit
+        # sequences shorter than t keep their alpha frozen
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        bp = jnp.where(active, best_prev,
+                       jnp.broadcast_to(jnp.arange(n)[None, :], (b, n)))
+        return (new_alpha, t + 1), bp
+
+    (alpha, _), bps = jax.lax.scan(
+        step, (alpha0, jnp.int32(1)),
+        jnp.moveaxis(pot[:, 1:, :], 1, 0))  # [t_max-1, b, n]
+
+    if include_bos_eos_tag:
+        alpha = alpha + tr[:, stop_idx][None, :]
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)  # [b]
+
+    def back(carry, bp):
+        tag, t = carry
+        # bp is for transition t -> t+1 (time index t in [1, t_max-1])
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        active = t < lengths
+        new_tag = jnp.where(active, prev, tag)
+        return (new_tag, t - 1), tag
+
+    (first_tag, _), path_rev = jax.lax.scan(
+        back, (last_tag, jnp.int32(t_max - 1)), bps, reverse=True)
+    # path_rev[t] = tag at time t+1; the final carry is the tag at time 0
+    paths = jnp.concatenate([first_tag[:, None],
+                             jnp.moveaxis(path_rev, 0, 1)], axis=1)
+    return scores, paths.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """reference text/viterbi_decode.py:25 — returns (scores [b],
+    paths [b, t])."""
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag=bool(include_bos_eos_tag))
+
+
+class ViterbiDecoder(Layer):
+    """reference text/viterbi_decode.py:100."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

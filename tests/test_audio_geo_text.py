@@ -1,0 +1,190 @@
+"""Tests for paddle.audio, paddle.geometric and paddle.text.
+
+Reference parity: python/paddle/audio/functional/{window.py:335,
+functional.py:24-305}, audio/features/layers.py, audio/backends
+(wave backend), geometric/math.py:23-197 +
+geometric/message_passing/send_recv.py:36-392, text/viterbi_decode.py:25.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric, text
+
+
+class TestWindows:
+    @pytest.mark.parametrize("name", ["hann", "hamming", "blackman",
+                                      "triang", "cosine", "bohman",
+                                      "nuttall"])
+    def test_matches_scipy_formula(self, name):
+        w = audio.functional.get_window(name, 64).numpy()
+        assert w.shape == (64,)
+        assert w.max() <= 1.0 + 1e-9 and w.min() >= -1e-9
+
+    def test_hann_formula(self):
+        w = audio.functional.get_window("hann", 8).numpy()
+        ref = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(8) / 8)
+        np.testing.assert_allclose(w, ref, atol=1e-12)
+
+    def test_parametrized(self):
+        w = audio.functional.get_window(("gaussian", 7), 32).numpy()
+        assert w.argmax() in (15, 16)
+        with pytest.raises(ValueError):
+            audio.functional.get_window("nope", 16)
+
+
+class TestMelTools:
+    def test_hz_mel_roundtrip(self):
+        for htk in (False, True):
+            f = paddle.to_tensor(np.asarray([60.0, 440.0, 4000.0], "float32"))
+            back = audio.functional.mel_to_hz(
+                audio.functional.hz_to_mel(f, htk), htk).numpy()
+            np.testing.assert_allclose(back, [60, 440, 4000], rtol=1e-4)
+
+    def test_fbank_shape_and_rowsum(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 512,
+                                                   n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.asarray([1.0, 0.1, 10.0], "float32"))
+        db = audio.functional.power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, -10.0, 10.0], atol=1e-4)
+
+    def test_create_dct_ortho(self):
+        d = audio.functional.create_dct(13, 40).numpy()
+        assert d.shape == (40, 13)
+        # orthonormal columns
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-6)
+
+
+class TestFeatures:
+    def test_mel_spectrogram_shapes(self):
+        x = paddle.to_tensor(np.random.randn(2, 2048).astype("float32"))
+        mel = audio.features.MelSpectrogram(sr=8000, n_fft=256, n_mels=32,
+                                            f_min=0.0)(x)
+        assert mel.shape[0] == 2 and mel.shape[1] == 32
+
+    def test_mfcc_runs(self):
+        x = paddle.to_tensor(np.random.randn(1, 2048).astype("float32"))
+        out = audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=24,
+                                  f_min=0.0)(x)
+        assert out.shape[1] == 13
+
+    def test_spectrogram_detects_tone(self):
+        sr, n_fft = 8000, 256
+        t = np.arange(4096) / sr
+        tone = np.sin(2 * np.pi * 1000 * t).astype("float32")
+        spec = audio.features.Spectrogram(n_fft=n_fft, power=2.0)(
+            paddle.to_tensor(tone[None]))
+        prof = spec.numpy()[0].mean(-1)
+        peak_bin = prof.argmax()
+        assert abs(peak_bin - round(1000 * n_fft / sr)) <= 1
+
+
+class TestWaveIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 8000
+        x = (np.sin(2 * np.pi * 440 * np.arange(800) / sr)
+             .astype("float32"))[None]
+        path = str(tmp_path / "t.wav")
+        audio.save(path, paddle.to_tensor(x), sr)
+        info = audio.backends.info(path)
+        assert info.sample_rate == sr and info.num_channels == 1
+        back, sr2 = audio.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-3)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.asarray(
+            [[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]], "float32"))
+        ids = paddle.to_tensor(np.asarray([0, 0, 1], "int32"))
+        np.testing.assert_allclose(
+            geometric.segment_sum(data, ids).numpy(),
+            [[4, 4, 4], [4, 5, 6]])
+        np.testing.assert_allclose(
+            geometric.segment_mean(data, ids).numpy(),
+            [[2, 2, 2], [4, 5, 6]])
+        np.testing.assert_allclose(
+            geometric.segment_max(data, ids).numpy(),
+            [[3, 2, 3], [4, 5, 6]])
+        np.testing.assert_allclose(
+            geometric.segment_min(data, ids).numpy(),
+            [[1, 2, 1], [4, 5, 6]])
+
+    def test_send_u_recv_reference_example(self):
+        x = paddle.to_tensor(np.asarray(
+            [[0, 2, 3], [1, 4, 5], [2, 6, 7]], "float32"))
+        src = paddle.to_tensor(np.asarray([0, 1, 2, 0], "int32"))
+        dst = paddle.to_tensor(np.asarray([1, 2, 1, 0], "int32"))
+        out = geometric.send_u_recv(x, src, dst).numpy()
+        np.testing.assert_allclose(out, [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.asarray([[1.], [2.], [3.]], "float32"))
+        y = paddle.to_tensor(np.asarray([[10.], [20.], [30.]], "float32"))
+        src = paddle.to_tensor(np.asarray([0, 1, 2], "int32"))
+        dst = paddle.to_tensor(np.asarray([1, 0, 0], "int32"))
+        out = geometric.send_ue_recv(x, y, src, dst, "mul", "sum").numpy()
+        # msgs = x[src]*y = [10, 40, 90] -> dst sums: [130, 10]
+        np.testing.assert_allclose(out, [[130.], [10.]])
+        uv = geometric.send_uv(x, x, src, dst, "add").numpy()
+        np.testing.assert_allclose(uv, [[3.], [3.], [4.]])
+
+    def test_out_size(self):
+        x = paddle.to_tensor(np.ones((3, 2), "float32"))
+        src = paddle.to_tensor(np.asarray([0, 1], "int32"))
+        dst = paddle.to_tensor(np.asarray([0, 0], "int32"))
+        out = geometric.send_u_recv(x, src, dst, out_size=5)
+        assert out.shape == [5, 2]
+
+
+def brute_force_viterbi(pot, trans, include_bos_eos_tag):
+    t_max, n = pot.shape
+    real_n = n
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(real_n), repeat=t_max):
+        s = pot[0, path[0]]
+        if include_bos_eos_tag:
+            s += trans[n - 1, path[0]]
+        for t in range(1, t_max):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include_bos_eos_tag:
+            s += trans[path[-1], n - 2]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.RandomState(0)
+        pot = rng.randn(2, 4, 3).astype("float32")
+        trans = rng.randn(3, 3).astype("float32")
+        lengths = np.asarray([4, 4], "int64")
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+        for b in range(2):
+            ref_s, ref_p = brute_force_viterbi(pot[b], trans, bos_eos)
+            np.testing.assert_allclose(scores.numpy()[b], ref_s, rtol=1e-5)
+            assert list(paths.numpy()[b]) == ref_p
+
+    def test_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        pot = rng.randn(1, 3, 4).astype("float32")
+        trans = rng.randn(4, 4).astype("float32")
+        dec = text.ViterbiDecoder(paddle.to_tensor(trans),
+                                  include_bos_eos_tag=False)
+        scores, paths = dec(paddle.to_tensor(pot),
+                            paddle.to_tensor(np.asarray([3], "int64")))
+        ref_s, ref_p = brute_force_viterbi(pot[0], trans, False)
+        np.testing.assert_allclose(scores.numpy()[0], ref_s, rtol=1e-5)
+        assert list(paths.numpy()[0]) == ref_p

