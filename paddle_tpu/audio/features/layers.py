@@ -32,12 +32,19 @@ class Spectrogram(Layer):
     def forward(self, x):
         from ... import signal
 
+        from ...fft import _complex_ok
+
         spec = signal.stft(x, self.n_fft, hop_length=self.hop_length,
                            win_length=self.win_length,
                            window=self.fft_window, center=self.center,
                            pad_mode=self.pad_mode)
-        # |S|^power — the spectrum may live on the host (complex fallback)
-        mag = Tensor(np.abs(np.asarray(spec._data)).astype(np.float32))
+        if _complex_ok():
+            # device path: differentiable and jit-traceable
+            mag = ops_math.abs(spec)
+        else:
+            # axon complex fallback: the spectrum lives on the host
+            # (eager-only, like every complex op on this backend)
+            mag = Tensor(np.abs(np.asarray(spec._data)).astype(np.float32))
         if self.power == 2.0:
             return mag * mag
         if self.power != 1.0:

@@ -1,0 +1,246 @@
+"""paddle.distributed.rpc — simple RPC between named workers.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc :73, rpc_sync
+:143, rpc_async :183, shutdown :278, get_worker_info :309,
+get_all_worker_infos :339) over a C++ TensorPipe agent
+(paddle/fluid/distributed/rpc/rpc_agent.h).
+
+TPU-native redesign: RPC is host-side control plane (parameter-server-style
+coordination, metrics, orchestration) — data-plane tensors ride XLA
+collectives, never RPC. So the agent is a small threaded TCP server with
+pickled (fn, args) payloads; worker discovery goes through the same
+shared-filesystem FileStore the elastic launcher uses (rendezvous derived
+from ``master_endpoint``). Each request gets a fresh connection; results or
+remote exceptions come back pickled, and ``rpc_async`` returns a
+concurrent.futures.Future.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import tempfile
+import threading
+import time
+
+from ..fleet.elastic import FileStore
+
+
+class _KVStore(FileStore):
+    """Key->value JSON store on the FileStore's locked read-modify-write.
+    One rendezvous file per master_endpoint; reuse an endpoint only for one
+    gang at a time (the reference's TCP store has the same contract)."""
+
+    def set(self, k, v):
+        with self._locked():
+            d = self._read()
+            d[k] = v
+            self._write(d)
+
+    def get(self, k):
+        return self._read().get(k)
+
+    def items(self):
+        return list(self._read().items())
+
+    def delete(self, k):
+        self.deregister(k)
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+_DEFAULT_TIMEOUT = 30.0
+
+
+class WorkerInfo:
+    """reference rpc.py WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+class _State:
+    server = None
+    server_thread = None
+    self_info = None
+    workers = {}  # name -> WorkerInfo
+    store = None
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = pickle.loads(_recv_msg(self.request))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # remote exception travels back
+                result = (False, e)
+            _send_msg(self.request, pickle.dumps(result, protocol=4))
+        except (ConnectionError, EOFError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _store_path(master_endpoint):
+    key = (master_endpoint or "default").replace(":", "_").replace("/", "_")
+    return os.path.join(tempfile.gettempdir(), f"paddle_tpu_rpc_{key}.json")
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """reference rpc.py:73 — start this worker's agent and wait for the
+    whole gang to register."""
+    if _State.server is not None:
+        raise RuntimeError("init_rpc already called in this process")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0) if rank is None
+               else rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)
+                     if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:0")
+
+    server = _Server(("127.0.0.1", 0), _Handler)
+    ip, port = server.server_address
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name=f"rpc-agent-{name}")
+    t.start()
+    _State.server, _State.server_thread = server, t
+    _State.self_info = WorkerInfo(name, rank, ip, port)
+
+    store = _KVStore(_store_path(master_endpoint))
+    store.set(f"worker_{name}", {"name": name, "rank": rank, "ip": ip,
+                                 "port": port})
+    _State.store = store
+
+    deadline = time.time() + _DEFAULT_TIMEOUT
+    observed = 0
+    while time.time() < deadline:
+        infos = {k: v for k, v in store.items()
+                 if k.startswith("worker_")}
+        # a crashed previous gang leaves stale entries behind (shutdown
+        # never ran): probe each endpoint and evict the dead ones instead
+        # of accepting them into the gang
+        live = {}
+        for k, v in infos.items():
+            if v["name"] == name:
+                live[k] = v
+                continue
+            try:
+                socket.create_connection((v["ip"], v["port"]),
+                                         timeout=0.5).close()
+                live[k] = v
+            except OSError:
+                store.delete(k)
+        observed = len(live)
+        if observed >= world_size:
+            _State.workers = {
+                v["name"]: WorkerInfo(v["name"], v["rank"], v["ip"],
+                                      v["port"])
+                for v in live.values()}
+            return
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"init_rpc: only {observed}/{world_size} workers "
+        "registered before timeout")
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    info = get_worker_info(to)
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout) as sock:
+        _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {}),
+                                     protocol=4))
+        sock.settimeout(timeout)
+        ok, result = pickle.loads(_recv_msg(sock))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """reference rpc.py:143 — blocking remote call; remote exceptions
+    re-raise locally."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+_pool = concurrent.futures.ThreadPoolExecutor(max_workers=8,
+                                              thread_name_prefix="rpc")
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """reference rpc.py:183 — returns a Future with .wait()/.result()."""
+    fut = _pool.submit(_invoke, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # reference API calls it wait()
+    return fut
+
+
+def get_worker_info(name):
+    """reference rpc.py:309."""
+    if name not in _State.workers and _State.store is not None:
+        v = _State.store.get(f"worker_{name}")
+        if v:
+            _State.workers[name] = WorkerInfo(v["name"], v["rank"],
+                                              v["ip"], v["port"])
+    if name not in _State.workers:
+        raise ValueError(f"unknown rpc worker {name!r}")
+    return _State.workers[name]
+
+
+def get_all_worker_infos():
+    """reference rpc.py:339."""
+    return sorted(_State.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    return _State.self_info
+
+
+def shutdown():
+    """reference rpc.py:278 — stop the agent and deregister."""
+    if _State.server is None:
+        return
+    if _State.store is not None and _State.self_info is not None:
+        try:
+            _State.store.delete(f"worker_{_State.self_info.name}")
+        except Exception:
+            pass
+    _State.server.shutdown()
+    _State.server.server_close()
+    _State.server = None
+    _State.server_thread = None
+    _State.self_info = None
+    _State.workers = {}
+    _State.store = None

@@ -1,0 +1,160 @@
+"""paddle.incubate.asp — 2:4 (n:m) structured sparsity.
+
+Reference: python/paddle/incubate/asp/ (asp.py: decorate :216,
+prune_model :302; utils.py: calculate_density :78, get_mask_1d :184,
+get_mask_2d_greedy :326, create_mask :498, check_sparsity :569).
+
+TPU-native: masks are computed host-side in numpy (a one-off pruning pass)
+and mask re-application after each optimizer step is one fused multiply —
+XLA folds it into the update. The reference's sparse tensor-core GEMMs have
+no TPU analog (the MXU is dense), so ASP here is the TRAINING-side workflow:
+prune, keep sparsity through updates, verify. That matches the reference's
+own CPU path, where masked weights run through dense kernels too.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers", "get_mask_1d",
+           "get_mask_2d_greedy", "check_sparsity", "ASPHelper"]
+
+_EXCLUDED: set[str] = set()
+
+
+def calculate_density(x):
+    """reference utils.py:78 — fraction of non-zeros."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-|.| of every m consecutive elements per row
+    (reference utils.py:184)."""
+    mat = np.asarray(mat)
+    shape = mat.shape
+    flat = mat.reshape(-1, m)
+    mask = np.zeros_like(flat, dtype=bool)
+    keep = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    np.put_along_axis(mask, keep, True, axis=1)
+    return mask.reshape(shape)
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy m x m block mask with n:m per row AND per column
+    (reference utils.py:326)."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    mask = np.zeros((h, w), dtype=bool)
+    for bi in range(0, h, m):
+        for bj in range(0, w, m):
+            block = np.abs(mat[bi:bi + m, bj:bj + m])
+            bm = np.zeros_like(block, dtype=bool)
+            order = np.argsort(-block, axis=None)
+            rows = np.zeros(block.shape[0], np.int64)
+            cols = np.zeros(block.shape[1], np.int64)
+            for idx in order:
+                i, j = divmod(int(idx), block.shape[1])
+                if rows[i] < n and cols[j] < n:
+                    bm[i, j] = True
+                    rows[i] += 1
+                    cols[j] += 1
+            mask[bi:bi + m, bj:bj + m] = bm
+    return mask
+
+
+def check_sparsity(tensor, n=2, m=4, func_name="check_1d"):
+    """reference utils.py:569 — every m-group holds <= n non-zeros."""
+    arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    if arr.ndim < 2 or arr.shape[-1] % m:
+        return False
+    flat = arr.reshape(-1, m)
+    return bool((np.count_nonzero(flat, axis=1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """reference asp.py:40."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    """reference asp.py:127."""
+    _EXCLUDED.clear()
+
+
+class ASPHelper:
+    """Mask registry (reference asp.py:515). Class-level like the
+    reference's per-program info map."""
+
+    _masks: dict[int, np.ndarray] = {}  # id(param) -> mask
+    _params: dict[int, Tensor] = {}
+
+    @classmethod
+    def _supported(cls, name, param):
+        if name in _EXCLUDED:
+            return False
+        arr = param._data
+        # Linear [in, out] / Conv [out, in, kh, kw]: prune along the input
+        # dim in groups of m like the reference's supported_layer_list
+        return arr.ndim >= 2 and "weight" in name.split(".")[-1]
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d",
+                    with_mask=True):
+        import jax.numpy as jnp
+
+        masks = {}
+        for name, p in model.named_parameters():
+            if not cls._supported(name, p):
+                continue
+            w = np.asarray(p._data, dtype=np.float32)
+            mat = w.reshape(w.shape[0], -1) if w.ndim > 2 else w
+            if mat.shape[-1] % m:
+                continue
+            if mask_algo in ("mask_1d", "MaskAlgo.MASK_1D"):
+                mask = get_mask_1d(mat, n, m)
+            else:
+                mask = get_mask_2d_greedy(mat, n, m)
+            mask = mask.reshape(w.shape)
+            p._data = (p._data * jnp.asarray(mask, p._data.dtype))
+            if with_mask:
+                masks[name] = mask
+                cls._masks[id(p)] = mask
+                cls._params[id(p)] = p
+        return masks
+
+    @classmethod
+    def reapply_masks(cls):
+        import jax.numpy as jnp
+
+        for pid, mask in cls._masks.items():
+            p = cls._params[pid]
+            p._data = p._data * jnp.asarray(mask, p._data.dtype)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference asp.py:302 — one-off magnitude pruning to n:m."""
+    return ASPHelper.prune_model(model, n, m, mask_algo, with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference asp.py:918 — re-applies masks after every step so pruned
+    slots stay zero through training."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        ASPHelper.reapply_masks()
+
+
+def decorate(optimizer):
+    """reference asp.py:216."""
+    return OptimizerWithSparsityGuarantee(optimizer)

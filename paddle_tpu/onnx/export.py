@@ -80,8 +80,11 @@ class _Ctx:
         self.initializers = []
         self.shapes = {}  # value name -> shape tuple
         self._const_cache = {}  # id(arr) -> name
+        self._keepalive = []  # pins cached arrays: id() reuse after free
+        # would alias different constants to one initializer
         self._tmp = 0
         self.param_names = {}  # id(arr) -> friendly name
+        self.min_opset = 13  # raised by emitters that need newer ops
 
     def tmp(self, hint="t"):
         self._tmp += 1
@@ -91,11 +94,15 @@ class _Ctx:
         key = id(arr)
         if key in self._const_cache:
             return self._const_cache[key]
+        self._keepalive.append(arr)
         name = self.param_names.get(key) or self.tmp(hint)
         self.initializers.append(_tensor_proto(name, np.asarray(arr)))
         self._const_cache[key] = name
         self.shapes[name] = tuple(np.asarray(arr).shape)
         return name
+
+    def need_opset(self, v):
+        self.min_opset = max(self.min_opset, v)
 
     def const_i64(self, values, hint="shape"):
         return self.const(np.asarray(values, np.int64), hint)
@@ -223,6 +230,7 @@ def _e_scale(ctx, ins, kw, node):
 
 def _e_reduce(onnx_op):
     def e(ctx, ins, kw, node):
+        ctx.need_opset(18)  # axes-as-input reduce signatures
         axis = kw.get("axis")
         keep = 1 if kw.get("keepdim") else 0
         if axis is None:
@@ -302,6 +310,7 @@ def _e_layer_norm(ctx, ins, kw, node):
     inputs = [ins[0], scale]
     if len(ins) > 2 and ins[2] is not None:
         inputs.append(ins[2])
+    ctx.need_opset(17)  # LayerNormalization
     return ctx.emit("LayerNormalization", inputs,
                     attrs=[_attr_i("axis", axis),
                            _attr_f("epsilon", kw.get("epsilon", 1e-5))])
@@ -309,6 +318,7 @@ def _e_layer_norm(ctx, ins, kw, node):
 
 def _e_rms_norm(ctx, ins, kw, node):
     # decompose: x * rsqrt(mean(x^2) + eps) * w
+    ctx.need_opset(18)  # axes-as-input ReduceMean
     dt = np.dtype(str(node.out_avals[0][1]))
     sq = ctx.emit("Mul", [ins[0], ins[0]])
     mean = ctx.emit("ReduceMean", [sq, ctx.const_i64([-1], "axes")],
@@ -351,7 +361,8 @@ _EMITTERS = {
     "relu": _e_unary("Relu"),
     "sigmoid": _e_unary("Sigmoid"),
     "tanh": _e_unary("Tanh"),
-    "gelu": _e_unary("Gelu"),
+    "gelu": lambda ctx, ins, kw, node: (
+        ctx.need_opset(20) or ctx.emit("Gelu", [ins[0]])),
     "exp": _e_unary("Exp"),
     "log": _e_unary("Log"),
     "sqrt": _e_unary("Sqrt"),
@@ -395,7 +406,6 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
     Traces ``layer`` with placeholders from ``input_spec`` (InputSpec or
     example Tensors; dynamic dims become a symbolic 'batch' dimension in the
     ONNX graph), converts the tape to ONNX nodes, and serializes."""
-    from ..core import state as _state
     from ..static import _collect_nodes
     from ..static.input_spec import InputSpec
 
@@ -486,7 +496,10 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
     model.int(1, 8)  # ir_version
     model.str(2, "paddle_tpu")
     model.msg(7, graph)
-    model.msg(8, Msg().str(1, "").int(2, int(opset_version)))
+    # ops used may require a newer opset than requested (Gelu: 20,
+    # axes-as-input reduces: 18) — declare what the graph actually needs
+    model.msg(8, Msg().str(1, "").int(
+        2, max(int(opset_version), ctx.min_opset)))
 
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     import os
@@ -495,11 +508,3 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
     with open(out_path, "wb") as f:
         f.write(model.tobytes())
     return out_path
-
-
-class _nullcontext:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False

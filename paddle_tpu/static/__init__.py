@@ -121,7 +121,7 @@ def _collect_nodes(fetch_tensors):
     return [seen[i] for i in sorted(seen)]
 
 
-def _compile_replay(fetch_tensors, feeds):
+def _compile_replay(fetch_tensors, feeds, declared=None):
     """Build a jitted fn(feed_arrays_dict) -> [fetch arrays] replaying the
     tape slice. Non-feed primals (parameters, constants) are baked in as
     jit constants — the legacy Executor contract (params change => rebuild
@@ -132,12 +132,20 @@ def _compile_replay(fetch_tensors, feeds):
 
     nodes = _collect_nodes(fetch_tensors)
     feed_ids = {id(t._data): name for name, t in feeds.items()}
+    # a DECLARED placeholder the graph uses but the caller didn't feed
+    # would otherwise silently bake in as zeros
+    unfed_ids = {id(t._data): name for name, t in (declared or {}).items()
+                 if name not in feeds}
     used = set()
     for n in nodes:
         for p in n.primals:
             nm = feed_ids.get(id(p))
             if nm is not None:
                 used.add(nm)
+            if id(p) in unfed_ids:
+                raise ValueError(
+                    f"placeholder {unfed_ids[id(p)]!r} is used by the fetch "
+                    "graph but missing from feed")
     for t in fetch_tensors:
         nm = feed_ids.get(id(t._data))
         if nm is not None:
@@ -209,8 +217,10 @@ class Executor:
         key = tuple(id(t) for t in fetches) + tuple(sorted(feed))
         fn = program._replay_cache.get(key)
         if fn is None:
-            fn = _compile_replay(fetches, active)
+            fn = _compile_replay(fetches, active, declared=program._feeds)
             program._replay_cache[key] = fn
+            while len(program._replay_cache) > 32:  # bound retained tapes
+                program._replay_cache.pop(next(iter(program._replay_cache)))
         import jax.numpy as jnp
 
         arrays = {n: (v._data if isinstance(v, Tensor) else jnp.asarray(v))

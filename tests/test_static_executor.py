@@ -124,3 +124,11 @@ class TestRound4ReviewFixes:
         assert out.shape == (17, 2)
         ref = model(paddle.to_tensor(big)).numpy()
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_missing_declared_feed_raises(self):
+        x = static.data("x", [2, 2], "float32")
+        y = static.data("y", [2, 2], "float32")
+        z = x + y
+        with pytest.raises(ValueError, match="missing from feed"):
+            static.Executor().run(feed={"x": np.zeros((2, 2), "float32")},
+                                  fetch_list=[z])
