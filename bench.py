@@ -213,7 +213,7 @@ def main():
     if on_tpu:
         cfg = llama_125m()
         seq, steps, warmup = 1024, 15, 3
-        batch_sizes = [8, 16, 32]
+        batch_sizes = [8, 16, 32]  # 64 OOMs on v5e and poisons the run
     else:  # CI / CPU smoke sizing
         from paddle_tpu.models import llama_tiny
 

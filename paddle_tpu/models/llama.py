@@ -133,6 +133,29 @@ class LlamaAttention(Layer):
                                                  is_causal=attn_mask is None)
         return self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
 
+    def forward_einsum_block(self, x, cos, sin, attn_mask=None):
+        """Head-major single-op attention block (PT_ATTN_EINSUM=1): the
+        h<->s transposes fold into the projection einsums. Returns None
+        when unavailable."""
+        import os
+
+        if (attn_mask is not None or self.use_ring_attention
+                or os.environ.get("PT_ATTN_EINSUM", "0") != "1"):
+            return None
+        b, s = x.shape[0], x.shape[1]
+        from ..ops.pallas.flash_attention import _attention_block_bhsd
+        from ..nn.functional.flash_attention import _use_pallas
+
+        class _S:
+            shape = (b, s, self.num_heads, self.head_dim)
+
+        if not _use_pallas(_S(), _S()):
+            return None
+        return _attention_block_bhsd(
+            x, self.q_proj.weight, self.k_proj.weight, self.v_proj.weight,
+            self.o_proj.weight, cos, sin, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, causal=True)
+
     def forward_pre_rope(self, x, cos, sin, attn_mask=None):
         """Projection + rope-fused flash attention (rope applied inside the
         Pallas kernel); returns None when the fused path is unavailable."""
@@ -252,7 +275,11 @@ class LlamaDecoderLayer(Layer):
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
         h = self.input_layernorm(x)
-        attn_out = self.self_attn.forward_pre_rope(h, cos, sin, attn_mask)
+        attn_out = self.self_attn.forward_einsum_block(h, cos, sin,
+                                                       attn_mask)
+        if attn_out is None:
+            attn_out = self.self_attn.forward_pre_rope(h, cos, sin,
+                                                       attn_mask)
         if attn_out is None:
             attn_out = self.self_attn(h, cos, sin, attn_mask)
         x = x + attn_out

@@ -415,3 +415,50 @@ def flash_attention_rope_fwd(q, k, v, cos, sin, causal=True, scale=None):
     """Tensor-level rope-fused entry used by nn.functional."""
     return _flash_attention_rope_arrays(q, k, v, cos, sin,
                                         causal=bool(causal), scale=scale)
+
+
+@_op("attention_block_bhsd")
+def _attention_block_bhsd(x, wq, wk, wv, wo, cos, sin, num_heads=1,
+                          num_kv_heads=1, causal=True):
+    """Whole attention block as ONE op with head-major internal layout:
+    the projections produce [b, h, s, d] directly (einsum folds the head
+    transpose into the matmul), rope applies in that layout, the kernel
+    consumes a free reshape to [b*h, s, d], and the output projection
+    contracts [b, h, s, d] straight back to [b, s, H] — the four 25 MB
+    HBM transposes per layer of the [b, s, h, d] path never happen.
+
+    Experimental (PT_ATTN_EINSUM=1): measured against the default path in
+    PERF.md. x: [B, S, K]; wq/wk/wv: [K, H*D] or [K, Hkv*D]; wo: [H*D, K];
+    cos/sin: [S, D/2]."""
+    b, s, kdim = x.shape
+    d = wq.shape[1] // num_heads
+    wq4 = wq.reshape(kdim, num_heads, d)
+    wk4 = wk.reshape(kdim, num_kv_heads, d)
+    wv4 = wv.reshape(kdim, num_kv_heads, d)
+    q = jnp.einsum("bsk,khd->bhsd", x, wq4)
+    k = jnp.einsum("bsk,khd->bhsd", x, wk4)
+    v = jnp.einsum("bsk,khd->bhsd", x, wv4)
+    c2 = jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32)
+    s2 = jnp.concatenate([sin, sin], axis=-1).astype(jnp.float32)
+
+    def rope4(t):
+        d2 = t.shape[-1] // 2
+        rot = jnp.concatenate([-t[..., d2:], t[..., :d2]], axis=-1)
+        return (t.astype(jnp.float32) * c2[None, None]
+                + rot.astype(jnp.float32) * s2[None, None]).astype(t.dtype)
+
+    q = rope4(q)
+    k = rope4(k)
+    if num_kv_heads != num_heads:
+        rep = num_heads // num_kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scale = 1.0 / math.sqrt(d)
+    bq, bk = _block_sizes(s, s)
+    out = _flash_mha(q.reshape(b * num_heads, s, d),
+                     k.reshape(b * num_heads, s, d),
+                     v.reshape(b * num_heads, s, d),
+                     float(scale), bool(causal), bq, bk)
+    out4 = out.reshape(b, num_heads, s, d)
+    wo4 = wo.reshape(num_heads, d, kdim)
+    return jnp.einsum("bhsd,hdk->bsk", out4, wo4)

@@ -138,3 +138,29 @@ class TestPallasGate:
             shape = (1, 1024, 12, 64)
 
         assert not _use_pallas(Q(), KV())
+
+
+class TestEinsumAttentionBlock:
+    def test_matches_standard_path(self, monkeypatch):
+        """PT_ATTN_EINSUM=1 head-major block == default path (PERF.md r4
+        experiment; kept opt-in because XLA lowers it slower on v5e)."""
+        import importlib
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM, llama_small
+
+        cfg = llama_small()
+        cfg.num_hidden_layers = 2
+        paddle.seed(3)
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (2, 256)).astype(np.int32))
+        ref = m(ids).numpy()
+
+        monkeypatch.setenv("PT_ATTN_EINSUM", "1")
+        fam = importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention")
+        monkeypatch.setattr(fam.jax, "default_backend", lambda: "tpu")
+        out = m(ids).numpy()
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 2e-3, err
