@@ -151,10 +151,17 @@ class LlamaAttention(Layer):
 
         if not _use_pallas(_S(), _S()):
             return None
-        return _attention_block_bhsd(
+        out = _attention_block_bhsd(
             x, self.q_proj.weight, self.k_proj.weight, self.v_proj.weight,
             self.o_proj.weight, cos, sin, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, causal=True)
+        import importlib
+
+        # path observability (LAST_PATH), same contract as the other routes
+        importlib.import_module(
+            "paddle_tpu.nn.functional.flash_attention").LAST_PATH = \
+            "einsum_block"
+        return out
 
     def forward_pre_rope(self, x, cos, sin, attn_mask=None):
         """Projection + rope-fused flash attention (rope applied inside the

@@ -390,6 +390,22 @@ def flash_attention_fwd(q, k, v, causal=True, scale=None):
     return _flash_attention_arrays(q, k, v, causal=bool(causal), scale=scale)
 
 
+def _widen_tables(cos, sin):
+    """[S, D/2] rope tables -> full-width [S, D] f32 (both halves)."""
+    return (jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32),
+            jnp.concatenate([sin, sin], axis=-1).astype(jnp.float32))
+
+
+def _rope_widened(x, c2, s2):
+    """Batched rope with full-width tables; x [..., S, D], c2/s2
+    broadcastable [S, D]. Same half-split convention as _rot_f32 /
+    models/llama.py:_rope_apply."""
+    d2 = x.shape[-1] // 2
+    rot = jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+    return (x.astype(jnp.float32) * c2
+            + rot.astype(jnp.float32) * s2).astype(x.dtype)
+
+
 @_op("flash_attention_rope_pallas")
 def _flash_attention_rope_arrays(q, k, v, cos, sin, causal=True, scale=None):
     """Rope-fused flash attention. q/k/v: [B, S, H, D] PRE-rotary;
@@ -401,8 +417,7 @@ def _flash_attention_rope_arrays(q, k, v, cos, sin, causal=True, scale=None):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    c2 = jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32)
-    s2 = jnp.concatenate([sin, sin], axis=-1).astype(jnp.float32)
+    c2, s2 = _widen_tables(cos, sin)
     qt = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, d)
     kt = jnp.swapaxes(k, 1, 2).reshape(b * hq, k.shape[1], d)
     vt = jnp.swapaxes(v, 1, 2).reshape(b * hq, v.shape[1], d)
@@ -438,17 +453,9 @@ def _attention_block_bhsd(x, wq, wk, wv, wo, cos, sin, num_heads=1,
     q = jnp.einsum("bsk,khd->bhsd", x, wq4)
     k = jnp.einsum("bsk,khd->bhsd", x, wk4)
     v = jnp.einsum("bsk,khd->bhsd", x, wv4)
-    c2 = jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32)
-    s2 = jnp.concatenate([sin, sin], axis=-1).astype(jnp.float32)
-
-    def rope4(t):
-        d2 = t.shape[-1] // 2
-        rot = jnp.concatenate([-t[..., d2:], t[..., :d2]], axis=-1)
-        return (t.astype(jnp.float32) * c2[None, None]
-                + rot.astype(jnp.float32) * s2[None, None]).astype(t.dtype)
-
-    q = rope4(q)
-    k = rope4(k)
+    c2, s2 = _widen_tables(cos, sin)
+    q = _rope_widened(q, c2, s2)
+    k = _rope_widened(k, c2, s2)
     if num_kv_heads != num_heads:
         rep = num_heads // num_kv_heads
         k = jnp.repeat(k, rep, axis=1)

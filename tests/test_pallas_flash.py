@@ -141,16 +141,12 @@ class TestPallasGate:
 
 
 class TestEinsumAttentionBlock:
-    def test_matches_standard_path(self, monkeypatch):
-        """PT_ATTN_EINSUM=1 head-major block == default path (PERF.md r4
-        experiment; kept opt-in because XLA lowers it slower on v5e)."""
+    def _run(self, monkeypatch, cfg):
         import importlib
 
         import paddle_tpu as paddle
-        from paddle_tpu.models import LlamaForCausalLM, llama_small
+        from paddle_tpu.models import LlamaForCausalLM
 
-        cfg = llama_small()
-        cfg.num_hidden_layers = 2
         paddle.seed(3)
         m = LlamaForCausalLM(cfg)
         ids = paddle.to_tensor(
@@ -161,6 +157,28 @@ class TestEinsumAttentionBlock:
         fam = importlib.import_module(
             "paddle_tpu.nn.functional.flash_attention")
         monkeypatch.setattr(fam.jax, "default_backend", lambda: "tpu")
+        fam.LAST_PATH = None
         out = m(ids).numpy()
+        # the einsum path must have actually run, not silently fallen back
+        assert fam.LAST_PATH == "einsum_block"
         err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
         assert err < 2e-3, err
+
+    def test_matches_standard_path(self, monkeypatch):
+        """PT_ATTN_EINSUM=1 head-major block == default path (PERF.md r4
+        experiment; kept opt-in because XLA lowers it slower on v5e)."""
+        from paddle_tpu.models import llama_small
+
+        cfg = llama_small()
+        cfg.num_hidden_layers = 2
+        self._run(monkeypatch, cfg)
+
+    def test_gqa_heads(self, monkeypatch):
+        """The kv-repeat branch (num_kv_heads < num_heads)."""
+        from paddle_tpu.models import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=512, hidden_size=256,
+                          intermediate_size=512, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256)
+        self._run(monkeypatch, cfg)
