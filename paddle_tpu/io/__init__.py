@@ -280,13 +280,95 @@ def _np_collate(batch):
 
 
 def _tensorize(tree):
-    if isinstance(tree, np.ndarray):
-        return Tensor(tree)
+    return _tree_map(
+        lambda t: Tensor(t) if isinstance(t, np.ndarray) else t, tree)
+
+
+def _tree_map(fn, tree):
+    """Map fn over the non-container leaves of a list/dict batch tree
+    (the one walker shared by tensorize/pack/unpack)."""
     if isinstance(tree, list):
-        return [_tensorize(t) for t in tree]
+        return [_tree_map(fn, t) for t in tree]
     if isinstance(tree, dict):
-        return {k: _tensorize(v) for k, v in tree.items()}
-    return tree
+        return {k: _tree_map(fn, v) for k, v in tree.items()}
+    return fn(tree)
+
+
+def _shm_pack(tree, min_bytes=1 << 20):
+    """Move the numpy leaves of a collated batch into ONE shared-memory
+    segment (reference use_shared_memory=True: io/dataloader/worker.py
+    sends batches via shared memory instead of pickling through the pipe).
+    Returns an ("shm", name, spec) token, or ("inline", tree) for small
+    batches where the segment setup would cost more than the copy."""
+    import multiprocessing.shared_memory as mshm
+
+    arrays = []
+
+    def mark(t):
+        if isinstance(t, np.ndarray):
+            arrays.append(np.ascontiguousarray(t))
+            a = arrays[-1]
+            # a.dtype (picklable) — a str() form can't round-trip
+            # structured/record dtypes
+            return ("__arr__", len(arrays) - 1, a.shape, a.dtype)
+        return t
+
+    spec = _tree_map(mark, tree)
+    total = sum(a.nbytes for a in arrays)
+    if not arrays or total < min_bytes:
+        return ("inline", tree)
+    seg = mshm.SharedMemory(create=True, size=total)
+    off = 0
+    offsets = []
+    for a in arrays:
+        view = np.ndarray(a.shape, a.dtype, buffer=seg.buf, offset=off)
+        np.copyto(view, a)
+        offsets.append(off)
+        off += a.nbytes
+    name = seg.name
+    seg.close()
+    return ("shm", name, spec, offsets)
+
+
+def _is_arr_marker(t):
+    return isinstance(t, tuple) and len(t) == 4 and t[0] == "__arr__"
+
+
+def _shm_unpack(token):
+    kind = token[0]
+    if kind == "inline":
+        return token[1]
+    import multiprocessing.shared_memory as mshm
+
+    _, name, spec, offsets = token
+    seg = mshm.SharedMemory(name=name)
+    try:
+        def restore(t):
+            if _is_arr_marker(t):
+                _, idx, shape, dtype = t
+                view = np.ndarray(shape, dtype, buffer=seg.buf,
+                                  offset=offsets[idx])
+                return view.copy()  # own the data before the segment dies
+            return t
+
+        return _tree_map(restore, spec)
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def _shm_discard(token):
+    """Unlink a packed batch without reading it (early-exit cleanup)."""
+    if token[0] != "shm":
+        return
+    import multiprocessing.shared_memory as mshm
+
+    try:
+        seg = mshm.SharedMemory(name=token[1])
+        seg.close()
+        seg.unlink()
+    except FileNotFoundError:
+        pass
 
 
 _PROC_BUILDER = None  # per-worker-process task state (set by initializer)
@@ -316,11 +398,13 @@ class _ProcBatchBuilder:
     forks long-lived workers fed by index queues; spawn + Pool.imap gives
     the same pipeline with order preservation on all platforms)."""
 
-    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers):
+    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers,
+                 use_shared_memory=True):
         self.dataset = dataset
         self.collate_fn = collate_fn  # None = numpy default collate
         self.worker_init_fn = worker_init_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
         self._inited = False
 
     def _lazy_init(self):
@@ -339,8 +423,11 @@ class _ProcBatchBuilder:
         self._lazy_init()
         samples = [self.dataset[i] for i in indices]
         if self.collate_fn is None:
-            return _np_collate(samples)
-        return self.collate_fn(samples)
+            batch = _np_collate(samples)
+            if self.use_shared_memory:
+                return _shm_pack(batch)
+            return ("inline", batch)
+        return ("inline", self.collate_fn(samples))
 
 
 def default_collate_fn(batch):
@@ -386,6 +473,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = bool(use_shared_memory)
         # process workers (reference worker.py uses processes always);
         # threads stay the default here because the C++ collate/prefetch
         # core already de-GILs the common path — processes pay pickling but
@@ -479,17 +567,28 @@ class DataLoader:
                 yield b
 
         builder = _ProcBatchBuilder(self.dataset, self._custom_collate,
-                                    self.worker_init_fn, self.num_workers)
+                                    self.worker_init_fn, self.num_workers,
+                                    use_shared_memory=self.use_shared_memory)
         with ctx.Pool(self.num_workers, initializer=_proc_worker_init,
                       initargs=(builder,)) as pool:
+            it = pool.imap(_proc_run_batch, feed(), chunksize=1)
             try:
-                for res in pool.imap(_proc_run_batch, feed(), chunksize=1):
+                for token in it:
                     sem.release()
+                    res = _shm_unpack(token)
                     yield (_tensorize(res) if self._custom_collate is None
                            else res)
             finally:
                 stop.set()
                 sem.release()  # unblock a feed() waiting on backpressure
+                # early exit / error: in-flight batches may hold shared-
+                # memory segments — drain and unlink so /dev/shm doesn't
+                # accumulate across abandoned iterators
+                try:
+                    for token in it:
+                        _shm_discard(token)
+                except Exception:
+                    pass
 
     def _threaded_iter(self):
         """Thread-pool prefetch pipeline preserving batch order, with

@@ -264,3 +264,71 @@ class TestProcessWorkersEarlyExit:
         n = sum(1 for _ in DataLoader(ds, batch_size=8, num_workers=2,
                                       use_process_workers=True))
         assert n == 8
+
+
+class _BigDataset:
+    """Batches > 1MB so the shared-memory transport engages."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return np.full((256, 1024), float(i), "float32")  # 1MB/sample
+
+
+class TestProcessWorkersSharedMemory:
+    def test_shm_transport_values(self):
+        from paddle_tpu.io import DataLoader
+
+        loader = DataLoader(_BigDataset(), batch_size=2, num_workers=2,
+                            use_process_workers=True, use_shared_memory=True)
+        seen = []
+        for b in loader:
+            assert b.shape == [2, 256, 1024]
+            seen.append(b.numpy()[:, 0, 0].tolist())
+        assert seen == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+    def test_shm_pack_roundtrip(self):
+        from paddle_tpu.io import _shm_pack, _shm_unpack
+
+        tree = {"x": np.random.randn(512, 600).astype("float32"),
+                "y": [np.arange(700000, dtype="int64"), 7]}
+        token = _shm_pack(tree)
+        assert token[0] == "shm"
+        out = _shm_unpack(token)
+        np.testing.assert_array_equal(out["x"], tree["x"])
+        np.testing.assert_array_equal(out["y"][0], tree["y"][0])
+        assert out["y"][1] == 7
+
+    def test_small_batch_stays_inline(self):
+        from paddle_tpu.io import _shm_pack
+
+        token = _shm_pack(np.zeros(16, "float32"))
+        assert token[0] == "inline"
+
+    def test_structured_dtype_roundtrip(self):
+        from paddle_tpu.io import _shm_pack, _shm_unpack
+
+        dt = np.dtype([("uid", "<i8"), ("feat", "<f4", (64,))])
+        arr = np.zeros(4096, dt)
+        arr["uid"] = np.arange(4096)
+        out = _shm_unpack(_shm_pack({"r": arr}))
+        np.testing.assert_array_equal(out["r"]["uid"], arr["uid"])
+        assert out["r"].dtype == dt
+
+    def test_early_exit_unlinks_segments(self):
+        import glob
+
+        from paddle_tpu.io import DataLoader
+
+        before = set(glob.glob("/dev/shm/psm_*")) | set(
+            glob.glob("/dev/shm/*"))
+        loader = DataLoader(_BigDataset(), batch_size=2, num_workers=2,
+                            use_process_workers=True,
+                            use_shared_memory=True)
+        for i, b in enumerate(loader):
+            if i == 0:
+                break
+        after = set(glob.glob("/dev/shm/*"))
+        leaked = {p for p in after - before if "wnsm" in p or "psm" in p}
+        assert not leaked, leaked
