@@ -195,6 +195,55 @@ def bench_deepfm(on_tpu):
     }))
 
 
+def bench_bert(on_tpu):
+    """BASELINE config 2: BERT-base fine-tune (seq classification),
+    tokens/sec — the ERNIE-3.0 / BERT fine-tune workload."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import BertForSequenceClassification, bert_base, \
+        bert_tiny
+
+    paddle.seed(0)
+    np.random.seed(0)
+    if on_tpu:
+        cfg = bert_base()
+        seq, steps, warmup, batch_sizes = 128, 15, 3, [32, 64, 128]
+    else:
+        cfg = bert_tiny()
+        seq, steps, warmup, batch_sizes = 32, 3, 1, [4]
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+
+    def build():
+        m = BertForSequenceClassification(cfg)
+        m.bfloat16()
+        m.train()
+        opt = paddle.optimizer.AdamW(learning_rate=2e-5,
+                                     parameters=m.parameters())
+        raw = paddle.incubate.fused_train_step(m, opt,
+                                               loss_fn=lambda o: o[0])
+        # labels must travel by keyword (position 2 is token_type_ids)
+        return lambda ids, labels: raw(ids, labels=labels)
+
+    step = build()
+
+    def make_batch(bs):
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
+        labels = paddle.to_tensor(
+            np.random.randint(0, cfg.num_labels, (bs,)).astype(np.int64))
+        return ids, labels
+
+    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup,
+                          build)
+    print(json.dumps({
+        "metric": "bert_base_finetune_tokens_per_sec" if on_tpu
+                  else "bert_tiny_cpu_finetune_tokens_per_sec",
+        "value": round(ips * seq, 1), "unit": "tokens/s",
+        "vs_baseline": None, "batch_size": bs, "seq_len": seq,
+        "baseline_note": "reference publishes no in-tree numbers",
+    }))
+
+
 def main():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_125m
@@ -304,13 +353,16 @@ if __name__ == "__main__":
         bench_resnet50(_on_tpu)
     elif workload == "deepfm":
         bench_deepfm(_on_tpu)
+    elif workload == "bert":
+        bench_bert(_on_tpu)
     elif workload == "llama":
         main()
     elif workload == "all":
         # default: ALL BASELINE workloads, one JSON line each; the flagship
         # llama line prints LAST (the driver parses the tail line)
         for fn in (lambda: bench_resnet50(_on_tpu),
-                   lambda: bench_deepfm(_on_tpu)):
+                   lambda: bench_deepfm(_on_tpu),
+                   lambda: bench_bert(_on_tpu)):
             try:
                 fn()
             except Exception:
@@ -318,4 +370,4 @@ if __name__ == "__main__":
         main()
     else:
         sys.exit(f"unknown workload {workload!r}; "
-                 "expected llama | resnet50 | deepfm | all")
+                 "expected llama | resnet50 | deepfm | bert | all")

@@ -3,6 +3,10 @@ BASELINE workloads come from: PaddleNLP Llama/ERNIE, PaddleClas ResNet,
 PaddleRec DeepFM)."""
 
 from .deepfm import DeepFM, deepfm_criteo  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+    bert_base, bert_tiny,
+)
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_1b, llama_7b, llama_13b,
     llama_125m, llama_small, llama_tiny,

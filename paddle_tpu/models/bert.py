@@ -1,0 +1,168 @@
+"""BERT encoder family — BASELINE config 2 (ERNIE-3.0 / BERT-base
+fine-tune) workload.
+
+Capability target: PaddleNLP's BertModel driven by the reference's
+`@to_static` + AMP path. Built from this framework's own transformer
+layers (nn/layer/transformer.py — post-norm, gelu, additive attention
+mask), bf16-friendly. ERNIE-3.0-base is architecturally this model
+(different pretraining data), so one implementation covers both names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..ops import creation, manipulation as M
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "bert_base", "bert_tiny"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    num_labels: int = 2
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = Normal(0.0, c.initializer_range)
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size, weight_attr=init)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(0, s, dtype="int32")
+        if token_type_ids is None:
+            # reference semantics: omitted segment ids mean all-zeros, and
+            # the type-0 embedding IS added (checkpoint parity)
+            token_type_ids = creation.zeros([s], dtype="int32")
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.dense = Linear(c.hidden_size, c.hidden_size,
+                            weight_attr=Normal(0.0, c.initializer_range))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """Embeddings -> post-norm transformer encoder -> pooler."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob,
+            normalize_before=False, layer_norm_eps=c.layer_norm_eps,
+            weight_attr=Normal(0.0, c.initializer_range))
+        self.encoder = TransformerEncoder(layer, c.num_hidden_layers)
+        self.pooler = BertPooler(c)
+
+    @staticmethod
+    def _extend_mask(attention_mask):
+        """[B, S] 1/0 -> additive [B, 1, 1, S] (broadcast over heads/query;
+        the reference's get_extended_attention_mask)."""
+        if attention_mask is None:
+            return None
+        m = attention_mask.astype("float32")
+        m = M.reshape(m, [m.shape[0], 1, 1, m.shape[1]])
+        return (m - 1.0) * 1e4  # 0 where attended, -1e4 where masked
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        h = self.encoder(h, self._extend_mask(attention_mask))
+        return h, self.pooler(h)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels,
+                                 weight_attr=Normal(
+                                     0.0, config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class BertForMaskedLM(Layer):
+    """MLM head tied to the word embedding table (pretraining loss)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.bert = BertModel(c)
+        self.transform = Linear(c.hidden_size, c.hidden_size,
+                                weight_attr=Normal(0.0, c.initializer_range))
+        self.transform_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.vocab_size = c.vocab_size
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        h, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(h)))
+        logits = F.linear(h, self.bert.embeddings.word_embeddings.weight.t())
+        if labels is not None:
+            loss = F.cross_entropy(
+                M.reshape(logits, [-1, self.vocab_size]),
+                M.reshape(labels, [-1]), ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=128,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      intermediate_size=256, max_position_embeddings=128,
+                      **kw)
