@@ -195,6 +195,56 @@ def bench_deepfm(on_tpu):
     }))
 
 
+def bench_ppyoloe(on_tpu):
+    """BASELINE config 3: PP-YOLOE-s training images/sec (conv-heavy,
+    640x640, full TAL/VFL/GIoU/DFL loss)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import PPYOLOE, PPYOLOEConfig
+
+    paddle.seed(0)
+    np.random.seed(0)
+    if on_tpu:
+        cfg = PPYOLOEConfig(depth_mult=0.33, width_mult=0.50, max_boxes=16)
+        img, steps, warmup, batch_sizes = 640, 10, 2, [8, 16, 32]
+    else:
+        cfg = PPYOLOEConfig(num_classes=4, depth_mult=0.33, width_mult=0.25,
+                            max_boxes=4)
+        img, steps, warmup, batch_sizes = 64, 3, 1, [2]
+
+    def build():
+        m = PPYOLOE(cfg)
+        m.bfloat16()
+        m.train()
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=m.parameters())
+        return paddle.incubate.fused_train_step(m, opt,
+                                                loss_fn=lambda o: o[0])
+
+    step = build()
+
+    def make_batch(bs):
+        x = paddle.to_tensor(
+            np.random.randn(bs, 3, img, img).astype(np.float32)
+        ).astype("bfloat16")
+        g = cfg.max_boxes
+        wh = np.random.uniform(img * 0.1, img * 0.5, (bs, g, 2))
+        xy = np.random.uniform(0, img * 0.5, (bs, g, 2))
+        gt_b = paddle.to_tensor(
+            np.concatenate([xy, xy + wh], -1).astype(np.float32))
+        gt_l = paddle.to_tensor(
+            np.random.randint(0, cfg.num_classes, (bs, g)).astype(np.int64))
+        return x, gt_b, gt_l
+
+    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
+    print(json.dumps({
+        "metric": "ppyoloe_s_train_images_per_sec" if on_tpu
+                  else "ppyoloe_tiny_cpu_train_images_per_sec",
+        "value": round(ips, 1), "unit": "images/s", "vs_baseline": None,
+        "batch_size": bs, "image_size": img,
+        "baseline_note": "reference publishes no in-tree numbers",
+    }))
+
+
 def bench_bert(on_tpu):
     """BASELINE config 2: BERT-base fine-tune (seq classification),
     tokens/sec — the ERNIE-3.0 / BERT fine-tune workload."""
@@ -355,6 +405,8 @@ if __name__ == "__main__":
         bench_deepfm(_on_tpu)
     elif workload == "bert":
         bench_bert(_on_tpu)
+    elif workload == "ppyoloe":
+        bench_ppyoloe(_on_tpu)
     elif workload == "llama":
         main()
     elif workload == "all":
@@ -362,7 +414,8 @@ if __name__ == "__main__":
         # llama line prints LAST (the driver parses the tail line)
         for fn in (lambda: bench_resnet50(_on_tpu),
                    lambda: bench_deepfm(_on_tpu),
-                   lambda: bench_bert(_on_tpu)):
+                   lambda: bench_bert(_on_tpu),
+                   lambda: bench_ppyoloe(_on_tpu)):
             try:
                 fn()
             except Exception:
@@ -370,4 +423,4 @@ if __name__ == "__main__":
         main()
     else:
         sys.exit(f"unknown workload {workload!r}; "
-                 "expected llama | resnet50 | deepfm | bert | all")
+                 "expected llama | resnet50 | deepfm | bert | ppyoloe | all")

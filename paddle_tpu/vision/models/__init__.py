@@ -1,6 +1,9 @@
 """Vision model zoo (reference: python/paddle/vision/models/__init__.py)."""
 
 from .lenet import LeNet  # noqa: F401
+from .ppyoloe import (  # noqa: F401
+    PPYOLOE, PPYOLOEConfig, ppyoloe_crn_s, ppyoloe_l, ppyoloe_m, ppyoloe_s,
+)
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
