@@ -205,7 +205,7 @@ def bench_ppyoloe(on_tpu):
     np.random.seed(0)
     if on_tpu:
         cfg = PPYOLOEConfig(depth_mult=0.33, width_mult=0.50, max_boxes=16)
-        img, steps, warmup, batch_sizes = 640, 10, 2, [8, 16, 32]
+        img, steps, warmup, batch_sizes = 640, 10, 2, [16, 32]
     else:
         cfg = PPYOLOEConfig(num_classes=4, depth_mult=0.33, width_mult=0.25,
                             max_boxes=4)
@@ -256,7 +256,7 @@ def bench_bert(on_tpu):
     np.random.seed(0)
     if on_tpu:
         cfg = bert_base()
-        seq, steps, warmup, batch_sizes = 128, 15, 3, [32, 64, 128]
+        seq, steps, warmup, batch_sizes = 128, 15, 3, [64, 128]
     else:
         cfg = bert_tiny()
         seq, steps, warmup, batch_sizes = 32, 3, 1, [4]
