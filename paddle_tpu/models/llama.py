@@ -362,6 +362,70 @@ class LlamaForCausalLM(Layer):
             return loss, logits
         return logits
 
+    # ---- generation (KV-cache decode) --------------------------------
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None, top_p=None, eos_token_id=None, seed=None,
+                 do_sample=False):
+        """Autoregressive decode with per-layer KV caches: one causal
+        prefill over the prompt, then seq-1 steps against the cache
+        (capability analog of PaddleNLP's model.generate greedy/sampling
+        path). Returns [B, prompt + new] token ids."""
+        rng = np.random.RandomState(seed)
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        L = self.config.num_hidden_layers
+        limit = self.config.max_position_embeddings
+        if s + max_new_tokens > limit:
+            raise ValueError(
+                f"generate: prompt ({s}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_position_embeddings "
+                f"({limit})")
+
+        # causal prefill THROUGH the cache path (explicit tril mask: the
+        # cache branch runs non-causal sdpa so the mask must say causal)
+        mask = Tensor(np.tril(np.ones((1, 1, s, s), bool)))
+        empty = Tensor(np.zeros(
+            (b, 0, self.config.num_key_value_heads, self.config.head_dim),
+            np.float32))
+        caches = [(empty, empty) for _ in range(L)]
+        h, caches = self.llama(input_ids, mask, caches)
+        out_ids = [input_ids]
+        finished = np.zeros(b, bool)
+        for step in range(max_new_tokens):
+            h = h[:, -1:]  # only the last position feeds the head
+            logits = (self.lm_head(h) if self.lm_head is not None
+                      else F.linear(h, self.llama.embed_tokens.weight.t()))
+            last = logits[:, -1].numpy().astype(np.float64)  # [B, V]
+            if do_sample:
+                last = last / max(temperature, 1e-6)
+                if top_k is not None:
+                    k_eff = min(int(top_k), last.shape[1])
+                    kth = np.sort(last, -1)[:, -k_eff][:, None]
+                    last = np.where(last < kth, -np.inf, last)
+                probs = np.exp(last - last.max(-1, keepdims=True))
+                probs /= probs.sum(-1, keepdims=True)
+                if top_p is not None:
+                    srt = np.argsort(-probs, -1)
+                    cum = np.cumsum(np.take_along_axis(probs, srt, -1), -1)
+                    cut = cum - np.take_along_axis(probs, srt, -1) > top_p
+                    kill = np.zeros_like(probs, bool)
+                    np.put_along_axis(kill, srt, cut, -1)
+                    probs = np.where(kill, 0, probs)
+                    probs /= probs.sum(-1, keepdims=True)
+                nxt = np.array([rng.choice(probs.shape[1], p=probs[i])
+                                for i in range(b)])
+            else:
+                nxt = last.argmax(-1)
+            if eos_token_id is not None:
+                nxt = np.where(finished, eos_token_id, nxt)
+                finished |= nxt == eos_token_id
+            cur = Tensor(nxt.astype(np.int32)[:, None])
+            out_ids.append(cur)
+            if eos_token_id is not None and finished.all():
+                break
+            if step + 1 < max_new_tokens:  # no wasted trailing forward
+                h, caches = self.llama(cur, None, caches)
+        return M.concat(out_ids, axis=1)
+
     # ---- sharding plan (consumed by auto_parallel / graft dryrun) ----
     @staticmethod
     def tp_partition_spec(param_name: str):

@@ -225,3 +225,51 @@ class TestLayers:
         total = np.sqrt(sum((g.numpy().astype(np.float64) ** 2).sum()
                             for _, g in pgs))
         assert total < 1.0 + 1e-4
+
+
+class TestLlamaGenerate:
+    """KV-cache autoregressive decode (PaddleNLP generate analog)."""
+
+    def _model(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(9)
+        cfg = llama_tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_greedy_matches_full_forward(self):
+        m, cfg = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (2, 8)).astype("int32"))
+        out = m.generate(ids, max_new_tokens=3)
+        assert out.shape == [2, 11]
+        # the first generated token must equal the argmax of the full
+        # (no-cache) forward at the last prompt position
+        logits = m(ids).numpy()
+        np.testing.assert_array_equal(out.numpy()[:, 8],
+                                      logits[:, -1].argmax(-1))
+        # and the second token must match a full forward over prompt+1
+        ext = paddle.to_tensor(out.numpy()[:, :9].astype("int32"))
+        logits2 = m(ext).numpy()
+        np.testing.assert_array_equal(out.numpy()[:, 9],
+                                      logits2[:, -1].argmax(-1))
+
+    def test_eos_early_stop(self):
+        m, cfg = self._model()
+        ids = paddle.to_tensor(np.zeros((1, 4), "int32"))
+        first = int(m.generate(ids, max_new_tokens=1).numpy()[0, -1])
+        out = m.generate(ids, max_new_tokens=16, eos_token_id=first)
+        assert out.shape[1] == 5  # stopped right after the eos token
+        assert (out.numpy()[0, 4:] == first).all()
+
+    def test_sampling_seeded(self):
+        m, cfg = self._model()
+        ids = paddle.to_tensor(np.zeros((1, 4), "int32"))
+        a = m.generate(ids, max_new_tokens=5, do_sample=True,
+                       temperature=1.5, top_k=20, top_p=0.9, seed=3)
+        b = m.generate(ids, max_new_tokens=5, do_sample=True,
+                       temperature=1.5, top_k=20, top_p=0.9, seed=3)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
