@@ -5,6 +5,7 @@ from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from .fleet import Fleet, fleet_singleton as _fleet  # noqa: F401
 from . import utils  # noqa: F401
+from . import meta_optimizers  # noqa: F401
 
 
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):

@@ -1,0 +1,20 @@
+"""Dygraph meta-optimizers with real TPU-native implementations.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ — the strategy-
+driven program rewriters. On TPU the two that change *optimization
+semantics* (not just communication scheduling) are implemented for real:
+
+- ``DGCMomentumOptimizer`` — top-k gradient sparsification with error
+  feedback (`dgc.py`).
+- ``LocalSGD`` — k local steps per dp replica + compiled parameter
+  averaging (`localsgd.py`).
+
+The purely communication-scheduling ones (fuse_all_reduce, raw_program,
+gradient_merge insertion) are XLA's job or live in
+``meta_parallel.hybrid_parallel_optimizer``.
+"""
+
+from .dgc import DGCMomentumOptimizer
+from .localsgd import LocalSGD
+
+__all__ = ["DGCMomentumOptimizer", "LocalSGD"]

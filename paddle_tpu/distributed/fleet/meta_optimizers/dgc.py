@@ -1,0 +1,138 @@
+"""Deep Gradient Compression momentum optimizer.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/dgc_optimizer.py
+(DGCMomentumOptimizer, sparsity rampup at :66-101) and the C++ op
+paddle/fluid/operators/dgc_op.cc (momentum correction + error feedback:
+u = m*u + g; v = v + u; communicate top-k of v; clear communicated slots).
+
+TPU-native design
+-----------------
+The reference sparsifies so the NCCL allreduce moves only top-k values over
+a bandwidth-limited interconnect. XLA collectives over ICI are dense — there
+is no sparse-allreduce payload to shrink — so what matters for parity is the
+*optimization algorithm*: momentum-corrected top-k selection with error
+feedback (the residual of unsent gradient mass accumulates locally and is
+never lost). That algorithm changes convergence behaviour and is implemented
+here exactly; the communicated tensor stays dense (masked), which under SPMD
+data parallelism is summed across the dp axis by the usual compiled
+allreduce. Selection uses a quantile threshold on |v| (the paper's sampled
+top-k estimator; the reference's dgc_op samples 1/1000 of the tensor for the
+same reason).
+
+The whole-model update is one jitted pytree function, matching the style of
+``paddle_tpu.optimizer.optimizers``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Optimizer
+from ....optimizer.optimizers import _f32
+
+__all__ = ["DGCMomentumOptimizer"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3), static_argnums=(7,))
+def _dgc_update(params, grads, us, vs, lr, mu, sparsity, use_nesterov, wds):
+    """One post-rampup DGC step for every parameter.
+
+    u: momentum-corrected accumulator, v: error-feedback residual
+    (dgc_kernel.cu:154-173; the external dgc lib's ``k_select`` then zeroes
+    the sent slots of both — momentum factor masking). The post-rampup
+    parameter update is plain SGD on the communicated values, exactly as
+    ``dgc_momentum_kernel_impl.h`` switches MomentumOp → SGDOp.
+    """
+
+    def upd(p, g, u, v, wd):
+        gf = _f32(g) + wd * _f32(p)
+        if use_nesterov:
+            u_new = mu * (u + gf)                # u = m*(u + g)
+            v_new = v + u_new + gf               # v = v + u + g
+        else:
+            u_new = mu * u + gf                  # momentum correction
+            v_new = v + u_new                    # accumulate into residual
+        av = jnp.abs(v_new).ravel()
+        # threshold s.t. ~(1-sparsity) of entries are communicated; like the
+        # dgc lib's k_select, estimate it from a sample instead of a full
+        # sort once tensors get large (the lib samples ~1/1000)
+        if av.size > 16384:
+            av = av[:: av.size // 4096]
+        thr = jnp.quantile(av, sparsity)
+        mask = jnp.abs(v_new) >= thr
+        comm = jnp.where(mask, v_new, 0.0)       # the "sent" gradient
+        v_out = jnp.where(mask, 0.0, v_new)      # error feedback: unsent mass
+        u_out = jnp.where(mask, 0.0, u_new)      # momentum factor masking
+        new_p = (_f32(p) - lr * comm).astype(p.dtype)
+        return new_p, u_out, v_out
+
+    out = jax.tree.map(upd, params, grads, us, vs, wds)
+    leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+    return (jax.tree.map(lambda t: t[0], out, is_leaf=leaf),
+            jax.tree.map(lambda t: t[1], out, is_leaf=leaf),
+            jax.tree.map(lambda t: t[2], out, is_leaf=leaf))
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Momentum SGD with DGC top-k sparsification + error feedback.
+
+    Args mirror the reference DGCMomentumOptimizer: before
+    ``rampup_begin_step`` it is exact momentum SGD; across ``rampup_step``
+    steps sparsity walks through ``sparsity`` (e.g. the paper's
+    [0.75, 0.9375, 0.984375, 0.996, 0.999]); afterwards the final value
+    holds.
+    """
+
+    _opt_name = "dgc_momentum"
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = list(sparsity)
+        self._use_nesterov = use_nesterov
+
+    def current_sparsity(self) -> float:
+        """Sparsity in effect for the upcoming step (reference :66-101)."""
+        step = self._global_step
+        if step < self._rampup_begin_step:
+            return 0.0
+        i = (step - self._rampup_begin_step) * len(self._sparsity) \
+            // self._rampup_step
+        return self._sparsity[min(i, len(self._sparsity) - 1)]
+
+    def _apply(self, params_grads):
+        from ....optimizer.optimizers import _momentum_update
+
+        params = [p._data for p, _ in params_grads]
+        grads = [g._data for _, g in params_grads]
+        wds = [self._weight_decay_value(p) for p, _ in params_grads]
+        lr = jnp.float32(self.get_lr())
+        sp = self.current_sparsity()
+        if sp <= 0.0:
+            # pre-rampup: exact momentum SGD (dgc_momentum_kernel_impl.h
+            # runs MomentumOp while current_step < rampup_begin_step)
+            vels = [self._acc("velocity", p) for p, _ in params_grads]
+            new_p, new_v = _momentum_update(
+                params, grads, vels, lr, jnp.float32(self._momentum),
+                self._use_nesterov, wds)
+            for (p, _), arr, v in zip(params_grads, new_p, new_v):
+                p._rebind(arr)
+                self._set_acc("velocity", p, v)
+            return
+        us = [self._acc("dgc_u", p) for p, _ in params_grads]
+        vs = [self._acc("dgc_v", p) for p, _ in params_grads]
+        new_p, new_u, new_v = _dgc_update(
+            params, grads, us, vs, lr, jnp.float32(self._momentum),
+            jnp.float32(sp), self._use_nesterov, wds)
+        for (p, _), arr, u, v in zip(params_grads, new_p, new_u, new_v):
+            p._rebind(arr)
+            self._set_acc("dgc_u", p, u)
+            self._set_acc("dgc_v", p, v)

@@ -31,19 +31,23 @@ class HybridParallelOptimizer:
       before the inner optimizer applies (grads accumulate in ``.grad`` by
       construction; the wrapper just defers/averages the apply) — the
       dygraph analog of the reference's gradient_merge meta-optimizer.
-    - ``dgc`` / ``localsgd`` / ``a_sync``: communication-compression and
-      async tricks for bandwidth-starved clusters; on ICI with XLA-scheduled
-      collectives they don't apply — warn loudly instead of silently
-      ignoring.
+    - ``dgc``: the inner Momentum optimizer is swapped for
+      ``fleet.meta_optimizers.DGCMomentumOptimizer`` — real top-k
+      sparsification with error feedback (matching the reference's
+      dgc_optimizer.py wrapping rule: DGC applies to Momentum only).
+    - ``localsgd``: divergent per-replica parameters don't exist in the
+      eager SPMD path (parameters are one logical array); the real
+      implementation is the compiled ``fleet.meta_optimizers.LocalSGD``
+      stepper — point the user there instead of silently ignoring.
+    - ``a_sync``: async PS training; on TPU the PS analog
+      (``distributed.ps.SparseEmbedding``) is synchronous by construction —
+      warn.
     """
 
     def __init__(self, optimizer, hcg, strategy):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
-        if optimizer._grad_clip is not None and hcg is not None:
-            optimizer._grad_clip = HybridParallelClipGrad(
-                optimizer._grad_clip, hcg)
         self._gm_steps = 0
         self._gm_k = 1
         if strategy is not None:
@@ -53,13 +57,43 @@ class HybridParallelOptimizer:
                 self._gm_avg = bool(cfg.get("avg", True))
             import warnings
 
-            for toggle in ("dgc", "localsgd", "a_sync"):
-                if getattr(strategy, toggle, False):
+            if getattr(strategy, "dgc", False):
+                from ...optimizer.optimizers import Momentum
+                if isinstance(optimizer, Momentum):
+                    from ..fleet.meta_optimizers import DGCMomentumOptimizer
+                    cfg = getattr(strategy, "dgc_configs",
+                                  {}) or {}
+                    dgc = DGCMomentumOptimizer(
+                        learning_rate=optimizer._learning_rate,
+                        momentum=optimizer._momentum,
+                        parameters=optimizer._parameter_list,
+                        rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                        rampup_step=cfg.get("rampup_step", 1),
+                        sparsity=cfg.get("sparsity", (0.999,)),
+                        use_nesterov=optimizer._use_nesterov,
+                        weight_decay=optimizer.regularization,
+                        grad_clip=optimizer._grad_clip)
+                    self._inner_opt = optimizer = dgc
+                else:
                     warnings.warn(
-                        f"DistributedStrategy.{toggle} targets "
-                        "bandwidth-limited NCCL/PS clusters; on TPU the "
-                        "XLA-scheduled ICI collectives make it moot — "
-                        "ignored", stacklevel=3)
+                        "DistributedStrategy.dgc applies to Momentum only "
+                        "(reference dgc_optimizer.py same rule) — ignored",
+                        stacklevel=3)
+            if getattr(strategy, "localsgd", False):
+                warnings.warn(
+                    "DistributedStrategy.localsgd: the eager SPMD path has "
+                    "one logical parameter copy, so per-replica local steps "
+                    "don't arise here; use the compiled "
+                    "paddle.distributed.fleet.meta_optimizers.LocalSGD "
+                    "stepper for real LocalSGD semantics", stacklevel=3)
+            if getattr(strategy, "a_sync", False):
+                warnings.warn(
+                    "DistributedStrategy.a_sync targets async parameter "
+                    "servers; the TPU PS analog is synchronous — ignored",
+                    stacklevel=3)
+        if optimizer._grad_clip is not None and hcg is not None:
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
