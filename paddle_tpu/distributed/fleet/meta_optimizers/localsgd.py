@@ -53,6 +53,14 @@ class LocalSGD:
         self.lr = float(learning_rate)
         self.n_replicas = mesh.shape[axis]
 
+    @classmethod
+    def from_strategy(cls, strategy, mesh, axis="dp", learning_rate=0.01):
+        """Build from ``DistributedStrategy.localsgd_configs`` (reference
+        localsgd_optimizer.py reads k_steps the same way)."""
+        cfg = getattr(strategy, "localsgd_configs", None) or {}
+        return cls(mesh, axis=axis, k_steps=cfg.get("k_steps", 1),
+                   learning_rate=learning_rate)
+
     def replicate(self, params):
         """Broadcast a params pytree to the stacked [R, ...] layout, sharded
         over the dp axis (every replica starts from the same point, as the

@@ -83,9 +83,10 @@ class HybridParallelOptimizer:
                 warnings.warn(
                     "DistributedStrategy.localsgd: the eager SPMD path has "
                     "one logical parameter copy, so per-replica local steps "
-                    "don't arise here; use the compiled "
-                    "paddle.distributed.fleet.meta_optimizers.LocalSGD "
-                    "stepper for real LocalSGD semantics", stacklevel=3)
+                    "don't arise here; use paddle.distributed.fleet."
+                    "meta_optimizers.LocalSGD.from_strategy(strategy, mesh) "
+                    "(consumes localsgd_configs) for real LocalSGD "
+                    "semantics", stacklevel=3)
             if getattr(strategy, "a_sync", False):
                 warnings.warn(
                     "DistributedStrategy.a_sync targets async parameter "

@@ -8,7 +8,8 @@ train step and (distributed) the sparse all-to-all MoE layer.
 from .fused_train_step import FusedTrainStep, fused_train_step  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
+from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 
-__all__ = ["FusedTrainStep", "fused_train_step", "asp", "autotune",
+__all__ = ["FusedTrainStep", "fused_train_step", "asp", "autotune", "nn",
            "optimizer"]

@@ -1,0 +1,9 @@
+"""paddle.incubate.nn — fused-op layer APIs.
+
+Reference: python/paddle/incubate/nn/ (FusedMultiHeadAttention,
+FusedFeedForward layer wrappers over the fused CUDA ops). Here the
+functional namespace maps onto the Pallas kernel suite (ops/pallas/)."""
+
+from . import functional  # noqa: F401
+
+__all__ = ["functional"]

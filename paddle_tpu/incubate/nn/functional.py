@@ -1,0 +1,111 @@
+"""paddle.incubate.nn.functional — fused functional ops.
+
+Reference: python/paddle/incubate/nn/functional/fused_rms_norm.py:21 and
+fused_layer_norm.py:21 (CUDA kernels supporting the
+``norm(bias + residual + x)`` fused pattern, returning
+``(out, residual_out)`` when a residual is passed). TPU-native: routes to
+the Pallas fused resid-add+norm kernels (ops/pallas/rms_norm.py) when the
+shape contract holds, else to the XLA composition — calling this API is
+itself the opt-in, no env flag needed. The int8 quant epilogue arguments
+are not supported (quantization lives in paddle.quantization)."""
+
+from __future__ import annotations
+
+__all__ = ["fused_rms_norm", "fused_layer_norm"]
+
+
+def _fusable(x, begin_norm_axis, *extras):
+    ndim = len(x.shape)
+    if begin_norm_axis not in (ndim - 1, -1):
+        return False
+    if x.shape[-1] % 128 != 0:
+        return False
+    return all(e is None for e in extras)
+
+
+def _norm_ndims(x, begin_norm_axis):
+    """Number of trailing dims the norm statistics cover."""
+    ndim = len(x.shape)
+    if begin_norm_axis < 0:
+        begin_norm_axis += ndim
+    return ndim - begin_norm_axis
+
+
+def _flat_norm(norm_fn, x, begin_norm_axis):
+    """Apply a last-dim norm over the flattened trailing dims selected by
+    begin_norm_axis (the reference normalizes x[begin_norm_axis:] as one
+    flattened axis), restoring the original shape."""
+    nd = _norm_ndims(x, begin_norm_axis)
+    if nd == 1:
+        return norm_fn(x)
+    shape = list(x.shape)
+    flat = x.reshape(shape[:len(shape) - nd] + [-1])
+    return norm_fn(flat).reshape(shape)
+
+
+def _check_quant(quant_scale):
+    if quant_scale != -1:
+        raise NotImplementedError(
+            "quantized fused norm is not supported on TPU; use "
+            "paddle.quantization for int8 paths")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                   bias=None, residual=None, quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    """RMSNorm(bias + residual + x) * norm_weight (+ norm_bias).
+
+    Returns ``(out, residual_out)`` when ``residual`` is given (the fused
+    pattern), else ``out`` — matching the reference return convention
+    (fused_rms_norm.py:95).
+    """
+    _check_quant(quant_scale)
+    import paddle_tpu.nn.functional as F
+
+    if residual is not None:
+        branch = x if bias is None else x + bias
+        if _fusable(x, begin_norm_axis, norm_bias):
+            from ...ops.pallas.rms_norm import fused_add_rms_norm
+
+            out, resid = fused_add_rms_norm(residual, branch, norm_weight,
+                                            epsilon=epsilon)
+            return out, resid
+        resid = residual + branch
+        out = _flat_norm(lambda t: F.rms_norm(t, norm_weight, epsilon),
+                         resid, begin_norm_axis)
+        if norm_bias is not None:
+            out = out + norm_bias
+        return out, resid
+    pre = x if bias is None else x + bias
+    out = _flat_norm(lambda t: F.rms_norm(t, norm_weight, epsilon),
+                     pre, begin_norm_axis)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                     bias=None, residual=None, quant_scale=-1,
+                     quant_round_type=0, quant_max_bound=0,
+                     quant_min_bound=0):
+    """LayerNorm(bias + residual + x); same conventions as
+    :func:`fused_rms_norm` (reference fused_layer_norm.py:21)."""
+    _check_quant(quant_scale)
+    import paddle_tpu.nn.functional as F
+
+    def ln(t):
+        return F.layer_norm(t, [t.shape[-1]], norm_weight, norm_bias,
+                            epsilon)
+
+    if residual is not None:
+        branch = x if bias is None else x + bias
+        if _fusable(x, begin_norm_axis) and norm_bias is not None:
+            from ...ops.pallas.rms_norm import fused_add_layer_norm
+
+            out, resid = fused_add_layer_norm(residual, branch, norm_weight,
+                                              norm_bias, epsilon=epsilon)
+            return out, resid
+        resid = residual + branch
+        return _flat_norm(ln, resid, begin_norm_axis), resid
+    pre = x if bias is None else x + bias
+    return _flat_norm(ln, pre, begin_norm_axis)

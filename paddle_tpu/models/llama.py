@@ -224,10 +224,16 @@ def _moe_topk_capacity(x, logits, gate_w, up_w, down_w, top_k=2,
     cap = moe_capacity(b * s, e, top_k, capacity_factor)
     ei, si, keep, w, aux = top_k_capacity_gating(probs, top_k, cap)
     expert_in = dispatch_to_experts(xf, ei, si, keep, e, cap)
-    hidden = jnp.einsum("ech,ehi->eci", expert_in, gate_w)
-    hidden = jax.nn.silu(hidden) * jnp.einsum("ech,ehi->eci", expert_in,
-                                              up_w)
-    expert_out = jnp.einsum("eci,eih->ech", hidden, down_w)
+    from ..ops.pallas.moe_ffn import (
+        moe_expert_ffn, moe_ffn_shapes_ok, use_fused_moe_ffn)
+
+    if use_fused_moe_ffn() and moe_ffn_shapes_ok(h, gate_w.shape[-1]):
+        expert_out = moe_expert_ffn(expert_in, gate_w, up_w, down_w)
+    else:
+        hidden = jnp.einsum("ech,ehi->eci", expert_in, gate_w)
+        hidden = jax.nn.silu(hidden) * jnp.einsum("ech,ehi->eci", expert_in,
+                                                  up_w)
+        expert_out = jnp.einsum("eci,eih->ech", hidden, down_w)
     out = combine_from_experts(expert_out, ei, si, keep, w)
     return out.reshape(b, s, h), aux
 
@@ -273,6 +279,7 @@ class LlamaDecoderLayer(Layer):
         use_moe = (config.num_experts > 0
                    and layer_idx % config.moe_every == config.moe_every - 1)
         self.mlp = LlamaMoE(config) if use_moe else LlamaMLP(config)
+        self._fusable_norm = config.hidden_size % 128 == 0
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None):
         if cache is not None:
@@ -289,6 +296,16 @@ class LlamaDecoderLayer(Layer):
                                                        attn_mask)
         if attn_out is None:
             attn_out = self.self_attn(h, cos, sin, attn_mask)
+        from ..ops.pallas.rms_norm import (
+            fused_add_rms_norm,
+            use_fused_rms_norm,
+        )
+
+        if use_fused_rms_norm() and self._fusable_norm:
+            ln = self.post_attention_layernorm
+            n2, resid = fused_add_rms_norm(x, attn_out, ln.weight,
+                                           epsilon=ln._epsilon)
+            return resid + self.mlp(n2)
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x

@@ -120,6 +120,22 @@ class TransformerEncoderLayer(Layer):
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
         self.activation = getattr(F, activation)
+        self._fusable_norm = d_model % 128 == 0
+
+    def _add_norm(self, residual, branch, norm):
+        """Post-norm epilogue ``norm(residual + branch)``; routes to the
+        fused Pallas kernel (resid-add + LN in one HBM pass) when enabled."""
+        from ...ops.pallas.rms_norm import (
+            fused_add_layer_norm,
+            use_fused_rms_norm,
+        )
+
+        if (use_fused_rms_norm() and self._fusable_norm
+                and norm.weight is not None and norm.bias is not None):
+            out, _ = fused_add_layer_norm(residual, branch, norm.weight,
+                                          norm.bias, epsilon=norm._epsilon)
+            return out
+        return norm(residual + branch)
 
     def forward(self, src, src_mask=None, cache=None):
         residual = src
@@ -130,16 +146,18 @@ class TransformerEncoderLayer(Layer):
         else:
             src, incremental_cache = self.self_attn(src, src, src, src_mask,
                                                     cache)
-        src = residual + self.dropout1(src)
         if not self.normalize_before:
-            src = self.norm1(src)
+            src = self._add_norm(residual, self.dropout1(src), self.norm1)
+        else:
+            src = residual + self.dropout1(src)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
         if not self.normalize_before:
-            src = self.norm2(src)
+            src = self._add_norm(residual, self.dropout2(src), self.norm2)
+        else:
+            src = residual + self.dropout2(src)
         return src if cache is None else (src, incremental_cache)
 
     def gen_cache(self, src):

@@ -1,4 +1,10 @@
 """Hand-written Pallas TPU kernels for the ops where XLA fusion isn't enough
 — the TPU-native replacement for the reference's fused CUDA ops
 (paddle/fluid/operators/fused/, paddle/phi/kernels/fusion/,
-third_party/flashattn)."""
+third_party/flashattn).
+
+Kernels: flash_attention (plain + rope-fused), rms_norm (fused
+residual-add + RMSNorm), moe_ffn (blockwise SwiGLU expert FFN). Each is
+parity-tested in interpret mode (tests/test_pallas_*.py) and gated by an
+opt-in env flag until an end-to-end win is measured on real hardware
+(PERF.md records every verdict)."""

@@ -40,14 +40,18 @@ def _interpret():
     return os.environ.get("PT_PALLAS_INTERPRET", "0") == "1"
 
 
+def _pick_block(env_var, default, extent, floor=1):
+    """Largest size <= min(env override, default) that divides ``extent``
+    (halving search), clamped to ``floor``. Shared by all Pallas modules."""
+    b = min(int(os.environ.get(env_var, default)), extent)
+    while extent % b:
+        b //= 2
+    return max(b, floor)
+
+
 def _block_sizes(seq_q, seq_k):
-    bq = min(int(os.environ.get("PT_FA_BQ", 512)), seq_q)
-    bk = min(int(os.environ.get("PT_FA_BK", 512)), seq_k)
-    while seq_q % bq:
-        bq //= 2
-    while seq_k % bk:
-        bk //= 2
-    return max(bq, 8), bk
+    return (_pick_block("PT_FA_BQ", 512, seq_q, floor=8),
+            _pick_block("PT_FA_BK", 512, seq_k))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,82 @@
+"""A/B the blockwise MoE expert-FFN Pallas kernel (ops/pallas/moe_ffn.py)
+against the einsum composition, end-to-end on the real chip.
+
+Same methodology as bench.py / PERF.md: full compiled train step, warmup,
+~steps*bs tokens of queued device work per measurement, forced final fetch.
+The flag is read at trace time, so each arm builds (and jits) its own step.
+
+Run: python scripts/bench_moe_ffn.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(cfg):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_of(out):
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    return paddle.incubate.fused_train_step(model, opt, loss_fn=loss_of)
+
+
+def measure(step, make_batch, bs, steps=12, warmup=3):
+    batch = make_batch(bs)
+    loss = None
+    for _ in range(warmup):
+        loss = step(*batch)
+    float(loss.numpy())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*batch)
+    float(loss.numpy())
+    return bs * steps / (time.perf_counter() - t0)
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig
+
+    np.random.seed(0)
+    cfg = LlamaConfig(hidden_size=768, intermediate_size=2048,
+                      num_hidden_layers=8, num_attention_heads=12,
+                      num_key_value_heads=12, vocab_size=32000,
+                      max_position_embeddings=1024,
+                      num_experts=8, num_experts_per_tok=2, moe_every=2)
+    bs, seq = 16, 1024
+
+    def make_batch(b):
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (b, seq)).astype(np.int32))
+        labels = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (b, seq)).astype(np.int32))
+        return ids, labels
+
+    results = {}
+    for name, flag in (("einsum", "0"), ("pallas", "1")):
+        os.environ["PT_FUSED_MOE"] = flag
+        step = build_step(cfg)
+        sps = measure(step, make_batch, bs)
+        results[name] = sps * seq
+        print(f"{name}: {sps * seq:,.0f} tok/s")
+        del step
+    ratio = results["pallas"] / results["einsum"]
+    print(f"pallas/einsum = {ratio:.3f}")
+
+
+if __name__ == "__main__":
+    main()

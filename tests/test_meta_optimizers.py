@@ -192,6 +192,16 @@ class TestLocalSGD:
         for rep in range(1, r):
             np.testing.assert_allclose(sw[0], sw[rep], rtol=1e-5, atol=1e-6)
 
+    def test_from_strategy_consumes_configs(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 8}
+        stepper = LocalSGD.from_strategy(strategy, self._mesh(),
+                                         learning_rate=0.2)
+        assert stepper.k_steps == 8 and stepper.lr == 0.2
+
     def test_localsgd_strategy_warns_with_pointer(self):
         import paddle_tpu.distributed.fleet as fleet
         from paddle_tpu.distributed.meta_parallel.hybrid_parallel_optimizer import (  # noqa: E501

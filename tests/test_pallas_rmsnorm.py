@@ -1,0 +1,232 @@
+"""Fused residual-add + RMSNorm Pallas kernel parity tests (interpret mode).
+
+Reference analog: paddle/phi/kernels/gpu/rms_norm_kernel.cu exposed via
+paddle.incubate.nn.functional.fused_rms_norm (residual variant). Parity is
+checked against the unfused jnp composition (add, then ops/math rms_norm)
+for forward AND backward, in f32 and bf16, plus the Tensor-level dispatch
+path and the Llama decoder-layer wiring behind PT_FUSED_NORM=1.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.rms_norm import (
+    _fused_add_rms_norm_nd,
+    fused_add_rms_norm,
+    use_fused_rms_norm,
+)
+
+ROWS, H = 64, 256
+EPS = 1e-5
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("PT_PALLAS_INTERPRET", "1")
+    yield
+
+
+def _ref(x, y, w, eps=EPS):
+    r = (x.astype(jnp.float32) + y.astype(jnp.float32)).astype(x.dtype)
+    rf = r.astype(jnp.float32)
+    ms = jnp.mean(rf * rf, axis=-1, keepdims=True)
+    out = (rf * jax.lax.rsqrt(ms + eps) * w.astype(jnp.float32)).astype(
+        x.dtype)
+    return out, r
+
+
+def _data(dtype=np.float32, lead=(ROWS,)):
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(*lead, H).astype(np.float32)).astype(dtype)
+    y = jnp.asarray(rng.randn(*lead, H).astype(np.float32)).astype(dtype)
+    w = jnp.asarray(1.0 + 0.1 * rng.randn(H).astype(np.float32)).astype(dtype)
+    return x, y, w
+
+
+class TestFusedAddRMSNormParity:
+    @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+    def test_fwd(self, dtype):
+        x, y, w = _data(dtype)
+        out, r = _fused_add_rms_norm_nd(x, y, w, EPS)
+        ref_out, ref_r = _ref(x, y, w)
+        tol = 1e-6 if dtype == np.float32 else 2e-2
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref_out, np.float32),
+                                   rtol=tol, atol=tol)
+        np.testing.assert_allclose(np.asarray(r, np.float32),
+                                   np.asarray(ref_r, np.float32),
+                                   rtol=tol, atol=tol)
+
+    def test_fwd_3d_batch(self):
+        x, y, w = _data(np.float32, lead=(4, 32))
+        out, r = _fused_add_rms_norm_nd(x, y, w, EPS)
+        ref_out, ref_r = _ref(x, y, w)
+        assert out.shape == (4, 32, H)
+        np.testing.assert_allclose(out, ref_out, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(r, ref_r, rtol=1e-6, atol=1e-6)
+
+    def test_bwd_matches_unfused(self):
+        x, y, w = _data(np.float32)
+
+        def loss_fused(x, y, w):
+            out, r = _fused_add_rms_norm_nd(x, y, w, EPS)
+            # use both outputs so both cotangents flow
+            return jnp.sum(out * jnp.cos(out)) + 0.5 * jnp.sum(r ** 2)
+
+        def loss_ref(x, y, w):
+            out, r = _ref(x, y, w)
+            return jnp.sum(out * jnp.cos(out)) + 0.5 * jnp.sum(r ** 2)
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, y, w)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, y, w)
+        for a, b, name in zip(gf, gr, "xyw"):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"grad wrt {name}")
+
+    def test_tensor_dispatch_path(self):
+        import paddle_tpu as paddle
+
+        x, y, w = _data(np.float32)
+        tx = paddle.to_tensor(np.asarray(x))
+        ty = paddle.to_tensor(np.asarray(y))
+        tw = paddle.to_tensor(np.asarray(w))
+        tx.stop_gradient = False
+        out, r = fused_add_rms_norm(tx, ty, tw, epsilon=EPS)
+        ref_out, ref_r = _ref(x, y, w)
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-6, atol=1e-6)
+        loss = (out * out).sum() + (r * r).sum()
+        loss.backward()
+        assert tx.grad is not None and tx.grad.shape == tx.shape
+
+
+class TestLlamaWiring:
+    def test_decoder_layer_fused_matches_unfused(self, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaDecoderLayer
+        from paddle_tpu.models.llama import _rope_cache
+
+        cfg = LlamaConfig(hidden_size=128, intermediate_size=256,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          num_hidden_layers=1, vocab_size=64,
+                          max_position_embeddings=64)
+        paddle.seed(7)
+        layer = LlamaDecoderLayer(cfg)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 16, 128).astype(np.float32))
+        cos, sin = _rope_cache(16, cfg.head_dim, cfg.rope_theta)
+
+        monkeypatch.setenv("PT_FUSED_NORM", "0")
+        base = layer(x, cos, sin).numpy()
+        monkeypatch.setenv("PT_FUSED_NORM", "1")
+        assert use_fused_rms_norm()
+        fused = layer(x, cos, sin).numpy()
+        np.testing.assert_allclose(fused, base, rtol=1e-5, atol=1e-5)
+
+
+class TestFusedAddLayerNorm:
+    def test_fwd_bwd_parity(self):
+        from paddle_tpu.ops.pallas.rms_norm import _fused_add_layer_norm_nd
+
+        x, y, w = _data(np.float32)
+        b = jnp.asarray(
+            0.1 * np.random.RandomState(9).randn(H).astype(np.float32))
+
+        def ref(x, y, w, b):
+            r = x + y
+            mu = jnp.mean(r, axis=-1, keepdims=True)
+            var = jnp.mean((r - mu) ** 2, axis=-1, keepdims=True)
+            return (r - mu) * jax.lax.rsqrt(var + EPS) * w + b, r
+
+        out, r = _fused_add_layer_norm_nd(x, y, w, b, EPS)
+        ref_out, ref_r = ref(x, y, w, b)
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r, ref_r, rtol=1e-6, atol=1e-6)
+
+        def loss_k(x, y, w, b):
+            o, rr = _fused_add_layer_norm_nd(x, y, w, b, EPS)
+            return jnp.sum(jnp.sin(o)) + jnp.sum(rr ** 2)
+
+        def loss_r(x, y, w, b):
+            o, rr = ref(x, y, w, b)
+            return jnp.sum(jnp.sin(o)) + jnp.sum(rr ** 2)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(x, y, w, b)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(x, y, w, b)
+        for a, bb, name in zip(gk, gr, ["x", "y", "w", "b"]):
+            np.testing.assert_allclose(a, bb, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"grad wrt {name}")
+
+    def test_incubate_functional_facade(self):
+        """paddle.incubate.nn.functional.fused_rms_norm / fused_layer_norm
+        match the unfused compositions and honor the (out, residual_out)
+        return convention (reference fused_rms_norm.py:95)."""
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(8, H).astype(np.float32))
+        res = paddle.to_tensor(rng.randn(8, H).astype(np.float32))
+        w = paddle.to_tensor(
+            (1.0 + 0.1 * rng.randn(H)).astype(np.float32))
+        b = paddle.to_tensor((0.1 * rng.randn(H)).astype(np.float32))
+
+        out, resid = IF.fused_rms_norm(x, w, None, EPS, 1, residual=res)
+        ref_out, ref_r = _ref(jnp.asarray(res.numpy()),
+                              jnp.asarray(x.numpy()),
+                              jnp.asarray(w.numpy()))
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-6,
+                                   atol=1e-6)
+        np.testing.assert_allclose(resid.numpy(), ref_r, rtol=1e-6,
+                                   atol=1e-6)
+        # no-residual form returns a single tensor
+        single = IF.fused_rms_norm(x, w, None, EPS, 1)
+        assert not isinstance(single, tuple)
+
+        out2, resid2 = IF.fused_layer_norm(x, w, b, EPS, 1, residual=res)
+        rr = res.numpy() + x.numpy()
+        mu = rr.mean(-1, keepdims=True)
+        var = ((rr - mu) ** 2).mean(-1, keepdims=True)
+        ln_ref = (rr - mu) / np.sqrt(var + EPS) * w.numpy() + b.numpy()
+        np.testing.assert_allclose(out2.numpy(), ln_ref, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(resid2.numpy(), rr, rtol=1e-6, atol=1e-6)
+        with pytest.raises(NotImplementedError):
+            IF.fused_rms_norm(x, w, None, EPS, 1, quant_scale=0.5)
+
+    def test_begin_norm_axis_flattens_trailing(self):
+        """begin_norm_axis < ndim-1 normalizes the flattened trailing dims
+        (the reference contract), via the unfused fallback."""
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(6)
+        x3 = rng.randn(4, 8, 32).astype(np.float32)
+        w = np.ones(8 * 32, np.float32)
+        b = np.zeros(8 * 32, np.float32)
+        out = IF.fused_layer_norm(paddle.to_tensor(x3),
+                                  paddle.to_tensor(w), paddle.to_tensor(b),
+                                  1e-5, 1)
+        flat = x3.reshape(4, 8 * 32)
+        mu = flat.mean(-1, keepdims=True)
+        var = ((flat - mu) ** 2).mean(-1, keepdims=True)
+        ref = ((flat - mu) / np.sqrt(var + 1e-5)).reshape(4, 8, 32)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_bert_encoder_fused_matches_unfused(self, monkeypatch):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(13)
+        layer = nn.TransformerEncoderLayer(128, 2, 256, dropout=0.0,
+                                           normalize_before=False)
+        layer.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 16, 128).astype(np.float32))
+        monkeypatch.setenv("PT_FUSED_NORM", "0")
+        base = layer(x).numpy()
+        monkeypatch.setenv("PT_FUSED_NORM", "1")
+        fused = layer(x).numpy()
+        np.testing.assert_allclose(fused, base, rtol=1e-5, atol=1e-5)
