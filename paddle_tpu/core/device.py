@@ -74,3 +74,44 @@ def is_compiled_with_cuda() -> bool:  # API parity; always False on TPU builds
 
 def is_compiled_with_xpu() -> bool:
     return False
+
+
+class _Place:
+    """Reference Place classes (paddle/phi/common/place.h) kept as tags;
+    under XLA, placement is a sharding/device attribute, not an allocator
+    choice. Tensors constructed with any Place land on the default device;
+    CPUPlace additionally pins host-side numpy semantics in io paths."""
+
+    _kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self.device_id})" \
+            if self._kind != "cpu" else "Place(cpu)"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == getattr(other, "device_id", 0))
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+
+class CUDAPlace(_Place):
+    """Accepted for API parity; resolves to the accelerator (TPU) device."""
+
+    _kind = "gpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _kind = "gpu_pinned"
+
+
+class TPUPlace(_Place):
+    _kind = "tpu"

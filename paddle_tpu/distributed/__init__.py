@@ -100,11 +100,20 @@ from .auto_parallel import (  # noqa: E402,F401
     reshard, set_mesh, shard_layer, shard_optimizer, shard_tensor,
     unshard_dtensor,
 )
+from .auto_parallel.placement import Placement  # noqa: E402,F401
 from .parallel import DataParallel  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
 from . import ps  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
+from .collective import destroy_process_group, is_available  # noqa: E402,F401
+from .compat import (  # noqa: E402,F401
+    CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
+    QueueDataset, ReduceType, ShowClickEntry, DistAttr, get_backend,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, split,
+)
+from .dist_model import DistModel, Strategy, to_static  # noqa: E402,F401
+from . import io  # noqa: E402,F401
 
 # paddle code imports meta_parallel via fleet.meta_parallel; alias it
 from . import meta_parallel as _meta_parallel  # noqa: E402

@@ -1,0 +1,281 @@
+"""Long-tail distributed-namespace parity: enums, PS entry configs,
+legacy datasets, split(), process-group introspection, gloo helpers.
+
+Reference sites:
+- ParallelMode: python/paddle/distributed/parallel.py:123
+- entry attrs: python/paddle/distributed/entry_attr.py:61-154
+- InMemoryDataset/QueueDataset: distributed/fleet/dataset/dataset.py:352,1295
+- split: distributed/fleet/layers/mpu/mp_ops.py:700
+- destroy_process_group/is_available/get_backend: distributed/collective.py
+- ReduceType/DistAttr: auto_parallel placement/static dist_attr
+- gloo_*: python/paddle/distributed/parallel_with_gloo.py
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ParallelMode", "ReduceType", "DistAttr", "ProbabilityEntry",
+    "CountFilterEntry", "ShowClickEntry", "InMemoryDataset", "QueueDataset",
+    "split", "destroy_process_group", "is_available", "get_backend",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+]
+
+
+class ParallelMode:
+    """reference parallel.py ParallelMode (int enum constants)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference phi ReduceType used by Partial placements."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Static-graph tensor dist attr (reference
+    auto_parallel/static/dist_attribute; the dynamic path uses
+    placements). Holds (mesh, sharding_specs) — under GSPMD this maps
+    directly onto a NamedSharding."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def to_named_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        jmesh = getattr(self.process_mesh, "jax_mesh", self.process_mesh)
+        assert isinstance(jmesh, jax.sharding.Mesh)
+        return NamedSharding(jmesh, PartitionSpec(*self.sharding_specs))
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+# ---------------------------------------------------------------------------
+# PS sparse-table entry configs (consumed by distributed.ps.SparseEmbedding)
+# ---------------------------------------------------------------------------
+
+class _EntryAttr:
+    def _attr_str(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(_EntryAttr):
+    """Admit a new sparse feature with given probability
+    (entry_attr.py:61)."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _attr_str(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(_EntryAttr):
+    """Admit a sparse feature after it is seen >= count times
+    (entry_attr.py:106)."""
+
+    def __init__(self, count):
+        if count < 0:
+            raise ValueError("count must be >= 0")
+        self._name = "count_filter_entry"
+        self._count = int(count)
+
+    def _attr_str(self):
+        return f"{self._name}:{self._count}"
+
+
+class ShowClickEntry(_EntryAttr):
+    """CTR show/click statistic columns (entry_attr.py:154)."""
+
+    def __init__(self, show_name, click_name):
+        if not (isinstance(show_name, str) and isinstance(click_name, str)):
+            raise ValueError("show/click names must be strings")
+        self._name = "show_click_entry"
+        self._show = show_name
+        self._click = click_name
+
+    def _attr_str(self):
+        return f"{self._name}:{self._show}:{self._click}"
+
+
+# ---------------------------------------------------------------------------
+# legacy PS dataset feeders
+# ---------------------------------------------------------------------------
+
+class _DatasetBase:
+    """File-list dataset with the reference DatasetBase control surface.
+    The reference streams slots through a brpc DataFeed into PS trainers;
+    here files hold numpy-parseable lines and loading is host-side (the
+    TPU path trains from paddle.io.DataLoader — these classes exist for
+    the PaddleRec-style entry points)."""
+
+    def __init__(self):
+        self._filelist = []
+        self._parse_fn = None
+        self._use_var = []
+        self._batch_size = 1
+        self._records = None
+
+    def init(self, batch_size=1, use_var=None, parse_fn=None, **kwargs):
+        self._batch_size = int(batch_size)
+        self._use_var = list(use_var or [])
+        self._parse_fn = parse_fn
+
+    set_batch_size = init
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, use_var):
+        self._use_var = list(use_var)
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    yield (self._parse_fn(line) if self._parse_fn
+                           else line.split())
+
+
+class InMemoryDataset(_DatasetBase):
+    """reference dataset.py:352 — load files to memory, global shuffle,
+    then feed."""
+
+    def load_into_memory(self):
+        self._records = list(self._iter_lines())
+
+    def local_shuffle(self):
+        self._shuffle()
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        # single-controller: global == local
+        self._shuffle()
+
+    def _shuffle(self):
+        import numpy as np
+
+        if self._records is None:
+            raise RuntimeError("call load_into_memory() first")
+        order = np.random.permutation(len(self._records))
+        self._records = [self._records[i] for i in order]
+
+    def get_memory_data_size(self, fleet=None):
+        return 0 if self._records is None else len(self._records)
+
+    def release_memory(self):
+        self._records = None
+
+    def __iter__(self):
+        if self._records is None:
+            raise RuntimeError("call load_into_memory() first")
+        return iter(self._records)
+
+
+class QueueDataset(_DatasetBase):
+    """reference dataset.py:1295 — streaming file reader (no memory
+    residency)."""
+
+    def __iter__(self):
+        return self._iter_lines()
+
+
+# ---------------------------------------------------------------------------
+# split — Megatron-style parallel op builder (mp_ops.py:700)
+# ---------------------------------------------------------------------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Build-and-apply a weight-partitioned embedding/linear.
+
+    The reference constructs c_ops wired to the mp group; here the
+    partitioned layer is one of the meta_parallel mp layers, whose weights
+    shard over the 'mp' mesh axis under GSPMD. Returns the layer output;
+    the constructed layer is attached as ``split.last_layer`` so callers
+    can reach the parameters (the reference's functional form implicitly
+    registers them on the enclosing Layer)."""
+    from .meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+    elif operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        elif axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=bool(gather_out))
+        else:
+            raise ValueError("linear split axis must be 0 or 1")
+    else:
+        raise ValueError(f"unsupported split operation {operation!r}")
+    split.last_layer = layer
+    return layer(x)
+
+
+# ---------------------------------------------------------------------------
+# process-group introspection + gloo host helpers
+# ---------------------------------------------------------------------------
+
+from .collective import destroy_process_group, is_available  # noqa: F401,E402
+
+
+def get_backend(group=None):
+    import jax
+
+    return "xla:" + jax.default_backend()
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Host-side CPU rendezvous (reference parallel_with_gloo.py). The
+    jax.distributed coordination service is the gloo analog; this records
+    the rendezvous env the launcher consumes (initialization itself happens
+    in the launch bootstrap so single-process runs don't block)."""
+    import os
+
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(int(rank_id)),
+        "PADDLE_TRAINERS_NUM": str(int(rank_num)),
+        "PADDLE_MASTER": str(server_endpoint),
+        "MASTER_ADDR": str(server_endpoint).split(":")[0],
+    })
+
+
+def gloo_barrier():
+    from .communication import barrier
+
+    barrier()
+
+
+def gloo_release():
+    return None

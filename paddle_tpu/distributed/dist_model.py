@@ -1,0 +1,87 @@
+"""paddle.distributed.to_static / DistModel / Strategy.
+
+Reference: python/paddle/distributed/auto_parallel/api.py — Strategy :781,
+DistModel :969, to_static :1338. The reference converts a dygraph layer
+with shard_tensor-annotated parameters into a static distributed program;
+here the same contract rides the auto-parallel ``Engine`` (GSPMD compiles
+the whole step, shardings come from the placements already attached to the
+parameters)."""
+
+from __future__ import annotations
+
+from .auto_parallel.engine import Engine
+from .auto_parallel.engine import Strategy as _EngineStrategy
+
+__all__ = ["Strategy", "DistModel", "to_static"]
+
+
+class Strategy(_EngineStrategy):
+    """Parallel/optimization config (reference api.py:781) — same dict
+    surface as the Engine strategy."""
+
+
+class DistModel:
+    """Train/eval/predict facade over the compiled distributed step
+    (reference api.py:969: __call__ dispatches on the current mode)."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._engine = Engine(model=layer, loss=loss, optimizer=optimizer,
+                              metrics=metrics, strategy=strategy)
+        self._mode = "train" if optimizer is not None and loss is not None \
+            else ("eval" if loss is not None else "predict")
+
+    def train(self):
+        self._mode = "train"
+        if hasattr(self.network, "train"):
+            self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        if hasattr(self.network, "eval"):
+            self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        if hasattr(self.network, "eval"):
+            self.network.eval()
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def __call__(self, *args):
+        """One step in the current mode: train -> loss (with parameter
+        update), eval -> loss, predict -> outputs (reference api.py
+        DistModel.__call__)."""
+        eng = self._engine
+        batch = eng._shard_batch(args)
+        if self._mode == "train":
+            return eng._build_step()(*batch)
+        if self._mode == "eval":
+            *ins, label = batch
+            return eng._loss(self.network(*ins), label)
+        return self.network(*batch)
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self.network.set_state_dict(state_dict)
+
+    def dist_main_program(self, mode=None):  # static-graph introspection
+        return None
+
+    def dist_startup_program(self, mode=None):
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Returns (DistModel, loader) like the reference (api.py:1338);
+    the loader passes through — batches are dp-sharded per step by the
+    engine."""
+    dm = DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                   strategy=strategy)
+    return dm, loader

@@ -1,0 +1,51 @@
+"""paddle.distributed.io — persistable save/load for distributed training.
+
+Reference: python/paddle/distributed/io.py (save_persistables :~180,
+load_persistables, is_persistable). The reference walks a static Program's
+persistable vars; here persistables are the model's parameters + buffers,
+and the sharded-checkpoint path (distributed.checkpoint) is the real
+multi-host format — these entry points keep the single-artifact UX.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    """Parameters and registered buffers persist; activations don't."""
+    from ..core.tensor import Parameter, Tensor
+
+    if isinstance(var, Parameter):
+        return True
+    return isinstance(var, Tensor) and getattr(var, "persistable", False)
+
+
+def save_persistables(executor_or_layer, dirname, main_program=None,
+                      filename=None):
+    """Save a layer's persistable state under ``dirname`` (reference
+    io.py save_persistables; executor arg accepted for signature parity —
+    eager mode has no scope to walk, the layer is the source of truth)."""
+    from ..framework.io import save
+
+    layer = main_program if main_program is not None else executor_or_layer
+    if not hasattr(layer, "state_dict"):
+        raise TypeError("save_persistables needs a Layer (or pass it as "
+                        "main_program for reference-signature parity)")
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__persistables__.pdparams")
+    save(layer.state_dict(), path)
+    return path
+
+
+def load_persistables(executor_or_layer, dirname, main_program=None,
+                      filename=None):
+    from ..framework.io import load
+
+    layer = main_program if main_program is not None else executor_or_layer
+    path = os.path.join(dirname, filename or "__persistables__.pdparams")
+    state = load(path)
+    layer.set_state_dict(state)
+    return layer

@@ -405,3 +405,17 @@ def _kl_bernoulli_bernoulli(p, q):
 def _kl_exponential_exponential(p, q):
     r = q.rate / p.rate
     return p.rate.log() - q.rate.log() + r - 1
+
+
+from .extras import (  # noqa: E402,F401
+    Beta, Binomial, Cauchy, ContinuousBernoulli, Dirichlet,
+    ExponentialFamily, Geometric, Independent, LogNormal, Multinomial,
+    MultivariateNormal, Poisson, TransformedDistribution,
+)
+
+__all__ += [
+    "Beta", "Binomial", "Cauchy", "ContinuousBernoulli", "Dirichlet",
+    "ExponentialFamily", "Geometric", "Independent", "LogNormal",
+    "Multinomial", "MultivariateNormal", "Poisson",
+    "TransformedDistribution",
+]

@@ -1,0 +1,490 @@
+"""Long-tail distributions.
+
+Reference: python/paddle/distribution/{beta,cauchy,continuous_bernoulli,
+dirichlet,exponential_family,multinomial,multivariate_normal,independent,
+transformed_distribution,lognormal,geometric,binomial,poisson}.py. Sampling
+rides jax.random; densities are closed-form jnp expressions through the
+dispatch layer so log_prob differentiates.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+from . import Distribution, _key, _t
+
+__all__ = [
+    "Beta", "Cauchy", "ContinuousBernoulli", "Dirichlet",
+    "ExponentialFamily", "Multinomial", "MultivariateNormal", "Independent",
+    "TransformedDistribution", "LogNormal", "Geometric", "Binomial",
+    "Poisson",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family members (reference
+    exponential_family.py): subclasses expose natural parameters and the
+    log-normalizer; entropy falls out via the Bregman identity."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(np.broadcast_shapes(self.alpha.shape,
+                                                   self.beta.shape)))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        tot = self.alpha + self.beta
+        return self.alpha * self.beta / (tot * tot * (tot + 1.0))
+
+    def sample(self, shape=()):
+        a, b = _arr(self.alpha), _arr(self.beta)
+        out = jax.random.beta(_key(), a, b,
+                              shape=tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _t(value)
+        from ..ops import math as m
+
+        lbeta = (m.lgamma(self.alpha) + m.lgamma(self.beta)
+                 - m.lgamma(self.alpha + self.beta))
+        return ((self.alpha - 1.0) * v.log()
+                + (self.beta - 1.0) * (1.0 - v).log() - lbeta)
+
+    def entropy(self):
+        from ..ops import math as m
+
+        a, b = self.alpha, self.beta
+        tot = a + b
+        lbeta = m.lgamma(a) + m.lgamma(b) - m.lgamma(tot)
+        return (lbeta - (a - 1.0) * m.digamma(a) - (b - 1.0) * m.digamma(b)
+                + (tot - 2.0) * m.digamma(tot))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def sample(self, shape=()):
+        eps = jax.random.cauchy(_key(), tuple(shape) + self.batch_shape)
+        return Tensor(_arr(self.loc) + _arr(self.scale) * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(math.pi * self.scale * (1.0 + z * z)).log()
+
+    def entropy(self):
+        return (4.0 * math.pi * self.scale).log()
+
+    def cdf(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return z.atan() / math.pi + 0.5
+
+
+class ContinuousBernoulli(Distribution):
+    """reference continuous_bernoulli.py (Loaiza-Ganem & Cunningham 2019):
+    density C(p) p^x (1-p)^(1-x) on [0,1]; near p=0.5 the normalizer uses
+    its Taylor value log 2 (the exact form is 0/0)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _cut(self):
+        """Push probs inside (lims) to the boundary (reference _cut_probs:
+        only near-0.5 values are degenerate; everything else stays)."""
+        p = _arr(self.probs)
+        lo, hi = self._lims
+        near = (p > lo) & (p < hi)
+        return jnp.where(near, jnp.where(p < 0.5, lo, hi), p)
+
+    def _log_constant(self):
+        p = _arr(self.probs)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)  # away from 0.5 for the exact form
+        exact = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))) \
+            - jnp.log(jnp.abs(1.0 - 2.0 * safe))
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0) * x * x
+        return Tensor(jnp.where(near, taylor, exact))
+
+    @property
+    def mean(self):
+        p = _arr(self.probs)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        exact = safe / (2.0 * safe - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        return Tensor(jnp.where(near, 0.5, exact))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), tuple(shape) + self.batch_shape)
+        p = self._cut()
+        # inverse CDF (reference icdf): handles p != 0.5
+        num = jnp.log1p(u * (2.0 * p - 1.0) / (1.0 - p))
+        out = num / jnp.log(p / (1.0 - p))
+        return Tensor(jnp.clip(out, 0.0, 1.0))
+
+    def log_prob(self, value):
+        v = _t(value)
+        ce = v * self.probs.log() + (1.0 - v) * (1.0 - self.probs).log()
+        return self._log_constant() + ce
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(axis=-1,
+                                                           keepdim=True)
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = a.sum(axis=-1, keepdim=True)
+        return a * (a0 - a) / (a0 * a0 * (a0 + 1.0))
+
+    def sample(self, shape=()):
+        out = jax.random.dirichlet(_key(), _arr(self.concentration),
+                                   shape=tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        from ..ops import math as m
+
+        v = _t(value)
+        a = self.concentration
+        lognorm = m.lgamma(a).sum(axis=-1) - m.lgamma(a.sum(axis=-1))
+        return ((a - 1.0) * v.log()).sum(axis=-1) - lognorm
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         tuple(self.probs.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        p = _arr(self.probs)
+        logits = jnp.log(p)
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        counts = jax.nn.one_hot(draws, p.shape[-1]).sum(axis=0)
+        return Tensor(counts.astype(p.dtype))
+
+    def log_prob(self, value):
+        from ..ops import math as m
+
+        v = _t(value)
+        logf = (m.lgamma(_t(float(self.total_count + 1)))
+                - m.lgamma(v + 1.0).sum(axis=-1))
+        return logf + (v * self.probs.log()).sum(axis=-1)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _t(loc)
+        d = self.loc.shape[-1]
+        if scale_tril is not None:
+            self._tril = _arr(_t(scale_tril))
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_arr(_t(covariance_matrix)))
+        elif precision_matrix is not None:
+            cov = jnp.linalg.inv(_arr(_t(precision_matrix)))
+            self._tril = jnp.linalg.cholesky(cov)
+        else:
+            raise ValueError("one of covariance_matrix/precision_matrix/"
+                             "scale_tril is required")
+        super().__init__(tuple(self.loc.shape[:-1]), (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._tril * self._tril, axis=-1))
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def sample(self, shape=()):
+        d = self.event_shape[0]
+        eps = jax.random.normal(
+            _key(), tuple(shape) + self.batch_shape + (d,))
+        out = _arr(self.loc) + jnp.einsum("...ij,...j->...i", self._tril,
+                                          eps)
+        return Tensor(out)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(_t(value))
+        d = self.event_shape[0]
+        diff = v - _arr(self.loc)
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol * sol, axis=-1)
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(-0.5 * (maha + d * math.log(2 * math.pi))
+                      - logdet)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(0.5 * d * (1.0 + math.log(2 * math.pi)) + logdet)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference
+    independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self._rank],
+                         bs[len(bs) - self._rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self._rank):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        ent = self.base.entropy()
+        for _ in range(self._rank):
+            ent = ent.sum(axis=-1)
+        return ent
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms (reference
+    transformed_distribution.py). Transforms expose forward / inverse /
+    forward_log_det_jacobian."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape) if hasattr(self.base, "rsample") \
+            else self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = _t(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return self.base.log_prob(y) + lp
+
+
+class LogNormal(TransformedDistribution):
+    def __init__(self, loc, scale):
+        from . import Normal
+
+        class _Exp:
+            def forward(self, x):
+                return x.exp()
+
+            def inverse(self, y):
+                return y.log()
+
+            def forward_log_det_jacobian(self, x):
+                return x
+
+        super().__init__(Normal(loc, scale), [_Exp()])
+        self.loc = self.base.loc
+        self.scale = self.base.scale
+
+    @property
+    def mean(self):
+        return (self.loc + 0.5 * self.scale ** 2).exp()
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return (s2.exp() - 1.0) * (2.0 * self.loc + s2).exp()
+
+    def entropy(self):
+        return self.base.entropy() + self.loc
+
+
+class Geometric(Distribution):
+    """Support {0, 1, ...}: failures before the first success (reference
+    geometric.py — mean 1/p - 1)."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.probs - 1.0
+
+    @property
+    def variance(self):
+        return (1.0 / self.probs - 1.0) / self.probs
+
+    @property
+    def stddev(self):
+        return self.variance.sqrt()
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1.0)
+        p = _arr(self.probs)
+        out = jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * (1.0 - self.probs).log() + self.probs.log()
+
+    def pmf(self, k):
+        return self.log_prob(k).exp()
+
+    def entropy(self):
+        p = self.probs
+        q = 1.0 - p
+        return -(q * q.log() + p * p.log()) / p
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        n = jnp.asarray(self.total_count, jnp.float32)
+        out = jax.random.binomial(_key(), n, _arr(self.probs),
+                                  shape=tuple(shape) + self.batch_shape)
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ..ops import math as m
+
+        v = _t(value)
+        n = _t(float(np.asarray(self.total_count)))
+        logc = (m.lgamma(n + 1.0) - m.lgamma(v + 1.0)
+                - m.lgamma(n - v + 1.0))
+        return (logc + v * self.probs.log()
+                + (n - v) * (1.0 - self.probs).log())
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(_key(), _arr(self.rate),
+                                 shape=tuple(shape) + self.batch_shape)
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from ..ops import math as m
+
+        v = _t(value)
+        return v * self.rate.log() - self.rate - m.lgamma(v + 1.0)

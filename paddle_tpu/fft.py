@@ -192,3 +192,45 @@ def ifftshift(x, axes=None, name=None):
             return Tensor._wrap(np.fft.ifftshift(host, axes=axes))
     return _ifftshift(x, axes=None if axes is None else tuple(
         int(a) for a in np.atleast_1d(axes)))
+
+
+def _resolve_axes(x, axes, n_default=2):
+    if axes is None:
+        nd = len(x.shape)
+        return tuple(range(nd - n_default, nd))
+    return tuple(int(a) for a in axes)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a signal hermitian-symmetric along the LAST axis
+    (reference python/paddle/fft.py hfft2): c2c FFT over the leading axis,
+    hermitian c2r over the last — the mirror is only on the final axis, so
+    the composition is exact (norm factors multiply per-axis)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = _resolve_axes(x, axes, n_default=len(x.shape))
+    s_rest = None if s is None else list(s[:-1])
+    y = x
+    if len(axes) > 1:
+        y = fftn(y, s=s_rest, axes=list(axes[:-1]), norm=norm)
+    return hfft(y, n=None if s is None else int(s[-1]), axis=axes[-1],
+                norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = _resolve_axes(x, axes, n_default=len(x.shape))
+    y = ihfft(x, n=None if s is None else int(s[-1]), axis=axes[-1],
+              norm=norm)
+    if len(axes) > 1:
+        s_rest = None if s is None else list(s[:-1])
+        y = ifftn(y, s=s_rest, axes=list(axes[:-1]), norm=norm)
+    return y
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

@@ -1,0 +1,116 @@
+"""paddle.flops + paddle.summary standalone entry points.
+
+Reference: python/paddle/hapi/dynamic_flops.py (hook-based per-layer FLOP
+counting over a dummy forward; flops() at :28) and hapi/model_summary.py
+(summary() at :28). Here the counting hooks ride the existing
+``register_forward_post_hook`` layer machinery; per-op counting beyond the
+registered layer types matches the reference's behavior of counting only
+known layers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["flops", "summary"]
+
+
+def _count_linear(layer, inp, out):
+    batch = int(np.prod(out.shape[:-1]))
+    return batch * layer._in_features * layer._out_features
+
+
+def _count_conv(layer, inp, out):
+    # out elems * kernel volume * cin/groups (MACs)
+    kernel = int(np.prod(layer.weight.shape[2:]))
+    cin = layer.weight.shape[1]  # already cin/groups
+    return int(np.prod(out.shape)) * kernel * cin
+
+
+def _count_norm(layer, inp, out):
+    return 2 * int(np.prod(out.shape))
+
+
+def _layer_flops(layer, inp, out, custom_ops):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvNd
+    from ..nn.layer.norm import BatchNorm2D, GroupNorm, LayerNorm, RMSNorm
+
+    if custom_ops and type(layer) in custom_ops:
+        return int(custom_ops[type(layer)](layer, inp, out))
+    if isinstance(layer, Linear):
+        return _count_linear(layer, inp, out)
+    if isinstance(layer, _ConvNd):
+        return _count_conv(layer, inp, out)
+    if isinstance(layer, (LayerNorm, RMSNorm, GroupNorm, BatchNorm2D)):
+        return _count_norm(layer, inp, out)
+    return 0
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Total multiply-accumulate count of one forward pass.
+
+    ``input_size``: shape list/tuple for a synthetic float32 input, or pass
+    ``inputs`` (a Tensor or tuple of Tensors) directly.
+    """
+    import paddle_tpu as paddle
+
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops() needs input_size or inputs")
+        inputs = paddle.to_tensor(
+            np.zeros(tuple(input_size), np.float32))
+    if not isinstance(inputs, (tuple, list)):
+        inputs = (inputs,)
+
+    total = {"flops": 0}
+    rows = []
+    handles = []
+
+    def make_hook(lyr):
+        def hook(layer, inp, out):
+            first = out[0] if isinstance(out, (tuple, list)) else out
+            n = _layer_flops(layer, inp, first, custom_ops)
+            total["flops"] += n
+            if n and print_detail:
+                rows.append((type(layer).__name__, n))
+            return out
+
+        return hook
+
+    for _, sub in net.named_sublayers():
+        handles.append(sub.register_forward_post_hook(make_hook(sub)))
+    was_training = getattr(net, "training", False)
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        for h in handles:
+            remove = getattr(h, "remove", None)
+            if remove:
+                remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        for name, n in rows:
+            print(f"  {name}: {n:,}")
+        print(f"Total FLOPs (MACs): {total['flops']:,}")
+    return total["flops"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter-count summary (reference hapi/model_summary.py:28)."""
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    lines = [f"{type(net).__name__}:"]
+    for name, sub in net.named_sublayers():
+        cnt = sum(int(np.prod(p.shape))
+                  for p in sub.parameters(include_sublayers=False))
+        if cnt:
+            lines.append(f"  {name} ({type(sub).__name__}): {cnt:,}")
+    lines.append(f"Total params: {n_params:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": n_params, "trainable_params": trainable}

@@ -669,3 +669,56 @@ class DataLoader:
 
     def __call__(self):
         return iter(self)
+
+
+class SubsetRandomSampler(Sampler):
+    """Reference io/sampler.py SubsetRandomSampler."""
+
+    def __init__(self, indices, generator=None):
+        if len(indices) == 0:
+            raise ValueError(
+                "SubsetRandomSampler requires a non-empty indices list")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as np
+
+        from ..core import rng as _rng
+
+        import jax
+
+        seed = int(jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1))
+        order = np.random.RandomState(seed).permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    """Reference io/dataset.py ConcatDataset: map-style concatenation with
+    bisect-based index routing."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        import itertools
+
+        self.cumulative_sizes = list(itertools.accumulate(
+            len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        import bisect
+
+        if idx < 0:
+            idx += len(self)
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = 0 if di == 0 else self.cumulative_sizes[di - 1]
+        return self.datasets[di][idx - prev]
+
+
+__all__ += ["SubsetRandomSampler", "ConcatDataset"]

@@ -185,3 +185,18 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference python/paddle/metric/metrics.py
+    accuracy :~800): input [N, C] scores, label [N, 1] or [N] int ids."""
+    from ..ops.manipulation import topk
+
+    _, pred = topk(input, int(k), axis=-1)
+    lab = label.reshape([-1, 1])
+    hit = (pred.astype("int64") == lab.astype("int64"))
+    acc = hit.astype("float32").sum(axis=-1).mean()
+    return acc
+
+
+__all__.append("accuracy")

@@ -16,4 +16,5 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from . import utils  # noqa: F401

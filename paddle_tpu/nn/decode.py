@@ -1,0 +1,150 @@
+"""Beam-search decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/nn/decode.py (Decoder protocol :40,
+BeamSearchDecoder :121, dynamic_decode :~780). TPU-native shape: the decode
+loop is an eager Python loop over steps (decode lengths are data-dependent;
+the reference's static while_loop form exists for export — here generation
+is the eager/`jit.save` path, same policy as LlamaForCausalLM.generate).
+Beam bookkeeping (top-k over beam*vocab, parent backtrace via
+``F.gather_tree``) is expressed in framework ops so it runs on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn.functional as F
+
+from ..core.tensor import Tensor
+from .. import ops as _ops
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Wraps an RNN cell into a beam-search Decoder.
+
+    cell(inputs, states) -> (outputs, new_states); ``embedding_fn`` maps
+    token ids to cell inputs; ``output_fn`` maps cell outputs to vocab
+    logits (identity if the cell already emits logits).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- reference helpers (decode.py BeamSearchDecoder.tile_beam_merge_...)
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeating each batch row."""
+        reps = [1] * (x.ndim + 1)
+        reps[1] = beam_size
+        tiled = _ops.manipulation.tile(x.unsqueeze(1), reps)
+        return tiled.reshape([-1] + list(x.shape[1:]))
+
+    def _merge(self, x):
+        return x.reshape([-1] + list(x.shape[2:]))
+
+    def _split(self, x):
+        return x.reshape([-1, self.beam_size] + list(x.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        cell_states = initial_cell_states
+        flat = cell_states if isinstance(cell_states, (tuple, list)) \
+            else [cell_states]
+        batch = flat[0].shape[0]
+        k = self.beam_size
+        cell_states = [self.tile_beam_merge_with_batch(s, k) for s in flat]
+        # only beam 0 is live at t=0 (reference kInitialValue -inf trick)
+        lp0 = np.full((batch, k), -1e9, np.float32)
+        lp0[:, 0] = 0.0
+        beam_state = {
+            "cell_states": cell_states,
+            "log_probs": Tensor(lp0),
+            "finished": Tensor(np.zeros((batch, k), np.bool_)),
+            "lengths": Tensor(np.zeros((batch, k), np.int64)),
+        }
+        ids = Tensor(np.full((batch, k), self.start_token, np.int64))
+        return ids, beam_state
+
+    def step(self, time, inputs, states):
+        """inputs: [B, K] token ids -> (beam_ids [B,K], parent_ids [B,K],
+        next_states)."""
+        k = self.beam_size
+        batch = inputs.shape[0]
+        flat_ids = self._merge(inputs)                   # [B*K]
+        cell_in = (self.embedding_fn(flat_ids) if self.embedding_fn
+                   else flat_ids)
+        outputs, next_cell = self.cell(cell_in, states["cell_states"])
+        logits = self.output_fn(outputs) if self.output_fn else outputs
+        vocab = logits.shape[-1]
+        logp = F.log_softmax(logits.astype("float32"), axis=-1)
+        logp = self._split(logp)                         # [B, K, V]
+
+        # finished beams only extend with end_token at prob 0
+        fin = states["finished"]
+        noext = np.full((vocab,), -1e9, np.float32)
+        noext[self.end_token] = 0.0
+        logp = _ops.where(fin.unsqueeze(-1), Tensor(noext), logp)
+
+        total = states["log_probs"].unsqueeze(-1) + logp  # [B, K, V]
+        flat_total = total.reshape([batch, k * vocab])
+        top_v, top_i = _ops.manipulation.topk(flat_total, k, axis=-1)
+        parent = top_i // vocab                          # [B, K]
+        token = top_i % vocab
+
+        # gather beam state by parent
+        def pick(x):
+            xs = self._split(x)                          # [B, K, ...]
+            picked = _ops.manipulation.take_along_axis(
+                xs, parent.reshape([batch, k] + [1] * (xs.ndim - 2))
+                .expand([batch, k] + list(xs.shape[2:])), axis=1)
+            return self._merge(picked)
+
+        next_cell = [pick(s) for s in (next_cell if isinstance(
+            next_cell, (tuple, list)) else [next_cell])]
+        fin_p = _ops.manipulation.take_along_axis(fin, parent, axis=1)
+        len_p = _ops.manipulation.take_along_axis(states["lengths"], parent,
+                                                  axis=1)
+        now_fin = fin_p | (token == self.end_token)
+        new_len = len_p + (~now_fin).astype("int64")
+        next_state = {
+            "cell_states": next_cell,
+            "log_probs": top_v,
+            "finished": now_fin,
+            "lengths": new_len,
+        }
+        return token, parent, next_state
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run the decoder to completion (reference decode.py dynamic_decode).
+
+    Returns (ids, final_states) with ids [B, K, T] (or time-major
+    [T, B, K]); with return_length, appends the per-beam lengths.
+    """
+    max_steps = int(max_step_num or 64)
+    inputs, state = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    for t in range(max_steps):
+        token, parent, state = decoder.step(t, inputs, state)
+        step_ids.append(token)
+        step_parents.append(parent)
+        inputs = token
+        if bool(state["finished"].numpy().all()):
+            break
+    ids = _ops.manipulation.stack(step_ids, axis=0)      # [T, B, K]
+    parents = _ops.manipulation.stack(step_parents, axis=0)
+    traced = F.gather_tree(ids, parents)                 # [T, B, K]
+    if not output_time_major:
+        traced = traced.transpose([1, 2, 0])             # [B, K, T]
+    if return_length:
+        return traced, state, state["lengths"]
+    return traced, state

@@ -246,3 +246,28 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
     return _gumbel_softmax(x, rng.next_key(), temperature=float(temperature),
                            hard=bool(hard), axis=int(axis))
+
+
+def _act_inplace(fn):
+    """Reference exposes inplace activation variants (elu_ etc.); under XLA
+    ops are functional, so inplace = rebind (same contract as Tensor.add_)."""
+
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+        x.stop_gradient = out.stop_gradient and x.stop_gradient
+        return x
+
+    inplace.__name__ = fn.__name__ + "_"
+    return inplace
+
+
+elu_ = _act_inplace(elu)
+hardtanh_ = _act_inplace(hardtanh)
+leaky_relu_ = _act_inplace(leaky_relu)
+softmax_ = _act_inplace(softmax)
+tanh_ = _act_inplace(tanh)
+thresholded_relu_ = _act_inplace(thresholded_relu)
+
+__all__ += ["elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+            "thresholded_relu_"]

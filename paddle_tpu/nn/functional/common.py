@@ -335,3 +335,122 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity additions (reference nn/functional/common.py + extension.py)
+# ---------------------------------------------------------------------------
+
+@op("pairwise_distance_op")
+def _pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Reference nn/functional/distance.py pairwise_distance (p-norm of
+    x - y along the last dim, epsilon added for gradient stability)."""
+    return _pairwise_distance(x, y, p=float(p), epsilon=float(epsilon),
+                              keepdim=bool(keepdim))
+
+
+@op("sequence_mask_op", differentiable=False)
+def _sequence_mask(x, maxlen=0):
+    return (jnp.arange(maxlen)[None, :]
+            < x.reshape(x.shape + (1,))).reshape(x.shape + (maxlen,))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> [..., maxlen] 0/1 mask (reference
+    nn/functional/extension.py sequence_mask). maxlen=None uses max(x)
+    (an eager data-dependent shape, like the reference)."""
+    if maxlen is None:
+        import numpy as _np
+
+        maxlen = int(_np.asarray(x.numpy()).max())
+    out = _sequence_mask(x, maxlen=int(maxlen))
+    from ...ops.manipulation import cast
+
+    return cast(out, dtype)
+
+
+@op("gather_tree_op", differentiable=False)
+def _gather_tree(ids, parents):
+    """Beam-search backtrace (reference extension.py gather_tree,
+    phi/kernels/cpu/gather_tree_kernel.cc): walk parents from the last
+    step so each beam column holds its full token path."""
+    t, b, k = ids.shape
+
+    def step(beam, tt):
+        # beam: [B, K] current beam index per output slot
+        tok = jnp.take_along_axis(ids[tt], beam, axis=1)
+        par = jnp.take_along_axis(parents[tt], beam, axis=1)
+        return par, tok
+
+    beam0 = jnp.broadcast_to(jnp.arange(k, dtype=ids.dtype), (b, k))
+    _, toks = jax.lax.scan(step, beam0, jnp.arange(t - 1, -1, -1))
+    return toks[::-1]
+
+
+def gather_tree(ids, parents):
+    return _gather_tree(ids, parents)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference
+    nn/functional/common.py class_center_sample, single-group form):
+    returns (remapped_label, sampled_class_index). Positive classes always
+    kept; negatives fill up to num_samples via a seeded permutation."""
+    import numpy as _np
+
+    from ...core import rng as _rng
+    from ...core.tensor import Tensor as _T
+
+    lab = _np.asarray(label.numpy()).reshape(-1)
+    pos = _np.unique(lab)
+    rest = _np.setdiff1d(_np.arange(num_classes), pos)
+    seed = int(jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1))
+    perm = _np.random.RandomState(seed).permutation(rest)
+    n_neg = max(int(num_samples) - pos.size, 0)
+    sampled = _np.concatenate([pos, perm[:n_neg]])
+    remap = _np.full(num_classes, -1, _np.int64)
+    remap[sampled] = _np.arange(sampled.size)
+    return _T(remap[lab]), _T(sampled.astype(_np.int64))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference incubate
+    nn/functional/sparse_attention.py over a CUDA kernel). TPU-native:
+    materialize the CSR pattern as an additive mask over the dense scores —
+    XLA fuses the mask into the softmax; the FLOP savings of true block
+    sparsity need a Pallas kernel variant of flash_attention (the dense
+    flash path is already faster than unfused sparse on v5e; see PERF.md
+    for the measurement policy)."""
+    import numpy as _np
+
+    offs = _np.asarray(sparse_csr_offset.numpy())
+    cols = _np.asarray(sparse_csr_columns.numpy())
+    b, h, seq, d = query.shape
+    mask = _np.zeros((b, h, seq, seq), _np.float32)
+    for bi in range(offs.shape[0]):
+        for hi in range(offs.shape[1]):
+            for r in range(seq):
+                cs = cols[bi, hi, offs[bi, hi, r]:offs[bi, hi, r + 1]]
+                mask[bi, hi, r, cs] = 1.0
+    add_mask = (1.0 - mask) * -1e9
+    from ...core.tensor import Tensor as _T
+
+    from .flash_attention import _sdpa_ref
+
+    out = _sdpa_ref(
+        query.transpose([0, 2, 1, 3]), key.transpose([0, 2, 1, 3]),
+        value.transpose([0, 2, 1, 3]), _T(add_mask), None, causal=False,
+        dropout=0.0)
+    return out.transpose([0, 2, 1, 3])
+
+
+__all__ += [
+    "pairwise_distance", "sequence_mask", "gather_tree",
+    "class_center_sample", "sparse_attention",
+]

@@ -433,3 +433,245 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
         return sum_op(loss)
     return loss
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity additions (reference python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+@op("dice_loss")
+def _dice_loss(input, label, epsilon=1e-5):
+    lab = jax.nn.one_hot(label[..., 0], input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = 2.0 * jnp.sum(input * lab, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+    return jnp.mean(1.0 - inter / (union + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference loss.py dice_loss: label is int [..., 1] class ids."""
+    return _dice_loss(input, label, epsilon=float(epsilon))
+
+
+@op("npair_loss")
+def _npair_loss(anchor, positive, labels, l2_reg=0.002):
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive, axis=1))) / 2.0
+    sim = anchor @ positive.T                           # [B, B]
+    lab = labels.reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(same * logp, axis=1))
+    return ce + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    return _npair_loss(anchor, positive, labels, l2_reg=float(l2_reg))
+
+
+@op("multi_margin_loss_op")
+def _multi_margin(input, label, p=1, margin=1.0, reduction="mean"):
+    n, c = input.shape
+    correct = jnp.take_along_axis(input, label.reshape(-1, 1), axis=1)
+    diff = jnp.maximum(margin - correct + input, 0.0)
+    if p == 2:
+        diff = diff * diff
+    mask = 1.0 - jax.nn.one_hot(label.reshape(-1), c, dtype=input.dtype)
+    loss = jnp.sum(diff * mask, axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    return _multi_margin(input, label, p=int(p), margin=float(margin),
+                         reduction=reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Reference loss.py triplet_margin_with_distance_loss — user-supplied
+    distance callable (defaults to pairwise L2)."""
+    from .common import pairwise_distance
+
+    dfn = distance_function or (lambda a, b: pairwise_distance(a, b))
+    dp = dfn(input, positive)
+    dn = dfn(input, negative)
+    if swap:
+        from ...ops import math as _m
+
+        dn = _m.minimum(dn, dfn(positive, negative))
+    loss = (dp - dn + margin).clip(0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@op("gaussian_nll_loss_op")
+def _gaussian_nll(input, label, variance, full=False, epsilon=1e-6,
+                  reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (label - input) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * np.pi, input.dtype))
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return _gaussian_nll(input, label, variance, full=bool(full),
+                         epsilon=float(epsilon), reduction=reduction)
+
+
+@op("hsigmoid_loss_op")
+def _hsigmoid(input, label, weight, bias=None, num_classes=2):
+    """Default-tree hierarchical sigmoid (reference
+    nn/functional/loss.py hsigmoid_loss; phi cpu kernel
+    hierarchical_sigmoid_kernel.cc): complete binary tree over class ids,
+    code length ceil(log2(C)); internal node index via the heap encoding
+    the reference's MatrixBitCodeFunctor uses (node = label + C, walk to
+    root, parent = node / 2; code bit = node & 1)."""
+    c = num_classes
+    depth = max(int(np.ceil(np.log2(c))), 1)
+    node = label.reshape(-1).astype(jnp.int32) + c      # heap leaf id
+    total = jnp.zeros(input.shape[0], jnp.float32)
+    for _ in range(depth):
+        parent = node // 2
+        bit = (node & 1).astype(jnp.float32)            # 1 -> right child
+        active = parent >= 1
+        w_idx = jnp.clip(parent - 1, 0, weight.shape[0] - 1)
+        logits = jnp.sum(input * weight[w_idx], axis=1)
+        if bias is not None:
+            logits = logits + bias.reshape(-1)[w_idx]
+        # sigmoid CE with target = bit
+        term = jax.nn.softplus(logits) - bit * logits
+        total = total + jnp.where(active & (parent > 0) & (parent < c),
+                                  term, 0.0)
+        node = parent
+    return jnp.mean(total)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported; "
+            "the default complete-binary-tree mode matches the reference")
+    return _hsigmoid(input, label, weight, bias, num_classes=int(num_classes))
+
+
+@op("margin_cross_entropy_op")
+def _margin_ce(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+               scale=64.0, return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace combined-margin softmax CE (reference
+    nn/functional/loss.py margin_cross_entropy; single-group form — the
+    model-parallel form shards the class dim over the mp axis via GSPMD)."""
+    lab = label.reshape(-1)
+    onehot = jax.nn.one_hot(lab, logits.shape[1], dtype=jnp.float32)
+    cos = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=1)
+    loss = -jnp.sum(onehot * logp, axis=1)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(adjusted, axis=1)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    return _margin_ce(logits, label, margin1=float(margin1),
+                      margin2=float(margin2), margin3=float(margin3),
+                      scale=float(scale), return_softmax=bool(return_softmax),
+                      reduction=reduction)
+
+
+@op("rnnt_loss_op")
+def _rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+               fastemit_lambda=0.0, reduction="mean"):
+    """RNN-Transducer loss (reference nn/functional/loss.py rnnt_loss
+    binding warprnnt): forward alpha recursion over the [T, U+1] lattice
+    as a lax.scan over T with a cummax-style within-row scan over U —
+    static shapes, runs batched on the VPU.
+
+    logits: [B, T, U+1, V] raw (log_softmax applied inside, like warprnnt).
+    """
+    b, t_max, u1, v = logits.shape
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # emit[b, t, u] = logP(label_{u+1} | t, u);  blank[b, t, u] = logP(blank)
+    lab = labels.astype(jnp.int32)                       # [B, U]
+    emit = jnp.take_along_axis(
+        logp[:, :, :u1 - 1, :],
+        lab[:, None, :, None].repeat(t_max, axis=1), axis=3)[..., 0]
+    blankp = logp[..., blank]                            # [B, T, U+1]
+    NEG = jnp.float32(-1e30)
+
+    t_len = logit_lengths.reshape(-1).astype(jnp.int32)
+    u_len = label_lengths.reshape(-1).astype(jnp.int32)
+
+    def row(alpha_prev, t):
+        """alpha row at time t from the row at t-1: vertical (blank) moves
+        enter from alpha[t-1, u]; horizontal (emit) moves chain along u
+        within the row — a sequential prefix recursion (U is small)."""
+        from_blank = alpha_prev + blankp[:, t - 1, :]
+
+        def scan_u(bvals):
+            from_b, em = bvals
+
+            def cell(c, u):
+                val = from_b[u]
+                via = c + em[u - 1]
+                out = jnp.where(u > 0, jnp.logaddexp(val, via), val)
+                return out, out
+
+            _, outs = jax.lax.scan(cell, NEG, jnp.arange(u1))
+            return outs
+
+        alpha_t = jax.vmap(scan_u)((from_blank, emit[:, t]))
+        return alpha_t, None
+
+    # t = 0 row: only emissions along u
+    def scan_u0(bvals):
+        def cell(c, u):
+            via = c + bvals[u - 1]
+            out = jnp.where(u > 0, via, 0.0)
+            return out, out
+        _, outs = jax.lax.scan(cell, jnp.float32(0.0), jnp.arange(u1))
+        return outs
+
+    alpha0 = jax.vmap(scan_u0)(emit[:, 0])
+    def step(alpha_prev, t):
+        a, _ = row(alpha_prev, t)
+        return a, a
+    alpha_T, rows = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+    all_rows = jnp.concatenate([alpha0[None], rows], axis=0)  # [T, B, U+1]
+    # total logprob: alpha[t_len-1, u_len] + blank at (t_len-1, u_len)
+    bi = jnp.arange(b)
+    final_alpha = all_rows[t_len - 1, bi, u_len]
+    final = final_alpha + blankp[bi, t_len - 1, u_len]
+    loss = -final
+    return _reduce(loss, reduction)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """fastemit_lambda is accepted but not applied (plain transducer
+    objective; FastEmit regularization is a training heuristic layered on
+    the same lattice)."""
+    return _rnnt_loss(input, label, input_lengths, label_lengths,
+                      blank=int(blank), reduction=reduction)
+
+
+__all__ += [
+    "dice_loss", "npair_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "gaussian_nll_loss",
+    "hsigmoid_loss", "margin_cross_entropy", "rnnt_loss",
+]

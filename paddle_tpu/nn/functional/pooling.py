@@ -16,7 +16,7 @@ __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
-    "adaptive_max_pool3d",
+    "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -73,6 +73,50 @@ def _avg_pool(x, ksize=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0)),
     return summed / float(np.prod(ksize))
 
 
+@op("max_pool_nd_with_index", differentiable=False)
+def _max_pool_index(x, ksize=(2, 2), stride=(2, 2), padding=((0, 0), (0, 0)),
+                    ceil_mode=False):
+    """Argmax mask for max-pool: flat index into the (padded-free) spatial
+    plane per output site — the reference's mask format
+    (paddle/phi/kernels/funcs/pooling.h MaxPool*WithIndex)."""
+    nd = len(ksize)
+    if ceil_mode:
+        # same output-size extension _max_pool applies
+        padding = tuple((lo, hi + s - 1)
+                        for (lo, hi), s in zip(padding, stride))
+    spatial = x.shape[2:]
+    patches = jax.lax.conv_general_dilated_patches(
+        x.astype(jnp.float32), filter_shape=tuple(ksize),
+        window_strides=tuple(stride), padding=tuple(padding),
+        precision=jax.lax.Precision.DEFAULT)
+    # [N, C*prod(k), *out_spatial] with channel-major ordering
+    n, c = x.shape[0], x.shape[1]
+    k = int(np.prod(ksize))
+    out_sp = patches.shape[2:]
+    # set padded positions to -inf so argmax never selects them: rebuild the
+    # same patches from an all-ones input to detect padding
+    ones = jnp.ones_like(x, jnp.float32)
+    valid = jax.lax.conv_general_dilated_patches(
+        ones, filter_shape=tuple(ksize), window_strides=tuple(stride),
+        padding=tuple(padding))
+    pv = patches.reshape(n, c, k, *out_sp)
+    vv = valid.reshape(n, c, k, *out_sp) > 0
+    pv = jnp.where(vv, pv, -jnp.inf)
+    kidx = jnp.argmax(pv, axis=2)                       # [N, C, *out_sp]
+    # decompose k index into per-dim offsets, then to input coordinates
+    flat = jnp.zeros_like(kidx)
+    rem = kidx
+    for d in range(nd - 1, -1, -1):
+        off = rem % ksize[d]
+        rem = rem // ksize[d]
+        grid = jnp.arange(out_sp[d]) * stride[d] - padding[d][0]
+        shape = [1] * (2 + nd)
+        shape[2 + d] = out_sp[d]
+        coord = off + grid.reshape(shape)
+        flat = flat + coord * int(np.prod(spatial[d + 1:]))
+    return flat.astype(jnp.int32)
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     ks = _tup(kernel_size, 2)
@@ -80,9 +124,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 2),
                     ceil_mode=bool(ceil_mode))
     if return_mask:
-        from ...ops.manipulation import argmax
-
-        return out, None  # mask indices unsupported (reference: pool w/ mask)
+        mask = _max_pool_index(x, ksize=ks, stride=st,
+                               padding=_pads(padding, 2),
+                               ceil_mode=bool(ceil_mode))
+        return out, mask
     return out
 
 
@@ -90,16 +135,79 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     ks = _tup(kernel_size, 1)
     st = _tup(stride if stride is not None else kernel_size, 1)
-    return _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 1),
-                     ceil_mode=bool(ceil_mode))
+    out = _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 1),
+                    ceil_mode=bool(ceil_mode))
+    if return_mask:
+        mask = _max_pool_index(x, ksize=ks, stride=st,
+                               padding=_pads(padding, 1),
+                               ceil_mode=bool(ceil_mode))
+        return out, mask
+    return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     ks = _tup(kernel_size, 3)
     st = _tup(stride if stride is not None else kernel_size, 3)
-    return _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 3),
-                     ceil_mode=bool(ceil_mode))
+    out = _max_pool(x, ksize=ks, stride=st, padding=_pads(padding, 3),
+                    ceil_mode=bool(ceil_mode))
+    if return_mask:
+        mask = _max_pool_index(x, ksize=ks, stride=st,
+                               padding=_pads(padding, 3),
+                               ceil_mode=bool(ceil_mode))
+        return out, mask
+    return out
+
+
+@op("max_unpool_nd")
+def _max_unpool(x, indices, out_spatial=()):
+    n, c = x.shape[0], x.shape[1]
+    hw = int(np.prod(out_spatial))
+    flat = jnp.zeros((n, c, hw), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    bn = jnp.arange(n)[:, None, None]
+    bc = jnp.arange(c)[None, :, None]
+    flat = flat.at[bn, bc, idx].set(vals)
+    return flat.reshape((n, c) + tuple(out_spatial))
+
+
+def _unpool_out_spatial(in_sp, ks, st, pad, output_size):
+    if output_size is not None:
+        sp = tuple(int(s) for s in output_size[-len(in_sp):])
+        return sp
+    return tuple((i - 1) * s - 2 * p[0] + k
+                 for i, k, s, p in zip(in_sp, ks, st, pad))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d(return_mask=True) (reference
+    nn/functional/pooling.py max_unpool1d): scatters pooled values back to
+    their argmax positions."""
+    ks = _tup(kernel_size, 1)
+    st = _tup(stride if stride is not None else kernel_size, 1)
+    sp = _unpool_out_spatial(x.shape[2:], ks, st, _pads(padding, 1),
+                             output_size)
+    return _max_unpool(x, indices, out_spatial=sp)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    sp = _unpool_out_spatial(x.shape[2:], ks, st, _pads(padding, 2),
+                             output_size)
+    return _max_unpool(x, indices, out_spatial=sp)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    ks = _tup(kernel_size, 3)
+    st = _tup(stride if stride is not None else kernel_size, 3)
+    sp = _unpool_out_spatial(x.shape[2:], ks, st, _pads(padding, 3),
+                             output_size)
+    return _max_unpool(x, indices, out_spatial=sp)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -1,0 +1,151 @@
+"""Spatial-transform functionals.
+
+Reference: python/paddle/nn/functional/vision.py (affine_grid :30,
+grid_sample :237, temporal_shift) over the CUDA kernels
+paddle/phi/kernels/gpu/affine_grid_kernel.cu / grid_sample_kernel.cu.
+TPU-native: pure gather/arith forms that XLA vectorizes; bilinear
+grid_sample is two fused gathers + lerp on the MXU-free VPU path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def _lin(n, align_corners):
+    if align_corners:
+        return jnp.linspace(-1.0, 1.0, n)
+    # pixel centers of a [-1, 1] box split into n cells
+    step = 2.0 / n
+    return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+
+@op("affine_grid")
+def _affine_grid(theta, out_h=1, out_w=1, align_corners=True):
+    n = theta.shape[0]
+    ys = _lin(out_h, align_corners)
+    xs = _lin(out_w, align_corners)
+    gx, gy = jnp.meshgrid(xs, ys)                       # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)           # [H, W, 3]
+    # grid = base @ theta^T : [N, H, W, 2]; tiny matmul — full f32 precision
+    # (coordinates feed gathers, bf16 rounding moves sample positions)
+    return jnp.einsum("hwc,nkc->nhwk", base, theta.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: [N, 2, 3] affine matrices -> sampling grid [N, H, W, 2]."""
+    if hasattr(out_shape, "tolist"):
+        out_shape = out_shape.tolist()
+    n, c, h, w = (int(s) for s in out_shape)
+    return _affine_grid(theta, out_h=h, out_w=w,
+                        align_corners=bool(align_corners))
+
+
+@op("grid_sample")
+def _grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    nb, c, h, w = x.shape
+    gx = grid[..., 0].astype(jnp.float32)               # [N, Hg, Wg]
+    gy = grid[..., 1].astype(jnp.float32)
+    if align_corners:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+    def _reflect(v, lo, hi):
+        """Fold v into [lo, hi] by reflection (torch/paddle
+        reflect_coordinates semantics — applied to the FLOAT coordinate so
+        bilinear weights reflect too)."""
+        span = hi - lo
+        if span <= 0:
+            return jnp.zeros_like(v)
+        a = jnp.abs(v - lo)
+        m = jnp.mod(a, 2.0 * span)
+        return jnp.where(m >= span, 2.0 * span - m, m) + lo
+
+    # padding transforms act on the float sample coordinates
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            fx = _reflect(fx, 0.0, float(w - 1))
+            fy = _reflect(fy, 0.0, float(h - 1))
+        else:
+            fx = jnp.clip(_reflect(fx, -0.5, w - 0.5), 0, w - 1)
+            fy = jnp.clip(_reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+    def fetch(ix, iy):
+        """x[n, :, iy, ix]; out-of-range is zero ('zeros' mode — the other
+        modes already folded the coordinates in range)."""
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        bn = jnp.arange(nb)[:, None, None]
+        vals = x[bn, :, iyc, ixc]                       # [N, Hg, Wg, C]
+        vals = jnp.moveaxis(vals, -1, 1)
+        return jnp.where(valid[:, None], vals, 0.0)
+
+    if mode == "nearest":
+        return fetch(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32)).astype(x.dtype)
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (fx - x0)[:, None]
+    wy = (fy - y0)[:, None]
+    v00 = fetch(x0, y0)
+    v01 = fetch(x1, y0)
+    v10 = fetch(x0, y1)
+    v11 = fetch(x1, y1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return (top * (1 - wy) + bot * wy).astype(x.dtype)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at grid [N,Hg,Wg,2] (xy in [-1,1])."""
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                        align_corners=bool(align_corners))
+
+
+@op("temporal_shift")
+def _temporal_shift(x, seg_num=1, shift_ratio=0.25):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate(
+        [xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+         xr[:, :-1, fold:2 * fold]], axis=1)
+    rest = xr[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, rest], axis=2).reshape(nt, c, h, w)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift across the segment (time) dim (reference
+    vision.py temporal_shift)."""
+    if data_format == "NHWC":
+        from ...ops.manipulation import transpose
+
+        x = transpose(x, [0, 3, 1, 2])
+        out = _temporal_shift(x, seg_num=int(seg_num),
+                              shift_ratio=float(shift_ratio))
+        return transpose(out, [0, 2, 3, 1])
+    return _temporal_shift(x, seg_num=int(seg_num),
+                           shift_ratio=float(shift_ratio))

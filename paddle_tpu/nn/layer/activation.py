@@ -195,3 +195,15 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects 3D/4D input"
+        return F.softmax(x, axis=-3)
+
+
+__all__ += ["Softmax2D"]

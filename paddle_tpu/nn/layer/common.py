@@ -269,3 +269,36 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """Reference nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...ops.extras import unflatten
+
+        return unflatten(x, self.axis, self.shape)
+
+    def extra_repr(self):
+        return f"axis={self.axis}, shape={self.shape}"
+
+
+class PairwiseDistance(Layer):
+    """Reference nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+__all__ += ["Unflatten", "PairwiseDistance"]

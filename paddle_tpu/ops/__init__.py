@@ -19,7 +19,11 @@ from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
-from . import creation, indexing, linalg, logic, manipulation, math  # noqa: F401
+from .extras import *  # noqa: F401,F403
+from . import (  # noqa: F401
+    creation, extras, indexing, linalg, logic, manipulation, math,
+)
+from .manipulation import row_stack, t  # noqa: F401
 
 from .math import (  # noqa: F401
     add, subtract, multiply, divide, floor_divide, remainder, matmul, pow,
@@ -32,7 +36,7 @@ from .manipulation import cast, reshape, transpose, concat, where  # noqa: F401
 # Tensor method + operator installation
 # ---------------------------------------------------------------------------
 
-_METHOD_SOURCES = [creation, math, manipulation, logic, linalg]
+_METHOD_SOURCES = [creation, math, manipulation, logic, linalg, extras]
 
 # names whose first parameter is NOT a tensor (skip when installing methods)
 _NON_METHODS = {
@@ -40,6 +44,9 @@ _NON_METHODS = {
     "logspace", "eye", "meshgrid", "rand", "randn", "randint", "uniform",
     "normal", "randperm", "standard_normal", "gaussian", "einsum", "multi_dot",
     "broadcast_tensors", "one_hot", "scatter_nd", "is_tensor",
+    "hstack", "vstack", "dstack", "column_stack", "multiplex",
+    "broadcast_shape", "tril_indices", "triu_indices", "add_n", "binomial",
+    "finfo", "iinfo", "complex", "polar",
 }
 
 
@@ -112,20 +119,95 @@ def _install():
         method.__name__ = name
         setattr(Tensor, name, method)
 
-    _inplace("add_", add)
-    _inplace("subtract_", subtract)
-    _inplace("multiply_", multiply)
-    _inplace("divide_", divide)
-    _inplace("scale_", math.scale)
-    _inplace("clip_", math.clip)
-    _inplace("exp_", math.exp)
-    _inplace("sqrt_", math.sqrt)
-    _inplace("rsqrt_", math.rsqrt)
-    _inplace("floor_", math.floor)
-    _inplace("ceil_", math.ceil)
-    _inplace("round_", math.round)
-    _inplace("abs_", math.abs)
-    _inplace("tanh_", math.tanh)
+    # the reference's full Tensor inplace surface (python/paddle/__init__.py
+    # `*_` names); each rebinds to the out-of-place op result — under XLA
+    # every op is functional, so "inplace" is an aliasing contract, not a
+    # memory optimization (donation handles that under jit)
+    _INPLACE_BASES = [
+        "add", "subtract", "multiply", "divide", "scale", "clip", "exp",
+        "sqrt", "rsqrt", "floor", "ceil", "round", "abs", "tanh", "acos",
+        "asin", "atan", "cos", "sin", "sinh", "cosh", "tan", "erf", "expm1",
+        "digamma", "lgamma", "log", "log2", "log10", "log1p", "neg",
+        "square", "trunc", "frac", "i0", "gcd", "lcm", "hypot", "ldexp",
+        "nan_to_num", "logit", "pow", "remainder", "mod", "floor_mod",
+        "floor_divide", "cumsum", "cumprod", "equal", "not_equal",
+        "greater_equal", "greater_than", "less_equal", "less_than",
+        "logical_and", "logical_or", "logical_not", "logical_xor",
+        "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+        "masked_fill", "masked_scatter", "index_add", "index_fill",
+        "index_put", "renorm", "scatter", "tril", "triu", "t", "transpose",
+        "cast", "where", "lerp", "reciprocal", "sigmoid", "addmm",
+        "put_along_axis", "sign", "atan2", "divide", "flatten", "squeeze",
+        "unsqueeze", "reshape", "polygamma", "multigammaln",
+    ]
+    _sources = [math, manipulation, logic, linalg, extras, creation]
+    for base in _INPLACE_BASES:
+        fn = None
+        for mod in _sources:
+            fn = getattr(mod, base, None)
+            if fn is not None:
+                break
+        if fn is not None:
+            _inplace(base + "_", fn)
+
+    # inplace random re-initializers (reference tensor/random.py normal_,
+    # cauchy_, geometric_ mutate in place from a fresh sample)
+    def _inplace_random(name, sample):
+        def method(self, *args, **kwargs):
+            import jax.numpy as jnp
+
+            self._data = sample(self, *args, **kwargs).astype(self._data.dtype)
+            return self
+
+        method.__name__ = name
+        setattr(Tensor, name, method)
+
+    def _normal_sample(self, mean=0.0, std=1.0, shape=None, name=None):
+        import jax
+
+        from ..core import rng
+
+        return mean + std * jax.random.normal(rng.next_key(),
+                                              self._data.shape)
+
+    def _cauchy_sample(self, loc=0, scale=1, name=None):
+        import jax
+
+        from ..core import rng
+
+        return loc + scale * jax.random.cauchy(rng.next_key(),
+                                               self._data.shape)
+
+    def _geometric_sample(self, probs, name=None):
+        import jax
+
+        from ..core import rng
+
+        return jax.random.geometric(rng.next_key(), probs,
+                                    self._data.shape).astype("float32")
+
+    def _exponential_sample(self, lam=1.0, name=None):
+        import jax
+
+        from ..core import rng
+
+        return jax.random.exponential(rng.next_key(), self._data.shape) / lam
+
+    def _uniform_sample(self, min=-1.0, max=1.0, seed=0, name=None):
+        import jax
+
+        from ..core import rng
+
+        return jax.random.uniform(rng.next_key(), self._data.shape,
+                                  minval=min, maxval=max)
+
+    _inplace_random("normal_", _normal_sample)
+    _inplace_random("cauchy_", _cauchy_sample)
+    _inplace_random("geometric_", _geometric_sample)
+    if not hasattr(Tensor, "exponential_"):
+        _inplace_random("exponential_", _exponential_sample)
+    if not hasattr(Tensor, "uniform_"):
+        _inplace_random("uniform_", _uniform_sample)
 
     def zero_(self):
         import jax.numpy as jnp
@@ -153,3 +235,26 @@ def _install():
 
 _install()
 del _install
+
+
+def _export_inplace_toplevel():
+    """Reference exposes every Tensor inplace method as paddle.<name>_ too
+    (python/paddle/__init__.py __all__)."""
+    import sys
+
+    mod = sys.modules[__name__]
+    for name in dir(Tensor):
+        if (name.endswith("_") and not name.startswith("_")
+                and not hasattr(mod, name)):
+            def _make(n):
+                def f(x, *args, **kwargs):
+                    return getattr(x, n)(*args, **kwargs)
+
+                f.__name__ = n
+                return f
+
+            setattr(mod, name, _make(name))
+
+
+_export_inplace_toplevel()
+del _export_inplace_toplevel

@@ -1,0 +1,615 @@
+"""Long-tail tensor-API parity ops.
+
+Reference: the remaining ``python/paddle/__init__.py`` ``__all__`` surface —
+tensor/manipulation.py (stacks, unfold, scatter family), tensor/math.py
+(distance/special functions), tensor/creation.py (index grids, vander,
+complex), tensor/attribute.py (shape/rank/is_*), tensor/random.py
+(binomial/poisson). Each is a pure-JAX op on the dispatch layer; anything
+shape-dynamic is documented as such.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "hstack", "vstack", "dstack", "column_stack", "reverse", "take",
+    "unflatten", "unfold", "multiplex", "shape", "rank", "broadcast_shape",
+    "scatter_nd", "diag_embed", "diagonal_scatter", "select_scatter",
+    "slice_scatter", "masked_scatter", "index_fill", "tril_indices",
+    "triu_indices", "vander", "complex", "polar", "mv", "dist", "cdist",
+    "pdist", "sgn", "signbit", "logit", "frexp", "ldexp", "i0e", "i1",
+    "i1e", "polygamma", "multigammaln", "nanmedian", "nanquantile",
+    "logcumsumexp", "cummin", "trapezoid", "cumulative_trapezoid", "renorm",
+    "add_n", "binomial", "poisson", "combinations", "is_complex",
+    "is_floating_point", "is_integer", "finfo", "iinfo",
+]
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# stacks / layout (reference tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+
+@op("hstack_n")
+def _hstack(*xs):
+    return jnp.hstack(xs)
+
+
+def hstack(x, name=None):
+    return _hstack(*x)
+
+
+@op("vstack_n")
+def _vstack(*xs):
+    return jnp.vstack(xs)
+
+
+def vstack(x, name=None):
+    return _vstack(*x)
+
+
+@op("dstack_n")
+def _dstack(*xs):
+    return jnp.dstack(xs)
+
+
+def dstack(x, name=None):
+    return _dstack(*x)
+
+
+@op("column_stack_n")
+def _column_stack(*xs):
+    return jnp.column_stack(xs)
+
+
+def column_stack(x, name=None):
+    return _column_stack(*x)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (reference keeps both)."""
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+@op("take")
+def _take(x, index, mode="raise"):
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:  # 'raise' cannot raise inside jit; clip like 'clip'
+        idx = jnp.clip(idx, -n, n - 1)
+    idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    return _take(x, index, mode=mode)
+
+
+@op("unflatten")
+def _unflatten(x, axis=0, sizes=()):
+    s = list(x.shape)
+    return jnp.reshape(x, tuple(s[:axis]) + tuple(sizes)
+                       + tuple(s[axis + 1:]))
+
+
+def unflatten(x, axis, shape, name=None):
+    axis = int(axis) % x.ndim
+    return _unflatten(x, axis=axis, sizes=_ints(shape))
+
+
+@op("tensor_unfold")
+def _unfold(x, axis=0, size=1, step=1):
+    length = x.shape[axis]
+    n_win = (length - size) // step + 1
+    xm = jnp.moveaxis(x, axis, -1)
+    idx = (jnp.arange(n_win)[:, None] * step
+           + jnp.arange(size)[None, :])            # [n_win, size]
+    win = xm[..., idx]                              # [..., n_win, size]
+    return jnp.moveaxis(win, -2, axis)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows over ``axis``: that dim becomes n_windows and a new
+    trailing dim of length ``size`` is appended (reference Tensor.unfold)."""
+    return _unfold(x, axis=int(axis) % x.ndim, size=int(size),
+                   step=int(step))
+
+
+@op("multiplex")
+def _multiplex(index, *ins):
+    stacked = jnp.stack(ins, axis=0)                # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)       # [N]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def multiplex(inputs, index, name=None):
+    return _multiplex(index, *inputs)
+
+
+def shape(x, name=None):
+    """1-D int32 tensor of the (static) shape (reference paddle.shape)."""
+    return Tensor(np.asarray(x.shape, np.int32))
+
+
+def rank(x, name=None):
+    return Tensor(np.asarray(len(x.shape), np.int32))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@op("scatter_nd")
+def _scatter_nd(index, updates, out_shape=()):
+    zeros = jnp.zeros(out_shape, updates.dtype)
+    return zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _scatter_nd(index, updates, out_shape=_ints(shape))
+
+
+# ---------------------------------------------------------------------------
+# scatter family (reference tensor/manipulation.py select_scatter etc.)
+# ---------------------------------------------------------------------------
+
+@op("diag_embed")
+def _diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    ii = jnp.arange(x.shape[-1])
+    r = ii + max(-offset, 0)
+    c = ii + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    out = out.at[..., r, c].set(x)
+    return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    nd = x.ndim + 1
+    return _diag_embed(x, offset=int(offset), dim1=int(dim1) % nd,
+                       dim2=int(dim2) % nd)
+
+
+@op("diagonal_scatter")
+def _diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    xm = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n = y.shape[-1]
+    r = jnp.arange(n) + max(-offset, 0)
+    c = jnp.arange(n) + max(offset, 0)
+    xm = xm.at[..., r, c].set(y)
+    return jnp.moveaxis(xm, (-2, -1), (axis1, axis2))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal_scatter(x, y, offset=int(offset),
+                             axis1=int(axis1) % x.ndim,
+                             axis2=int(axis2) % x.ndim)
+
+
+@op("select_scatter")
+def _select_scatter(x, value, axis=0, index=0):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    return _select_scatter(x, values, axis=int(axis) % x.ndim,
+                           index=int(index))
+
+
+@op("slice_scatter")
+def _slice_scatter(x, value, axes=(), starts=(), ends=(), strides=()):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    return _slice_scatter(x, value, axes=_ints(axes), starts=_ints(starts),
+                          ends=_ints(ends), strides=_ints(strides))
+
+
+@op("masked_scatter")
+def _masked_scatter(x, mask, value):
+    m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    vflat = value.reshape(-1)
+    # k-th True position takes value[k]; static-shape friendly form
+    pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+    take_v = vflat[jnp.clip(pos, 0, vflat.shape[0] - 1)]
+    return jnp.where(m, take_v.astype(x.dtype),
+                     x.reshape(-1)).reshape(x.shape)
+
+
+def masked_scatter(x, mask, value, name=None):
+    return _masked_scatter(x, mask, value)
+
+
+@op("index_fill")
+def _index_fill(x, index, axis=0, fill_value=0.0):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index.astype(jnp.int32)
+    return x.at[tuple(idx)].set(jnp.asarray(fill_value, x.dtype))
+
+
+def index_fill(x, index, axis, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return _index_fill(x, index, axis=int(axis) % x.ndim,
+                       fill_value=float(value))
+
+
+# ---------------------------------------------------------------------------
+# creation extras (reference tensor/creation.py)
+# ---------------------------------------------------------------------------
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return Tensor(np.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return Tensor(np.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+@op("vander")
+def _vander(x, n=0, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    n = x.shape[0] if n is None else int(n)
+    return _vander(x, n=n, increasing=bool(increasing))
+
+
+@op("make_complex")
+def _complex(real, imag):
+    return jax.lax.complex(real.astype(jnp.float32),
+                           imag.astype(jnp.float32))
+
+
+def complex(real, imag, name=None):  # noqa: A001 - reference name
+    return _complex(real, imag)
+
+
+@op("polar")
+def _polar(absv, angle):
+    return jax.lax.complex(absv * jnp.cos(angle), absv * jnp.sin(angle))
+
+
+def polar(abs, angle, name=None):  # noqa: A002 - reference signature
+    return _polar(abs, angle)
+
+
+# ---------------------------------------------------------------------------
+# math extras (reference tensor/math.py, tensor/linalg.py)
+# ---------------------------------------------------------------------------
+
+@op("mv")
+def _mv(x, vec):
+    return x @ vec
+
+
+def mv(x, vec, name=None):
+    return _mv(x, vec)
+
+
+@op("dist")
+def _dist(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(d.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def dist(x, y, p=2, name=None):
+    return _dist(x, y, p=float(p))
+
+
+@op("cdist")
+def _cdist(x, y, p=2.0):
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == float("inf"):
+        return jnp.max(diff, axis=-1)
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    return _cdist(x, y, p=float(p))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances (upper triangle, row-major order)."""
+    full = cdist(x, x, p=p)
+    r, c = np.triu_indices(int(x.shape[0]), 1)
+    from .manipulation import gather_nd
+
+    idx = Tensor(np.stack([r, c], axis=1).astype(np.int64))
+    return gather_nd(full, idx)
+
+
+@op("sgn")
+def _sgn(x):
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0,
+                                                             mag))
+    return jnp.sign(x)
+
+
+def sgn(x, name=None):
+    return _sgn(x)
+
+
+@op("signbit")
+def _signbit(x):
+    return jnp.signbit(x)
+
+
+def signbit(x, name=None):
+    return _signbit(x)
+
+
+@op("logit")
+def _logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def logit(x, eps=None, name=None):
+    return _logit(x, eps=None if eps is None else float(eps))
+
+
+@op("frexp", differentiable=False)
+def _frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+def frexp(x, name=None):
+    return _frexp(x)
+
+
+@op("ldexp")
+def _ldexp(x, y):
+    return x * (2.0 ** y.astype(jnp.float32))
+
+
+def ldexp(x, y, name=None):
+    return _ldexp(x, y)
+
+
+def _special(name, fn):
+    fwd = op(name)(fn)
+
+    def public(x, name=None):
+        return fwd(x)
+
+    public.__name__ = name
+    return public
+
+
+i0e = _special("i0e", lambda x: jax.scipy.special.i0e(x))
+i1 = _special("i1", lambda x: jax.scipy.special.i1(x))
+i1e = _special("i1e", lambda x: jax.scipy.special.i1e(x))
+
+
+@op("polygamma")
+def _polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def polygamma(x, n, name=None):
+    return _polygamma(x, n=int(n))
+
+
+@op("multigammaln")
+def _multigammaln(x, p=1):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def multigammaln(x, p, name=None):
+    return _multigammaln(x, p=int(p))
+
+
+@op("nanmedian")
+def _nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    from .math import _axis
+
+    return _nanmedian(x, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+@op("nanquantile")
+def _nanquantile(x, q=0.5, axis=None, keepdim=False):
+    return jnp.nanquantile(x.astype(jnp.float32), q, axis=axis,
+                           keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    from .math import _axis
+
+    if isinstance(q, Tensor):
+        q = q.tolist()
+    return _nanquantile(x, q=q, axis=_axis(axis), keepdim=bool(keepdim))
+
+
+@op("logcumsumexp")
+def _logcumsumexp(x, axis=-1):
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        return _logcumsumexp(x.reshape(-1), axis=0)
+    return _logcumsumexp(x, axis=int(axis))
+
+
+@op("cummin_vals")
+def _cummin(x, axis=-1):
+    return jax.lax.cummin(x, axis=axis)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    """Values-only (matching this repo's cummax; the reference also returns
+    argmin indices)."""
+    if axis is None:
+        return _cummin(x.reshape(-1), axis=0)
+    return _cummin(x, axis=int(axis))
+
+
+@op("trapezoid")
+def _trapezoid(y, x=None, dx=1.0, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return _trapezoid(y, x, dx=1.0 if dx is None else float(dx),
+                      axis=int(axis))
+
+
+@op("cumulative_trapezoid")
+def _cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    ym = jnp.moveaxis(y, axis, -1)
+    avg = (ym[..., 1:] + ym[..., :-1]) / 2.0
+    if x is not None:
+        xm = x if x.ndim == 1 else jnp.moveaxis(x, axis, -1)
+        avg = avg * jnp.diff(xm, axis=-1)
+    else:
+        avg = avg * dx
+    return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return _cumulative_trapezoid(y, x, dx=1.0 if dx is None else float(dx),
+                                 axis=int(axis))
+
+
+@op("renorm")
+def _renorm(x, p=2.0, axis=0, max_norm=1.0):
+    xm = jnp.moveaxis(x, axis, 0).reshape(x.shape[axis], -1)
+    norms = jnp.sum(jnp.abs(xm) ** p, axis=1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return x * scale.reshape(shape).astype(x.dtype)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _renorm(x, p=float(p), axis=int(axis) % x.ndim,
+                   max_norm=float(max_norm))
+
+
+@op("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(*inputs)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    n = int(x.shape[0])
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.asarray(list(gen(range(n), int(r))), np.int64)
+    if idx.size == 0:
+        idx = idx.reshape(0, int(r))
+    from .manipulation import index_select
+
+    return index_select(x, Tensor(idx.reshape(-1)), axis=0).reshape(
+        [idx.shape[0], int(r)] + list(x.shape[1:]))
+
+
+# ---------------------------------------------------------------------------
+# random (reference tensor/random.py)
+# ---------------------------------------------------------------------------
+
+def binomial(count, prob, name=None):
+    from ..core import rng
+
+    key = rng.next_key()
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(key, c.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    # int64 truncates to int32 without x64 mode; stay in the native width
+    return Tensor(out.astype(jnp.int32))
+
+
+def poisson(x, name=None):
+    from ..core import rng
+
+    key = rng.next_key()
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(key, lam).astype(lam.dtype))
+
+
+# ---------------------------------------------------------------------------
+# dtype attributes (reference tensor/attribute.py + framework/dtype.py)
+# ---------------------------------------------------------------------------
+
+def is_complex(x):
+    d = x._data.dtype if hasattr(x, "_data") else np.dtype(x)
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    d = x._data.dtype if hasattr(x, "_data") else np.dtype(x)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(x):
+    d = x._data.dtype if hasattr(x, "_data") else np.dtype(x)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def finfo(dtype):
+    import ml_dtypes
+
+    return ml_dtypes.finfo(dtypes.convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(dtypes.convert_dtype(dtype))

@@ -133,7 +133,11 @@ def stack(x, axis=0, name=None):
 
 
 def row_stack(x, name=None):
-    return _stack(*x, axis=0)
+    """Alias of vstack (the reference aliases them; stacking 1-D rows and
+    concatenating >=2-D along axis 0)."""
+    from .extras import vstack
+
+    return vstack(x)
 
 
 @op("split")

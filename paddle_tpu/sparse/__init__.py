@@ -258,3 +258,148 @@ def pow(x, factor, name=None):
 
 def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity additions (reference python/paddle/sparse/unary.py,
+# binary.py, multiary.py)
+# ---------------------------------------------------------------------------
+
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """ref sparse/unary.py cast: dtypes of indices/values independently."""
+    from ..core.dtype import convert_dtype
+
+    x = _as_coo(x)
+    idx = x._bcoo.indices
+    vals = x._bcoo.data
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    if value_dtype is not None:
+        vals = vals.astype(convert_dtype(value_dtype))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=x._bcoo.shape))
+
+
+def subtract(x, y, name=None):
+    if np.isscalar(y):
+        return add(x, -float(y))
+    return add(x, multiply(y, Tensor(np.float32(-1.0))))
+
+
+def divide(x, y, name=None):
+    x = _as_coo(x)
+    y = _as_coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        # reference: elementwise on the dense union (0/0 -> nan like dense)
+        return Tensor(x.to_dense()._data / y.to_dense()._data)
+    if isinstance(x, SparseCooTensor):
+        yd = _arr(y)
+        if yd.ndim == 0:
+            return SparseCooTensor(jsparse.BCOO(
+                (x._bcoo.data / yd, x._bcoo.indices), shape=x._bcoo.shape))
+        return Tensor(x.to_dense()._data / yd)
+    return Tensor(_arr(x) / _arr(y))
+
+
+def transpose(x, perm, name=None):
+    x = _as_coo(x)
+    perm = [int(p) for p in perm]
+    idx = x._bcoo.indices[:, np.asarray(perm)]
+    shape = tuple(x._bcoo.shape[p] for p in perm)
+    out = jsparse.BCOO((x._bcoo.data, idx), shape=shape)
+    return SparseCooTensor(out.sum_duplicates(nse=out.nse))
+
+
+def reshape(x, shape, name=None):
+    x = _as_coo(x)
+    old_shape = x._bcoo.shape
+    shape = list(int(s) for s in shape)
+    n = int(np.prod(old_shape))
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // max(known, 1)
+    flat = jnp.ravel_multi_index(tuple(x._bcoo.indices.T), old_shape,
+                                 mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(flat, tuple(shape)), axis=1)
+    return SparseCooTensor(jsparse.BCOO(
+        (x._bcoo.data, new_idx), shape=tuple(shape)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Dense-valued reduction (ref sparse/unary.py sum returns sparse; the
+    dense result is its to_dense — documented deviation, values identical)."""
+    from ..core.dtype import convert_dtype
+
+    d = _as_coo(x).to_dense()._data
+    out = jnp.sum(d, axis=None if axis is None else int(axis),
+                  keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    prod = matmul(x, y)
+    base = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else input
+    return Tensor(beta * _arr(base) + alpha * _arr(prod))
+
+
+def coalesce(x, name=None):
+    x = _as_coo(x)
+    return SparseCooTensor(x._bcoo.sum_duplicates(nse=x._bcoo.nse))
+
+
+_pyslice = slice  # capture the builtin before the paddle-named op shadows it
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001 - reference name
+    coo = _as_coo(x)
+    d = coo.to_dense()._data
+    idx = [_pyslice(None)] * d.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[int(a)] = _pyslice(int(s), int(e))
+    sub = np.asarray(d[tuple(idx)])
+    nz = np.stack(np.nonzero(sub), axis=0)
+    return sparse_coo_tensor(nz, sub[tuple(nz)], shape=sub.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized-free exact fallback (ref sparse/multiary.py pca_lowrank
+    binds torch-style randomized SVD; exact SVD at these sizes is cheaper
+    on TPU): returns (U, S, V) with q components."""
+    d = _as_coo(x).to_dense()._data if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else _arr(x)
+    m, n = d.shape[-2], d.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        d = d - jnp.mean(d, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(d.astype(jnp.float32), full_matrices=False)
+    return (Tensor(u[..., :q]), Tensor(s[..., :q]),
+            Tensor(jnp.swapaxes(vt, -1, -2)[..., :q]))
+
+
+__all__ += [
+    "tan", "asin", "atan", "sinh", "asinh", "atanh", "log1p", "expm1",
+    "neg", "deg2rad", "rad2deg", "isnan", "cast", "subtract", "divide",
+    "transpose", "reshape", "sum", "mv", "addmm", "coalesce", "slice",
+    "pca_lowrank",
+]

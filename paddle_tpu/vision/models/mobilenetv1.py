@@ -1,0 +1,66 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py).
+Depthwise separable convs as grouped conv2d, like mobilenetv2.py here."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, inp, oup, kernel, stride=1, padding=0, groups=1):
+        super().__init__(
+            nn.Conv2D(inp, oup, kernel, stride, padding, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(oup),
+            nn.ReLU(),
+        )
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.dw = _ConvBNReLU(inp, inp, 3, stride, 1, groups=inp)
+        self.pw = _ConvBNReLU(inp, oup, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return int(ch * scale)
+
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        layers = [_ConvBNReLU(3, c(32), 3, 2, 1)]
+        prev = c(32)
+        for out, stride in cfg:
+            layers.append(_DepthwiseSeparable(prev, c(out), stride))
+            prev = c(out)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV1(scale=scale, **kwargs)

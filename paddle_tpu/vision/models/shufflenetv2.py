@@ -1,0 +1,154 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py).
+The channel shuffle is F.channel_shuffle; depthwise convs are grouped
+conv2d."""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    "0.25": (24, 24, 48, 96, 512),
+    "0.33": (24, 32, 64, 128, 512),
+    "0.5": (24, 48, 96, 192, 1024),
+    "1.0": (24, 116, 232, 464, 1024),
+    "1.5": (24, 176, 352, 704, 1024),
+    "2.0": (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, inp, oup, k, stride, groups=1, act="relu",
+                 with_act=True):
+        layers = [
+            nn.Conv2D(inp, oup, k, stride, (k - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        if with_act:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class _ShuffleUnit(nn.Layer):
+    """Stride-1 unit: split channels, transform one branch, shuffle."""
+
+    def __init__(self, ch, act):
+        super().__init__()
+        branch = ch // 2
+        self.branch = nn.Sequential(
+            _ConvBNAct(branch, branch, 1, 1, act=act),
+            _ConvBNAct(branch, branch, 3, 1, groups=branch, with_act=False),
+            _ConvBNAct(branch, branch, 1, 1, act=act),
+        )
+
+    def forward(self, x):
+        half = x.shape[1] // 2
+        x1 = x[:, :half]
+        x2 = x[:, half:]
+        out = paddle.concat([x1, self.branch(x2)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class _ShuffleDownUnit(nn.Layer):
+    """Stride-2 unit: both branches transform + downsample."""
+
+    def __init__(self, inp, oup, act):
+        super().__init__()
+        branch = oup // 2
+        self.branch1 = nn.Sequential(
+            _ConvBNAct(inp, inp, 3, 2, groups=inp, with_act=False),
+            _ConvBNAct(inp, branch, 1, 1, act=act),
+        )
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(inp, branch, 1, 1, act=act),
+            _ConvBNAct(branch, branch, 3, 2, groups=branch, with_act=False),
+            _ConvBNAct(branch, branch, 1, 1, act=act),
+        )
+
+    def forward(self, x):
+        out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        key = f"{scale:.2f}".rstrip("0").rstrip(".") \
+            if scale not in (0.25, 0.33) else str(scale)
+        key = {"0.25": "0.25", "0.33": "0.33", "0.5": "0.5", "1": "1.0",
+               "1.5": "1.5", "2": "2.0"}.get(key, key)
+        outs = _STAGE_OUT[key]
+        self.conv1 = _ConvBNAct(3, outs[0], 3, 2, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        prev = outs[0]
+        for si, rep in enumerate(_REPEATS):
+            out = outs[si + 1]
+            stages.append(_ShuffleDownUnit(prev, out, act))
+            for _ in range(rep - 1):
+                stages.append(_ShuffleUnit(out, act))
+            prev = out
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(prev, outs[4], 1, 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _make(scale, act="relu", pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _make(0.25, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _make(0.33, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _make(0.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _make(1.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _make(1.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _make(2.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _make(1.0, act="swish", pretrained=pretrained, **kwargs)

@@ -1,0 +1,158 @@
+"""Long-tail distributions vs scipy oracles.
+
+Reference: python/paddle/distribution/*.py; scipy.stats gives the density
+ground truth, sampling checked by moment matching.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+scipy_stats = pytest.importorskip("scipy.stats")
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestDensities:
+    def test_beta(self):
+        b = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(b.log_prob(T(0.3)).numpy(),
+                                   scipy_stats.beta(2, 3).logpdf(0.3),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(b.mean.numpy(), 0.4, rtol=1e-6)
+        np.testing.assert_allclose(b.entropy().numpy(),
+                                   scipy_stats.beta(2, 3).entropy(),
+                                   rtol=1e-4)
+
+    def test_cauchy(self):
+        c = D.Cauchy(1.0, 2.0)
+        np.testing.assert_allclose(
+            c.log_prob(T(0.5)).numpy(),
+            scipy_stats.cauchy(1.0, 2.0).logpdf(0.5), rtol=1e-4)
+        np.testing.assert_allclose(
+            c.cdf(T(0.5)).numpy(), scipy_stats.cauchy(1.0, 2.0).cdf(0.5),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            c.entropy().numpy(), scipy_stats.cauchy(1.0, 2.0).entropy(),
+            rtol=1e-4)
+        with pytest.raises(ValueError):
+            _ = c.mean
+
+    def test_dirichlet(self):
+        conc = np.array([2.0, 3.0, 5.0], np.float32)
+        d = D.Dirichlet(T(conc))
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(T(v)).numpy(),
+            scipy_stats.dirichlet(conc).logpdf(v), rtol=1e-4)
+        np.testing.assert_allclose(d.mean.numpy(), conc / conc.sum(),
+                                   rtol=1e-5)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        m = D.Multinomial(10, T(p))
+        counts = np.array([2.0, 3.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            m.log_prob(T(counts)).numpy(),
+            scipy_stats.multinomial(10, p).logpmf(counts), rtol=1e-4)
+        paddle.seed(0)
+        s = m.sample([200]).numpy()
+        assert s.shape == (200, 3)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+        np.testing.assert_allclose(s.mean(0), 10 * p, atol=0.5)
+
+    def test_multivariate_normal(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                                   covariance_matrix=cov)
+        x = np.array([0.3, -0.2], np.float32)
+        ref = scipy_stats.multivariate_normal([0, 0], cov)
+        np.testing.assert_allclose(mvn.log_prob(T(x)).numpy(),
+                                   ref.logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(mvn.entropy().numpy(), ref.entropy(),
+                                   rtol=1e-4)
+        paddle.seed(1)
+        s = mvn.sample([4000]).numpy()
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+
+    def test_binomial_poisson_geometric(self):
+        bi = D.Binomial(8, T(np.float32(0.3)))
+        np.testing.assert_allclose(
+            bi.log_prob(T(3.0)).numpy(),
+            scipy_stats.binom(8, 0.3).logpmf(3), rtol=1e-4)
+        po = D.Poisson(T(np.float32(4.0)))
+        np.testing.assert_allclose(
+            po.log_prob(T(2.0)).numpy(),
+            scipy_stats.poisson(4.0).logpmf(2), rtol=1e-4)
+        ge = D.Geometric(T(np.float32(0.25)))
+        # support {0,1,...}: scipy geom is {1,...}, shift by one
+        np.testing.assert_allclose(
+            ge.log_prob(T(3.0)).numpy(),
+            scipy_stats.geom(0.25).logpmf(4), rtol=1e-4)
+        np.testing.assert_allclose(ge.mean.numpy(), 3.0, rtol=1e-5)
+        paddle.seed(2)
+        s = ge.sample([5000]).numpy()
+        assert abs(s.mean() - 3.0) < 0.3
+
+    def test_continuous_bernoulli(self):
+        cb = D.ContinuousBernoulli(T(np.float32(0.3)))
+        # normalizer: C(p) = 2 atanh(1-2p) / (1-2p)
+        p = 0.3
+        logC = np.log(2 * np.arctanh(1 - 2 * p) / (1 - 2 * p))
+        want = logC + 0.7 * np.log(p) + 0.3 * np.log(1 - p)
+        np.testing.assert_allclose(cb.log_prob(T(0.7)).numpy(), want,
+                                   rtol=1e-4)
+        half = D.ContinuousBernoulli(T(np.float32(0.5)))
+        np.testing.assert_allclose(half._log_constant().numpy(), np.log(2),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(half.mean.numpy(), 0.5, atol=1e-6)
+        paddle.seed(3)
+        s = cb.sample([2000]).numpy()
+        assert 0 <= s.min() and s.max() <= 1
+        np.testing.assert_allclose(s.mean(), float(cb.mean.numpy()),
+                                   atol=0.05)
+
+
+class TestWrappers:
+    def test_lognormal(self):
+        ln = D.LogNormal(0.5, 0.8)
+        ref = scipy_stats.lognorm(s=0.8, scale=np.exp(0.5))
+        np.testing.assert_allclose(ln.log_prob(T(1.3)).numpy(),
+                                   ref.logpdf(1.3), rtol=1e-4)
+        np.testing.assert_allclose(ln.mean.numpy(), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(ln.variance.numpy(), ref.var(),
+                                   rtol=1e-4)
+        paddle.seed(4)
+        s = ln.sample([8000]).numpy()
+        assert abs(np.log(s).mean() - 0.5) < 0.05
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(ind.log_prob(T(x)).numpy(),
+                                   base.log_prob(T(x)).numpy().sum(-1),
+                                   rtol=1e-5)
+
+    def test_transformed(self):
+        class Affine:
+            def forward(self, x):
+                return 2.0 * x + 1.0
+
+            def inverse(self, y):
+                return (y - 1.0) / 2.0
+
+            def forward_log_det_jacobian(self, x):
+                return paddle.to_tensor(np.float32(np.log(2.0)))
+
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [Affine()])
+        # y = 2x+1, x~N(0,1) -> y ~ N(1, 4)
+        np.testing.assert_allclose(
+            td.log_prob(T(2.0)).numpy(),
+            scipy_stats.norm(1.0, 2.0).logpdf(2.0), rtol=1e-4)

@@ -1,0 +1,308 @@
+"""Long-tail tensor-API parity ops (ops/extras.py + bulk inplace surface).
+
+Reference model: test/legacy_test per-op tests; here numpy oracles. Also
+asserts the audit invariant the round-4 work established: every name in the
+reference's top-level ``python/paddle/__init__.py`` ``__all__`` exists on
+``paddle_tpu``.
+"""
+
+import ast
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestStacks:
+    def test_stacks_match_numpy(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        for name in ("hstack", "vstack", "dstack", "column_stack",
+                     "row_stack"):
+            got = getattr(paddle, name)([T(a), T(b)]).numpy()
+            np.testing.assert_allclose(got, getattr(np, name)((a, b)),
+                                       err_msg=name)
+
+    def test_take_and_reverse(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([0, 5, -1])
+        np.testing.assert_allclose(paddle.take(T(x), T(idx)).numpy(),
+                                   np.take(x, idx))
+        np.testing.assert_allclose(
+            paddle.reverse(T(x), axis=[0]).numpy(), x[::-1])
+
+    def test_unflatten_unfold(self):
+        x = np.arange(24, dtype=np.float32)
+        got = paddle.unflatten(T(x), 0, [4, 6])
+        assert got.shape == [4, 6]
+        w = paddle.unfold(T(x), 0, size=4, step=2).numpy()
+        assert w.shape == (11, 4)
+        np.testing.assert_allclose(w[3], x[6:10])
+
+    def test_multiplex(self):
+        a = np.arange(8, dtype=np.float32).reshape(4, 2)
+        b = -a
+        index = np.array([[0], [1], [0], [1]], np.int32)
+        out = paddle.multiplex([T(a), T(b)], T(index)).numpy()
+        np.testing.assert_allclose(out, np.stack([a[0], b[1], a[2], b[3]]))
+
+
+class TestScatterFamily:
+    def test_diag_embed_and_scatter(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        d = paddle.diag_embed(T(x)).numpy()
+        np.testing.assert_allclose(d, np.diag(x))
+        d1 = paddle.diag_embed(T(x), offset=1).numpy()
+        np.testing.assert_allclose(d1, np.diag(x, 1))
+
+        m = np.zeros((3, 3), np.float32)
+        out = paddle.diagonal_scatter(T(m), T(x)).numpy()
+        np.testing.assert_allclose(np.diag(out), x)
+
+    def test_select_slice_scatter(self):
+        x = np.zeros((3, 4), np.float32)
+        v = np.ones(4, np.float32)
+        out = paddle.select_scatter(T(x), T(v), axis=0, index=1).numpy()
+        np.testing.assert_allclose(out[1], v)
+        sl = paddle.slice_scatter(T(x), T(np.full((3, 2), 7.0, np.float32)),
+                                  axes=[1], starts=[1], ends=[3],
+                                  strides=[1]).numpy()
+        assert (sl[:, 1:3] == 7).all() and (sl[:, 0] == 0).all()
+
+    def test_masked_scatter_index_fill(self):
+        x = np.zeros(6, np.float32)
+        mask = np.array([True, False, True, False, True, False])
+        vals = np.array([1.0, 2.0, 3.0, 99.0], np.float32)
+        out = paddle.masked_scatter(T(x), T(mask), T(vals)).numpy()
+        np.testing.assert_allclose(out, [1, 0, 2, 0, 3, 0])
+
+        y = paddle.index_fill(T(x), T(np.array([1, 4])), 0, 5.0).numpy()
+        np.testing.assert_allclose(y, [0, 5, 0, 0, 5, 0])
+
+    def test_scatter_nd(self):
+        idx = np.array([[1], [3]], np.int64)
+        upd = np.array([9.0, 10.0], np.float32)
+        out = paddle.scatter_nd(T(idx), T(upd), [5]).numpy()
+        np.testing.assert_allclose(out, [0, 9, 0, 10, 0])
+
+
+class TestMathExtras:
+    def test_distances(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        y = np.random.randn(5, 3).astype(np.float32)
+        cd = paddle.cdist(T(x), T(y)).numpy()
+        ref = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(cd, ref, rtol=1e-5, atol=1e-6)
+        pd = paddle.pdist(T(x)).numpy()
+        r, c = np.triu_indices(4, 1)
+        np.testing.assert_allclose(pd, ref2 := np.sqrt(
+            ((x[r] - x[c]) ** 2).sum(-1)), rtol=1e-5, atol=1e-6)
+        d = paddle.dist(T(x), T(x[:1]), p=2).numpy()
+        np.testing.assert_allclose(
+            d, np.linalg.norm((x - x[:1]).ravel()), rtol=1e-5)
+
+    def test_special(self):
+        from scipy import special as sp
+
+        x = np.abs(np.random.randn(8).astype(np.float32)) + 0.1
+        np.testing.assert_allclose(paddle.i0e(T(x)).numpy(), sp.i0e(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(paddle.i1(T(x)).numpy(), sp.i1(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(paddle.i1e(T(x)).numpy(), sp.i1e(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(paddle.polygamma(T(x), 1).numpy(),
+                                   sp.polygamma(1, x), rtol=1e-3)
+        np.testing.assert_allclose(paddle.multigammaln(T(x) + 3, 2).numpy(),
+                                   sp.multigammaln(x + 3, 2), rtol=1e-4)
+
+    def test_cums_and_integrals(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.cummin(T(x), axis=1).numpy(),
+                                   np.minimum.accumulate(x, 1))
+        np.testing.assert_allclose(
+            paddle.logcumsumexp(T(x), axis=1).numpy(),
+            np.log(np.cumsum(np.exp(x), 1)), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.trapezoid(T(x), axis=1).numpy(),
+                                   np.trapezoid(x, axis=1), rtol=1e-5)
+        ct = paddle.cumulative_trapezoid(T(x), axis=1).numpy()
+        assert ct.shape == (3, 4)
+        np.testing.assert_allclose(ct[:, -1], np.trapezoid(x, axis=1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_misc_math(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        v = np.random.randn(3).astype(np.float32)
+        np.testing.assert_allclose(paddle.mv(T(x), T(v)).numpy(), x @ v,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(paddle.sgn(T(x)).numpy(), np.sign(x))
+        np.testing.assert_allclose(paddle.signbit(T(x)).numpy(),
+                                   np.signbit(x))
+        p = np.random.rand(5).astype(np.float32) * 0.8 + 0.1
+        np.testing.assert_allclose(paddle.logit(T(p)).numpy(),
+                                   np.log(p / (1 - p)), rtol=1e-4)
+        m, e = paddle.frexp(T(x))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.ldexp(T(x), T(np.array([2]))).numpy(), x * 4)
+        n = paddle.renorm(T(x), p=2, axis=0, max_norm=1.0).numpy()
+        assert (np.linalg.norm(n, axis=1) <= 1.0 + 1e-5).all()
+        np.testing.assert_allclose(paddle.add_n([T(x), T(x), T(x)]).numpy(),
+                                   3 * x, rtol=1e-6)
+        nanx = x.copy()
+        nanx[0, 0] = np.nan
+        np.testing.assert_allclose(paddle.nanmedian(T(nanx)).numpy(),
+                                   np.nanmedian(nanx))
+        np.testing.assert_allclose(
+            paddle.nanquantile(T(nanx), 0.5).numpy(),
+            np.nanquantile(nanx, 0.5), rtol=1e-6)
+
+    def test_combinations_and_vander(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        c = paddle.combinations(T(x), 2).numpy()
+        np.testing.assert_allclose(c, [[1, 2], [1, 3], [2, 3]])
+        np.testing.assert_allclose(paddle.vander(T(x)).numpy(),
+                                   np.vander(x))
+
+    def test_complex_polar(self):
+        re = np.array([1.0, 0.0], np.float32)
+        im = np.array([0.0, 1.0], np.float32)
+        z = paddle.complex(T(re), T(im)).numpy()
+        np.testing.assert_allclose(z, re + 1j * im)
+        pz = paddle.polar(T(np.array([2.0], np.float32)),
+                          T(np.array([np.pi / 2], np.float32))).numpy()
+        np.testing.assert_allclose(pz.real, 0.0, atol=1e-6)
+        np.testing.assert_allclose(pz.imag, 2.0, rtol=1e-6)
+
+
+class TestCreationAttr:
+    def test_tri_indices(self):
+        t = paddle.tril_indices(3, 3).numpy()
+        r, c = np.tril_indices(3)
+        np.testing.assert_array_equal(t, np.stack([r, c]))
+        t2 = paddle.triu_indices(3, offset=1).numpy()
+        r2, c2 = np.triu_indices(3, 1)
+        np.testing.assert_array_equal(t2, np.stack([r2, c2]))
+
+    def test_shape_rank_broadcast(self):
+        x = T(np.zeros((2, 3), np.float32))
+        np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 3])
+        assert int(paddle.rank(x).numpy()) == 2
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    def test_dtype_introspection(self):
+        x = T(np.zeros(2, np.float32))
+        assert bool(paddle.is_floating_point(x))
+        assert not bool(paddle.is_integer(x))
+        assert not bool(paddle.is_complex(x))
+        assert paddle.finfo("bfloat16").bits == 16
+        assert paddle.iinfo("int32").max == 2**31 - 1
+
+    def test_random_families(self):
+        paddle.seed(7)
+        b = paddle.binomial(T(np.full(1000, 10.0, np.float32)),
+                            T(np.full(1000, 0.5, np.float32))).numpy()
+        assert 3.5 < b.mean() < 6.5 and b.max() <= 10
+        p = paddle.poisson(T(np.full(1000, 4.0, np.float32))).numpy()
+        assert 3.0 < p.mean() < 5.0
+
+
+class TestInplaceSurface:
+    def test_inplace_rebinds_and_tracks_grad(self):
+        x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = x * 2
+        y.abs_()  # inplace on a tracked intermediate
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, -2.0, 2.0])
+
+    def test_inplace_math_values(self):
+        x = T(np.array([1.0, 4.0, 9.0], np.float32))
+        assert x.sqrt_() is x
+        np.testing.assert_allclose(x.numpy(), [1, 2, 3])
+        x.add_(T(np.ones(3, np.float32)))
+        np.testing.assert_allclose(x.numpy(), [2, 3, 4])
+        x.clip_(0, 3.5)
+        np.testing.assert_allclose(x.numpy(), [2, 3, 3.5])
+
+    def test_toplevel_inplace_functions(self):
+        x = T(np.array([-1.0, 2.0], np.float32))
+        out = paddle.abs_(x)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), [1, 2])
+        t2 = T(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        paddle.tril_(t2)
+        np.testing.assert_allclose(t2.numpy(), [[1, 0], [3, 4]])
+
+    def test_inplace_random(self):
+        paddle.seed(3)
+        x = T(np.zeros((200,), np.float32))
+        x.normal_(mean=1.0, std=2.0)
+        assert 0.5 < x.numpy().mean() < 1.5
+        x.uniform_(0.0, 1.0)
+        assert 0 <= x.numpy().min() and x.numpy().max() <= 1
+        x.exponential_()
+        assert (x.numpy() >= 0).all()
+        x.cauchy_()
+        x.geometric_(0.5)
+        assert (x.numpy() >= 1).all()
+
+
+class TestTopLevelInfra:
+    def test_create_parameter(self):
+        p = paddle.create_parameter([4, 4])
+        assert isinstance(p, paddle.Parameter)
+        assert p.numpy().std() > 0  # xavier init, not zeros
+        pb = paddle.create_parameter([4], is_bias=True)
+        assert (pb.numpy() == 0).all()
+
+    def test_batch_reader(self):
+        def reader():
+            yield from range(7)
+
+        out = list(paddle.batch(reader, 3)())
+        assert out == [[0, 1, 2], [3, 4, 5], [6]]
+        out = list(paddle.batch(reader, 3, drop_last=True)())
+        assert out == [[0, 1, 2], [3, 4, 5]]
+
+    def test_places_and_guards(self):
+        assert paddle.CPUPlace() == paddle.CPUPlace()
+        assert paddle.CUDAPlace(0) != paddle.CPUPlace()
+        with paddle.LazyGuard():
+            p = paddle.create_parameter([2])
+        assert p.shape == [2]
+        with pytest.raises(TypeError):
+            paddle.check_shape("notashape", "op")
+
+    def test_flops_and_summary(self, capsys):
+        import paddle_tpu.nn as nn
+
+        net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                            nn.Flatten(), nn.Linear(8 * 4 * 4, 10))
+        n = paddle.flops(net, input_size=[1, 3, 4, 4])
+        # conv: 16 out elems * 8 ch * 9 * 3 MACs + linear 128*10
+        assert n == 4 * 4 * 8 * 9 * 3 + 128 * 10
+        info = paddle.summary(net, input_size=[1, 3, 4, 4])
+        assert info["total_params"] > 0
+        capsys.readouterr()
+
+
+class TestTopLevelAuditComplete:
+    def test_reference_all_covered(self):
+        src = open("/root/reference/python/paddle/__init__.py").read()
+        ref_all = None
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        ref_all = ast.literal_eval(node.value)
+        assert ref_all
+        missing = [n for n in ref_all if not hasattr(paddle, n)]
+        assert missing == [], f"top-level API gaps vs reference: {missing}"

@@ -1,0 +1,247 @@
+"""Namespace-parity additions: fft hermitian 2d/nd, metric.accuracy, io
+samplers, sparse long-tail ops, distributed compat surface.
+
+Each asserts behavior (numpy/roundtrip oracles), plus the audit invariant
+that the reference __all__ of each namespace is fully covered.
+"""
+
+import ast
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _ref_all(path):
+    for node in ast.walk(ast.parse(open(path).read())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    return ast.literal_eval(node.value)
+    return []
+
+
+class TestFFTHermitian:
+    def test_hfftn_roundtrip(self):
+        rng = np.random.RandomState(0)
+        r = rng.randn(4, 8).astype(np.float32)
+        x = paddle.fft.ihfftn(T(r))
+        back = paddle.fft.hfftn(x, s=[4, 8])
+        np.testing.assert_allclose(back.numpy(), r, rtol=1e-4, atol=1e-4)
+
+    def test_hfft2_matches_1d_composition(self):
+        rng = np.random.RandomState(1)
+        r = rng.randn(6, 10).astype(np.float32)
+        x = paddle.fft.ihfft2(T(r))
+        back = paddle.fft.hfft2(x, s=[6, 10])
+        np.testing.assert_allclose(back.numpy(), r, rtol=1e-4, atol=1e-4)
+
+
+class TestMetricAccuracy:
+    def test_topk_accuracy(self):
+        scores = T(np.array([[0.1, 0.9, 0.0], [0.8, 0.05, 0.15],
+                             [0.2, 0.3, 0.5]], np.float32))
+        label = T(np.array([[1], [2], [2]], np.int64))
+        np.testing.assert_allclose(
+            paddle.metric.accuracy(scores, label, k=1).numpy(), 2 / 3,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.metric.accuracy(scores, label, k=2).numpy(), 1.0,
+            rtol=1e-6)
+
+
+class TestIOAdditions:
+    def test_subset_random_sampler(self):
+        s = paddle.io.SubsetRandomSampler([3, 7, 11])
+        out = list(iter(s))
+        assert sorted(out) == [3, 7, 11] and len(s) == 3
+        with pytest.raises(ValueError):
+            paddle.io.SubsetRandomSampler([])
+
+    def test_concat_dataset(self):
+        class R(paddle.io.Dataset):
+            def __init__(self, lo, n):
+                self.lo, self.n = lo, n
+
+            def __len__(self):
+                return self.n
+
+            def __getitem__(self, i):
+                return self.lo + i
+
+        d = paddle.io.ConcatDataset([R(0, 3), R(100, 2)])
+        assert len(d) == 5
+        assert [d[i] for i in range(5)] == [0, 1, 2, 100, 101]
+        assert d[-1] == 101
+
+
+class TestSparseAdditions:
+    def _coo(self):
+        import paddle_tpu.sparse as sp
+
+        return sp.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                    np.array([2.0, -3.0], np.float32),
+                                    shape=[2, 2])
+
+    def test_unary_family(self):
+        import paddle_tpu.sparse as sp
+
+        x = self._coo()
+        np.testing.assert_allclose(sp.neg(x).to_dense().numpy(),
+                                   [[0, -2], [3, 0]])
+        np.testing.assert_allclose(sp.expm1(x).to_dense().numpy(),
+                                   [[0, np.expm1(2.0)], [np.expm1(-3.0), 0]],
+                                   rtol=1e-6)
+        assert bool(sp.isnan(x).values().numpy().sum() == 0)
+
+    def test_structural(self):
+        import paddle_tpu.sparse as sp
+
+        x = self._coo()
+        np.testing.assert_allclose(sp.transpose(x, [1, 0]).to_dense().numpy(),
+                                   [[0, -3], [2, 0]])
+        np.testing.assert_allclose(sp.reshape(x, [4]).to_dense().numpy(),
+                                   [0, 2, -3, 0])
+        np.testing.assert_allclose(sp.sum(x).numpy(), -1.0)
+        c = sp.cast(x, value_dtype="float64")
+        assert "64" in str(c.values().numpy().dtype) or \
+               "32" in str(c.values().numpy().dtype)  # x64 off truncates
+
+    def test_scalar_subtract_and_reshape_infer(self):
+        """Review regressions: scalar subtrahend must not square; -1 in
+        reshape must infer the true dim."""
+        import paddle_tpu.sparse as sp
+
+        x = sp.sparse_coo_tensor(np.array([[0, 1], [0, 1]]),
+                                 np.array([1.0, 3.0], np.float32),
+                                 shape=[2, 2])
+        np.testing.assert_allclose(sp.subtract(x, 2.0).numpy(),
+                                   [[-1, -2], [-2, 1]])
+        assert list(sp.reshape(x, [4, -1]).shape) == [4, 1]
+
+    def test_binary_and_mm(self):
+        import paddle_tpu.sparse as sp
+
+        x = self._coo()
+        d = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        np.testing.assert_allclose(
+            sp.subtract(x, x).to_dense().numpy(), np.zeros((2, 2)))
+        np.testing.assert_allclose(
+            sp.divide(x, T(np.float32(2.0))).to_dense().numpy(),
+            [[0, 1], [-1.5, 0]])
+        np.testing.assert_allclose(sp.mv(x, T(np.array([1.0, 1.0],
+                                                       np.float32))).numpy(),
+                                   [2.0, -3.0])
+        np.testing.assert_allclose(
+            sp.addmm(T(d), x, T(d), beta=0.5, alpha=2.0).numpy(),
+            0.5 * d + 2.0 * (x.to_dense().numpy() @ d), rtol=1e-5)
+        u, s, v = sp.pca_lowrank(x, q=2)
+        assert s.shape == [2]
+
+
+class TestDistributedCompat:
+    def test_enums_and_entries(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        assert dist.ReduceType.kRedSum == 0
+        assert dist.ProbabilityEntry(0.5)._attr_str() == \
+            "probability_entry:0.5"
+        assert dist.CountFilterEntry(3)._attr_str() == "count_filter_entry:3"
+        assert dist.ShowClickEntry("s", "c")._attr_str() == \
+            "show_click_entry:s:c"
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(0.0)
+        assert dist.is_available()
+        assert dist.get_backend().startswith("xla:")
+
+    def test_datasets(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        f = tmp_path / "a.txt"
+        f.write_text("1 2\n3 4\n5 6\n")
+        ds = dist.InMemoryDataset()
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        ds.global_shuffle()
+        rows = sorted(list(ds))
+        assert rows == [["1", "2"], ["3", "4"], ["5", "6"]]
+        q = dist.QueueDataset()
+        q.set_filelist([str(f)])
+        assert len(list(q)) == 3
+
+    def test_split_linear_and_embedding(self):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(1)
+        x = T(np.random.randn(4, 8).astype(np.float32))
+        out = dist.split(x, (8, 6), operation="linear", axis=1,
+                         num_partitions=2)
+        assert out.shape == [4, 6]
+        w = dist.split.last_layer.weight
+        np.testing.assert_allclose(out.numpy(), x.numpy() @ w.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        ids = T(np.array([[0, 3], [5, 1]], np.int64))
+        emb = dist.split(ids, (10, 4), operation="embedding",
+                         num_partitions=2)
+        assert emb.shape == [2, 2, 4]
+
+    def test_dist_model_to_static(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+
+        paddle.seed(2)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        dm, _ = dist.to_static(model, loss=loss_fn, optimizer=opt)
+        assert dm.mode == "train"
+        X = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+        Y = (X.sum(1) > 0).astype(np.int64)
+        losses = [float(dm(T(X), T(Y)).numpy()) for _ in range(15)]
+        assert losses[-1] < losses[0]
+        dm.eval()
+        ev = float(dm(T(X), T(Y)).numpy())
+        assert np.isfinite(ev)
+        dm.predict()
+        out = dm(T(X))
+        assert out.shape == [16, 4]
+
+    def test_io_persistables(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+
+        paddle.seed(3)
+        m = nn.Linear(4, 3)
+        p = dist.io.save_persistables(m, str(tmp_path))
+        m2 = nn.Linear(4, 3)
+        dist.io.load_persistables(m2, str(tmp_path))
+        np.testing.assert_allclose(m2.weight.numpy(), m.weight.numpy())
+        assert dist.io.is_persistable(m.weight)
+
+
+class TestNamespaceAuditsComplete:
+    @pytest.mark.parametrize("ref,mod", [
+        ("distributed/__init__.py", "paddle_tpu.distributed"),
+        ("sparse/__init__.py", "paddle_tpu.sparse"),
+        ("fft.py", "paddle_tpu.fft"),
+        ("metric/__init__.py", "paddle_tpu.metric"),
+        ("io/__init__.py", "paddle_tpu.io"),
+        ("nn/__init__.py", "paddle_tpu.nn"),
+        ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
+    ])
+    def test_all_covered(self, ref, mod):
+        import importlib
+
+        ra = _ref_all("/root/reference/python/paddle/" + ref)
+        assert ra, f"no __all__ parsed from {ref}"
+        m = importlib.import_module(mod)
+        missing = [n for n in ra if not hasattr(m, n)]
+        assert missing == [], f"{mod} gaps: {missing}"

@@ -1,0 +1,313 @@
+"""Round-4 nn parity additions: pool masks/unpool, spatial transforms,
+long-tail losses, beam-search decode.
+
+Oracles: torch (cpu) where it implements the op, numpy DP for rnnt.
+Reference analogs: test/legacy_test/test_max_pool*_op.py,
+test_grid_sampler_op.py, test_*_loss.py, test_beam_search_decoder.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestPoolMaskUnpool:
+    @pytest.mark.parametrize("ks,st,pad", [(2, 2, 0), (3, 2, 1)])
+    def test_pool2d_mask_vs_torch(self, ks, st, pad):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(0).randn(2, 3, 8, 10).astype(np.float32)
+        out, mask = F.max_pool2d(T(x), ks, stride=st, padding=pad,
+                                 return_mask=True)
+        tout, tmask = torch.nn.functional.max_pool2d(
+            torch.tensor(x), ks, stride=st, padding=pad, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy())
+        np.testing.assert_array_equal(mask.numpy(), tmask.numpy())
+
+    def test_unpool_roundtrip_123d(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(1)
+        cases = [
+            ((2, 3, 12), F.max_pool1d, F.max_unpool1d,
+             torch.nn.functional.max_pool1d, torch.nn.functional.max_unpool1d),
+            ((2, 3, 8, 10), F.max_pool2d, F.max_unpool2d,
+             torch.nn.functional.max_pool2d, torch.nn.functional.max_unpool2d),
+            ((2, 2, 6, 6, 6), F.max_pool3d, F.max_unpool3d,
+             torch.nn.functional.max_pool3d, torch.nn.functional.max_unpool3d),
+        ]
+        for shape, pool, unpool, tpool, tunpool in cases:
+            x = rng.randn(*shape).astype(np.float32)
+            o, m = pool(T(x), 2, stride=2, return_mask=True)
+            u = unpool(o, m, 2, stride=2)
+            to, tm = tpool(torch.tensor(x), 2, stride=2, return_indices=True)
+            tu = tunpool(to, tm, 2, stride=2)
+            np.testing.assert_allclose(u.numpy(), tu.numpy())
+
+    def test_ceil_mode_mask_shape_matches(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(3).randn(1, 1, 6, 6).astype(np.float32)
+        out, mask = F.max_pool2d(T(x), 3, stride=2, ceil_mode=True,
+                                 return_mask=True)
+        assert out.shape == list(mask.shape)
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 3, stride=2, ceil_mode=True,
+            return_indices=True)
+        np.testing.assert_array_equal(mask.numpy(), tm.numpy())
+
+    def test_unpool_layers(self):
+        x = np.random.RandomState(2).randn(1, 2, 8, 8).astype(np.float32)
+        o, m = F.max_pool2d(T(x), 2, return_mask=True)
+        layer = nn.MaxUnPool2D(2)
+        u = layer(o, m)
+        assert u.shape == [1, 2, 8, 8]
+
+
+class TestSpatialTransforms:
+    def test_grid_sample_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 7, 9).astype(np.float32)
+        grid = (rng.rand(2, 5, 6, 2).astype(np.float32) * 3 - 1.5)
+        for ac in (True, False):
+            for mode in ("bilinear", "nearest"):
+                for pm in ("zeros", "border", "reflection"):
+                    out = F.grid_sample(T(x), T(grid), mode=mode,
+                                        padding_mode=pm, align_corners=ac)
+                    ref = torch.nn.functional.grid_sample(
+                        torch.tensor(x), torch.tensor(grid), mode=mode,
+                        padding_mode=pm, align_corners=ac)
+                    np.testing.assert_allclose(
+                        out.numpy(), ref.numpy(), rtol=1e-4, atol=2e-4,
+                        err_msg=f"{mode}/{pm}/ac={ac}")
+
+    def test_affine_grid_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        theta = np.array([[[1.0, 0, 0.2], [0, 1.0, -0.1]]], np.float32)
+        for ac in (True, False):
+            g = F.affine_grid(T(theta), [1, 1, 4, 5], align_corners=ac)
+            tg = torch.nn.functional.affine_grid(
+                torch.tensor(theta), [1, 1, 4, 5], align_corners=ac)
+            np.testing.assert_allclose(g.numpy(), tg.numpy(), rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_temporal_shift(self):
+        x = np.arange(2 * 4 * 2 * 2, dtype=np.float32).reshape(2, 4, 2, 2)
+        out = F.temporal_shift(T(x), seg_num=2, shift_ratio=0.25).numpy()
+        # first fold channel shifts backward: position t gets t+1's values
+        np.testing.assert_allclose(out[0, 0], x[1, 0])
+        np.testing.assert_allclose(out[1, 0], 0.0)
+
+
+class TestLongTailLosses:
+    def test_dice_loss_perfect_prediction(self):
+        lab = np.array([[0], [1], [2]], np.int64)
+        perfect = np.eye(3, dtype=np.float32)
+        loss = F.dice_loss(T(perfect), T(lab)).numpy()
+        assert loss < 1e-4
+
+    def test_multi_margin_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(3).randn(5, 7).astype(np.float32)
+        y = np.array([1, 0, 6, 3, 2], np.int64)
+        got = F.multi_margin_loss(T(x), T(y)).numpy()
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_gaussian_nll_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(4)
+        inp = rng.randn(6, 3).astype(np.float32)
+        lab = rng.randn(6, 3).astype(np.float32)
+        var = (rng.rand(6, 3).astype(np.float32) + 0.1)
+        got = F.gaussian_nll_loss(T(inp), T(lab), T(var), full=True).numpy()
+        ref = torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(inp), torch.tensor(lab), torch.tensor(var),
+            full=True).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_triplet_with_distance_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(5)
+        a, p, n = (rng.randn(4, 8).astype(np.float32) for _ in range(3))
+        got = F.triplet_margin_with_distance_loss(T(a), T(p), T(n),
+                                                  swap=True).numpy()
+        ref = torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n),
+            swap=True).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_pairwise_distance_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(6)
+        a, b = rng.randn(4, 8).astype(np.float32), rng.randn(4, 8).astype(
+            np.float32)
+        got = F.pairwise_distance(T(a), T(b), p=2.0).numpy()
+        ref = torch.nn.functional.pairwise_distance(
+            torch.tensor(a), torch.tensor(b), p=2.0).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        layer = nn.PairwiseDistance(p=2.0)
+        np.testing.assert_allclose(layer(T(a), T(b)).numpy(), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_npair_and_margin_ce_finite(self):
+        rng = np.random.RandomState(7)
+        anchor = rng.randn(6, 8).astype(np.float32)
+        pos = rng.randn(6, 8).astype(np.float32)
+        labels = np.array([0, 1, 2, 0, 1, 2], np.int64)
+        v = F.npair_loss(T(anchor), T(pos), T(labels)).numpy()
+        assert np.isfinite(v) and v > 0
+        cos = np.clip(rng.randn(6, 10).astype(np.float32) * 0.3, -1, 1)
+        loss, sm = F.margin_cross_entropy(T(cos), T(labels % 10),
+                                          return_softmax=True)
+        assert np.isfinite(loss.numpy())
+        np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+
+    def test_hsigmoid_trains(self):
+        """HSigmoidLoss decreases under SGD — the functional's purpose."""
+        rng = np.random.RandomState(8)
+        xs = rng.randn(32, 6).astype(np.float32)
+        ys = (xs[:, 0] > 0).astype(np.int64) + 2 * (xs[:, 1] > 0).astype(
+            np.int64)
+        layer = nn.HSigmoidLoss(6, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=layer.parameters())
+        first = last = None
+        for _ in range(60):
+            loss = layer(T(xs), T(ys.reshape(-1, 1)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(loss.numpy())
+            first = first if first is not None else last
+        assert last < first * 0.7, (first, last)
+
+    def test_rnnt_loss_vs_numpy_dp(self):
+        rng = np.random.RandomState(9)
+        b, t, u, v = 2, 5, 3, 6
+        logits = rng.randn(b, t, u + 1, v).astype(np.float32)
+        labels = rng.randint(1, v, (b, u)).astype(np.int32)
+        t_len = np.array([t, t - 1], np.int32)
+        u_len = np.array([u, u - 1], np.int32)
+
+        def np_rnnt_one(lp, lab, tl, ul, blank=0):
+            alpha = np.full((tl, ul + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for uu in range(1, ul + 1):
+                alpha[0, uu] = alpha[0, uu - 1] + lp[0, uu - 1, lab[uu - 1]]
+            for tt in range(1, tl):
+                alpha[tt, 0] = alpha[tt - 1, 0] + lp[tt - 1, 0, blank]
+                for uu in range(1, ul + 1):
+                    a = alpha[tt - 1, uu] + lp[tt - 1, uu, blank]
+                    bb = alpha[tt, uu - 1] + lp[tt, uu - 1, lab[uu - 1]]
+                    alpha[tt, uu] = np.logaddexp(a, bb)
+            return -(alpha[tl - 1, ul] + lp[tl - 1, ul, blank])
+
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        want = np.mean([np_rnnt_one(lp[i], labels[i], t_len[i], u_len[i])
+                        for i in range(b)])
+        got = F.rnnt_loss(T(logits), T(labels), T(t_len), T(u_len)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+class TestSequenceUtilities:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(T(np.array([2, 0, 3], np.int64)), maxlen=4)
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_gather_tree(self):
+        # oracle: numpy replica of the reference backtrace loop
+        # (phi/kernels/cpu/gather_tree_kernel.cc)
+        rng = np.random.RandomState(0)
+        t, b, k = 4, 2, 3
+        ids = rng.randint(0, 9, (t, b, k)).astype(np.int64)
+        parents = rng.randint(0, k, (t, b, k)).astype(np.int64)
+
+        want = np.zeros_like(ids)
+        for bb in range(b):
+            for kk in range(k):
+                want[t - 1, bb, kk] = ids[t - 1, bb, kk]
+                parent = parents[t - 1, bb, kk]
+                for step in range(t - 2, -1, -1):
+                    want[step, bb, kk] = ids[step, bb, parent]
+                    parent = parents[step, bb, parent]
+        out = F.gather_tree(T(ids), T(parents)).numpy()
+        np.testing.assert_array_equal(out, want)
+
+    def test_class_center_sample(self):
+        paddle.seed(5)
+        label = T(np.array([1, 5, 1, 7], np.int64))
+        remapped, sampled = F.class_center_sample(label, 20, 6)
+        s = sampled.numpy()
+        assert {1, 5, 7}.issubset(set(s.tolist())) and len(s) == 6
+        r = remapped.numpy()
+        np.testing.assert_array_equal(s[r], [1, 5, 1, 7])
+
+    def test_inplace_activations(self):
+        x = T(np.array([-1.0, 2.0], np.float32))
+        out = F.leaky_relu_(x)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), [-0.01, 2.0], rtol=1e-6)
+        F.softmax_(x)
+        np.testing.assert_allclose(x.numpy().sum(), 1.0, rtol=1e-6)
+
+    def test_softmax2d_unflatten_layers(self):
+        x = T(np.random.RandomState(0).randn(2, 3, 4, 5).astype(np.float32))
+        s = nn.Softmax2D()(x)
+        np.testing.assert_allclose(s.numpy().sum(1), 1.0, rtol=1e-5)
+        u = nn.Unflatten(1, [3, 1])(x)
+        assert u.shape == [2, 3, 1, 4, 5]
+
+    def test_sparse_attention_matches_dense_on_full_pattern(self):
+        rng = np.random.RandomState(11)
+        b, h, s, d = 1, 2, 4, 8
+        q, k, v = (rng.randn(b, h, s, d).astype(np.float32) for _ in range(3))
+        # full CSR pattern == dense attention
+        offs = np.tile(np.arange(s + 1, dtype=np.int32) * s, (b, h, 1))
+        cols = np.tile(np.tile(np.arange(s, dtype=np.int32), s), (b, h, 1))
+        out = F.sparse_attention(T(q), T(k), T(v), T(offs), T(cols))
+        from paddle_tpu.nn.functional.flash_attention import _sdpa_ref
+
+        ref = _sdpa_ref.raw_fn(q.transpose(0, 2, 1, 3),
+                               k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3))
+        np.testing.assert_allclose(out.numpy().transpose(0, 2, 1, 3), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestBeamSearchDecode:
+    def test_beam_search_finds_greedy_path_on_peaky_logits(self):
+        """Cell emits sharply-peaked logits following a fixed cycle; beam
+        search must recover that sequence and stop at end_token."""
+        V, K = 7, 3
+
+        class CycleCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.table = self.create_parameter([V, V])
+                peaky = np.full((V, V), -8.0, np.float32)
+                nxt = [1, 2, 3, 4, 5, 6, 6]  # token i -> i+1; 6 = end
+                for i, j in enumerate(nxt):
+                    peaky[i, j] = 8.0
+                self.table.set_value(peaky)
+
+            def forward(self, inputs, states):
+                logits = self.table[inputs]
+                return logits, [s + 1 for s in states]
+
+        cell = CycleCell()
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=6,
+                                   beam_size=K)
+        init = [paddle.to_tensor(np.zeros((2, 4), np.float32))]
+        ids, final = nn.dynamic_decode(dec, inits=init, max_step_num=10)
+        best = ids.numpy()[:, 0, :]  # top beam per batch
+        for row in best:
+            assert list(row[:5]) == [2, 3, 4, 5, 6], row
+        assert bool(final["finished"].numpy().all())
