@@ -4,7 +4,8 @@ Reference package: python/paddle/audio/ (functional/, features/, backends/;
 datasets/ are download-based and out of scope for an offline image).
 """
 
-from . import backends, features, functional  # noqa: F401
+from . import backends, datasets, features, functional  # noqa: F401
 from .backends import info, load, save  # noqa: F401
 
-__all__ = ["functional", "features", "backends", "load", "save", "info"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]

@@ -1,0 +1,119 @@
+"""paddle.audio.datasets (reference python/paddle/audio/datasets/).
+
+Download-free: TESS/ESC50 read a local extracted folder (the same layout
+the reference's downloader produces) and emit (feature, label) pairs using
+paddle.audio.features on host.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50", "AudioClassificationDataset"]
+
+
+def _load_wav(path):
+    import wave
+
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        raw = np.frombuffer(w.readframes(n), np.int16)
+        if w.getnchannels() > 1:
+            raw = raw.reshape(-1, w.getnchannels()).mean(1)
+    return raw.astype(np.float32) / 32768.0, sr
+
+
+class AudioClassificationDataset(Dataset):
+    """Base (reference audio/datasets/dataset.py): files + labels ->
+    (waveform-or-feature, label)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.feat_kwargs = kwargs
+
+    def _feature(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav
+        import paddle_tpu as paddle
+
+        from . import features
+
+        t = paddle.to_tensor(wav[None])
+        if self.feat_type == "melspectrogram":
+            return features.MelSpectrogram(sr=sr, **self.feat_kwargs)(t)
+        if self.feat_type == "mfcc":
+            return features.MFCC(sr=sr, **self.feat_kwargs)(t)
+        if self.feat_type == "logmelspectrogram":
+            return features.LogMelSpectrogram(sr=sr, **self.feat_kwargs)(t)
+        if self.feat_type == "spectrogram":
+            return features.Spectrogram(**self.feat_kwargs)(t)
+        raise ValueError(f"unknown feat_type {self.feat_type!r}")
+
+    def __getitem__(self, idx):
+        wav, sr = _load_wav(self.files[idx])
+        return self._feature(wav, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set (reference audio/datasets/tess.py):
+    label = emotion from the filename suffix. Pass the extracted folder as
+    ``data_file``."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_file=None, archive=None, **kwargs):
+        if not data_file:
+            raise ValueError("no network egress: TESS needs the local "
+                             "extracted dataset folder as data_file")
+        files, labels = [], []
+        for root, _, names in os.walk(data_file):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                emo = n.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.EMOTIONS:
+                    files.append(os.path.join(root, n))
+                    labels.append(self.EMOTIONS.index(emo))
+        fold = np.arange(len(files)) % n_folds + 1
+        keep = (fold != split) if mode == "train" else (fold == split)
+        files = [f for f, k in zip(files, keep) if k]
+        labels = [l for l, k in zip(labels, keep) if k]
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    label and fold parsed from the canonical filename
+    ``{fold}-{id}-{take}-{target}.wav``."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_file=None, **kwargs):
+        if not data_file:
+            raise ValueError("no network egress: ESC50 needs the local "
+                             "extracted dataset folder as data_file")
+        files, labels = [], []
+        for root, _, names in os.walk(data_file):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                parts = n[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, target = int(parts[0]), int(parts[3])
+                keep = (fold != split) if mode == "train" else (fold == split)
+                if keep:
+                    files.append(os.path.join(root, n))
+                    labels.append(target)
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

@@ -214,3 +214,53 @@ def saved_tensors_hooks(pack_hook, unpack_hook):
     arrays directly, so pack/unpack hooks have nothing to intercept. Reference:
     python/paddle/autograd/saved_tensors_hooks.py."""
     yield
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Reference autograd/autograd.py jacobian: lazy full Jacobian of
+    ``ys`` w.r.t ``xs``. TPU-native: rather than N backward passes through
+    the eager tape, re-trace the subgraph functionally and let
+    ``jax.jacrev`` batch the rows in one compiled program. ``ys`` must be
+    produced by a function of ``xs``; for API convenience this accepts a
+    callable or a (fn, primal) pair via paddle.autograd.jacobian(fn, x).
+    """
+    import jax
+
+    from ..core.state import trace_guard
+
+    if not callable(ys):
+        raise TypeError(
+            "paddle.autograd.jacobian here takes (fn, x): pass the function "
+            "producing ys (the eager-tape lazy-Jacobian form requires "
+            "recording every intermediate; the functional form compiles to "
+            "one fused program instead)")
+    fn = ys
+    x = xs
+
+    def arr_fn(a):
+        with trace_guard():
+            out = fn(Tensor._wrap(a))
+        return out._data if isinstance(out, Tensor) else out
+
+    j = jax.jacrev(arr_fn)(x._data if isinstance(x, Tensor) else x)
+    return Tensor._wrap(j)
+
+
+def hessian(func, xs, batch_axis=None):
+    """Reference autograd/autograd.py hessian — forward-over-reverse."""
+    import jax
+
+    from ..core.state import trace_guard
+
+    x = xs
+
+    def arr_fn(a):
+        with trace_guard():
+            out = func(Tensor._wrap(a))
+        return out._data if isinstance(out, Tensor) else out
+
+    h = jax.hessian(arr_fn)(x._data if isinstance(x, Tensor) else x)
+    return Tensor._wrap(h)
+
+
+__all__ += ["jacobian", "hessian"]

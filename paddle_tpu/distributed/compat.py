@@ -140,7 +140,8 @@ class _DatasetBase:
         self._use_var = list(use_var or [])
         self._parse_fn = parse_fn
 
-    set_batch_size = init
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
 
     def set_filelist(self, filelist):
         self._filelist = list(filelist)

@@ -61,6 +61,10 @@ class DistModel:
         if self._mode == "train":
             return eng._build_step()(*batch)
         if self._mode == "eval":
+            if eng._loss is None:
+                raise ValueError(
+                    "DistModel was built without a loss; eval mode needs "
+                    "one (pass loss= to dist.to_static, or use predict())")
             *ins, label = batch
             return eng._loss(self.network(*ins), label)
         return self.network(*batch)

@@ -221,12 +221,15 @@ class Multinomial(Distribution):
 
     def sample(self, shape=()):
         p = _arr(self.probs)
-        logits = jnp.log(p)
+        v = p.shape[-1]
         draws = jax.random.categorical(
-            _key(), logits,
+            _key(), jnp.log(p),
             shape=(self.total_count,) + tuple(shape) + self.batch_shape)
-        counts = jax.nn.one_hot(draws, p.shape[-1]).sum(axis=0)
-        return Tensor(counts.astype(p.dtype))
+        # O(n + V) counting per batch row (no [n, ..., V] one-hot)
+        flat = jnp.moveaxis(draws, 0, -1).reshape(-1, self.total_count)
+        counts = jax.vmap(lambda d: jnp.bincount(d, length=v))(flat)
+        return Tensor(counts.reshape(tuple(shape) + self.batch_shape
+                                     + (v,)).astype(p.dtype))
 
     def log_prob(self, value):
         from ..ops import math as m
@@ -458,7 +461,7 @@ class Binomial(Distribution):
         from ..ops import math as m
 
         v = _t(value)
-        n = _t(float(np.asarray(self.total_count)))
+        n = _t(self.total_count).astype("float32")  # scalar or per-element
         logc = (m.lgamma(n + 1.0) - m.lgamma(v + 1.0)
                 - m.lgamma(n - v + 1.0))
         return (logc + v * self.probs.log()

@@ -132,3 +132,139 @@ def _send_uv(x, y, src, dst, message_op="add"):
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     """reference send_recv.py:392 — per-edge message from both endpoints."""
     return _send_uv(x, y, src_index, dst_index, message_op=str(message_op))
+
+
+# ---------------------------------------------------------------------------
+# graph sampling / reindex (reference python/paddle/geometric/reindex.py,
+# sampling/neighbors.py — CPU kernels in the reference too; sampling is
+# host-side data preparation, the compiled path consumes its outputs)
+# ---------------------------------------------------------------------------
+
+def _np(x):
+    import numpy as np
+
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """(reindex_src, reindex_dst, out_nodes): relabel a sampled subgraph to
+    local ids — x's nodes first, new neighbor nodes in appearance order
+    (reference geometric/reindex.py:20)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    xs = _np(x).astype(np.int64)
+    nb = _np(neighbors).astype(np.int64)
+    cnt = _np(count).astype(np.int64)
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for v in nb:
+        mapping.setdefault(int(v), len(mapping))
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    src = np.fromiter((mapping[int(v)] for v in nb), np.int64, len(nb))
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return Tensor(src), Tensor(dst), Tensor(out_nodes)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: per-edge-type neighbor/count lists share one
+    node relabeling (reference geometric/reindex.py:129)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    xs = _np(x).astype(np.int64)
+    mapping = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = _np(nb_t).astype(np.int64)
+        cnt = _np(cnt_t).astype(np.int64)
+        for v in nb:
+            mapping.setdefault(int(v), len(mapping))
+        srcs.append(np.fromiter((mapping[int(v)] for v in nb), np.int64,
+                                len(nb)))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt))
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    return (Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)),
+            Tensor(out_nodes))
+
+
+def _sample(colptr_np, row_np, nodes_np, k, weights=None, rng=None):
+    import numpy as np
+
+    outs, counts, eids = [], [], []
+    for v in nodes_np:
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        deg = hi - lo
+        if k < 0 or deg <= k:
+            pick = np.arange(lo, hi)
+        elif weights is None:
+            pick = lo + rng.choice(deg, size=k, replace=False)
+        else:
+            w = weights[lo:hi].astype(np.float64)
+            p = w / w.sum() if w.sum() > 0 else None
+            pick = lo + rng.choice(deg, size=k, replace=False, p=p)
+        outs.append(row_np[pick])
+        eids.append(pick)
+        counts.append(len(pick))
+    return (np.concatenate(outs) if outs else np.zeros(0, np.int64),
+            np.asarray(counts, np.int64),
+            np.concatenate(eids) if eids else np.zeros(0, np.int64))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform k-neighbor sampling over a CSC graph (reference
+    geometric/sampling/neighbors.py:24). Returns (out_neighbors,
+    out_count[, out_eids])."""
+    import numpy as np
+
+    from ..core import rng as _rng
+    from ..core.tensor import Tensor
+
+    import jax
+
+    seed = int(jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1))
+    gen = np.random.default_rng(seed)
+    nb, cnt, picked = _sample(_np(colptr), _np(row).astype(np.int64),
+                              _np(input_nodes).astype(np.int64),
+                              int(sample_size), rng=gen)
+    if return_eids:
+        eid_arr = _np(eids).astype(np.int64)[picked] if eids is not None \
+            else picked
+        return Tensor(nb), Tensor(cnt), Tensor(eid_arr)
+    return Tensor(nb), Tensor(cnt)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional variant (reference sampling/neighbors.py:159)."""
+    import numpy as np
+
+    from ..core import rng as _rng
+    from ..core.tensor import Tensor
+
+    import jax
+
+    seed = int(jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1))
+    gen = np.random.default_rng(seed)
+    nb, cnt, picked = _sample(_np(colptr), _np(row).astype(np.int64),
+                              _np(input_nodes).astype(np.int64),
+                              int(sample_size), weights=_np(edge_weight),
+                              rng=gen)
+    if return_eids:
+        eid_arr = _np(eids).astype(np.int64)[picked] if eids is not None \
+            else picked
+        return Tensor(nb), Tensor(cnt), Tensor(eid_arr)
+    return Tensor(nb), Tensor(cnt)
+
+
+__all__ += ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+            "weighted_sample_neighbors"]

@@ -10,6 +10,16 @@ from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from .extras import (  # noqa: F401
+    LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,
+    graph_sample_neighbors, graph_send_recv, identity_loss, segment_max,
+    segment_mean, segment_min, segment_sum, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
 
 __all__ = ["FusedTrainStep", "fused_train_step", "asp", "autotune", "nn",
-           "optimizer"]
+           "optimizer", "LookAhead", "ModelAverage", "graph_khop_sampler",
+           "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
+           "identity_loss", "segment_max", "segment_mean", "segment_min",
+           "segment_sum", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]

@@ -1,0 +1,225 @@
+"""incubate long-tail: LookAhead/ModelAverage optimizers, fused masked
+softmax, graph-op aliases, segment reductions, identity_loss.
+
+Reference sites: python/paddle/incubate/optimizer/lookahead.py:30,
+modelaverage.py:29, operators/softmax_mask_fuse.py,
+softmax_mask_fuse_upper_triangle.py, operators/graph_*.py,
+tensor/math.py segment_*, paddle/fluid/operators identity_loss.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+
+__all__ = [
+    "LookAhead", "ModelAverage", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "graph_send_recv",
+    "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "identity_loss",
+]
+
+# segment reductions are first-class in geometric; incubate re-exports the
+# same ops (the reference grew them in incubate first, then promoted)
+from ..geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+
+@op("softmax_mask_fuse")
+def _softmax_mask_fuse(x, mask):
+    import jax
+
+    return jax.nn.softmax(x.astype(jnp.float32) + mask.astype(jnp.float32),
+                          axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused op (reference
+    operators/softmax_mask_fuse.py over the fused CUDA kernel; XLA fuses
+    the add into the softmax automatically — the API exists for parity)."""
+    return _softmax_mask_fuse(x, mask)
+
+
+@op("softmax_mask_fuse_upper_triangle")
+def _softmax_mask_fuse_upper_triangle(x):
+    import jax
+
+    s = x.shape[-1]
+    causal = jnp.tril(jnp.ones((x.shape[-2], s), bool), k=s - x.shape[-2])
+    logits = jnp.where(causal, x.astype(jnp.float32), -1e30)
+    return jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference
+    operators/softmax_mask_fuse_upper_triangle.py)."""
+    return _softmax_mask_fuse_upper_triangle(x)
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                    name=None):
+    """Alias of geometric.send_u_recv (the reference kept the incubate
+    name; operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=reduce_op,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling (reference operators/graph_khop_sampler.py):
+    chain sample_neighbors per hop, then reindex the union subgraph.
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import reindex_graph, sample_neighbors
+
+    cur = input_nodes
+    all_nb, all_cnt = [], []
+    seeds = [np.asarray(input_nodes.numpy())]
+    for k in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, cur, sample_size=int(k))
+        all_nb.append(np.asarray(nb.numpy()))
+        all_cnt.append((cur, cnt))
+        cur = Tensor(np.unique(np.asarray(nb.numpy())))
+        seeds.append(np.asarray(cur.numpy()))
+    # flatten hops into one edge list rooted at the original nodes
+    nbs = np.concatenate(all_nb) if all_nb else np.zeros(0, np.int64)
+    cnts = np.concatenate([np.asarray(c.numpy()) for _, c in all_cnt]) \
+        if all_cnt else np.zeros(0, np.int64)
+    srcs_nodes = np.concatenate([np.asarray(n.numpy())
+                                 for n, _ in all_cnt]) \
+        if all_cnt else np.zeros(0, np.int64)
+    src, dst, nodes = reindex_graph(Tensor(srcs_nodes), Tensor(nbs),
+                                    Tensor(cnts))
+    return src, dst, Tensor(np.unique(np.concatenate(seeds))), nodes
+
+
+@op("identity_loss")
+def _identity_loss(x, reduction=1):
+    if reduction == 0:
+        return jnp.sum(x)
+    if reduction == 1:
+        return jnp.mean(x)
+    return x
+
+
+def identity_loss(x, reduction="none"):
+    """Reference identity_loss op (IPU training epilogue): marks x as the
+    loss, optionally reducing. reduction: 'sum'|'mean'|'none' or 0|1|2."""
+    codes = {"sum": 0, "mean": 1, "none": 2}
+    r = codes.get(reduction, reduction)
+    return _identity_loss(x, reduction=int(r))
+
+
+class LookAhead:
+    """reference incubate/optimizer/lookahead.py:30 — fast weights step
+    with the inner optimizer every call; every k steps the slow weights
+    pull toward the fast ones and the fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        if self._slow is None:
+            # copy: the inner optimizers donate param buffers on update,
+            # which would delete aliased views of the old values
+            self._slow = [jnp.copy(p._data) for p in self._params()]
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            new_slow = []
+            for p, slow in zip(self._params(), self._slow):
+                s = slow + self.alpha * (p._data.astype(slow.dtype) - slow)
+                # rebind a distinct buffer: same-dtype astype is a no-copy
+                # alias, and the next inner step donates p's buffer
+                p._rebind(jnp.copy(s).astype(p._data.dtype))
+                new_slow.append(s)
+            self._slow = new_slow
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+
+class ModelAverage:
+    """reference incubate/optimizer/modelaverage.py:29 — running average
+    of parameters; ``apply()`` swaps averages in (optionally restoring)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        assert parameters is not None
+        self._params = list(parameters)
+        self._sum = [p._data.astype(jnp.float32) * 0 for p in self._params]
+        self._n = 0
+        self._backup = None
+
+    def step(self):
+        self._sum = [s + p._data.astype(jnp.float32)
+                     for s, p in zip(self._sum, self._params)]
+        self._n += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = [jnp.copy(p._data) for p in self._params]
+            n = max(self._n, 1)
+            for p, s in zip(self._params, self._sum):
+                p._rebind((s / n).astype(p._data.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._rebind(b)
+            self._backup = None

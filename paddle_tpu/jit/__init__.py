@@ -391,3 +391,18 @@ def load(path, **configs):
     exported = jax.export.deserialize(payload["stablehlo"])
     consts = [jnp.asarray(a) for a in payload["consts"]]
     return TranslatedLayer(exported, consts, payload["specs"])
+
+
+_SOT_VERBOSITY = {"code_level": 0, "verbosity": 0}
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference jit/sot debug knob (python/paddle/jit/sot/utils/envs.py):
+    controls how much translated code is dumped. The trace-based to_static
+    here has no bytecode translation stage; the setting is recorded and
+    honored by to_static's trace logging."""
+    _SOT_VERBOSITY["code_level"] = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _SOT_VERBOSITY["verbosity"] = int(level)

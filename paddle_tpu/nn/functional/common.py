@@ -433,11 +433,11 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     cols = _np.asarray(sparse_csr_columns.numpy())
     b, h, seq, d = query.shape
     mask = _np.zeros((b, h, seq, seq), _np.float32)
-    for bi in range(offs.shape[0]):
+    counts = offs[..., 1:] - offs[..., :-1]            # [b, h, seq]
+    for bi in range(offs.shape[0]):                    # b*h scatters only
         for hi in range(offs.shape[1]):
-            for r in range(seq):
-                cs = cols[bi, hi, offs[bi, hi, r]:offs[bi, hi, r + 1]]
-                mask[bi, hi, r, cs] = 1.0
+            rows = _np.repeat(_np.arange(seq), counts[bi, hi])
+            mask[bi, hi, rows, cols[bi, hi, :rows.size]] = 1.0
     add_mask = (1.0 - mask) * -1e9
     from ...core.tensor import Tensor as _T
 

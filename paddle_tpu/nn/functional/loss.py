@@ -471,7 +471,8 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
 
 
 @op("multi_margin_loss_op")
-def _multi_margin(input, label, p=1, margin=1.0, reduction="mean"):
+def _multi_margin(input, label, weight=None, p=1, margin=1.0,
+                  reduction="mean"):
     n, c = input.shape
     correct = jnp.take_along_axis(input, label.reshape(-1, 1), axis=1)
     diff = jnp.maximum(margin - correct + input, 0.0)
@@ -479,13 +480,16 @@ def _multi_margin(input, label, p=1, margin=1.0, reduction="mean"):
         diff = diff * diff
     mask = 1.0 - jax.nn.one_hot(label.reshape(-1), c, dtype=input.dtype)
     loss = jnp.sum(diff * mask, axis=1) / c
+    if weight is not None:
+        # per-class weight of each sample's TARGET class (torch/reference)
+        loss = loss * weight.reshape(-1)[label.reshape(-1)]
     return _reduce(loss, reduction)
 
 
 def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
                       reduction="mean", name=None):
-    return _multi_margin(input, label, p=int(p), margin=float(margin),
-                         reduction=reduction)
+    return _multi_margin(input, label, weight, p=int(p),
+                         margin=float(margin), reduction=reduction)
 
 
 def triplet_margin_with_distance_loss(input, positive, negative,

@@ -138,7 +138,8 @@ def _install():
         "index_put", "renorm", "scatter", "tril", "triu", "t", "transpose",
         "cast", "where", "lerp", "reciprocal", "sigmoid", "addmm",
         "put_along_axis", "sign", "atan2", "divide", "flatten", "squeeze",
-        "unsqueeze", "reshape", "polygamma", "multigammaln",
+        "unsqueeze", "reshape", "polygamma", "multigammaln", "atanh",
+        "acosh", "asinh", "erfinv",
     ]
     _sources = [math, manipulation, logic, linalg, extras, creation]
     for base in _INPLACE_BASES:
@@ -231,6 +232,54 @@ def _install():
     Tensor.dim = lambda self: self.ndim
     Tensor.rank = lambda self: self.ndim
     Tensor.cpu = Tensor.cpu
+
+    # the reference monkey-patches every tensor_method_func name onto
+    # Tensor, including module-level factories — bind the stragglers so the
+    # method surface audits complete (python/paddle/tensor/__init__.py)
+    Tensor.inverse = extras.inverse
+    Tensor.top_p_sampling = extras.top_p_sampling
+    Tensor.multiplex = lambda self, index: extras.multiplex([self], index)
+    Tensor.polar = staticmethod(extras.polar)
+    Tensor.add_n = staticmethod(extras.add_n)
+    Tensor.broadcast_shape = staticmethod(extras.broadcast_shape)
+    Tensor.scatter_nd = staticmethod(extras.scatter_nd)
+    Tensor.pca_lowrank = linalg.pca_lowrank
+    Tensor.householder_product = linalg.householder_product
+    Tensor.lu_unpack = linalg.lu_unpack
+    Tensor.multi_dot = staticmethod(linalg.multi_dot)
+    Tensor.broadcast_tensors = staticmethod(manipulation.broadcast_tensors)
+    Tensor.is_tensor = staticmethod(
+        lambda x: isinstance(x, Tensor))
+
+    def _tensor_stft(self, *args, **kwargs):
+        from .. import signal
+
+        return signal.stft(self, *args, **kwargs)
+
+    def _tensor_istft(self, *args, **kwargs):
+        from .. import signal
+
+        return signal.istft(self, *args, **kwargs)
+
+    Tensor.stft = _tensor_stft
+    Tensor.istft = _tensor_istft
+
+    def _create_parameter(shape, dtype="float32", **kwargs):
+        import paddle_tpu
+
+        return paddle_tpu.create_parameter(shape, dtype, **kwargs)
+
+    def _create_tensor(dtype="float32", name=None, persistable=False):
+        import numpy as _np
+
+        from ..core import dtype as _dt
+
+        t = Tensor(_np.zeros((0,), _dt.convert_dtype(dtype)))
+        t.persistable = persistable
+        return t
+
+    Tensor.create_parameter = staticmethod(_create_parameter)
+    Tensor.create_tensor = staticmethod(_create_tensor)
 
 
 _install()

@@ -28,7 +28,8 @@ __all__ = [
     "i1e", "polygamma", "multigammaln", "nanmedian", "nanquantile",
     "logcumsumexp", "cummin", "trapezoid", "cumulative_trapezoid", "renorm",
     "add_n", "binomial", "poisson", "combinations", "is_complex",
-    "is_floating_point", "is_integer", "finfo", "iinfo",
+    "is_floating_point", "is_integer", "finfo", "iinfo", "inverse",
+    "top_p_sampling",
 ]
 
 
@@ -613,3 +614,37 @@ def finfo(dtype):
 
 def iinfo(dtype):
     return np.iinfo(dtypes.convert_dtype(dtype))
+
+
+def inverse(x, name=None):
+    """Alias of linalg.inv (reference tensor/math.py inverse)."""
+    from .linalg import inv
+
+    return inv(x)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling per row (reference tensor/random.py
+    top_p_sampling over the fused CUDA kernel): keep the smallest prefix
+    of descending-probability tokens whose mass exceeds ``ps``, renormalize,
+    sample one. Returns (values, ids)."""
+    import jax
+
+    from ..core import rng
+
+    probs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    p_arr = ps._data if isinstance(ps, Tensor) else jnp.asarray(ps)
+    p_arr = jnp.reshape(p_arr, (-1, 1)).astype(jnp.float32)
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep tokens whose preceding mass < ps (always keep the first)
+    keep = (cum - sorted_p) < p_arr
+    filt = jnp.where(keep, sorted_p, 0.0)
+    filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+    key = rng.next_key() if seed < 0 else jax.random.PRNGKey(int(seed))
+    choice = jax.random.categorical(key, jnp.log(filt + 1e-30), axis=-1)
+    ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)
+    vals = jnp.take_along_axis(probs, ids, axis=-1)
+    return Tensor(vals), Tensor(ids.astype(jnp.int64))

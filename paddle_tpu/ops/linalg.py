@@ -290,3 +290,94 @@ def _einsum(*operands, equation=""):
 def einsum(equation, *operands):
     """paddle.einsum (reference: python/paddle/tensor/einsum.py)."""
     return _einsum(*operands, equation=equation)
+
+
+@op("householder_product")
+def _householder_product(x, tau):
+    """Q from Householder reflectors (reference tensor/linalg.py
+    householder_product over LAPACK orgqr): accumulate I - tau v v^T."""
+    m, n = x.shape[-2], x.shape[-1]
+
+    def one(vecs, taus):
+        q = jnp.eye(m, dtype=jnp.float32)
+        for i in range(n):
+            v = jnp.concatenate([
+                jnp.zeros((i,), jnp.float32),
+                jnp.ones((1,), jnp.float32),
+                vecs[i + 1:, i].astype(jnp.float32)])
+            q = q - taus[i] * (q @ v)[:, None] * v[None, :]
+        return q
+
+    if x.ndim == 2:
+        return one(x, tau).astype(x.dtype)
+    batch = x.reshape((-1,) + x.shape[-2:])
+    taus = tau.reshape((-1,) + tau.shape[-1:])
+    out = jax.vmap(one)(batch, taus)
+    return out.reshape(x.shape[:-2] + (m, m)).astype(x.dtype)
+
+
+def householder_product(x, tau, name=None):
+    return _householder_product(x, tau)
+
+
+@op("lu_unpack", differentiable=False)
+def _lu_unpack(lu_data, pivots, unpack_ludata=True, unpack_pivots=True):
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    lower = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k,
+                                                       dtype=lu_data.dtype)
+    upper = jnp.triu(lu_data[..., :k, :])
+    # pivots (1-based LAPACK swaps) -> permutation matrix
+    def perm_of(piv):
+        perm = jnp.arange(m)
+
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[0], body, perm)
+        return jax.nn.one_hot(perm, m, dtype=lu_data.dtype).T
+
+    if lu_data.ndim == 2:
+        pmat = perm_of(pivots)
+    else:
+        pmat = jax.vmap(perm_of)(pivots.reshape(-1, pivots.shape[-1]))
+        pmat = pmat.reshape(lu_data.shape[:-2] + (m, m))
+    return pmat, lower, upper
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(P, L, U) from paddle.linalg.lu output (reference tensor/linalg.py
+    lu_unpack)."""
+    return _lu_unpack(x, y, unpack_ludata=unpack_ludata,
+                      unpack_pivots=unpack_pivots)
+
+
+@op("matrix_exp")
+def _matrix_exp(x):
+    return jax.scipy.linalg.expm(x.astype(jnp.float32)).astype(x.dtype)
+
+
+def matrix_exp(x, name=None):
+    return _matrix_exp(x)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Dense PCA via exact SVD (reference tensor/linalg.py pca_lowrank's
+    randomized algorithm trades exactness for speed on huge dense GPUs;
+    at these ranks exact SVD on the MXU is cheaper)."""
+    from ..core.tensor import Tensor
+
+    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = d.shape[-2], d.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        d = d - jnp.mean(d, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(d.astype(jnp.float32), full_matrices=False)
+    return (Tensor(u[..., :q]), Tensor(s[..., :q]),
+            Tensor(jnp.swapaxes(vt, -1, -2)[..., :q]))
+
+
+__all__ += ["householder_product", "lu_unpack", "matrix_exp", "pca_lowrank"]

@@ -435,3 +435,157 @@ class Lamb(Optimizer):
             p._rebind(arr)
             self._set_acc("moment1", p, a)
             self._set_acc("moment2", p, b)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _rprop_update(params, grads, prevs, steps, lr_min, lr_max, eta_neg,
+                  eta_pos):
+    """Resilient backprop (reference optimizer/rprop.py): per-element step
+    sizes grow where successive grads agree in sign, shrink where they
+    flip; flipped elements skip the update (grad zeroed)."""
+
+    def upd(p, g, prev, step):
+        gf = _f32(g)
+        sign = jnp.sign(gf * prev)
+        step_new = jnp.clip(
+            jnp.where(sign > 0, step * eta_pos,
+                      jnp.where(sign < 0, step * eta_neg, step)),
+            lr_min, lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, gf)
+        new_p = (_f32(p) - jnp.sign(g_eff) * step_new).astype(p.dtype)
+        return new_p, g_eff, step_new
+
+    out = jax.tree.map(upd, params, grads, prevs, steps)
+    leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+    return (jax.tree.map(lambda t: t[0], out, is_leaf=leaf),
+            jax.tree.map(lambda t: t[1], out, is_leaf=leaf),
+            jax.tree.map(lambda t: t[2], out, is_leaf=leaf))
+
+
+class Rprop(Optimizer):
+    _opt_name = "rprop"
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._lr_range = (float(learning_rate_range[0]),
+                          float(learning_rate_range[1]))
+        self._etas = (float(etas[0]), float(etas[1]))
+
+    def _apply(self, params_grads):
+        init_step = lambda p: jnp.full(  # noqa: E731
+            p._data.shape, float(self.get_lr()), jnp.float32)
+        params = [p._data for p, _ in params_grads]
+        grads = [g._data for _, g in params_grads]
+        prevs = [self._acc("rprop_prev", p) for p, _ in params_grads]
+        steps = [self._acc("rprop_step", p, init_step)
+                 for p, _ in params_grads]
+        new_p, new_prev, new_step = _rprop_update(
+            params, grads, prevs, steps,
+            jnp.float32(self._lr_range[0]), jnp.float32(self._lr_range[1]),
+            jnp.float32(self._etas[0]), jnp.float32(self._etas[1]))
+        for (p, _), arr, pr, st in zip(params_grads, new_p, new_prev,
+                                       new_step):
+            p._rebind(arr)
+            self._set_acc("rprop_prev", p, pr)
+            self._set_acc("rprop_step", p, st)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference optimizer/lbfgs.py): closure-based
+    ``step(closure)`` with two-loop-recursion direction and backtracking
+    Armijo line search (the reference's strong_wolfe option also accepts
+    None == fixed step; backtracking sits between the two and keeps the
+    whole step host-driven, which is fine — LBFGS is a full-batch
+    optimizer, each closure call is one compiled forward/backward)."""
+
+    _opt_name = "lbfgs"
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = int(max_iter)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._history = int(history_size)
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    # ---- flat helpers ----
+    def _flat(self, arrs):
+        return jnp.concatenate([jnp.ravel(_f32(a)) for a in arrs])
+
+    def _assign(self, flat):
+        import numpy as np
+
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape))
+            chunk = flat[off:off + n].reshape(p._data.shape)
+            p._rebind(chunk.astype(p._data.dtype))
+            off += n
+
+    def _gather_grad(self):
+        return self._flat([p.grad._data for p in self._parameter_list])
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / (jnp.dot(y, s) + 1e-10)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((rho, a, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.dot(s, y) / (jnp.dot(y, y) + 1e-10))
+        for rho, a, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure=None):
+        assert closure is not None, "LBFGS.step needs a closure"
+        loss = closure()
+        flat_g = self._gather_grad()
+        flat_x = self._flat([p._data for p in self._parameter_list])
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(flat_g))) <= self._tol_grad:
+                break
+            d = self._direction(flat_g)
+            t = float(self.get_lr())
+            if self._line_search in ("strong_wolfe", "backtracking"):
+                f0 = float(loss.numpy())
+                gtd = float(jnp.dot(flat_g, d))
+                for _ls in range(20):
+                    self._assign(flat_x + t * d)
+                    self.clear_grad()
+                    loss = closure()
+                    if float(loss.numpy()) <= f0 + 1e-4 * t * gtd:
+                        break
+                    t *= 0.5
+            else:
+                self._assign(flat_x + t * d)
+                self.clear_grad()
+                loss = closure()
+            new_g = self._gather_grad()
+            new_x = self._flat([p._data for p in self._parameter_list])
+            s, y = new_x - flat_x, new_g - flat_g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(new_x - flat_x))) < self._tol_change:
+                flat_x, flat_g = new_x, new_g
+                break
+            flat_x, flat_g = new_x, new_g
+        return loss
+
+
+__all__ += ["Rprop", "LBFGS"]

@@ -330,3 +330,29 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
     prog._run_loaded = run
     return prog, feed_names, [_FetchToken()]
+
+
+from .extras import (  # noqa: E402,F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy,
+    ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy, Print,
+    Variable, WeightNormParamAttr, accuracy, append_backward, auc,
+    cpu_places, create_global_var, create_parameter, ctr_metric_bundle,
+    cuda_places, deserialize_persistables, deserialize_program,
+    device_guard, gradients, ipu_shard_guard, load, load_from_file,
+    load_program_state, normalize_program, py_func, save, save_to_file,
+    scope_guard, serialize_persistables, serialize_program, set_ipu_shard,
+    set_program_state, xpu_places,
+)
+
+__all__ += [
+    "append_backward", "gradients", "scope_guard", "BuildStrategy",
+    "CompiledProgram", "ipu_shard_guard", "IpuCompiledProgram",
+    "IpuStrategy", "Print", "py_func", "ExecutionStrategy",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "cpu_places", "cuda_places", "xpu_places", "Variable",
+    "create_global_var", "accuracy", "auc", "device_guard",
+    "create_parameter", "set_ipu_shard", "ctr_metric_bundle",
+]

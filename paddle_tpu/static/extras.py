@@ -1,0 +1,474 @@
+"""paddle.static long-tail: gradients/append_backward, strategies,
+program serialization, EMA, utility vars and metrics.
+
+Reference sites: python/paddle/base/backward.py (append_backward :~2000,
+gradients), static/__init__.py strategy exports (BuildStrategy et al. from
+core.CompiledProgram machinery), static/io.py (save/load + serialize
+family :~400-900), incubate ExponentialMovingAverage
+(static/ema.py), nn/metric.py (accuracy :28, auc :120), base/layers Print,
+py_func.
+
+TPU-native posture: the eager tape IS the program (see __init__ docstring),
+so backward/gradients delegate to the autograd engine; strategies are
+honest config carriers consumed where XLA has an equivalent and inert where
+it does not (each documents which); serialization rides framework.io /
+jit.save artifacts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+__all__ = [
+    "append_backward", "gradients", "scope_guard", "BuildStrategy",
+    "ExecutionStrategy", "CompiledProgram", "ipu_shard_guard",
+    "IpuCompiledProgram", "IpuStrategy", "set_ipu_shard", "Print", "py_func",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "cpu_places", "cuda_places", "xpu_places", "Variable",
+    "create_global_var", "create_parameter", "accuracy", "auc",
+    "device_guard", "ctr_metric_bundle",
+]
+
+
+# ---------------------------------------------------------------------------
+# autodiff entry points
+# ---------------------------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Eager analog of base/backward.py append_backward: run backward from
+    ``loss`` and return [(param, grad)] pairs (the reference returns the
+    appended grad vars)."""
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        from ..core.tensor import Parameter
+
+        # every Parameter that received a grad participates
+        params = [t for t in _live_parameters() if t.grad is not None]
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def _live_parameters():
+    import gc
+
+    from ..core.tensor import Parameter
+
+    return [o for o in gc.get_objects() if isinstance(o, Parameter)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """base/backward.py gradients -> autograd.grad."""
+    from ..autograd import grad
+
+    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return grad(tgts, ins, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+# ---------------------------------------------------------------------------
+# scopes / strategies / compiled program
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    from . import global_scope
+
+    main = global_scope()
+    backup = dict(main)
+    main.clear()
+    main.update(scope if isinstance(scope, dict) else {})
+    try:
+        yield
+    finally:
+        if isinstance(scope, dict):
+            scope.clear()
+            scope.update(main)
+        main.clear()
+        main.update(backup)
+
+
+class BuildStrategy:
+    """Graph-build toggles (reference core.BuildStrategy). XLA performs
+    fusion/memory-planning itself; the recognized toggles are recorded so
+    programs can introspect them, none require action on TPU."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.fuse_all_reduce_ops = True
+        self.enable_sequential_execution = False
+        self.build_cuda_graph = False
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """reference base/compiler.py CompiledProgram — under XLA every
+    Executor.run is already compiled; this carries the strategy and
+    forwards the program."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """IPU pipeline annotation — no IPU backend exists here; accepted and
+    inert so shared model code imports cleanly."""
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.num_ipus = 1
+
+    def set_graph_config(self, **kw):
+        return None
+
+    def set_pipelining_config(self, **kw):
+        return None
+
+    def set_precision_config(self, **kw):
+        return None
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise NotImplementedError(
+            "IPU compilation targets Graphcore hardware; this framework "
+            "compiles via XLA — use Executor.run / jit.to_static")
+
+
+# ---------------------------------------------------------------------------
+# debug ops
+# ---------------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Eager print-through (reference base/layers/control_flow Print op)."""
+    head = message or getattr(input, "name", "var")
+    vals = np.asarray(input.numpy()).reshape(-1)[:summarize]
+    parts = [head]
+    if print_tensor_shape:
+        parts.append(f"shape={list(input.shape)}")
+    if print_tensor_type:
+        parts.append(f"dtype={input.dtype}")
+    parts.append(f"data={vals}")
+    print("  ".join(str(p) for p in parts))
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Eager python-op (reference static/nn/common.py py_func): call
+    ``func`` on the inputs; custom backward hooks belong to PyLayer in the
+    eager paradigm (use paddle.autograd.PyLayer for a differentiable py
+    op)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    result = func(*xs)
+    return result if out is None else result
+
+
+# ---------------------------------------------------------------------------
+# params / vars / EMA
+# ---------------------------------------------------------------------------
+
+class WeightNormParamAttr:
+    """reference static/nn/common.py WeightNormParamAttr — carries the
+    weight-norm dim; layers here don't reparameterize (use
+    paddle.nn.utils.weight_norm for the dynamic-graph mechanism)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """reference static/ema.py ExponentialMovingAverage: shadow = decay *
+    shadow + (1-decay) * param, swapped in under ``apply()``."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}   # id(param) -> f32 shadow array
+        self._refs = {}     # id(param) -> param
+        self._backup = {}
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+
+        params = parameters or _live_parameters()
+        for p in params:
+            pid = id(p)
+            cur = p._data.astype(jnp.float32)
+            prev = self._shadow.get(pid)
+            self._shadow[pid] = (cur if prev is None
+                                 else self._decay * prev
+                                 + (1 - self._decay) * cur)
+            self._refs[pid] = p
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        for pid, p in self._refs.items():
+            self._backup[pid] = jnp.copy(p._data)
+            p._rebind(self._shadow[pid].astype(p._data.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for pid, p in self._refs.items():
+            if pid in self._backup:
+                p._rebind(self._backup.pop(pid))
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.tensor import Tensor
+
+    t = Tensor(np.full(tuple(int(s) for s in shape), value,
+                       np.dtype(dtype) if not isinstance(dtype, str)
+                       else dtype))
+    t.persistable = persistable
+    if name:
+        t.name = name
+        from . import global_scope
+
+        global_scope()[name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu
+
+    return paddle_tpu.create_parameter(shape, dtype, name=name, attr=attr,
+                                       is_bias=is_bias,
+                                       default_initializer=default_initializer)
+
+
+# Variable is the Tensor in this world (reference base/framework.py:1461)
+from ..core.tensor import Tensor as Variable  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# places / guards
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places; on this build they resolve to the TPU devices."""
+    import jax
+
+    from ..core.device import CUDAPlace
+
+    ids = device_ids if device_ids is not None else range(
+        max(len(jax.devices()), 1))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference static device_guard: per-op device pinning. XLA placement
+    is sharding-driven; 'cpu' pins nothing here (ops on numpy-backed hosts
+    already run on host), so the guard is accepted and inert."""
+    yield
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC (reference static/nn/metric.py auc): returns
+    (auc_value, batch_auc, [state]) — here the exact pairwise AUC of the
+    batch for both values."""
+    from ..core.tensor import Tensor
+
+    probs = np.asarray(input.numpy())
+    pos_score = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+        else probs.reshape(-1)
+    y = np.asarray(label.numpy()).reshape(-1)
+    pos = pos_score[y == 1]
+    neg = pos_score[y == 0]
+    if len(pos) == 0 or len(neg) == 0:
+        val = 0.5
+    else:
+        greater = (pos[:, None] > neg[None, :]).sum()
+        equal = (pos[:, None] == neg[None, :]).sum()
+        val = (greater + 0.5 * equal) / (len(pos) * len(neg))
+    out = Tensor(np.float32(val))
+    return out, out, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference static/nn/metric.py ctr_metric_bundle: (auc, sqrerr, abserr,
+    prob, q, pos, total) batch statistics for CTR models."""
+    from ..core.tensor import Tensor
+
+    probs = np.asarray(input.numpy()).reshape(-1)
+    y = np.asarray(label.numpy()).reshape(-1).astype(np.float64)
+    sqrerr = float(((probs - y) ** 2).sum())
+    abserr = float(np.abs(probs - y).sum())
+    prob = float(probs.sum())
+    q = float(probs.sum())
+    pos = float(y.sum())
+    total = float(len(y))
+    auc_v, _, _ = auc(input, label)
+    return (auc_v, Tensor(np.float32(sqrerr)), Tensor(np.float32(abserr)),
+            Tensor(np.float32(prob)), Tensor(np.float32(q)),
+            Tensor(np.float32(pos)), Tensor(np.float32(total)))
+
+
+# ---------------------------------------------------------------------------
+# program serialization (over the jit.save / framework.io substrate)
+# ---------------------------------------------------------------------------
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist the scope variables a static-style workflow accumulated
+    (reference static/io.py save: persistables of the Program)."""
+    from . import global_scope
+    from ..framework.io import save as _save
+
+    state = {k: v for k, v in global_scope().items() if v is not None}
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from . import global_scope
+    from ..framework.io import load as _load
+
+    state = _load(model_path + ".pdparams")
+    sc = global_scope()
+    for k, v in state.items():
+        if k in sc and sc[k] is not None and hasattr(sc[k], "_rebind"):
+            sc[k]._rebind(v._data if hasattr(v, "_data") else v)
+        else:
+            sc[k] = v
+
+
+def normalize_program(program, feeds, fetchs, **kwargs):
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+
+    return pickle.dumps({
+        "feeds": [getattr(v, "name", None) for v in feed_vars],
+        "fetches": [getattr(v, "name", None) for v in fetch_vars],
+    })
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+
+    from . import global_scope
+
+    return pickle.dumps({k: np.asarray(v.numpy())
+                         for k, v in global_scope().items()
+                         if v is not None and hasattr(v, "numpy")})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    from ..core.tensor import Tensor
+    from . import global_scope
+
+    state = pickle.loads(data)
+    sc = global_scope()
+    for k, v in state.items():
+        # rebind in place so existing references observe the loaded values
+        if sc.get(k) is not None and hasattr(sc[k], "_rebind"):
+            import jax.numpy as jnp
+
+            sc[k]._rebind(jnp.asarray(v))
+        else:
+            sc[k] = Tensor(v)
+    return sc
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+
+    state = _load(model_path + ".pdparams")
+    return {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    from ..core.tensor import Tensor
+    from . import global_scope
+
+    sc = global_scope()
+    for k, v in state_dict.items():
+        sc[k] = Tensor(v)

@@ -93,3 +93,11 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+from .datasets import (  # noqa: E402,F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+
+__all__ += ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+            "WMT14", "WMT16"]

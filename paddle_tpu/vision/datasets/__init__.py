@@ -200,3 +200,90 @@ class Cifar10(Dataset):
 class Cifar100(Cifar10):
     _batches = {"train": ["train"], "test": ["test"]}
     _label_key = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """Oxford-102 Flowers (reference vision/datasets/flowers.py). Offline:
+    pass local ``data_file`` (102flowers.tgz), ``label_file``
+    (imagelabels.mat) and ``setid_file`` (setid.mat); no download."""
+
+    MODE_FIELD = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        assert mode in self.MODE_FIELD, mode
+        if not (data_file and label_file and setid_file):
+            raise ValueError(
+                "no network egress: Flowers needs local data_file/"
+                "label_file/setid_file paths")
+        import scipy.io as sio
+
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        ids = sio.loadmat(setid_file)[self.MODE_FIELD[mode]].ravel()
+        self._tar = tarfile.open(data_file)
+        self._names = {}
+        for m in self._tar.getmembers():
+            base = os.path.basename(m.name)
+            if base.startswith("image_"):
+                idx = int(base[6:11])
+                self._names[idx] = m.name
+        self._items = [(self._names[i], int(labels[i - 1]) - 1)
+                       for i in ids if i in self._names]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        name, label = self._items[idx]
+        img = Image.open(self._tar.extractfile(name)).convert("RGB")
+        arr = np.asarray(img)
+        if self.transform is not None:
+            arr = self.transform(arr)
+        return arr, np.array(label, np.int64)
+
+    def __len__(self):
+        return len(self._items)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py). Offline: pass the local
+    VOCtrainval tar as ``data_file``."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if not data_file:
+            raise ValueError("no network egress: VOC2012 needs a local "
+                             "data_file tar path")
+        assert mode in ("train", "valid", "trainval", "test"), mode
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        names = {m.name for m in self._tar.getmembers()}
+        seg_dir = next((n for n in names if n.endswith(
+            "ImageSets/Segmentation")), None)
+        # reference MODE_FLAG_MAP: train -> trainval split, test -> train
+        list_name = {"train": "trainval.txt", "valid": "val.txt",
+                     "trainval": "trainval.txt", "test": "train.txt"}[mode]
+        list_path = next(n for n in names
+                         if n.endswith("Segmentation/" + list_name))
+        ids = self._tar.extractfile(list_path).read().decode().split()
+        root = list_path.split("ImageSets")[0]
+        self._items = [(root + f"JPEGImages/{i}.jpg",
+                        root + f"SegmentationClass/{i}.png") for i in ids]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        img_n, lab_n = self._items[idx]
+        img = np.asarray(Image.open(self._tar.extractfile(img_n))
+                         .convert("RGB"))
+        lab = np.asarray(Image.open(self._tar.extractfile(lab_n)))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
+    def __len__(self):
+        return len(self._items)
+
+
+__all__ += ["Flowers", "VOC2012"]

@@ -13,6 +13,8 @@ TPU-first design notes:
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -413,3 +415,390 @@ class RoIPool(nn.Layer):
     def forward(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self._output_size,
                         self._spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# round-4 additions: detection long-tail (reference python/paddle/vision/
+# ops.py prior_box/distribute_fpn_proposals/generate_proposals/psroi_pool/
+# matrix_nms, paddle/fluid/operators/detection/yolov3_loss_op.h yolo_loss,
+# ops.py read_file/decode_jpeg). Proposal-shaped ops are host-side (dynamic
+# output sizes — the reference's CPU/GPU kernels also produce LoD outputs);
+# the dense per-pixel math (prior_box, yolo_loss, psroi_pool) is jnp.
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes per feature-map cell (reference vision/ops.py
+    prior_box). Returns (boxes [H,W,P,4], variances [H,W,P,4]),
+    normalized xmin/ymin/xmax/ymax."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / w
+    step_h = steps[1] or img_h / h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    sizes = []
+    for i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            sizes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[i]
+                sizes.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                sizes.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[i]
+                sizes.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+    P = len(sizes)
+    cy = (np.arange(h) + offset) * step_h
+    cx = (np.arange(w) + offset) * step_w
+    boxes = np.zeros((h, w, P, 4), np.float32)
+    for pi, (bw, bh) in enumerate(sizes):
+        boxes[:, :, pi, 0] = (cx[None, :] - bw / 2) / img_w
+        boxes[:, :, pi, 1] = (cy[:, None] - bh / 2) / img_h
+        boxes[:, :, pi, 2] = (cx[None, :] + bw / 2) / img_w
+        boxes[:, :, pi, 3] = (cy[:, None] + bh / 2) / img_h
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    return Tensor(boxes), Tensor(vars_)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route each RoI to its FPN level by scale (reference vision/ops.py
+    distribute_fpn_proposals; FPN paper eq.1). Returns
+    (multi_rois, restore_ind[, rois_num_per_level])."""
+    rois = np.asarray(fpn_rois.numpy())
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0.0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0.0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, order, counts = [], [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi.append(Tensor(rois[idx].astype(np.float32)))
+        counts.append(len(idx))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    restore_t = Tensor(restore.astype(np.int32).reshape(-1, 1))
+    if rois_num is not None:
+        return multi, restore_t, [Tensor(np.asarray([c], np.int32))
+                                  for c in counts]
+    return multi, restore_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference vision/ops.py generate_proposals):
+    decode deltas onto anchors, clip to the image, drop tiny boxes, top-k,
+    NMS. Single-image batches processed independently."""
+    sc = np.asarray(scores.numpy())          # [N, A, H, W]
+    dl = np.asarray(bbox_deltas.numpy())     # [N, A*4, H, W]
+    szs = np.asarray(img_size.numpy())       # [N, 2] (h, w)
+    anc = np.asarray(anchors.numpy()).reshape(-1, 4)
+    var = np.asarray(variances.numpy()).reshape(-1, 4)
+    n = sc.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = dl[b].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(var[:, 2] * d[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(var[:, 3] * d[:, 3], 10.0))
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        ih, iw = szs[b][0], szs[b][1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        boxes, s = boxes[order], s[order]
+        if len(boxes):
+            kept = nms(Tensor(boxes.astype(np.float32)),
+                       iou_threshold=float(nms_thresh),
+                       scores=Tensor(s.astype(np.float32)),
+                       top_k=post_nms_top_n)
+            kept = np.asarray(kept.numpy())
+        else:
+            kept = np.zeros(0, np.int64)
+        all_rois.append(boxes[kept].astype(np.float32))
+        all_scores.append(s[kept].astype(np.float32))
+        nums.append(len(kept))
+    rois = Tensor(np.concatenate(all_rois) if all_rois
+                  else np.zeros((0, 4), np.float32))
+    rscores = Tensor(np.concatenate(all_scores) if all_scores
+                     else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rscores, Tensor(np.asarray(nums, np.int32))
+    return rois, rscores
+
+
+@op("psroi_pool_op")
+def _psroi_pool(x, boxes, boxes_num=None, out_hw=(7, 7), spatial_scale=1.0):
+    """Position-sensitive RoI average pooling (reference
+    phi/kernels/gpu/psroi_pool_kernel.cu): input channels C = out_c*ph*pw;
+    bin (i,j) of output channel c pools channel c*ph*pw + i*pw + j."""
+    ph, pw = out_hw
+    n, c, hh, ww = x.shape
+    out_c = c // (ph * pw)
+    nb = boxes.shape[0]
+
+    def one(roi, img_idx):
+        x1, y1, x2, y2 = (roi * spatial_scale)
+        rh = jnp.maximum(y2 - y1, 0.1) / ph
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        feat = jax.lax.dynamic_index_in_dim(x, img_idx, axis=0,
+                                            keepdims=False)
+        rows = []
+        for i in range(ph):
+            cols = []
+            for j in range(pw):
+                ys = jnp.clip(jnp.floor(y1 + i * rh), 0, hh - 1).astype(int)
+                ye = jnp.clip(jnp.ceil(y1 + (i + 1) * rh), 1, hh).astype(int)
+                xs = jnp.clip(jnp.floor(x1 + j * rw), 0, ww - 1).astype(int)
+                xe = jnp.clip(jnp.ceil(x1 + (j + 1) * rw), 1, ww).astype(int)
+                # mask-average over the bin (static shapes)
+                yy = jnp.arange(hh)[:, None]
+                xx = jnp.arange(ww)[None, :]
+                m = ((yy >= ys) & (yy < ye) & (xx >= xs)
+                     & (xx < xe)).astype(x.dtype)
+                chans = feat[(jnp.arange(out_c) * ph * pw + i * pw + j)]
+                total = jnp.sum(chans * m[None], axis=(1, 2))
+                cnt = jnp.maximum(jnp.sum(m), 1.0)
+                cols.append(total / cnt)
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)  # [out_c, ph, pw]
+
+    if boxes_num is None:
+        img_ids = jnp.zeros((nb,), jnp.int32)
+    else:
+        img_ids = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                             total_repeat_length=nb)
+    return jax.vmap(one)(boxes.astype(jnp.float32), img_ids)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    hw = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    return _psroi_pool(x, boxes, boxes_num, out_hw=hw,
+                       spatial_scale=float(spatial_scale))
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self._args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py matrix_nms; SOLOv2 paper):
+    decay each box's score by its IoU with higher-scored same-class boxes
+    instead of hard suppression."""
+    bb = np.asarray(bboxes.numpy())          # [N, M, 4]
+    sc = np.asarray(scores.numpy())          # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for b in range(bb.shape[0]):
+        dets = []
+        det_idx = []
+        for cls in range(sc.shape[1]):
+            if cls == background_label:
+                continue
+            s = sc[b, cls]
+            keep = np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c, s_c = bb[b][order], s[order]
+            # pairwise IoU of the sorted boxes
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            area = ((boxes_c[:, 2] - boxes_c[:, 0])
+                    * (boxes_c[:, 3] - boxes_c[:, 1]))
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-9)
+            iou = np.triu(iou, 1)
+            # comp[i]: box i's own max overlap with a higher-scored box —
+            # the matrix-NMS denominator (SOLOv2 eq. 5) is the
+            # suppressor's compensation, indexed by row
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                d = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                           / gaussian_sigma)
+            else:
+                d = (1 - iou) / (1 - comp[:, None] + 1e-9)
+            decay = np.minimum(d.min(axis=0), 1.0)
+            s_dec = s_c * decay
+            ok = s_dec >= post_threshold
+            for i in np.nonzero(ok)[0]:
+                dets.append([cls, s_dec[i], *boxes_c[i]])
+                det_idx.append(order[i] + b * sc.shape[2])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        det_idx = np.asarray(det_idx, np.int64)
+        take = np.argsort(-dets[:, 1])[:keep_top_k] if len(dets) else []
+        outs.append(dets[take] if len(dets) else dets)
+        idxs.append(det_idx[take] if len(dets) else det_idx)
+        nums.append(len(outs[-1]))
+    out = Tensor(np.concatenate(outs) if outs
+                 else np.zeros((0, 6), np.float32))
+    result = [out]
+    if return_index:
+        result.append(Tensor(np.concatenate(idxs).reshape(-1, 1)
+                             if idxs else np.zeros((0, 1), np.int64)))
+    if return_rois_num:
+        result.append(Tensor(np.asarray(nums, np.int32)))
+    return tuple(result) if len(result) > 1 else out
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference vision/ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode an encoded-image byte tensor to CHW uint8 (reference
+    vision/ops.py decode_jpeg over nvjpeg; PIL on host here)."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x.numpy()).astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged") and img.mode != "RGB" \
+            and mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr.copy())
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss for one detection head (reference
+    paddle/fluid/operators/detection/yolov3_loss_op.h): box x/y BCE +
+    w/h L1 + objectness BCE (with ignore region by IoU) + class BCE,
+    anchors matched to gt by best whole-image IoU."""
+    import jax
+
+    xv = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    gb = np.asarray(gt_box.numpy())          # [N, B, 4] cx,cy,w,h (norm)
+    gl = np.asarray(gt_label.numpy())        # [N, B]
+    gs = (np.asarray(gt_score.numpy()) if gt_score is not None
+          else np.ones_like(gl, np.float32))
+    n, _, h, w = xv.shape
+    na = len(anchor_mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = an_all[np.asarray(anchor_mask)]
+    input_size = downsample_ratio * h
+    pred = xv.reshape(n, na, 5 + class_num, h, w)
+
+    tx = np.zeros((n, na, h, w), np.float32)
+    ty = np.zeros_like(tx)
+    tw = np.zeros_like(tx)
+    th = np.zeros_like(tx)
+    tweight = np.zeros_like(tx)
+    tobj = np.zeros_like(tx)
+    tcls = np.zeros((n, na, class_num, h, w), np.float32)
+    tscore = np.zeros_like(tx)
+    for b in range(n):
+        for g in range(gb.shape[1]):
+            gw, gh = gb[b, g, 2], gb[b, g, 3]
+            if gw <= 0 or gh <= 0:
+                continue
+            # best anchor over ALL anchors by shape IoU
+            inter = (np.minimum(an_all[:, 0], gw * input_size)
+                     * np.minimum(an_all[:, 1], gh * input_size))
+            union = (an_all[:, 0] * an_all[:, 1]
+                     + gw * gh * input_size * input_size - inter)
+            best = int(np.argmax(inter / union))
+            if best not in list(anchor_mask):
+                continue
+            k = list(anchor_mask).index(best)
+            gi = min(int(gb[b, g, 0] * w), w - 1)
+            gj = min(int(gb[b, g, 1] * h), h - 1)
+            tx[b, k, gj, gi] = gb[b, g, 0] * w - gi
+            ty[b, k, gj, gi] = gb[b, g, 1] * h - gj
+            tw[b, k, gj, gi] = np.log(gw * input_size / an[k, 0] + 1e-9)
+            th[b, k, gj, gi] = np.log(gh * input_size / an[k, 1] + 1e-9)
+            tweight[b, k, gj, gi] = 2.0 - gw * gh
+            tobj[b, k, gj, gi] = 1.0
+            tscore[b, k, gj, gi] = gs[b, g]
+            smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+            tcls[b, k, :, gj, gi] = smooth
+            tcls[b, k, int(gl[b, g]), gj, gi] = 1.0 - smooth if \
+                use_label_smooth else 1.0
+
+    px, py = pred[:, :, 0], pred[:, :, 1]
+    pw, phh = pred[:, :, 2], pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]
+    bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(  # noqa: E731
+        jnp.exp(-jnp.abs(z)))
+    wmask = jnp.asarray(tweight)
+    obj = jnp.asarray(tobj)
+    loss_xy = jnp.sum((bce(px, jnp.asarray(tx)) + bce(py, jnp.asarray(ty)))
+                      * wmask * obj, axis=(1, 2, 3))
+    loss_wh = jnp.sum((jnp.abs(pw - jnp.asarray(tw))
+                       + jnp.abs(phh - jnp.asarray(th))) * wmask * obj,
+                      axis=(1, 2, 3))
+    # objectness: positives weighted by gt_score; negatives everywhere else
+    # except high-IoU ignore region — approximated by the matched mask
+    # (the ignore_thresh refinement needs per-cell pred/gt IoU)
+    loss_obj = jnp.sum(bce(pobj, jnp.asarray(tscore)) *
+                       jnp.where(obj > 0, jnp.asarray(tscore), 1.0),
+                       axis=(1, 2, 3))
+    loss_cls = jnp.sum(bce(pcls, jnp.asarray(tcls)) * obj[:, :, None],
+                       axis=(1, 2, 3, 4))
+    return Tensor(loss_xy + loss_wh + loss_obj + loss_cls)
+
+
+__all__ += [
+    "prior_box", "distribute_fpn_proposals", "generate_proposals",
+    "psroi_pool", "PSRoIPool", "matrix_nms", "read_file", "decode_jpeg",
+    "yolo_loss",
+]

@@ -252,3 +252,108 @@ def erase(img, i, j, h, w, v, inplace=False, data_format="HWC"):
     else:
         out[i:i + h, j:j + w] = v
     return out
+
+
+def _inverse_affine_matrix(center, angle, translate, scale, shear):
+    """Inverse of the torchvision/paddle affine matrix convention
+    (reference vision/transforms/functional.py affine -> cv/pil helpers)."""
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center+translate) * R(rot) * Shear * Scale * T(-center)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    t_fwd = np.eye(3)
+    t_fwd[0, 2] = cx + tx
+    t_fwd[1, 2] = cy + ty
+    t_back = np.eye(3)
+    t_back[0, 2] = -cx
+    t_back[1, 2] = -cy
+    fwd = t_fwd @ m @ t_back
+    return np.linalg.inv(fwd)
+
+
+def _sample_inverse(img, inv, out_shape, interpolation, fill):
+    h, w = img.shape[:2]
+    nh, nw = out_shape
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    ones = np.ones_like(xx)
+    pts = np.stack([xx, yy, ones], axis=0).reshape(3, -1)  # x, y order
+    src = inv @ pts
+    xs = (src[0] / np.maximum(src[2], 1e-9)).reshape(nh, nw)
+    ys = (src[1] / np.maximum(src[2], 1e-9)).reshape(nh, nw)
+    if interpolation == "bilinear":
+        x0 = np.floor(xs).astype(np.int64)
+        y0 = np.floor(ys).astype(np.int64)
+        out = np.zeros((nh, nw, img.shape[2]), np.float32)
+        tot_w = np.zeros((nh, nw, 1), np.float32)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi, yi = x0 + dx, y0 + dy
+                wgt = ((1 - np.abs(xs - xi)) * (1 - np.abs(ys - yi)))
+                valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                wgt = np.where(valid, wgt, 0.0)[..., None]
+                xi = np.clip(xi, 0, w - 1)
+                yi = np.clip(yi, 0, h - 1)
+                out += wgt * img[yi, xi].astype(np.float32)
+                tot_w += wgt
+        filled = tot_w[..., 0] <= 1e-6
+        out = out / np.maximum(tot_w, 1e-6)
+        out[filled] = fill
+        return out.astype(img.dtype)
+    xi = np.round(xs).astype(np.int64)
+    yi = np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full((nh, nw, img.shape[2]), fill, img.dtype)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference vision/transforms/functional.py affine)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _inverse_affine_matrix(center, angle, translate, scale,
+                                 tuple(shear))
+    return _sample_inverse(img, inv, (h, w), interpolation,
+                           fill if np.isscalar(fill) else fill[0])
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Homography mapping endpoints -> startpoints (the inverse map used
+    for sampling), solved as the standard 8-dof linear system."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    m = np.concatenate([coeffs, [1.0]]).reshape(3, 3)
+    return m
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp given 4 point correspondences (reference
+    functional.py perspective)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _sample_inverse(img, inv, (h, w), interpolation,
+                           fill if np.isscalar(fill) else fill[0])
+
+
+__all__ += ["affine", "perspective"]

@@ -398,3 +398,83 @@ def normalize_collate(mean, std, data_format="CHW"):
         return _normalize(list(batch))
 
     return collate
+
+
+class RandomAffine(BaseTransform):
+    """reference transforms.py RandomAffine — random rotation/translation/
+    scale/shear per sample."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        from . import functional as F
+
+        h, w = _hw(img)
+        angle = np.random.uniform(*self.degrees)
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        else:
+            tx = ty = 0.0
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif np.isscalar(self.shear):
+            sh = (np.random.uniform(-self.shear, self.shear), 0.0)
+        elif len(self.shear) == 4:  # [x_lo, x_hi, y_lo, y_hi]
+            sh = (np.random.uniform(self.shear[0], self.shear[1]),
+                  np.random.uniform(self.shear[2], self.shear[3]))
+        else:
+            sh = (np.random.uniform(self.shear[0], self.shear[1]), 0.0)
+        return F.affine(img, angle, (tx, ty), sc, sh,
+                        interpolation=self.interpolation, fill=self.fill,
+                        center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from . import functional as F
+
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = _hw(img)
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+
+        def jitter(px, py):
+            return (px + int(np.random.uniform(-dx, dx)),
+                    py + int(np.random.uniform(-dy, dy)))
+
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jitter(*p) for p in start]
+        return F.perspective(img, start, end,
+                             interpolation=self.interpolation,
+                             fill=self.fill)
+
+
+def _hw(img):
+    arr = np.asarray(img) if not hasattr(img, "shape") else img
+    return arr.shape[0], arr.shape[1]
+
+
+__all__ += ["RandomAffine", "RandomPerspective"]

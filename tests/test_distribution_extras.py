@@ -98,6 +98,14 @@ class TestDensities:
         s = ge.sample([5000]).numpy()
         assert abs(s.mean() - 3.0) < 0.3
 
+    def test_binomial_tensor_counts(self):
+        bi = D.Binomial(T(np.array([5.0, 10.0], np.float32)),
+                        T(np.array([0.5, 0.5], np.float32)))
+        lp = bi.log_prob(T(np.array([2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(
+            lp.numpy(), [scipy_stats.binom(5, 0.5).logpmf(2),
+                         scipy_stats.binom(10, 0.5).logpmf(3)], rtol=1e-3)
+
     def test_continuous_bernoulli(self):
         cb = D.ContinuousBernoulli(T(np.float32(0.3)))
         # normalizer: C(p) = 2 atanh(1-2p) / (1-2p)

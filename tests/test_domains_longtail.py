@@ -1,0 +1,224 @@
+"""Final domain long-tail: detection ops, affine/perspective transforms,
+offline dataset loaders (vision/text/audio).
+
+Reference analogs: test/legacy_test/test_prior_box_op.py,
+test_distribute_fpn_proposals_op.py, test_psroi_pool_op.py,
+test_matrix_nms_op.py, test_yolov3_loss_op.py; dataset tests build
+synthetic archives in the reference's exact layouts.
+"""
+
+import io
+import os
+import tarfile
+import wave
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestDetectionOps:
+    def test_prior_box_geometry(self):
+        feat = T(np.zeros((1, 8, 4, 4), np.float32))
+        img = T(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, vars_ = V.prior_box(feat, img, min_sizes=[8.0],
+                                   max_sizes=[16.0], aspect_ratios=[2.0],
+                                   flip=True)
+        assert boxes.shape[3] == 4 and vars_.shape == boxes.shape
+        b00 = boxes.numpy()[0, 0, 0]
+        np.testing.assert_allclose((b00[0] + b00[2]) / 2, 4 / 32, atol=1e-6)
+        np.testing.assert_allclose(b00[2] - b00[0], 8 / 32, atol=1e-6)
+
+    def test_distribute_fpn_restore_roundtrip(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100],
+                         [0, 0, 300, 300], [0, 0, 60, 60]], np.float32)
+        multi, restore = V.distribute_fpn_proposals(T(rois), 2, 5, 4, 224)
+        cat = np.concatenate([m.numpy() for m in multi])
+        r = restore.numpy().ravel()
+        np.testing.assert_allclose(cat[r], rois)  # restore inverts routing
+
+    def test_psroi_pool_constant_regions(self):
+        # each of the 8 channels constant -> each output bin = its channel
+        x = np.stack([np.full((4, 4), c, np.float32) for c in range(8)])[None]
+        out = V.psroi_pool(T(x), T(np.array([[0, 0, 4, 4]], np.float32)),
+                           T(np.array([1], np.int32)), 2)
+        np.testing.assert_allclose(out.numpy().reshape(2, 2, 2),
+                                   np.arange(8, dtype=np.float32)
+                                   .reshape(2, 2, 2))
+
+    def test_matrix_nms_decays_overlaps(self):
+        bb = T(np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60]]], np.float32))
+        sc = T(np.array([[[0, 0, 0], [0.9, 0.85, 0.7]]], np.float32))
+        out, idx, nums = V.matrix_nms(bb, sc, 0.1, 0.0, 10, 5,
+                                      background_label=0, return_index=True)
+        o = out.numpy()
+        assert int(nums.numpy()[0]) == 3
+        # the overlapping 2nd box got decayed below its raw 0.85
+        second = sorted(o[:, 1])[::-1][1]
+        assert second < 0.85
+
+    def test_generate_proposals_counts(self):
+        A, H, W = 3, 4, 4
+        rng = np.random.RandomState(0)
+        anchors = rng.rand(H, W, A, 4).astype(np.float32) * 16
+        anchors[..., 2:] += anchors[..., :2] + 4
+        rois, rsc, n = V.generate_proposals(
+            T(rng.rand(1, A, H, W).astype(np.float32)),
+            T(np.zeros((1, A * 4, H, W), np.float32)),
+            T(np.array([[32.0, 32.0]], np.float32)), T(anchors),
+            T(np.ones_like(anchors) * 0.1), pre_nms_top_n=20,
+            post_nms_top_n=5, return_rois_num=True)
+        assert rois.shape[1] == 4 and 0 < int(n.numpy()[0]) <= 5
+
+    def test_yolo_loss_prefers_correct_prediction(self):
+        anchors = [10, 13, 16, 30, 33, 23]
+        gtb = T(np.array([[[0.5, 0.5, 0.4, 0.4]]], np.float32))
+        gtl = T(np.array([[1]], np.int64))
+
+        def loss_of(bias):
+            x = np.full((1, 3 * 7, 4, 4), bias, np.float32)
+            return float(V.yolo_loss(T(x), gtb, gtl, anchors=anchors,
+                                     anchor_mask=[0, 1, 2], class_num=2,
+                                     ignore_thresh=0.7,
+                                     downsample_ratio=8).numpy()[0])
+
+        # all-negative logits (confident "no object") beat all-positive
+        assert loss_of(-4.0) < loss_of(4.0)
+
+    def test_read_decode_roundtrip(self, tmp_path):
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+
+        arr = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+        p = str(tmp_path / "img.png")
+        Image.fromarray(arr).save(p)
+        dec = V.decode_jpeg(V.read_file(p))
+        np.testing.assert_array_equal(dec.numpy(), arr.transpose(2, 0, 1))
+
+
+class TestWarpTransforms:
+    def test_affine_identity_and_translate(self):
+        import paddle_tpu.vision.transforms.functional as F
+
+        img = (np.random.RandomState(0).rand(9, 11, 3) * 255).astype(
+            np.uint8)
+        np.testing.assert_array_equal(
+            F.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0)), img)
+        sh = F.affine(img, 0.0, (2, 0), 1.0, (0.0, 0.0))
+        np.testing.assert_array_equal(sh[:, 2:], img[:, :-2])
+
+    def test_perspective_identity(self):
+        import paddle_tpu.vision.transforms.functional as F
+
+        img = (np.random.RandomState(1).rand(9, 11, 3) * 255).astype(
+            np.uint8)
+        pts = [(0, 0), (10, 0), (10, 8), (0, 8)]
+        np.testing.assert_array_equal(F.perspective(img, pts, pts), img)
+
+    def test_random_classes(self):
+        import paddle_tpu.vision.transforms as TR
+
+        img = (np.random.RandomState(2).rand(16, 16, 3) * 255).astype(
+            np.uint8)
+        np.random.seed(0)
+        assert TR.RandomAffine(10, translate=(0.1, 0.1),
+                               scale=(0.9, 1.1))(img).shape == img.shape
+        assert TR.RandomPerspective(prob=1.0)(img).shape == img.shape
+
+
+class TestOfflineDatasets:
+    def test_uci_housing(self, tmp_path):
+        p = str(tmp_path / "housing.data")
+        np.savetxt(p, np.random.RandomState(0).rand(50, 14))
+        ds = paddle.text.UCIHousing(data_file=p, mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,) and len(ds) == 40
+        assert len(paddle.text.UCIHousing(data_file=p, mode="test")) == 10
+
+    def test_imdb(self, tmp_path):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for i, (pol, text) in enumerate([("pos", "good movie fun"),
+                                             ("neg", "bad movie"),
+                                             ("pos", "good good")]):
+                data = text.encode()
+                ti = tarfile.TarInfo(f"aclImdb/train/{pol}/{i}.txt")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        p = str(tmp_path / "aclImdb.tar")
+        open(p, "wb").write(buf.getvalue())
+        ds = paddle.text.Imdb(data_file=p, mode="train", cutoff=1)
+        doc, lab = ds[0]
+        assert doc.dtype == np.int64 and int(lab) in (0, 1) and len(ds) == 3
+        assert "<unk>" in ds.word_idx
+
+    def test_wmt16_and_conll(self, tmp_path):
+        p = str(tmp_path / "pairs.txt")
+        open(p, "w").write("hello world ||| hallo welt\ngood ||| gut\n")
+        wmt = paddle.text.WMT16(data_file=p, mode="train")
+        s, t, tnext = wmt[0]
+        assert t[0] == wmt.trg_ids["<s>"]
+        assert tnext[-1] == wmt.trg_ids["<e>"]
+
+        c = str(tmp_path / "srl.txt")
+        open(c, "w").write("The B-A0\ncat B-V\n\nDogs B-A0\n")
+        conll = paddle.text.Conll05st(data_file=c)
+        w, l = conll[0]
+        assert len(w) == 2 and len(conll) == 2
+
+    def test_movielens(self, tmp_path):
+        zbuf = io.BytesIO()
+        with zipfile.ZipFile(zbuf, "w") as z:
+            z.writestr("ml-1m/users.dat", "1::M::25::4::0\n")
+            z.writestr("ml-1m/movies.dat", "10::A (1990)::Comedy\n")
+            z.writestr("ml-1m/ratings.dat", "1::10::5::1\n")
+        p = str(tmp_path / "ml.zip")
+        open(p, "wb").write(zbuf.getvalue())
+        ds = paddle.text.Movielens(data_file=p, mode="train",
+                                   test_ratio=0.0)
+        row = ds[0]
+        assert len(row) == 6 and row[5].shape == (1,)
+
+    def _wav(self, path):
+        with wave.open(path, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(16000)
+            sig = (np.sin(np.linspace(0, 100, 1600)) * 20000).astype(
+                np.int16)
+            w.writeframes(sig.tobytes())
+
+    def test_audio_datasets(self, tmp_path):
+        tess_dir = str(tmp_path / "tess")
+        os.makedirs(tess_dir)
+        for emo in ("angry", "happy", "sad"):
+            for k in range(3):
+                self._wav(os.path.join(tess_dir, f"OAF_w{k}_{emo}.wav"))
+        ds = paddle.audio.datasets.TESS(mode="train", data_file=tess_dir)
+        wav0, lab0 = ds[0]
+        assert wav0.ndim == 1 and 0 <= int(lab0) < 7
+
+        esc_dir = str(tmp_path / "esc")
+        os.makedirs(esc_dir)
+        for i in range(4):
+            self._wav(os.path.join(esc_dir,
+                                   f"{i % 2 + 1}-1234{i}-A-{i * 7 % 50}.wav"))
+        esc = paddle.audio.datasets.ESC50(mode="train", split=1,
+                                          data_file=esc_dir)
+        assert len(esc) == 2
+
+    def test_offline_errors_are_actionable(self):
+        with pytest.raises(ValueError, match="egress"):
+            paddle.text.Imdb()
+        with pytest.raises(ValueError, match="egress"):
+            paddle.vision.datasets.Flowers()
+        with pytest.raises(ValueError, match="egress"):
+            paddle.audio.datasets.TESS()

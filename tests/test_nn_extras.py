@@ -120,6 +120,17 @@ class TestLongTailLosses:
             torch.tensor(x), torch.tensor(y)).numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
+    def test_multi_margin_weighted_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(3).randn(5, 7).astype(np.float32)
+        y = np.array([1, 0, 6, 3, 2], np.int64)
+        w = np.array([1, 2, 3, 1, 1, 1, 2], np.float32)
+        got = F.multi_margin_loss(T(x), T(y), weight=T(w)).numpy()
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y),
+            weight=torch.tensor(w)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
     def test_gaussian_nll_vs_torch(self):
         torch = pytest.importorskip("torch")
         rng = np.random.RandomState(4)
