@@ -5,5 +5,13 @@ FusedFeedForward layer wrappers over the fused CUDA ops). Here the
 functional namespace maps onto the Pallas kernel suite (ops/pallas/)."""
 
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedEcMoe,
+    FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
+    FusedMultiTransformer, FusedTransformerEncoderLayer,
+)
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe",
+           "FusedDropoutAdd"]

@@ -1,0 +1,95 @@
+"""incubate.nn fused layer classes (reference
+incubate/nn/layer/fused_transformer.py) — forward shapes, norm semantics,
+expert-choice MoE routing, and the namespace audit.
+"""
+
+import ast
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _x(b=2, s=6, h=32, seed=0):
+    return T(np.random.RandomState(seed).randn(b, s, h).astype(np.float32))
+
+
+class TestFusedLayers:
+    def test_linear_and_transpose(self):
+        paddle.seed(0)
+        x = _x()
+        fl = inn.FusedLinear(32, 16)
+        assert fl(x).shape == [2, 6, 16]
+        flt = inn.FusedLinear(32, 16, transpose_weight=True)
+        assert flt.weight.shape == [16, 32]
+        assert flt(x).shape == [2, 6, 16]
+
+    def test_dropout_add_and_bias_ln(self):
+        x = _x()
+        np.testing.assert_allclose(inn.FusedDropoutAdd(p=0.0)(x, x).numpy(),
+                                   2 * x.numpy(), rtol=1e-6)
+        bln = inn.FusedBiasDropoutResidualLayerNorm(32, dropout_rate=0.0)
+        np.testing.assert_allclose(bln(x, x).numpy().mean(-1), 0.0,
+                                   atol=1e-5)
+
+    def test_attention_pre_vs_post_norm(self):
+        paddle.seed(1)
+        x = _x()
+        for pre in (True, False):
+            mha = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                              attn_dropout_rate=0.0,
+                                              normalize_before=pre)
+            mha.eval()
+            out = mha(x)
+            assert out.shape == [2, 6, 32]
+            if not pre:  # post-norm output is layer-normalized
+                np.testing.assert_allclose(out.numpy().mean(-1), 0.0,
+                                           atol=1e-4)
+
+    def test_encoder_stack_trains(self):
+        paddle.seed(2)
+        enc = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=enc.parameters())
+        x = _x(seed=3)
+        tgt = _x(seed=4)
+        first = None
+        for _ in range(6):
+            loss = ((enc(x) - tgt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first
+
+    def test_multi_transformer(self):
+        paddle.seed(5)
+        mt = inn.FusedMultiTransformer(32, 4, 64, num_layers=2)
+        mt.eval()
+        assert mt(_x()).shape == [2, 6, 32]
+
+    def test_ec_moe_balanced_and_differentiable(self):
+        paddle.seed(6)
+        moe = inn.FusedEcMoe(32, 64, num_experts=4)
+        x = _x()
+        x.stop_gradient = False
+        gate = T(np.random.RandomState(7).randn(2, 6, 4).astype(np.float32))
+        out = moe(x, gate)
+        assert out.shape == [2, 6, 32]
+        (out ** 2).sum().backward()
+        assert x.grad is not None and moe.bmm_weight0.grad is not None
+
+    def test_namespace_audit(self):
+        src = open("/root/reference/python/paddle/incubate/nn/"
+                   "__init__.py").read()
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        ra = ast.literal_eval(node.value)
+        assert [n for n in ra if not hasattr(inn, n)] == []
