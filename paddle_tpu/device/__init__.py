@@ -99,3 +99,130 @@ import sys
 
 sys.modules[f"{__name__}.cuda"] = cuda
 sys.modules[f"{__name__}.xpu"] = xpu
+
+
+# ---- reference device/__init__.py long tail: version probes, place types,
+# stream/event objects. On TPU, XLA owns stream scheduling — Stream/Event
+# are ordering no-ops that preserve the API contract (synchronize waits on
+# all queued work via a device fence).
+
+from ..core.device import (  # noqa: E402,F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,
+)
+
+
+class XPUPlace(CUDAPlace):
+    _kind = "xpu"
+
+
+class IPUPlace(CPUPlace):
+    _kind = "ipu"
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in a TPU build (reference returns None on CPU)
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True  # jax.distributed multi-host is always compiled in
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+class Stream:
+    """Ordering token (reference device.Stream). XLA serializes per-device
+    execution; synchronize() fences queued work."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        import jax
+
+        jax.effects_barrier()
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+
+    def record(self, stream=None):
+        return None
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        import jax
+
+        jax.effects_barrier()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+import contextlib as _contextlib  # noqa: E402
+
+
+@_contextlib.contextmanager
+def stream_guard(stream):
+    prev = set_stream(stream)
+    try:
+        yield
+    finally:
+        set_stream(prev)

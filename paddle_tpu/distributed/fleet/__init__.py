@@ -6,6 +6,12 @@ from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: 
 from .fleet import Fleet, fleet_singleton as _fleet  # noqa: F401
 from . import utils  # noqa: F401
 from . import meta_optimizers  # noqa: F401
+from .base.role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker, UtilBase,
+)
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
 
 
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):

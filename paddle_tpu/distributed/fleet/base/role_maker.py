@@ -1,0 +1,127 @@
+"""Role makers + UtilBase (reference
+python/paddle/distributed/fleet/base/role_maker.py and util_factory.py).
+
+Under single-controller JAX the "role" is derived from the launch env
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, set by distributed.launch or the
+cloud scheduler); PS roles map onto the collective PS path
+(distributed/ps) so every role maker reports TRAINER unless the env
+declares a server list.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "UtilBase"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def _worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def _worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _server_num(self):
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return len([e for e in eps.split(",") if e]) if eps else 0
+
+    def _role_id(self):
+        return self._worker_index()
+
+    # public aliases the reference exposes through Fleet
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_first_worker = _is_first_worker
+    is_worker = _is_worker
+    is_server = _is_server
+    server_num = _server_num
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """reference role_maker.py PaddleCloudRoleMaker: roles from the
+    PaddleCloud/k8s env variables."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = (Role.SERVER if training_role == "PSERVER"
+                      else Role.WORKER)
+
+    def _generate_role(self):
+        return self._role
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference UserDefinedRoleMaker: explicit role/rank/size instead of
+    env sniffing."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._kwargs = kwargs
+        self._role = kwargs.get("role", Role.WORKER)
+        if "current_id" in kwargs:
+            os.environ["PADDLE_TRAINER_ID"] = str(kwargs["current_id"])
+        if "worker_num" in kwargs:
+            os.environ["PADDLE_TRAINERS_NUM"] = str(kwargs["worker_num"])
+
+
+class UtilBase:
+    """reference util_factory.py UtilBase: small cross-worker utilities.
+    Collectives ride the in-process group (single-controller: world of
+    one unless launched multi-process)."""
+
+    def __init__(self):
+        self.role_maker = PaddleCloudRoleMaker()
+
+    def _set_role_maker(self, rm):
+        self.role_maker = rm
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference
+        UtilBase.get_file_shard)."""
+        n = self.role_maker._worker_num()
+        i = self.role_maker._worker_index()
+        per, rem = divmod(len(files), n)
+        start = i * per + min(i, rem)
+        return files[start:start + per + (1 if i < rem else 0)]
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ... import communication as _comm  # noqa: F401
+
+        return np.asarray(input)  # world-of-one: identity; multi-process
+        # reductions go through paddle.distributed.all_reduce on tensors
+
+    def barrier(self, comm_world="worker"):
+        return None
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def print_on_rank(self, message, rank_id=0):
+        if self.role_maker._worker_index() == int(rank_id):
+            print(message)

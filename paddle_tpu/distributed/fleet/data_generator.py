@@ -1,0 +1,78 @@
+"""MultiSlot data generators (reference
+python/paddle/distributed/fleet/data_generator/data_generator.py).
+
+These produce the line protocol the PS DataFeed consumes: per slot,
+``n_values v1 ... vn`` (counts then values, space-joined across slots —
+slot NAMES are schema, not wire data). The TPU path trains from
+DataLoaders, but PaddleRec-style pipelines call these generators to
+preprocess text streams — the protocol is preserved so those scripts run
+unchanged (InMemoryDataset/QueueDataset parse_fn can consume the output).
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 1
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    def generate_sample(self, line):
+        """User override: line -> iterator of (slot_name, values) lists."""
+        raise NotImplementedError(
+            "implement generate_sample(self, line) returning an iterator")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _format(self, record):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            for record in self._records_of(line):
+                sys.stdout.write(self._format(record))
+
+    def _records_of(self, line):
+        gen = self.generate_sample(line)
+        out = []
+        for record in gen():
+            out.append(record)
+        return out
+
+    def run_from_memory(self):
+        """Test/offline hook: returns the formatted lines instead of
+        streaming stdin->stdout."""
+        raise NotImplementedError
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slot values; wire line per record: ``len v1 v2 ...`` per
+    slot, space-joined (reference _gen_str of MultiSlotDataGenerator)."""
+
+    def _format(self, record):
+        parts = []
+        for name, values in record:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+    def generate_lines(self, lines):
+        return [self._format(r) for line in lines
+                for r in self._records_of(line)]
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued variant — same wire format, values pass through as
+    strings (reference MultiSlotStringDataGenerator)."""

@@ -229,3 +229,82 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """reference paddle_infer.DataType enum."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    FLOAT64 = 7
+    BOOL = 8
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.FLOAT64: 8, DataType.BOOL: 1}
+    return sizes[dtype]
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU builds (the XLA compiler is the deployment
+    compiler); version triple is all-zero like reference CPU builds."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """PHI kernels collapse into XLA ops here; the name maps through."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference inference/convert_to_mixed_precision: offline fp16/bf16
+    rewrite of a saved model. The jax.export artifact re-traces under
+    amp instead — re-export with paddle.amp.auto_cast for a mixed
+    artifact; this entry point documents that path."""
+    raise NotImplementedError(
+        "offline mixed-precision conversion of a serialized artifact is a "
+        "TensorRT-era workflow; re-export the model under "
+        "paddle.amp.auto_cast(level='O2') to get a bf16 artifact")
+
+
+class XpuConfig:
+    """Kunlun XPU deploy knobs — accepted, inert (no XPU backend)."""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class PredictorPool:
+    """reference paddle_infer.PredictorPool: N predictors over one config
+    (thread serving). Predictor.clone() shares the executable, so the pool
+    is a thin list."""
+
+    def __init__(self, config, size=1):
+        first = create_predictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrive(self, idx):  # reference spells it this way
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+__all__ += ["DataType", "get_num_bytes_of_data_type",
+            "get_trt_compile_version", "get_trt_runtime_version",
+            "convert_to_mixed_precision", "XpuConfig", "PredictorPool",
+            "_get_phi_kernel_name"]

@@ -23,3 +23,45 @@ __all__ = [
     "export_chrome_tracing", "load_profiler_result", "SortedKeys",
     "RecordEvent", "in_profiler_mode", "Benchmark", "benchmark",
 ]
+
+
+class SummaryView:
+    """reference profiler SummaryView enum (table selection)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    OperatorDetailView = 6
+    MemoryView = 7
+    MemoryManipulationView = 8
+    UDFView = 9
+
+
+def export_protobuf(dir_name=None, worker_name=None):
+    """reference profiler.export_protobuf: on-trace-ready handler saving
+    the host event tree. The chrome-trace JSON is this framework's
+    canonical artifact; this handler writes the same events with a .pb
+    extension (pickled event list — there is no paddle profiler proto
+    consumer off-device)."""
+    import os
+    import pickle
+    import time
+
+    def handler(prof):
+        d = dir_name or "./profiler_log"
+        os.makedirs(d, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(d, f"{name}_{int(time.time())}.pb")
+        events = getattr(prof, "_events_snapshot", [])
+        with open(path, "wb") as f:
+            pickle.dump([e.__dict__ if hasattr(e, "__dict__") else e
+                         for e in events], f)
+        return path
+
+    return handler
+
+
+__all__ += ["SummaryView", "export_protobuf"]

@@ -29,3 +29,28 @@ __all__ = [
     "ObserveWrapper", "QuantedLinear", "QuantedConv2D",
     "Int8InferenceLinear", "observers", "quanters",
 ]
+
+
+def quanter(name):
+    """Class decorator registering a custom quanter factory (reference
+    quantization/factory.py quanter: creates a <name> QuanterFactory bound
+    to the decorated BaseQuanter subclass). The factory is a module-level
+    QuanterFactory subclass, so configured instances stay picklable."""
+    def deco(cls):
+        import sys
+
+        from .factory import QuanterFactory
+
+        mod = sys.modules[__name__]
+        factory = type(name, (QuanterFactory,),
+                       {"_get_class": lambda self, _cls=cls: _cls,
+                        "__module__": __name__})
+        setattr(mod, name, factory)
+        if name not in __all__:
+            __all__.append(name)
+        return cls
+
+    return deco
+
+
+__all__.append("quanter")

@@ -10,3 +10,85 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference utils/deprecated.py: warn-once decorator."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not warned:
+                warned.append(True)
+                msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+                if since:
+                    msg += f" since {since}"
+                if update_to:
+                    msg += f", use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """reference utils/__init__.py require_version against
+    paddle.__version__."""
+    import paddle_tpu
+
+    def parse(v):
+        import re as _re
+
+        parts = []
+        for x in str(v).split(".")[:3]:
+            m = _re.match(r"\d+", x)
+            parts.append(int(m.group()) if m else 0)
+        while len(parts) < 3:  # 0.1 == 0.1.0 under tuple comparison
+            parts.append(0)
+        return tuple(parts)
+
+    cur = parse(paddle_tpu.__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {paddle_tpu.__version__} < required "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {paddle_tpu.__version__} > allowed "
+            f"{max_version}")
+    return True
+
+
+def run_check():
+    """reference utils/install_check.py run_check: compile + run a tiny
+    training step on the available device(s) and report."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    dev = paddle.device.get_device()
+    print(f"Running verify on {dev} ...")
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(8, 2).astype("float32"))
+    loss = ((model(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    print(f"paddle_tpu is installed successfully on {dev}! loss="
+          f"{float(loss.numpy()):.4f}")
+
+
+__all__ = list(globals().get("__all__", [])) + [
+    "deprecated", "require_version", "run_check"]

@@ -236,6 +236,28 @@ class TestNamespaceAuditsComplete:
         ("io/__init__.py", "paddle_tpu.io"),
         ("nn/__init__.py", "paddle_tpu.nn"),
         ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
+        ("quantization/__init__.py", "paddle_tpu.quantization"),
+        ("inference/__init__.py", "paddle_tpu.inference"),
+        ("profiler/__init__.py", "paddle_tpu.profiler"),
+        ("device/__init__.py", "paddle_tpu.device"),
+        ("utils/__init__.py", "paddle_tpu.utils"),
+        ("distributed/fleet/__init__.py", "paddle_tpu.distributed.fleet"),
+        ("incubate/nn/__init__.py", "paddle_tpu.incubate.nn"),
+        ("vision/models/__init__.py", "paddle_tpu.vision.models"),
+        ("vision/ops.py", "paddle_tpu.vision.ops"),
+        ("vision/transforms/__init__.py", "paddle_tpu.vision.transforms"),
+        ("vision/datasets/__init__.py", "paddle_tpu.vision.datasets"),
+        ("text/__init__.py", "paddle_tpu.text"),
+        ("audio/__init__.py", "paddle_tpu.audio"),
+        ("geometric/__init__.py", "paddle_tpu.geometric"),
+        ("incubate/__init__.py", "paddle_tpu.incubate"),
+        ("optimizer/__init__.py", "paddle_tpu.optimizer"),
+        ("autograd/__init__.py", "paddle_tpu.autograd"),
+        ("jit/__init__.py", "paddle_tpu.jit"),
+        ("static/__init__.py", "paddle_tpu.static"),
+        ("distribution/__init__.py", "paddle_tpu.distribution"),
+        ("signal.py", "paddle_tpu.signal"),
+        ("amp/__init__.py", "paddle_tpu.amp"),
     ])
     def test_all_covered(self, ref, mod):
         import importlib
