@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import functools
 import threading
+import traceback
+import warnings
 
 import numpy as np
 import jax
@@ -29,6 +31,7 @@ import jax.numpy as jnp
 from ..core import rng as rng_mod
 from ..core import state
 from ..core.engine import Edge, GradNode
+from ..core.flags import flag_value, register_flag
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
 from ..static.input_spec import InputSpec
@@ -37,6 +40,65 @@ __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "enable_to_static", "ignore_module"]
 
 _TO_STATIC_ENABLED = True
+
+# SOT-style graceful degradation (reference: jit/sot eval-frame fallback,
+# paddle/fluid/pybind/eval_frame.c:411): when tracing hits data-dependent
+# control flow the whole function cannot express, fall back to running the
+# function eagerly (per-call, uncompiled) with a one-time actionable warning.
+# FLAGS_to_static_fallback=0 turns the fallback into a hard framework error
+# carrying the same diagnostic.
+register_flag("to_static_fallback", True,
+              help="fall back to eager when to_static tracing hits "
+                   "data-dependent control flow (SOT semantics)")
+
+_TRACER_LEAK_ERRORS = (
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+
+def _user_frame(exc):
+    """The deepest traceback frame in user code — i.e. not in an installed
+    library (site-packages/dist-packages) and not in paddle_tpu itself.
+    REPL/exec frames (``<stdin>``, ``<string>``) count as user code."""
+    import paddle_tpu
+
+    pkg_dir = paddle_tpu.__file__.rsplit("/", 1)[0]
+    best = None
+    for frame in traceback.extract_tb(exc.__traceback__):
+        f = frame.filename
+        if "site-packages/" in f or "dist-packages/" in f:
+            continue
+        if f.startswith(pkg_dir):
+            continue
+        best = frame
+    return best
+
+
+def _tracer_leak_message(fn_name, exc):
+    frame = _user_frame(exc)
+    where = (f'  File "{frame.filename}", line {frame.lineno}, in '
+             f"{frame.name}\n"
+             + (f"    {frame.line}\n" if frame.line else "")
+             if frame is not None else "  (offending line inside a library "
+             "call — see the chained JAX traceback)\n")
+    return (
+        f"to_static could not compile `{fn_name}`: a Python branch or loop "
+        "depends on a Tensor VALUE, which is unknown while tracing (the "
+        "whole function is compiled ONCE by XLA).\n"
+        f"{where}"
+        "Fix one of these ways:\n"
+        "  1. paddle.static.nn.cond(pred, true_fn, false_fn) — compiles "
+        "BOTH branches, differentiable.\n"
+        "  2. paddle.static.nn.while_loop(cond_fn, body_fn, loop_vars) — "
+        "data-dependent trip count.\n"
+        "  3. paddle.where(mask, a, b) — elementwise select, usually "
+        "fastest on TPU.\n"
+        "  4. mark the whole function @paddle.jit.not_to_static BEFORE "
+        "to_static wraps it, to always run it eagerly.\n"
+        f"(original: {type(exc).__name__})")
 
 
 def enable_to_static(flag: bool):
@@ -114,14 +176,19 @@ class StaticFunction:
             return self._dygraph_function
         return None
 
+    def _call_eager(self, *args, **kwargs):
+        if self._instance is not None:
+            return self._dygraph_function(self._instance, *args, **kwargs)
+        return self._dygraph_function(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED:
-            if self._instance is not None:
-                return self._dygraph_function(self._instance, *args, **kwargs)
-            return self._dygraph_function(*args, **kwargs)
+            return self._call_eager(*args, **kwargs)
         layer = self._collect_layer()
         key = self._key(layer, args, kwargs)
         entry = self._cache.get(key)
+        if entry == "eager":  # earlier fallback for this shape key
+            return self._call_eager(*args, **kwargs)
 
         # flatten dynamic (tensor) leaves out of args
         flat_args, arg_tree = jax.tree.flatten(
@@ -135,8 +202,26 @@ class StaticFunction:
                         and not flat_args[i].stop_gradient for i in dyn_idx]
 
         if entry is None:
-            entry = self._trace(layer, arg_tree, flat_args, dyn_idx)
+            try:
+                entry = self._trace(layer, arg_tree, flat_args, dyn_idx)
+            except _TRACER_LEAK_ERRORS as e:
+                msg = _tracer_leak_message(self.__name__, e)
+                if not flag_value("to_static_fallback", True):
+                    raise RuntimeError(msg) from e
+                warnings.warn(msg + "\nFalling back to EAGER execution for "
+                              "this function (uncompiled; set "
+                              "FLAGS_to_static_fallback=0 to make this an "
+                              "error). Note: the function body partially "
+                              "executed once during the failed trace — "
+                              "non-idempotent Python side effects (appends, "
+                              "counters) before the offending line ran "
+                              "twice, and values stashed during the trace "
+                              "are unusable tracers.", stacklevel=2)
+                entry = "eager"
             self._cache[key] = entry
+
+        if entry == "eager":
+            return self._call_eager(*args, **kwargs)
 
         params = entry.params
         key_arr = rng_mod.DEFAULT_GENERATOR.next_key()
@@ -260,8 +345,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         if isinstance(fn, Layer):
             # wrap the layer's forward; calling the layer still works because
             # we return a layer-like callable
+            if getattr(type(fn).forward, "_not_to_static", False):
+                return fn
             sf = StaticFunction(type(fn).forward, input_spec, instance=fn)
             fn.forward = sf
+            return fn
+        if getattr(fn, "_not_to_static", False):
             return fn
         return StaticFunction(fn, input_spec)
 

@@ -356,3 +356,9 @@ __all__ += [
     "create_global_var", "accuracy", "auc", "device_guard",
     "create_parameter", "set_ipu_shard", "ctr_metric_bundle",
 ]
+
+
+# paddle.static.nn — layer builders + control flow (static/nn/)
+from . import nn  # noqa: E402,F401
+
+__all__ += ["nn"]

@@ -1,0 +1,13 @@
+"""paddle.static.nn — static-graph layer builders + control flow.
+
+Reference: python/paddle/static/nn/__init__.py (__all__ :58). Control flow
+lowers onto lax.cond/lax.while_loop/lax.switch (control_flow.py); layer
+builders are functional facades over the nn layer classes with a persistent
+parameter registry (common.py).
+"""
+
+from .common import *  # noqa: F401,F403
+from .common import __all__ as _common_all
+from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
+
+__all__ = list(_common_all)

@@ -1,0 +1,498 @@
+"""paddle.static.nn common layers — functional facades with persistent state.
+
+Reference: python/paddle/static/nn/common.py — ``fc`` (:48), ``embedding``
+(:3668), ``sparse_embedding`` (:3805), plus the conv/norm wrappers the
+namespace re-exports.
+
+TPU-native redesign: the reference's static builders create parameters
+inside the Program's startup block; here static mode is eager-with-tape
+(static/__init__.py), so each builder keeps its parameters in a persistent
+layer registry keyed by (api, name, weight shape) — repeat calls with the
+same key reuse the same parameters, matching the Program's
+create-once-then-run semantics. ``paddle.static.nn.reset_parameters()``
+clears the registry (a fresh startup program).
+
+LoD sequence ops (sequence_conv/pool/...) are deliberately out of scope:
+LoD tensors do not exist in this framework (variable-length batches are
+expressed with padding + masks, the XLA-friendly form); each stub raises
+with that guidance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ... import nn
+from ...nn import functional as F
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse", "reset_parameters",
+]
+
+# (api, name, config key) -> Layer; the static-graph "create parameter
+# once in startup program" semantics for the eager-replay Executor. The key
+# carries every math-affecting hyperparameter, so two calls share parameters
+# only when they are the same layer (same name — or both unnamed — AND same
+# config); use ``name=`` to keep two same-config layers distinct.
+_REGISTRY: dict = {}
+
+
+def reset_parameters():
+    """Forget all parameters created by static.nn builders (i.e. run a fresh
+    startup program)."""
+    _REGISTRY.clear()
+
+
+def _hp(v):
+    """Hashable form of a hyperparameter (lists/tuples -> nested tuples)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_hp(x) for x in v)
+    return v
+
+
+def _get_layer(api, name, key, build):
+    k = (api, name, _hp(key))
+    layer = _REGISTRY.get(k)
+    if layer is None:
+        # Layer creation must be CONCRETE even when the builder is first hit
+        # inside a to_static trace: suspend the traced rng base AND escape
+        # the ambient trace (ensure_compile_time_eval) so initializers draw
+        # from the host key and produce real arrays. The weights then enter
+        # the traced fn as compile-time constants, and retraces see the same
+        # concrete weights instead of a leaked tracer.
+        import jax
+
+        from ...core import rng as rng_mod
+
+        gen = rng_mod.DEFAULT_GENERATOR
+        prev = gen._traced_base
+        gen._traced_base = None
+        try:
+            with jax.ensure_compile_time_eval():
+                layer = build()
+        finally:
+            gen._traced_base = prev
+        _REGISTRY[k] = layer
+    return layer
+
+
+def parameters():
+    """All parameters created by static.nn builders (feed these to an
+    optimizer when using the functional facades directly)."""
+    out = []
+    for layer in _REGISTRY.values():
+        out.extend(p for _, p in layer.named_parameters())
+    return out
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected: flatten trailing dims, xW+b, optional activation.
+
+    Reference: python/paddle/static/nn/common.py:48. Multiple input tensors
+    (list) are each projected and summed, as the reference does.
+    """
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for i, xi in enumerate(xs):
+        shp = xi.shape
+        if num_flatten_dims < 0:
+            num_flatten_dims = len(shp) + num_flatten_dims
+        in_features = int(np.prod(shp[num_flatten_dims:]))
+        flat = xi.reshape(list(shp[:num_flatten_dims]) + [in_features])
+        layer = _get_layer(
+            "fc", name, (i, in_features, size),
+            lambda: nn.Linear(in_features, size, weight_attr=weight_attr,
+                              bias_attr=bias_attr if i == 0 else False))
+        outs.append(layer(flat))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """Reference: python/paddle/static/nn/common.py:3668."""
+    layer = _get_layer(
+        "embedding", name, tuple(size),
+        lambda: nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                             sparse=is_sparse, weight_attr=param_attr))
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None, name=None):
+    """Distributed-PS sparse table lookup.
+
+    Reference: python/paddle/static/nn/common.py:3805. Routed to the
+    row-sharded PS table (distributed/ps); ``entry`` carries the admission
+    filter config (CountFilterEntry / ProbabilityEntry).
+    """
+    from ...distributed import ps
+
+    return ps.sparse_embedding(input, size, padding_idx=padding_idx,
+                               param_attr=param_attr, dtype=dtype, name=name,
+                               entry=entry)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """Reference: python/paddle/static/nn/common.py:2661."""
+    ch_axis = 1 if data_layout == "NCHW" else -1
+    num_channels = input.shape[ch_axis]
+    layer = _get_layer(
+        "batch_norm", name,
+        (num_channels, data_layout, momentum, epsilon, use_global_stats),
+        lambda: nn.BatchNorm(num_channels, momentum=momentum,
+                             epsilon=epsilon, weight_attr=param_attr,
+                             bias_attr=bias_attr, data_format=data_layout,
+                             use_global_stats=use_global_stats))
+    layer.training = not is_test
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Reference: python/paddle/static/nn/common.py:2982."""
+    normalized_shape = list(input.shape[begin_norm_axis:])
+    layer = _get_layer(
+        "layer_norm", name, (tuple(normalized_shape), epsilon, scale, shift),
+        lambda: nn.LayerNorm(normalized_shape, epsilon=epsilon,
+                             weight_attr=param_attr if scale else False,
+                             bias_attr=bias_attr if shift else False))
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    """Reference: python/paddle/static/nn/common.py:3111."""
+    ch_axis = 1 if data_layout == "NCHW" else -1
+    num_channels = input.shape[ch_axis]
+    layer = _get_layer(
+        "group_norm", name, (groups, num_channels, data_layout, epsilon),
+        lambda: nn.GroupNorm(groups, num_channels, epsilon=epsilon,
+                             weight_attr=param_attr, bias_attr=bias_attr,
+                             data_format=data_layout))
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    """Reference: python/paddle/static/nn/common.py:2852."""
+    num_channels = input.shape[1]
+    cls = {3: nn.InstanceNorm1D, 4: nn.InstanceNorm2D,
+           5: nn.InstanceNorm3D}[len(input.shape)]
+    layer = _get_layer(
+        "instance_norm", name, (num_channels, len(input.shape), epsilon),
+        lambda: cls(num_channels, epsilon=epsilon, weight_attr=param_attr,
+                    bias_attr=bias_attr))
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Per-feature normalization by accumulated batch statistics (CTR
+    models). Reference: python/paddle/static/nn/common.py:2478. Scoped-down:
+    normalizes with running statistics updated eagerly per call."""
+    ch = input.shape[-1] if data_layout != "NCHW" or len(input.shape) == 2 \
+        else input.shape[1]
+    layer = _get_layer(
+        "data_norm", name, (ch,),
+        lambda: nn.BatchNorm1D(ch, momentum=summary_decay_rate,
+                               epsilon=epsilon))
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def _conv_nd(api, cls, input, num_filters, filter_size, stride, padding,
+             dilation, groups, param_attr, bias_attr, act, name,
+             data_format="NCHW", output_padding=0, transpose=False):
+    ch_axis = 1 if data_format in ("NCHW", "NCDHW") else -1
+    in_ch = input.shape[ch_axis]
+    kw = dict(stride=stride, padding=padding, dilation=dilation,
+              groups=groups or 1, weight_attr=param_attr,
+              bias_attr=bias_attr, data_format=data_format)
+    if transpose:
+        kw["output_padding"] = output_padding
+    layer = _get_layer(
+        api, name, (in_ch, num_filters, tuple(np.atleast_1d(filter_size)),
+                    data_format, stride, padding, dilation, groups,
+                    output_padding),
+        lambda: cls(in_ch, num_filters, filter_size, **kw))
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """Reference: python/paddle/static/nn/common.py:1072."""
+    return _conv_nd("conv2d", nn.Conv2D, input, num_filters, filter_size,
+                    stride, padding, dilation, groups, param_attr, bias_attr,
+                    act, name, data_format)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    """Reference: python/paddle/static/nn/common.py:1380."""
+    return _conv_nd("conv3d", nn.Conv3D, input, num_filters, filter_size,
+                    stride, padding, dilation, groups, param_attr, bias_attr,
+                    act, name, data_format)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """Reference: python/paddle/static/nn/common.py:1680."""
+    assert filter_size is not None, \
+        "static.nn.conv2d_transpose requires filter_size on this framework"
+    return _conv_nd("conv2d_transpose", nn.Conv2DTranspose, input,
+                    num_filters, filter_size, stride, padding, dilation,
+                    groups, param_attr, bias_attr, act, name, data_format,
+                    transpose=True)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """Reference: python/paddle/static/nn/common.py:2093."""
+    assert filter_size is not None, \
+        "static.nn.conv3d_transpose requires filter_size on this framework"
+    return _conv_nd("conv3d_transpose", nn.Conv3DTranspose, input,
+                    num_filters, filter_size, stride, padding, dilation,
+                    groups, param_attr, bias_attr, act, name, data_format,
+                    transpose=True)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """Reference: python/paddle/static/nn/common.py:3310."""
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1 if data_format == "NCHW" else -1]
+    elif mode == "element":
+        num = int(np.prod(x.shape[1:]))
+    else:
+        raise ValueError(f"prelu mode should be all/channel/element, got "
+                         f"{mode!r}")
+    layer = _get_layer(
+        "prelu", name, (mode, num),
+        lambda: nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                         data_format=data_format))
+    return layer(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[i] = x W_i y + b. Reference: python/paddle/static/nn/common.py:3549."""
+    layer = _get_layer(
+        "bilinear_tensor_product", name, (x.shape[-1], y.shape[-1], size),
+        lambda: nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                            weight_attr=param_attr, bias_attr=bias_attr))
+    out = layer(x, y)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Reference: python/paddle/static/nn/common.py:3448."""
+    layer = _get_layer(
+        "spectral_norm", name, (tuple(weight.shape), dim, power_iters, eps),
+        lambda: nn.SpectralNorm(weight.shape, dim=dim,
+                                power_iters=power_iters, epsilon=eps))
+    return layer(weight)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Reference: python/paddle/static/nn/common.py:588. Routed to
+    vision.ops.deform_conv2d with a registry-held weight."""
+    from ...vision.ops import DeformConv2D
+
+    in_ch = x.shape[1]
+    layer = _get_layer(
+        "deform_conv2d", name,
+        (in_ch, num_filters, tuple(np.atleast_1d(filter_size)), stride,
+         padding, dilation, groups, deformable_groups),
+        lambda: DeformConv2D(in_ch, num_filters, filter_size, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups,
+                             deformable_groups=deformable_groups,
+                             weight_attr=param_attr, bias_attr=bias_attr))
+    return layer(x, offset, mask)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss.
+
+    Reference: python/paddle/static/nn/common.py:2138. Scoped-down dense
+    form: uniform negative sampling, logistic loss over true + sampled
+    logits."""
+    import jax.numpy as jnp
+
+    from ... import ops
+    from ...core import rng as rng_mod
+
+    dim = input.shape[-1]
+    num_neg = num_neg_samples or 10
+    layer = _get_layer(
+        "nce", name, (num_total_classes, dim),
+        lambda: nn.Linear(dim, num_total_classes, weight_attr=param_attr,
+                          bias_attr=bias_attr))
+    logits = layer(input)  # [B, C]
+    label_flat = label.reshape([-1])
+    key = rng_mod.DEFAULT_GENERATOR.next_key()
+    import jax
+
+    neg = jax.random.randint(key, (num_neg,), 0, num_total_classes)
+    pos_logit = ops.take_along_axis(
+        logits, label_flat.reshape([-1, 1]), axis=1)
+    neg_logit = ops.index_select(
+        logits, Tensor._wrap(jnp.asarray(neg)), axis=1)
+    pos_loss = F.binary_cross_entropy_with_logits(
+        pos_logit, ops.ones_like(pos_logit), reduction="none")
+    neg_loss = F.binary_cross_entropy_with_logits(
+        neg_logit, ops.zeros_like(neg_logit), reduction="none")
+    return (pos_loss.sum(axis=1) + neg_loss.sum(axis=1)).reshape([-1, 1])
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (DeepSpeech2).
+
+    Reference: python/paddle/static/nn/common.py:3386. out[t] = sum_{i=0..k}
+    in[t+i] * w[i] — implemented as a depthwise causal-in-future conv."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_op
+
+    d = input.shape[-1]
+    k = future_context_size + 1
+    layer = _get_layer(
+        "row_conv", None, (d, k),
+        lambda: nn.Linear(k, 1, bias_attr=False, weight_attr=param_attr))
+    w = layer.weight.reshape([k])  # [k]
+
+    def _row_conv(x_a, w_a):
+        # x: [B, T, D] (or [T, D]); slide window over T
+        squeeze = x_a.ndim == 2
+        if squeeze:
+            x_a = x_a[None]
+        pad = jnp.pad(x_a, ((0, 0), (0, k - 1), (0, 0)))
+        out = sum(pad[:, i:i + x_a.shape[1]] * w_a[i] for i in range(k))
+        return out[0] if squeeze else out
+
+    out = apply_op("row_conv", _row_conv, input, w)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a Python callable as an op.
+
+    Reference: python/paddle/static/nn/common.py:4054. Eager-with-tape
+    static mode simply calls it; ``out`` supplies the output template(s)
+    (reference semantics: pre-created out vars)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res if res is not None else out
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference: python/paddle/static/nn/static_pylayer.py. Routed to the
+    eager PyLayer mechanism (autograd/py_layer.py)."""
+    if backward_fn is None:
+        from ...core import state
+
+        with state.no_grad_guard():
+            return forward_fn(*inputs)
+
+    from ...autograd import PyLayer
+
+    class _Static(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _Static.apply(*inputs)
+
+
+def _lod_stub(api):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{api} operates on LoD tensors, which this "
+            "TPU-native framework does not model (XLA needs static shapes). "
+            "Express variable-length sequences as padded dense tensors + "
+            "masks: nn.functional.sequence_mask builds the mask, and the "
+            "dense nn.Conv1D/pooling/softmax ops replace the sequence_* "
+            "ops. See DESIGN_DECISIONS.md.")
+    fn.__name__ = api
+    fn.__qualname__ = api
+    fn.__doc__ = (f"LoD sequence op (reference python/paddle/static/nn/"
+                  f"sequence_lod.py) — see raise message for the dense "
+                  f"TPU-native recipe.")
+    return fn
+
+
+sequence_conv = _lod_stub("sequence_conv")
+sequence_softmax = _lod_stub("sequence_softmax")
+sequence_pool = _lod_stub("sequence_pool")
+sequence_concat = _lod_stub("sequence_concat")
+sequence_first_step = _lod_stub("sequence_first_step")
+sequence_last_step = _lod_stub("sequence_last_step")
+sequence_slice = _lod_stub("sequence_slice")
+sequence_expand = _lod_stub("sequence_expand")
+sequence_expand_as = _lod_stub("sequence_expand_as")
+sequence_pad = _lod_stub("sequence_pad")
+sequence_unpad = _lod_stub("sequence_unpad")
+sequence_reshape = _lod_stub("sequence_reshape")
+sequence_scatter = _lod_stub("sequence_scatter")
+sequence_enumerate = _lod_stub("sequence_enumerate")
+sequence_reverse = _lod_stub("sequence_reverse")

@@ -1,0 +1,304 @@
+"""paddle.static.nn control flow — cond / while_loop / case / switch_case.
+
+Reference: python/paddle/static/nn/control_flow.py — ``cond`` (:1126),
+``while_loop`` (:629), ``case`` (:807), ``switch_case`` (:939). There the ops
+build ConditionalBlock / While graph ops with sub-blocks and a dedicated
+backward pass per sub-block.
+
+TPU-native redesign: two execution regimes, picked per call by inspecting
+whether the predicate is a concrete value or a JAX tracer:
+
+- **Eager** (concrete predicate): exactly the reference's dygraph semantics —
+  evaluate the predicate, run only the selected branch. Autograd flows
+  through the ordinary eager tape; nothing special is needed because the
+  untaken branch contributes no ops.
+- **Traced** (inside ``to_static`` / ``jax.jit``): lower to
+  ``lax.cond`` / ``lax.switch`` / ``lax.while_loop``. Both branches are
+  traced (the reference's static-graph "both branches in net building"
+  semantics), XLA compiles them into one executable, and reverse-mode
+  autodiff flows through ``lax.cond``/``lax.switch`` natively.
+  ``lax.while_loop`` is forward-only under reverse-mode AD (a JAX
+  constraint: the trip count is unbounded, so nothing to checkpoint);
+  differentiable loops with a static bound should use ``lax.scan`` /
+  ``paddle_tpu.fleet.recompute`` — the error message says so.
+
+Branch outputs must agree in pytree structure, shapes and dtypes (same
+constraint the reference enforces via ``select_input``); mismatches raise a
+one-screen framework error naming both structures.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import core as jax_core
+
+from ...core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _pred_array(pred, api):
+    """Normalize a predicate to a scalar jax bool array."""
+    arr = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
+    if arr.size != 1:
+        raise TypeError(
+            f"the shape of the predicate passed to {api} should have exactly "
+            f"one element, but got shape {list(arr.shape)}.")
+    return arr.reshape(()).astype(jnp.bool_)
+
+
+def _is_traced(arr) -> bool:
+    return isinstance(arr, jax_core.Tracer)
+
+
+def _flatten_branch_out(out):
+    """Flatten a branch result (nest of Tensors/arrays/None) to arrays."""
+    flat, tree = jax.tree.flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    arrays = [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+              for o in flat]
+    return arrays, tree
+
+
+def _wrap_out(arrays, tree):
+    return jax.tree.unflatten(tree, [Tensor._wrap(a) for a in arrays])
+
+
+def _structure_sig(arrays, tree):
+    return (tree, tuple((tuple(a.shape), jnp.result_type(a)) for a in arrays))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Reference: python/paddle/static/nn/control_flow.py:1126. Works eagerly
+    (only the selected branch runs) and under ``to_static`` (both branches
+    traced into one ``lax.cond``; grads flow through both).
+    """
+    if true_fn is not None and not callable(true_fn):
+        raise TypeError("true_fn in cond should be callable")
+    if false_fn is not None and not callable(false_fn):
+        raise TypeError("false_fn in cond should be callable")
+    p = _pred_array(pred, "static.nn.cond")
+
+    if not _is_traced(p):
+        fn = true_fn if bool(p) else false_fn
+        return fn() if fn is not None else None
+
+    # Traced: lower onto lax.cond. Validate both branches ABSTRACTLY first
+    # (jax.eval_shape: no ops land in the outer jaxpr) so a structure
+    # mismatch surfaces as a framework error, not a lax internals error, and
+    # so we know the common output tree before the real per-branch trace
+    # inside lax.cond.
+    def run(fn):
+        out = fn() if fn is not None else None
+        return _flatten_branch_out(out)
+
+    def probe(fn):
+        cell = {}
+
+        def thunk():
+            arrays, tree = run(fn)
+            cell["tree"] = tree
+            return tuple(arrays)
+
+        shapes = jax.eval_shape(thunk)
+        return list(shapes), cell["tree"]
+
+    t_arrays, t_tree = probe(true_fn)
+    f_arrays, f_tree = probe(false_fn)
+    if _structure_sig(t_arrays, t_tree) != _structure_sig(f_arrays, f_tree):
+        raise ValueError(
+            "static.nn.cond: true_fn and false_fn must return the same "
+            "nest structure, shapes and dtypes.\n"
+            f"  true_fn : tree={t_tree}, "
+            f"avals={[(tuple(a.shape), str(a.dtype)) for a in t_arrays]}\n"
+            f"  false_fn: tree={f_tree}, "
+            f"avals={[(tuple(a.shape), str(a.dtype)) for a in f_arrays]}")
+    if not t_arrays:  # both return None / empty
+        return None
+
+    out_arrays = jax.lax.cond(
+        p,
+        lambda: tuple(run(true_fn)[0]),
+        lambda: tuple(run(false_fn)[0]))
+    return _wrap_out(list(out_arrays), t_tree)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat ``body`` until ``cond`` returns False.
+
+    Reference: python/paddle/static/nn/control_flow.py:629. Eagerly this is a
+    Python loop (differentiable through the unrolled tape); under
+    ``to_static`` it lowers to ``lax.while_loop`` (forward-only under
+    reverse-mode AD — use a static-bound ``lax.scan`` loop for training).
+    """
+    if not callable(cond):
+        raise TypeError("cond in while_loop should be callable")
+    if not callable(body):
+        raise TypeError("body in while_loop should be callable")
+    if not isinstance(loop_vars, (list, tuple)) or len(loop_vars) == 0:
+        raise ValueError("loop_vars in while_loop should be a non-empty "
+                         "list or tuple")
+
+    pre = _pred_array(cond(*loop_vars), "static.nn.while_loop cond")
+
+    if not _is_traced(pre) and not any(
+            _is_traced(v._data if isinstance(v, Tensor) else v)
+            for v in loop_vars):
+        vars_ = list(loop_vars)
+        while bool(_pred_array(cond(*vars_), "static.nn.while_loop cond")):
+            out = body(*vars_)
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            if len(out) != len(vars_):
+                raise ValueError(
+                    "body in while_loop must return the same arity as "
+                    f"loop_vars ({len(vars_)}), got {len(out)}")
+            vars_ = list(out)
+        return type(loop_vars)(vars_)
+
+    # Traced: lax.while_loop over the array pytree.
+    init_arrays, tree = _flatten_branch_out(list(loop_vars))
+    avals = [(tuple(a.shape), jnp.result_type(a)) for a in init_arrays]
+
+    def to_vars(arrays):
+        return _wrap_out(list(arrays), tree)
+
+    def cond_fun(arrays):
+        return _pred_array(cond(*to_vars(arrays)),
+                           "static.nn.while_loop cond")
+
+    def body_fun(arrays):
+        out = body(*to_vars(arrays))
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        out_arrays, out_tree = _flatten_branch_out(list(out))
+        new_avals = [(tuple(a.shape), jnp.result_type(a))
+                     for a in out_arrays]
+        if out_tree != tree or new_avals != avals:
+            raise ValueError(
+                "static.nn.while_loop: body must return loop_vars with "
+                "unchanged structure, shapes and dtypes.\n"
+                f"  loop_vars: {avals}\n  body out : {new_avals}")
+        return tuple(out_arrays)
+
+    out_arrays = jax.lax.while_loop(cond_fun, body_fun, tuple(init_arrays))
+    return type(loop_vars)(to_vars(out_arrays))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is True wins; else ``default``.
+
+    Reference: python/paddle/static/nn/control_flow.py:807. Built as a
+    right-fold of :func:`cond`, so it shares both execution regimes.
+    """
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs in case should be a non-empty list "
+                        "or tuple")
+    for i, pair in enumerate(pred_fn_pairs):
+        if not isinstance(pair, tuple) or len(pair) != 2:
+            raise TypeError(f"pred_fn_pairs[{i}] should be a (pred, fn) "
+                            "tuple")
+        if not callable(pair[1]):
+            raise TypeError(f"fn of pred_fn_pairs[{i}] should be callable")
+    if default is None:
+        # reference semantics: last fn doubles as the default
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+    if not callable(default):
+        raise TypeError("default in case should be callable")
+
+    out = default
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        out = (lambda p, f, rest: lambda: cond(p, f, rest))(pred, fn, out)
+    return out()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Run the branch keyed by ``branch_index``.
+
+    Reference: python/paddle/static/nn/control_flow.py:939. Eagerly picks
+    the branch; under ``to_static`` lowers to ``lax.switch`` (all branches
+    traced, differentiable).
+    """
+    idx = (branch_index._data if isinstance(branch_index, Tensor)
+           else jnp.asarray(branch_index))
+    if idx.size != 1:
+        raise TypeError("branch_index in switch_case must have exactly one "
+                        f"element, got shape {list(idx.shape)}")
+    if not jnp.issubdtype(idx.dtype, jnp.integer):
+        raise TypeError("branch_index in switch_case must be an integer "
+                        f"tensor, got {idx.dtype}")
+    idx = idx.reshape(()).astype(jnp.int32)
+
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif isinstance(branch_fns, (list, tuple)):
+        if branch_fns and callable(branch_fns[0]):
+            pairs = list(enumerate(branch_fns))
+        else:
+            pairs = sorted(branch_fns, key=lambda kv: kv[0])
+    else:
+        raise TypeError("branch_fns in switch_case should be a dict, list "
+                        "or tuple")
+    if not pairs:
+        raise ValueError("branch_fns in switch_case should not be empty")
+    keys = [k for k, _ in pairs]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"duplicated branch keys in switch_case: {keys}")
+    for k, fn in pairs:
+        if not isinstance(k, int):
+            raise TypeError(f"branch key {k!r} in switch_case should be int")
+        if not callable(fn):
+            raise TypeError(f"branch_fns[{k}] in switch_case should be "
+                            "callable")
+    if default is None:
+        default = pairs[-1][1]
+    if not callable(default):
+        raise TypeError("default in switch_case should be callable")
+
+    if not _is_traced(idx):
+        i = int(idx)
+        fn = dict(pairs).get(i, default)
+        return fn()
+
+    # Traced: map the (possibly sparse) keys onto a dense lax.switch table:
+    # slot j holds the fn for the j-th key; the last slot is the default.
+    table = [fn for _, fn in pairs] + [default]
+
+    # dense selector: position of idx in keys, else len(pairs) (default)
+    key_arr = jnp.asarray(keys, dtype=jnp.int32)
+    match = jnp.where(key_arr == idx, jnp.arange(len(keys), dtype=jnp.int32),
+                      jnp.int32(len(keys)))
+    selector = jnp.min(match) if len(keys) else jnp.int32(0)
+
+    # Abstract validation pass (eval_shape — no ops land in the outer
+    # jaxpr); the real per-branch trace happens once, inside lax.switch.
+    sig = sig_tree = None
+    n_out = 0
+    for fn in table:
+        cell = {}
+
+        def thunk(fn=fn):
+            arrays, tree = _flatten_branch_out(fn())
+            cell["tree"] = tree
+            return tuple(arrays)
+
+        shapes = list(jax.eval_shape(thunk))
+        s = _structure_sig(shapes, cell["tree"])
+        if sig is None:
+            sig, sig_tree, n_out = s, cell["tree"], len(shapes)
+        elif s != sig:
+            raise ValueError(
+                "static.nn.switch_case: every branch (and default) must "
+                "return the same nest structure, shapes and dtypes.")
+    if n_out == 0:
+        return None
+
+    out_arrays = jax.lax.switch(
+        selector,
+        [(lambda f: lambda: tuple(_flatten_branch_out(f())[0]))(fn)
+         for fn in table])
+    return _wrap_out(list(out_arrays), sig_tree)

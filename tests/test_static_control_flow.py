@@ -1,0 +1,291 @@
+"""static.nn control flow: cond/while_loop/case/switch_case, eager and under
+to_static, plus the to_static tracer-leak fallback/diagnostic.
+
+Mirrors the reference's test/dygraph_to_static ifelse/loop suites: eager vs
+to_static equality with tensor-dependent branches (reference
+test/dygraph_to_static/test_ifelse.py, test_loop.py).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as static_nn
+
+
+def T(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+class TestCond:
+    def test_eager_runs_selected_branch_only(self):
+        x = T([2.0])
+        calls = []
+
+        def tf():
+            calls.append("t")
+            return x + 1
+
+        def ff():
+            calls.append("f")
+            return x - 1
+
+        out = static_nn.cond(x.sum() > 0, tf, ff)
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        assert calls == ["t"]
+
+    def test_eager_vs_to_static_equality(self):
+        def model(x):
+            return static_nn.cond(
+                x.sum() > 0, lambda: x * 2 + 1, lambda: x * 3 - 1)
+
+        st = paddle.jit.to_static(model)
+        for sign in (1.0, -1.0):
+            x = T(sign * np.ones((3, 4)))
+            np.testing.assert_allclose(
+                st(x).numpy(), model(x).numpy(), rtol=1e-6)
+
+    def test_grad_through_traced_cond(self):
+        class M(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                return static_nn.cond(
+                    x.sum() > 0,
+                    lambda: self.lin(x) * 2,
+                    lambda: self.lin(x) * 3)
+
+        m = M()
+        x = T(np.ones((2, 4)))
+        m(x).sum().backward()
+        g_pos = np.array(m.lin.weight.grad.numpy())
+        assert np.abs(g_pos).sum() > 0
+        m.lin.weight.clear_gradient()
+        m(T(-np.ones((2, 4)))).sum().backward()
+        g_neg = np.array(m.lin.weight.grad.numpy())
+        # d/dW of 3*lin(-1s) vs 2*lin(1s): different branch, different grad
+        assert not np.allclose(g_pos, g_neg)
+
+    def test_nested_structure_and_none(self):
+        x = T([1.0])
+        out = static_nn.cond(x > 0, lambda: (x + 1, [x * 2]),
+                             lambda: (x - 1, [x * 3]))
+        np.testing.assert_allclose(out[0].numpy(), [2.0])
+        np.testing.assert_allclose(out[1][0].numpy(), [2.0])
+        assert static_nn.cond(x > 0, None, None) is None
+
+    def test_structure_mismatch_raises_framework_error(self):
+        @paddle.jit.to_static
+        def f(x):
+            return static_nn.cond(x.sum() > 0, lambda: (x, x),
+                                  lambda: x * 2)
+
+        with pytest.raises(ValueError, match="same\\s+nest structure"):
+            f(T(np.ones((2,))))
+
+    def test_pred_numel_check(self):
+        with pytest.raises(TypeError, match="one element"):
+            static_nn.cond(T(np.ones((2,))) > 0, lambda: 1, lambda: 2)
+
+
+class TestWhileLoop:
+    def test_eager_matches_python_loop(self):
+        i = paddle.to_tensor(np.array(0, np.int64))
+        ten = paddle.to_tensor(np.array(10, np.int64))
+        i_out, _ = static_nn.while_loop(
+            lambda i, t: i < t, lambda i, t: [i + 1, t], [i, ten])
+        assert int(i_out.numpy()) == 10
+
+    def test_eager_autograd_through_unrolled_loop(self):
+        x = T([1.5])
+        x.stop_gradient = False
+        i0 = paddle.to_tensor(np.array(0, np.int64))
+        _, acc = static_nn.while_loop(
+            lambda i, a: i < 3, lambda i, a: [i + 1, a * a], [i0, x])
+        acc.backward()
+        # a -> a^2 three times = x^8; d/dx = 8 x^7
+        np.testing.assert_allclose(acc.numpy(), [1.5 ** 8], rtol=1e-6)
+        np.testing.assert_allclose(x.grad.numpy(), [8 * 1.5 ** 7], rtol=1e-5)
+
+    def test_to_static_lowers_to_lax_while(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            def c(i, acc):
+                return i < n
+
+            def b(i, acc):
+                return [i + 1, acc * 2]
+
+            i0 = paddle.zeros([], dtype="int32")
+            _, acc = static_nn.while_loop(c, b, [i0, x])
+            return acc
+
+        x = T(np.ones((2,)))
+        np.testing.assert_allclose(
+            f(x, paddle.to_tensor(np.array(5, np.int32))).numpy(),
+            [32.0, 32.0])
+        # same compiled fn, different trip count at runtime
+        np.testing.assert_allclose(
+            f(x, paddle.to_tensor(np.array(3, np.int32))).numpy(),
+            [8.0, 8.0])
+
+    def test_body_arity_check(self):
+        i = paddle.to_tensor(np.array(0, np.int64))
+        with pytest.raises(ValueError, match="arity"):
+            static_nn.while_loop(lambda i, t: i < t, lambda i, t: [i + 1],
+                                 [i, i + 3])
+
+    def test_empty_loop_vars(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            static_nn.while_loop(lambda: True, lambda: [], [])
+
+
+class TestCaseSwitch:
+    def test_case_first_true_wins(self):
+        x = T([1.0])
+        out = static_nn.case(
+            [(paddle.to_tensor(False), lambda: x + 1),
+             (paddle.to_tensor(True), lambda: x + 2),
+             (paddle.to_tensor(True), lambda: x + 3)],
+            default=lambda: x)
+        np.testing.assert_allclose(out.numpy(), [3.0])
+
+    def test_case_default_and_last_fn_fallback(self):
+        x = T([1.0])
+        out = static_nn.case([(paddle.to_tensor(False), lambda: x + 1)],
+                             default=lambda: x * 10)
+        np.testing.assert_allclose(out.numpy(), [10.0])
+        # no default: last fn is the default (reference semantics)
+        out = static_nn.case([(paddle.to_tensor(False), lambda: x + 1),
+                              (paddle.to_tensor(False), lambda: x * 7)])
+        np.testing.assert_allclose(out.numpy(), [7.0])
+
+    def test_switch_case_eager_and_traced(self):
+        def model(idx, x):
+            return static_nn.switch_case(
+                idx, {1: lambda: x + 1, 3: lambda: x * 10},
+                default=lambda: x * 0)
+
+        st = paddle.jit.to_static(model)
+        x = T([2.0])
+        for i, want in [(1, [3.0]), (3, [20.0]), (7, [0.0])]:
+            idx = paddle.to_tensor(np.array(i, np.int32))
+            np.testing.assert_allclose(model(idx, x).numpy(), want)
+            np.testing.assert_allclose(st(idx, x).numpy(), want)
+
+    def test_switch_case_list_form_and_checks(self):
+        x = T([2.0])
+        out = static_nn.switch_case(
+            paddle.to_tensor(np.array(0, np.int64)),
+            [lambda: x + 1, lambda: x + 2])
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        with pytest.raises(TypeError, match="integer"):
+            static_nn.switch_case(T([1.0]), [lambda: x])
+        with pytest.raises(ValueError, match="duplicated"):
+            static_nn.switch_case(
+                paddle.to_tensor(np.array(0, np.int64)),
+                [(1, lambda: x), (1, lambda: x)])
+
+
+class TestToStaticFallback:
+    def test_tensor_dependent_if_falls_back_to_eager(self):
+        @paddle.jit.to_static
+        def f(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x * 3
+
+        x = T(np.ones((2, 2)))
+        x.stop_gradient = False
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(x)
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones((2, 2)))
+        msgs = [str(wi.message) for wi in w]
+        assert any("static.nn.cond" in m and "EAGER" in m for m in msgs)
+        # the diagnostic names the offending user source line
+        assert any("if float(x.sum()) > 0:" in m for m in msgs)
+        # eager fallback still differentiates via the tape
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 2)))
+        # and actually branches per-value (it is eager, not baked)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            np.testing.assert_allclose(
+                f(T(-np.ones((2, 2)))).numpy(), -3 * np.ones((2, 2)))
+
+    def test_strict_flag_raises_framework_error(self):
+        paddle.set_flags({"FLAGS_to_static_fallback": 0})
+        try:
+            @paddle.jit.to_static
+            def g(x):
+                while float(x.sum()) > 0:
+                    x = x - 1
+                return x
+
+            with pytest.raises(RuntimeError, match="static.nn.cond"):
+                g(T(np.ones((2,))))
+        finally:
+            paddle.set_flags({"FLAGS_to_static_fallback": 1})
+
+
+class TestStaticNNCommon:
+    def test_fc_reuses_parameters_across_calls(self):
+        static_nn.reset_parameters()
+        x = T(np.random.RandomState(0).randn(4, 8))
+        o1 = static_nn.fc(x, size=16, name="fc_a")
+        o2 = static_nn.fc(x, size=16, name="fc_a")
+        np.testing.assert_allclose(o1.numpy(), o2.numpy())
+        assert o1.shape == [4, 16]
+        # num_flatten_dims collapses trailing dims
+        x3 = T(np.random.RandomState(1).randn(2, 3, 4))
+        assert static_nn.fc(x3, size=5, num_flatten_dims=1,
+                            name="fc_b").shape == [2, 5]
+
+    def test_fc_activation_and_multi_input(self):
+        static_nn.reset_parameters()
+        x = T(np.random.RandomState(0).randn(4, 8))
+        out = static_nn.fc([x, x], size=6, activation="relu", name="fc_m")
+        assert out.shape == [4, 6] and float(out.numpy().min()) >= 0
+
+    def test_embedding_and_sparse_embedding(self):
+        static_nn.reset_parameters()
+        ids = paddle.to_tensor(np.array([[1], [3]], np.int64))
+        out = static_nn.embedding(ids, size=(10, 4), name="emb")
+        assert out.shape == [2, 1, 4]
+        out2 = static_nn.sparse_embedding(ids, size=(10, 4), name="semb")
+        assert list(out2.shape)[-1] == 4
+
+    def test_norm_and_conv_builders(self):
+        static_nn.reset_parameters()
+        x = T(np.random.RandomState(0).randn(2, 3, 8, 8))
+        assert static_nn.batch_norm(x, name="bn").shape == [2, 3, 8, 8]
+        assert static_nn.conv2d(x, 6, 3, name="c2").shape == [2, 6, 6, 6]
+        assert static_nn.layer_norm(x, begin_norm_axis=1,
+                                    name="ln").shape == [2, 3, 8, 8]
+        assert static_nn.group_norm(x, groups=3,
+                                    name="gn").shape == [2, 3, 8, 8]
+        assert static_nn.prelu(x, mode="channel",
+                               name="pr").shape == [2, 3, 8, 8]
+
+    def test_sequence_ops_raise_with_recipe(self):
+        with pytest.raises(NotImplementedError, match="sequence_mask"):
+            static_nn.sequence_pool(T([1.0]), "sum")
+
+    def test_namespace_parity_vs_reference(self):
+        import ast
+
+        ref = "/root/reference/python/paddle/static/nn/__init__.py"
+        for node in ast.walk(ast.parse(open(ref).read())):
+            if isinstance(node, ast.Assign) and any(
+                    getattr(t, "id", None) == "__all__"
+                    for t in node.targets):
+                ref_all = ast.literal_eval(node.value)
+                break
+        missing = [n for n in ref_all if not hasattr(static_nn, n)]
+        assert not missing, f"static.nn missing vs reference: {missing}"
