@@ -109,3 +109,489 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
         return _flat_norm(ln, resid, begin_norm_axis), resid
     pre = x if bias is None else x + bias
     return _flat_norm(ln, pre, begin_norm_axis)
+
+
+# ---------------------------------------------------------------------------
+# Fused transformer functional surface
+# (reference: python/paddle/incubate/nn/functional/__init__.py __all__ :41)
+# The CUDA fused kernels collapse into XLA fusion + the Pallas flash path:
+# calling these APIs routes to scaled_dot_product_attention (Pallas when
+# shapes qualify) and XLA-fused matmul epilogues — same contract, TPU body.
+# ---------------------------------------------------------------------------
+
+__all__ += [
+    "fused_multi_head_attention", "fused_feedforward",
+    "fused_bias_dropout_residual_layer_norm", "fused_dropout_add",
+    "fused_rotary_position_embedding", "fused_linear", "fused_matmul_bias",
+    "fused_linear_activation", "fused_ec_moe", "fused_multi_transformer",
+]
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """y + dropout(x) in one epilogue (reference fused_dropout_add.py:22).
+    XLA fuses the mask-scale-add chain into one kernel."""
+    import paddle_tpu.nn.functional as F
+
+    return y + F.dropout(x, p=p, training=training, mode=mode)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (reference fused_matmul_bias.py:21, cublasLt);
+    XLA fuses the bias add into the GEMM."""
+    from paddle_tpu import ops
+
+    out = ops.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Reference fused_matmul_bias.py:75."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """Reference fused_matmul_bias.py:110 (GEMM + bias + gelu/relu
+    epilogue)."""
+    import paddle_tpu.nn.functional as F
+
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x,
+                            transpose_y=trans_y)
+    if activation in (None, "none", ""):
+        return out
+    if activation not in ("gelu", "relu"):
+        raise ValueError(
+            f"fused_linear_activation supports gelu/relu, got {activation!r}")
+    return getattr(F, activation)(out)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    """layer_norm(residual + dropout(bias + x)) — reference
+    fused_transformer.py:323."""
+    import paddle_tpu.nn.functional as F
+
+    h = x if bias is None else x + bias
+    h = residual + F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    return F.layer_norm(h, [h.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+
+
+def _default_rope_tables(seq_len, head_dim, dtype, neox=True):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    inv = 1.0 / (10000.0 ** (np.arange(0, head_dim, 2,
+                                       dtype=np.float64) / head_dim))
+    t = np.arange(seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)                       # [S, D/2]
+    if neox:
+        # pairs (2i, 2i+1) share frequency i -> interleaved layout
+        emb = np.repeat(freqs, 2, axis=-1)         # [f0,f0,f1,f1,...]
+    else:
+        # half-rotation pairs (i, i+D/2) share frequency i -> concat layout
+        emb = np.concatenate([freqs, freqs], axis=-1)  # [f0..fk,f0..fk]
+    return (paddle.to_tensor(np.sin(emb).astype(dtype)),
+            paddle.to_tensor(np.cos(emb).astype(dtype)))
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """Rotary embedding applied to q/k/v in one pass (reference
+    fused_rotary_position_embedding.py:21; CUDA fused_rope kernel).
+
+    Shapes: q/k/v [B, S, H, D]; sin/cos [S, D] or [1, S, 1, D];
+    position_ids [B, S]. neox style rotates adjacent pairs; non-neox
+    rotates front/back halves. Returns a tuple matching the (q, k, v)
+    arguments that were passed.
+    """
+    from paddle_tpu import ops
+
+    head_dim = q.shape[-1]
+    if head_dim % 2 != 0:
+        raise ValueError("head_dim must be even for rotary embedding, got "
+                         f"{head_dim}")
+    if (sin is None) != (cos is None):
+        raise ValueError("sin and cos must be given together")
+    if sin is None:
+        sin, cos = _default_rope_tables(q.shape[1], head_dim,
+                                        str(q.dtype).split(".")[-1],
+                                        neox=use_neox_rotary_style)
+
+    # normalize tables to [S, D] then index / broadcast to [B-or-1, S, 1, D]
+    if len(sin.shape) == 4:
+        sin = sin.reshape([sin.shape[1], sin.shape[3]])
+        cos = cos.reshape([cos.shape[1], cos.shape[3]])
+    if position_ids is not None:
+        sin = ops.gather(sin, position_ids.reshape([-1]), axis=0) \
+            .reshape([position_ids.shape[0], position_ids.shape[1], 1,
+                      head_dim])
+        cos = ops.gather(cos, position_ids.reshape([-1]), axis=0) \
+            .reshape([position_ids.shape[0], position_ids.shape[1], 1,
+                      head_dim])
+    else:
+        sin = sin.reshape([1, sin.shape[0], 1, head_dim])
+        cos = cos.reshape([1, cos.shape[0], 1, head_dim])
+
+    import jax.numpy as jnp
+
+    from ...core.dispatch import op as _op
+
+    if not hasattr(fused_rotary_position_embedding, "_kernel"):
+        @_op("fused_rope")
+        def _kernel(x, sin_a, cos_a, neox=True):
+            if neox:
+                # pairs (0,1),(2,3),...: rotate_half interleaves (-x1, x0)
+                x0 = x[..., 0::2]
+                x1 = x[..., 1::2]
+                rot = jnp.stack([-x1, x0], axis=-1).reshape(x.shape)
+            else:
+                # front half / back half
+                half = x.shape[-1] // 2
+                rot = jnp.concatenate([-x[..., half:], x[..., :half]],
+                                      axis=-1)
+            return x * cos_a + rot * sin_a
+
+        fused_rotary_position_embedding._kernel = _kernel
+
+    kern = fused_rotary_position_embedding._kernel
+    outs = tuple(
+        kern(t, sin, cos, neox=use_neox_rotary_style)
+        if t is not None else None
+        for t in (q, k, v))
+    present = [o for o in outs if o is not None]
+    return present[0] if len(present) == 1 else tuple(present)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-05, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-05,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Packed-QKV self-attention block (reference fused_transformer.py:514
+    pseudo code): optional pre-LN, QKV projection, sdpa (Pallas flash when
+    shapes qualify), out projection, dropout+residual, optional post-LN.
+    """
+    import paddle_tpu.nn.functional as F
+
+    b, s, embed_dim = x.shape
+    if transpose_qkv_wb:
+        assert num_heads > 0, "num_heads required when transpose_qkv_wb"
+        n_heads = num_heads
+        qkv_w = qkv_weight                     # [E, 3E]
+        bias_flat = qkv_bias                   # [3E] or None
+    else:
+        _, n_heads, head_dim, _ = qkv_weight.shape
+        qkv_w = qkv_weight.reshape([3 * n_heads * head_dim, embed_dim]).t()
+        bias_flat = (qkv_bias.reshape([3 * n_heads * head_dim])
+                     if qkv_bias is not None else None)
+    head_dim = embed_dim // n_heads
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [embed_dim], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    qkv = fused_matmul_bias(h, qkv_w, bias_flat)
+    qkv = qkv.reshape([b, s, 3, n_heads, head_dim])
+    q, k, v = (qkv[:, :, i] for i in range(3))  # [b, s, h, d]
+
+    cache_out = None
+    if cache_kv is not None:
+        # cache_kv: [2, B, n_heads, cache_len, head_dim] (reference layout);
+        # append this step's k/v and attend over the full sequence
+        from paddle_tpu import ops
+
+        k_cache = cache_kv[0].transpose([0, 2, 1, 3])  # [B, cache, H, D]
+        v_cache = cache_kv[1].transpose([0, 2, 1, 3])
+        k = ops.concat([k_cache, k], axis=1)
+        v = ops.concat([v_cache, v], axis=1)
+        cache_out = ops.stack([k.transpose([0, 2, 1, 3]),
+                               v.transpose([0, 2, 1, 3])], axis=0)
+
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0, training=training)
+    out = out.reshape([b, s, n_heads * head_dim])
+    out = fused_matmul_bias(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [embed_dim], ln_scale, ln_bias, ln_epsilon)
+    return out if cache_out is None else (out, cache_out)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """Transformer FFN block (reference fused_transformer.py:36 pseudo
+    code): optional pre-LN, linear1+act+dropout1, linear2, dropout2 +
+    residual, optional post-LN."""
+    import paddle_tpu.nn.functional as F
+
+    d_model = x.shape[-1]
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [d_model], ln1_scale, ln1_bias, ln1_epsilon)
+    h = fused_linear_activation(h, linear1_weight, linear1_bias,
+                                activation=activation)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = residual + h
+    if not pre_layer_norm:
+        h = F.layer_norm(h, [d_model], ln2_scale, ln2_bias, ln2_epsilon)
+    return h
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Expert-choice MoE (reference fused_ec_moe.py:18) — routed through the
+    same dense einsum dispatch kernel as incubate.nn.FusedEcMoe."""
+    from .layer import ec_moe_kernel
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"act_type must be gelu/relu, got {act_type!r}")
+    return ec_moe_kernel()(x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                           bmm1_bias, act=act_type)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-05, cache_kvs=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None, rotary_emb_dims=0,
+                            time_step=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """Stacked fused transformer blocks (reference fused_transformer.py:976)
+    — each layer runs fused_multi_head_attention + fused_feedforward.
+    cache_kvs follows the same [2, B, H, T, D]-per-layer convention."""
+    unsupported = {"rotary_embs": rotary_embs, "time_step": time_step,
+                   "seq_lens": seq_lens, "pre_caches": pre_caches}
+    bad = [k for k, v in unsupported.items() if v is not None]
+    if bad:
+        raise NotImplementedError(
+            f"fused_multi_transformer does not support {bad} on TPU; apply "
+            "fused_rotary_position_embedding before the stack, and use "
+            "masked_multihead_attention / models.llama generate for "
+            "decode-step caching")
+    n_layers = len(qkv_weights)
+    h = x
+    cache_outs = [] if cache_kvs is not None else None
+    for i in range(n_layers):
+        cache = cache_kvs[i] if cache_kvs is not None else None
+        att = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
+            pre_ln_bias=ln_biases[i], ln_scale=ln_scales[i],
+            ln_bias=ln_biases[i], pre_ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            cache_kv=cache, attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, ln_epsilon=epsilon,
+            training=training, mode=mode)
+        if cache is not None:
+            att, cache_out = att
+            cache_outs.append(cache_out)
+        h = fused_feedforward(
+            att, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i], ln1_bias=ffn_ln_biases[i],
+            ln2_scale=ffn_ln_scales[i], ln2_bias=ffn_ln_biases[i],
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=training, mode=mode)
+    if cache_outs is not None:
+        return h, cache_outs
+    return h
+
+
+__all__ += ["masked_multihead_attention", "block_multihead_attention",
+            "variable_length_memory_efficient_attention"]
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Variable-length attention (reference
+    variable_length_memory_efficient_attention.py:28, CUTLASS kernel).
+
+    TPU-native: padded dense attention with a length mask — XLA/Pallas want
+    static shapes, so variable length is expressed as masking, not ragged
+    kernels. Shapes: q/k/v [B, S, H, D] (paddle convention), seq_lens /
+    kv_seq_lens [B, 1].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import op as _op
+
+    if not hasattr(variable_length_memory_efficient_attention, "_kernel"):
+        @_op("varlen_mea_attention")
+        def _kernel(q, k, v, q_lens, kv_lens, mask, scale=None,
+                    causal=False, pre_cache_length=0):
+            b, sq, h, d = q.shape
+            sk = k.shape[1]
+            if scale is None:
+                scale = 1.0 / (d ** 0.5)
+            qt = jnp.swapaxes(q, 1, 2)          # [B, H, Sq, D]
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            neg = jnp.finfo(jnp.float32).min
+            kv_valid = (jnp.arange(sk)[None, :]
+                        < kv_lens.reshape(-1, 1))          # [B, Sk]
+            logits = jnp.where(kv_valid[:, None, None, :], logits, neg)
+            if causal:
+                # query i attends kv positions <= offset + i, where the
+                # offset covers the pre-cache (and any kv prefix when
+                # sk > sq): kv j visible iff j - offset <= i
+                offset = pre_cache_length if pre_cache_length else sk - sq
+                cm = (jnp.arange(sk)[None, :] - offset
+                      <= jnp.arange(sq)[:, None])          # [Sq, Sk]
+                logits = jnp.where(cm[None, None], logits, neg)
+            if mask is not None:
+                logits = logits + mask
+            p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(qt.dtype), vt)
+            q_valid = (jnp.arange(sq)[None, :]
+                       < q_lens.reshape(-1, 1))            # [B, Sq]
+            out = out * q_valid[:, None, :, None].astype(out.dtype)
+            return jnp.swapaxes(out, 1, 2)                 # [B, S, H, D]
+
+        variable_length_memory_efficient_attention._kernel = _kernel
+
+    return variable_length_memory_efficient_attention._kernel(
+        query, key, value, seq_lens, kv_seq_lens, mask,
+        scale=None if scale is None else float(scale), causal=bool(causal),
+        pre_cache_length=int(pre_cache_length))
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """One-token decode attention against a KV cache (reference
+    masked_multihead_attention.py:19).
+
+    x: [B, 3*H*D] packed qkv for THIS step; cache_kv: [2, B, H, T_max, D].
+    ``sequence_lengths`` [B, 1] gives each row's current length (entries at
+    and beyond it are masked); the step's k/v are written at that position.
+    Returns (out [B, H*D], updated cache_kv) like the reference. The int8
+    quant epilogue args are unsupported (paddle.quantization owns that).
+    """
+    if any(a is not None for a in (cum_offsets, beam_cache_offset,
+                                   qkv_out_scale, out_shift, out_smooth)):
+        raise NotImplementedError(
+            "masked_multihead_attention quant/beam epilogues are not "
+            "supported on TPU")
+    assert cache_kv is not None, "cache_kv is required"
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import op as _op
+
+    if not hasattr(masked_multihead_attention, "_kernel"):
+        @_op("masked_mha_decode")
+        def _kernel(x, cache, bias, src_mask, seq_lens, rotary, neox=False):
+            b = x.shape[0]
+            _, _, h, t_max, d = cache.shape
+            qkv = x.reshape(b, 3, h, d)
+            if bias is not None:
+                qkv = qkv + bias[None]
+            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+            if seq_lens is None:
+                pos = jnp.zeros((b,), jnp.int32)
+            else:
+                pos = seq_lens.reshape(-1).astype(jnp.int32)
+            if rotary is not None:
+                # rotary: [2, B, 1, T_max, D] (cos, sin) per reference
+                cos = jnp.take_along_axis(
+                    rotary[0].reshape(b, t_max, d),
+                    pos[:, None, None], axis=1)              # [B, 1, D]
+                sin = jnp.take_along_axis(
+                    rotary[1].reshape(b, t_max, d),
+                    pos[:, None, None], axis=1)
+
+                def rot(t):
+                    if neox:
+                        t0, t1 = t[..., 0::2], t[..., 1::2]
+                        r = jnp.stack([-t1, t0], -1).reshape(t.shape)
+                    else:
+                        half = t.shape[-1] // 2
+                        r = jnp.concatenate([-t[..., half:], t[..., :half]],
+                                            -1)
+                    return t * cos + r * sin
+
+                q, k_new = rot(q), rot(k_new)
+            # write k/v at pos
+            onehot = jax.nn.one_hot(pos, t_max, dtype=cache.dtype)  # [B, T]
+            k_cache = cache[0] * (1 - onehot[:, None, :, None]) + \
+                k_new[:, :, None, :] * onehot[:, None, :, None]
+            v_cache = cache[1] * (1 - onehot[:, None, :, None]) + \
+                v_new[:, :, None, :] * onehot[:, None, :, None]
+            scale = 1.0 / (d ** 0.5)
+            logits = jnp.einsum("bhd,bhtd->bht", q, k_cache) * scale
+            neg = jnp.finfo(jnp.float32).min
+            valid = jnp.arange(t_max)[None, :] <= pos[:, None]  # [B, T]
+            logits = jnp.where(valid[:, None, :], logits, neg)
+            if src_mask is not None:
+                logits = logits + src_mask.reshape(b, 1, -1)[:, :, :t_max]
+            p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bht,bhtd->bhd", p.astype(q.dtype), v_cache)
+            return (out.reshape(b, h * d),
+                    jnp.stack([k_cache, v_cache], axis=0))
+
+        masked_multihead_attention._kernel = _kernel
+
+    return masked_multihead_attention._kernel(
+        x, cache_kv, bias, src_mask, sequence_lengths, rotary_tensor,
+        neox=bool(use_neox_rotary_style))
+
+
+def block_multihead_attention(*args, **kwargs):
+    """Paged/blocked KV-cache attention (reference
+    block_multihead_attention.py — CUDA paged-attention kernel).
+
+    Not supported: paged KV block tables are a GPU-memory-pool design; the
+    TPU-native serving path keeps dense per-sequence caches
+    (models/llama.py generate: prefill + windowed decode under jit) and
+    masked_multihead_attention for single-step decode. Use those.
+    """
+    raise NotImplementedError(
+        "block_multihead_attention (paged KV cache) is not supported on "
+        "TPU; use masked_multihead_attention for single-step decode or "
+        "models.llama's KV-cache generate path")

@@ -41,8 +41,10 @@ class FusedLinear(Layer):
                      if bias_attr is not False else None)
 
     def forward(self, x):
-        w = self.weight.t() if self.transpose_weight else self.weight
-        return F.linear(x, w, self.bias)
+        from . import functional as IF
+
+        return IF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self.transpose_weight)
 
 
 class FusedDropoutAdd(Layer):
@@ -54,8 +56,10 @@ class FusedDropoutAdd(Layer):
         self.mode = mode
 
     def forward(self, x, y):
-        return y + F.dropout(x, p=self.p, training=self.training,
-                             mode=self.mode)
+        from . import functional as IF
+
+        return IF.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                    mode=self.mode)
 
 
 class FusedBiasDropoutResidualLayerNorm(Layer):
@@ -77,10 +81,12 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
                                              is_bias=True)
 
     def forward(self, x, residual):
-        h = F.dropout(x + self.linear_bias, p=self.dropout_rate,
-                      training=self.training)
-        return F.layer_norm(residual + h, [self.embed_dim], self.ln_scale,
-                            self.ln_bias, self._epsilon)
+        from . import functional as IF
+
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
 
 
 class FusedMultiHeadAttention(Layer):
@@ -303,41 +309,51 @@ class FusedEcMoe(Layer):
 
     def forward(self, x, gate):
         """x: [B, S, H]; gate: [B, S, E] logits."""
+        return ec_moe_kernel()(x, gate, self.bmm_weight0, self.bmm_bias0,
+                               self.bmm_weight1, self.bmm_bias1,
+                               act=self.act_type)
+
+
+_EC_MOE_KERNEL = None
+
+
+def ec_moe_kernel():
+    """Lazily-registered expert-choice MoE dispatch op, shared by the
+    FusedEcMoe layer and incubate.nn.functional.fused_ec_moe."""
+    global _EC_MOE_KERNEL
+    if _EC_MOE_KERNEL is None:
         import jax
         import jax.numpy as jnp
 
         from ...core.dispatch import op as _op
 
-        if not hasattr(FusedEcMoe, "_kernel"):
-            @_op("fused_ec_moe")
-            def _kernel(x, gate, w0, b0, w1, b1, act="gelu"):
-                b, s, h = x.shape
-                e = gate.shape[-1]
-                t = b * s
-                cap = max(t // e, 1)
-                xf = x.reshape(t, h)
-                probs = jax.nn.softmax(gate.reshape(t, e).astype(jnp.float32),
-                                       axis=-1)
-                # expert-choice: each expert takes its top-cap tokens
-                topv, topi = jax.lax.top_k(probs.T, cap)      # [E, cap]
-                tok = jnp.take(xf, topi.reshape(-1), axis=0) \
-                    .reshape(e, cap, h)
-                hmid = jnp.einsum("ech,ehi->eci", tok, w0)
-                if b0 is not None:
-                    hmid = hmid + b0
-                hmid = (jax.nn.gelu(hmid) if act == "gelu"
-                        else jnp.maximum(hmid, 0))
-                out_e = jnp.einsum("eci,eih->ech", hmid, w1)
-                if b1 is not None:
-                    out_e = out_e + b1
-                # combine: scatter-add weighted expert outputs back
-                flat = jnp.zeros((t, h), out_e.dtype)
-                contrib = out_e * topv[..., None].astype(out_e.dtype)
-                flat = flat.at[topi.reshape(-1)].add(
-                    contrib.reshape(e * cap, h))
-                return flat.reshape(b, s, h).astype(x.dtype)
+        @_op("fused_ec_moe")
+        def _kernel(x, gate, w0, b0, w1, b1, act="gelu"):
+            b, s, h = x.shape
+            e = gate.shape[-1]
+            t = b * s
+            cap = max(t // e, 1)
+            xf = x.reshape(t, h)
+            probs = jax.nn.softmax(gate.reshape(t, e).astype(jnp.float32),
+                                   axis=-1)
+            # expert-choice: each expert takes its top-cap tokens
+            topv, topi = jax.lax.top_k(probs.T, cap)      # [E, cap]
+            tok = jnp.take(xf, topi.reshape(-1), axis=0) \
+                .reshape(e, cap, h)
+            hmid = jnp.einsum("ech,ehi->eci", tok, w0)
+            if b0 is not None:
+                hmid = hmid + b0
+            hmid = (jax.nn.gelu(hmid) if act == "gelu"
+                    else jnp.maximum(hmid, 0))
+            out_e = jnp.einsum("eci,eih->ech", hmid, w1)
+            if b1 is not None:
+                out_e = out_e + b1
+            # combine: scatter-add weighted expert outputs back
+            flat = jnp.zeros((t, h), out_e.dtype)
+            contrib = out_e * topv[..., None].astype(out_e.dtype)
+            flat = flat.at[topi.reshape(-1)].add(
+                contrib.reshape(e * cap, h))
+            return flat.reshape(b, s, h).astype(x.dtype)
 
-            FusedEcMoe._kernel = staticmethod(_kernel)
-        return FusedEcMoe._kernel(x, gate, self.bmm_weight0, self.bmm_bias0,
-                                  self.bmm_weight1, self.bmm_bias1,
-                                  act=self.act_type)
+        _EC_MOE_KERNEL = _kernel
+    return _EC_MOE_KERNEL

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...core.dispatch import op as _dispatch_op
 from ...core.tensor import Tensor
 from ... import nn
 from ...nn import functional as F
@@ -128,7 +129,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
     """Reference: python/paddle/static/nn/common.py:3668."""
     layer = _get_layer(
-        "embedding", name, tuple(size),
+        "embedding", name, (tuple(size), padding_idx, is_sparse),
         lambda: nn.Embedding(size[0], size[1], padding_idx=padding_idx,
                              sparse=is_sparse, weight_attr=param_attr))
     return layer(input)
@@ -399,46 +400,65 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     return (pos_loss.sum(axis=1) + neg_loss.sum(axis=1)).reshape([-1, 1])
 
 
+def _row_conv_fn(x_a, w_a):
+    import jax.numpy as jnp
+
+    # x: [B, T, D] (or [T, D]); slide a future-context window over T
+    squeeze = x_a.ndim == 2
+    if squeeze:
+        x_a = x_a[None]
+    k = w_a.shape[0]
+    pad = jnp.pad(x_a, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i:i + x_a.shape[1]] * w_a[i] for i in range(k))
+    return out[0] if squeeze else out
+
+
+_row_conv_op = _dispatch_op("row_conv")(_row_conv_fn)
+
+
 def row_conv(input, future_context_size, param_attr=None, act=None):
     """Lookahead row convolution (DeepSpeech2).
 
     Reference: python/paddle/static/nn/common.py:3386. out[t] = sum_{i=0..k}
-    in[t+i] * w[i] — implemented as a depthwise causal-in-future conv."""
-    import jax.numpy as jnp
-
-    from ...core.dispatch import apply_op
-
+    in[t+i] * w[i] — a depthwise conv over the future context window."""
     d = input.shape[-1]
     k = future_context_size + 1
     layer = _get_layer(
         "row_conv", None, (d, k),
         lambda: nn.Linear(k, 1, bias_attr=False, weight_attr=param_attr))
-    w = layer.weight.reshape([k])  # [k]
-
-    def _row_conv(x_a, w_a):
-        # x: [B, T, D] (or [T, D]); slide window over T
-        squeeze = x_a.ndim == 2
-        if squeeze:
-            x_a = x_a[None]
-        pad = jnp.pad(x_a, ((0, 0), (0, k - 1), (0, 0)))
-        out = sum(pad[:, i:i + x_a.shape[1]] * w_a[i] for i in range(k))
-        return out[0] if squeeze else out
-
-    out = apply_op("row_conv", _row_conv, input, w)
+    out = _row_conv_op(input, layer.weight.reshape([k]))
     if act is not None:
         out = getattr(F, act)(out)
     return out
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """Run a Python callable as an op.
+    """Run a Python callable as an op with an optional custom gradient.
 
     Reference: python/paddle/static/nn/common.py:4054. Eager-with-tape
-    static mode simply calls it; ``out`` supplies the output template(s)
-    (reference semantics: pre-created out vars)."""
-    xs = x if isinstance(x, (list, tuple)) else [x]
-    res = func(*xs)
-    return res if res is not None else out
+    static mode calls it directly; ``out`` supplies the output template(s)
+    (reference semantics: pre-created out vars). When ``backward_func`` is
+    given it becomes the op's gradient (grad-of-outputs in, grad-of-inputs
+    out), wired through the PyLayer mechanism like the reference wires the
+    py_func grad op."""
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    if backward_func is None:
+        res = func(*xs)
+        return res if res is not None else out
+
+    from ...autograd import PyLayer
+
+    class _PyFunc(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            res = func(*args)
+            return res if res is not None else out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_func(*grads)
+
+    return _PyFunc.apply(*xs)
 
 
 def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):

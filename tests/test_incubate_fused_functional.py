@@ -1,0 +1,374 @@
+"""incubate.nn.functional fused ops: parity vs the unfused composition.
+
+Reference surface: python/paddle/incubate/nn/functional/__init__.py __all__.
+Dropout rates are pinned to 0 so the fused and unfused paths are
+deterministic and comparable.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def T(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+def rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestFusedLinearFamily:
+    def test_fused_matmul_bias(self):
+        x, w, b = rand(4, 8), rand(8, 3, seed=1), rand(3, seed=2)
+        out = IF.fused_matmul_bias(T(x), T(w), T(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+        out_t = IF.fused_matmul_bias(T(x), T(w.T), T(b), transpose_y=True)
+        np.testing.assert_allclose(out_t.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_fused_linear_and_activation(self):
+        x, w, b = rand(4, 8), rand(8, 3, seed=1), rand(3, seed=2)
+        np.testing.assert_allclose(
+            IF.fused_linear(T(x), T(w), T(b)).numpy(), x @ w + b, rtol=1e-5)
+        got = IF.fused_linear_activation(T(x), T(w), T(b), activation="relu")
+        np.testing.assert_allclose(got.numpy(), np.maximum(x @ w + b, 0),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="gelu/relu"):
+            IF.fused_linear_activation(T(x), T(w), T(b), activation="tanh")
+
+    def test_fused_dropout_add(self):
+        x, y = rand(4, 8), rand(4, 8, seed=1)
+        out = IF.fused_dropout_add(T(x), T(y), p=0.0)
+        np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+        # eval mode: dropout inert at any p
+        out = IF.fused_dropout_add(T(x), T(y), p=0.7, training=False)
+        np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+
+
+class TestFusedBiasDropoutResidualLN:
+    def test_parity_vs_unfused(self):
+        x, resid = rand(2, 4, 8), rand(2, 4, 8, seed=1)
+        bias, scale, ln_b = rand(8, seed=2), rand(8, seed=3), rand(8, seed=4)
+        got = IF.fused_bias_dropout_residual_layer_norm(
+            T(x), T(resid), bias=T(bias), ln_scale=T(scale), ln_bias=T(ln_b),
+            dropout_rate=0.0)
+        want = F.layer_norm(T(resid) + (T(x) + T(bias)), [8], T(scale),
+                            T(ln_b), 1e-5)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5)
+
+
+class TestFusedRope:
+    def _ref_rope_neox(self, x, sin, cos):
+        x0, x1 = x[..., 0::2], x[..., 1::2]
+        rot = np.stack([-x1, x0], axis=-1).reshape(x.shape)
+        return x * cos + rot * sin
+
+    def test_neox_style_vs_numpy(self):
+        b, s, h, d = 2, 6, 2, 8
+        q = rand(b, s, h, d)
+        inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+        emb = np.repeat(np.outer(np.arange(s), inv), 2, axis=-1)
+        sin, cos = np.sin(emb).astype(np.float32), \
+            np.cos(emb).astype(np.float32)
+        got = IF.fused_rotary_position_embedding(T(q), sin=T(sin), cos=T(cos))
+        want = self._ref_rope_neox(q, sin[None, :, None, :],
+                                   cos[None, :, None, :])
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+    def test_default_tables_match_explicit(self):
+        q = rand(1, 4, 2, 8)
+        d = 8
+        inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+        emb = np.repeat(np.outer(np.arange(4), inv), 2, axis=-1)
+        explicit = IF.fused_rotary_position_embedding(
+            T(q), sin=T(np.sin(emb)), cos=T(np.cos(emb)))
+        default = IF.fused_rotary_position_embedding(T(q))
+        np.testing.assert_allclose(default.numpy(), explicit.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_qkv_tuple_and_norm_preservation(self):
+        q, k, v = rand(1, 4, 2, 8), rand(1, 4, 2, 8, seed=1), \
+            rand(1, 4, 2, 8, seed=2)
+        oq, ok, ov = IF.fused_rotary_position_embedding(T(q), T(k), T(v))
+        # rotation preserves per-position norms
+        np.testing.assert_allclose(
+            np.linalg.norm(oq.numpy(), axis=-1),
+            np.linalg.norm(q, axis=-1), rtol=1e-4)
+        assert ok.shape == list(k.shape) and ov.shape == list(v.shape)
+
+    def test_position_ids_gather(self):
+        q = rand(2, 4, 2, 8)
+        pos = np.array([[3, 2, 1, 0], [0, 1, 2, 3]], np.int64)
+        got = IF.fused_rotary_position_embedding(
+            T(q), position_ids=paddle.to_tensor(pos))
+        # batch 1 uses identity positions == default path
+        want = IF.fused_rotary_position_embedding(T(q[1:2]))
+        np.testing.assert_allclose(got.numpy()[1:2], want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_half_style_differs(self):
+        q = rand(1, 4, 2, 8)
+        a = IF.fused_rotary_position_embedding(T(q),
+                                               use_neox_rotary_style=True)
+        b = IF.fused_rotary_position_embedding(T(q),
+                                               use_neox_rotary_style=False)
+        assert not np.allclose(a.numpy(), b.numpy())
+
+    def test_odd_head_dim_raises(self):
+        with pytest.raises(ValueError, match="even"):
+            IF.fused_rotary_position_embedding(T(rand(1, 2, 2, 7)))
+
+
+class TestFusedMHA:
+    def _unfused(self, x, qkv_w, lin_w, qkv_b, lin_b, ln_s, ln_b, n_heads):
+        b, s, e = x.shape
+        hd = e // n_heads
+        flat_w = qkv_w.reshape(3 * e, e).T
+        qkv = x @ flat_w + qkv_b.reshape(-1)
+        qkv = qkv.reshape(b, s, 3, n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # [b, s, h, d] -> [b, h, s, d]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        logits = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        att = (p @ v).transpose(0, 2, 1, 3).reshape(b, s, e)
+        out = att @ lin_w + lin_b
+        out = x + out
+        mu = out.mean(-1, keepdims=True)
+        var = out.var(-1, keepdims=True)
+        return ((out - mu) / np.sqrt(var + 1e-5)) * ln_s + ln_b
+
+    def test_parity_vs_unfused_numpy(self):
+        b, s, e, h = 2, 6, 16, 4
+        x = rand(b, s, e)
+        qkv_w = rand(3, h, e // h, e, seed=1) * 0.2
+        qkv_b = rand(3, h, e // h, seed=2) * 0.1
+        lin_w = rand(e, e, seed=3) * 0.2
+        lin_b = rand(e, seed=4) * 0.1
+        ln_s, ln_b_ = rand(e, seed=5), rand(e, seed=6)
+        got = IF.fused_multi_head_attention(
+            T(x), T(qkv_w), T(lin_w), qkv_bias=T(qkv_b), linear_bias=T(lin_b),
+            ln_scale=T(ln_s), ln_bias=T(ln_b_), dropout_rate=0.0,
+            attn_dropout_rate=0.0)
+        want = self._unfused(x, qkv_w, lin_w, qkv_b, lin_b, ln_s, ln_b_, h)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_pre_layer_norm_and_no_residual(self):
+        x = rand(1, 4, 8)
+        qkv_w = rand(3, 2, 4, 8, seed=1) * 0.2
+        lin_w = rand(8, 8, seed=2) * 0.2
+        pre_s, pre_b = np.ones(8, np.float32), np.zeros(8, np.float32)
+        out = IF.fused_multi_head_attention(
+            T(x), T(qkv_w), T(lin_w), pre_layer_norm=True,
+            pre_ln_scale=T(pre_s), pre_ln_bias=T(pre_b), dropout_rate=0.0,
+            attn_dropout_rate=0.0, add_residual=False)
+        assert out.shape == [1, 4, 8]
+
+    def test_cache_kv_append(self):
+        b, s, e, h = 1, 1, 8, 2
+        x = rand(b, s, e)
+        qkv_w = rand(3, h, e // h, e, seed=1) * 0.2
+        lin_w = rand(e, e, seed=2) * 0.2
+        cache = np.zeros((2, b, h, 3, e // h), np.float32)
+        out, new_cache = IF.fused_multi_head_attention(
+            T(x), T(qkv_w), T(lin_w), cache_kv=T(cache), dropout_rate=0.0,
+            attn_dropout_rate=0.0, ln_scale=T(np.ones(e, np.float32)),
+            ln_bias=T(np.zeros(e, np.float32)))
+        assert out.shape == [b, s, e]
+        assert new_cache.shape == [2, b, h, 4, e // h]
+
+
+class TestFusedFFN:
+    def test_parity_vs_unfused(self):
+        x = rand(2, 4, 8)
+        w1, w2 = rand(8, 16, seed=1) * 0.3, rand(16, 8, seed=2) * 0.3
+        b1, b2 = rand(16, seed=3) * 0.1, rand(8, seed=4) * 0.1
+        ln_s, ln_b = rand(8, seed=5), rand(8, seed=6)
+        got = IF.fused_feedforward(
+            T(x), T(w1), T(w2), linear1_bias=T(b1), linear2_bias=T(b2),
+            ln2_scale=T(ln_s), ln2_bias=T(ln_b), dropout1_rate=0.0,
+            dropout2_rate=0.0, activation="relu")
+        h = np.maximum(x @ w1 + b1, 0) @ w2 + b2
+        out = x + h
+        mu, var = out.mean(-1, keepdims=True), out.var(-1, keepdims=True)
+        want = ((out - mu) / np.sqrt(var + 1e-5)) * ln_s + ln_b
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_pre_ln_gelu(self):
+        x = rand(1, 3, 8)
+        w1, w2 = rand(8, 16, seed=1) * 0.3, rand(16, 8, seed=2) * 0.3
+        out = IF.fused_feedforward(
+            T(x), T(w1), T(w2), ln1_scale=T(np.ones(8, np.float32)),
+            ln1_bias=T(np.zeros(8, np.float32)), dropout1_rate=0.0,
+            dropout2_rate=0.0, activation="gelu", pre_layer_norm=True)
+        assert out.shape == [1, 3, 8]
+
+
+class TestFusedEcMoeFunctional:
+    def test_matches_layer(self):
+        b, s, hdim, e, inter = 2, 4, 8, 2, 16
+        x = rand(b, s, hdim)
+        gate = rand(b, s, e, seed=1)
+        w0 = rand(e, hdim, inter, seed=2) * 0.2
+        b0 = rand(e, 1, inter, seed=3) * 0.1
+        w1 = rand(e, inter, hdim, seed=4) * 0.2
+        b1 = rand(e, 1, hdim, seed=5) * 0.1
+        got = IF.fused_ec_moe(T(x), T(gate), T(w0), T(b0), T(w1), T(b1),
+                              act_type="gelu")
+        assert got.shape == [b, s, hdim]
+        from paddle_tpu.incubate.nn import FusedEcMoe
+
+        layer = FusedEcMoe(hdim, inter, e, act_type="gelu")
+        layer.bmm_weight0.set_value(T(w0))
+        layer.bmm_bias0.set_value(T(b0))
+        layer.bmm_weight1.set_value(T(w1))
+        layer.bmm_bias1.set_value(T(b1))
+        np.testing.assert_allclose(got.numpy(), layer(T(x), T(gate)).numpy(),
+                                   rtol=1e-5)
+
+
+class TestFusedMultiTransformer:
+    def test_two_layer_stack(self):
+        b, s, e, h = 1, 4, 8, 2
+        mk = lambda *shape, seed: T(rand(*shape, seed=seed) * 0.2)
+        n = 2
+        out = IF.fused_multi_transformer(
+            T(rand(b, s, e)),
+            ln_scales=[T(np.ones(e, np.float32))] * n,
+            ln_biases=[T(np.zeros(e, np.float32))] * n,
+            qkv_weights=[mk(3, h, e // h, e, seed=i) for i in range(n)],
+            qkv_biases=[T(np.zeros((3, h, e // h), np.float32))] * n,
+            linear_weights=[mk(e, e, seed=10 + i) for i in range(n)],
+            linear_biases=[T(np.zeros(e, np.float32))] * n,
+            ffn_ln_scales=[T(np.ones(e, np.float32))] * n,
+            ffn_ln_biases=[T(np.zeros(e, np.float32))] * n,
+            ffn1_weights=[mk(e, 2 * e, seed=20 + i) for i in range(n)],
+            ffn1_biases=[T(np.zeros(2 * e, np.float32))] * n,
+            ffn2_weights=[mk(2 * e, e, seed=30 + i) for i in range(n)],
+            ffn2_biases=[T(np.zeros(e, np.float32))] * n)
+        assert out.shape == [b, s, e]
+
+
+class TestLayersRouteThroughFunctionals:
+    def test_fused_linear_layer(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+
+        layer = FusedLinear(8, 3)
+        x = T(rand(4, 8))
+        want = F.linear(x, layer.weight, layer.bias)
+        np.testing.assert_allclose(layer(x).numpy(), want.numpy(), rtol=1e-6)
+
+    def test_fused_dropout_add_layer(self):
+        from paddle_tpu.incubate.nn import FusedDropoutAdd
+
+        layer = FusedDropoutAdd(p=0.0)
+        x, y = T(rand(3, 3)), T(rand(3, 3, seed=1))
+        np.testing.assert_allclose(layer(x, y).numpy(),
+                                   (x + y).numpy(), rtol=1e-6)
+
+
+class TestVarlenAndMaskedAttention:
+    def test_varlen_masks_and_matches_dense(self):
+        b, s, h, d = 2, 4, 2, 8
+        q, k, v = rand(b, s, h, d), rand(b, s, h, d, seed=1), \
+            rand(b, s, h, d, seed=2)
+        sl = np.array([[4], [2]], np.int32)
+        out = IF.variable_length_memory_efficient_attention(
+            T(q), T(k), T(v), paddle.to_tensor(sl), paddle.to_tensor(sl))
+        # padded q rows are zeroed
+        assert np.abs(out.numpy()[1, 2:]).sum() == 0
+        # full-length row matches dense softmax attention
+        lg = q[0].transpose(1, 0, 2)[0] @ k[0].transpose(1, 0, 2)[0].T \
+            / np.sqrt(d)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy()[0, :, 0, :],
+                                   p @ v[0].transpose(1, 0, 2)[0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_masked_mha_decode_step(self):
+        b, h, d, t_max = 2, 2, 8, 6
+        x = rand(b, 3 * h * d)
+        cache = np.zeros((2, b, h, t_max, d), np.float32)
+        sl = np.array([[0], [3]], np.int64)
+        out, new_cache = IF.masked_multihead_attention(
+            T(x), T(cache), sequence_lengths=paddle.to_tensor(sl))
+        # row 0 decodes at position 0: attends only to itself -> v_new
+        qkv = x.reshape(b, 3, h, d)
+        np.testing.assert_allclose(out.numpy()[0], qkv[0, 2].reshape(-1),
+                                   rtol=1e-4, atol=1e-5)
+        # row 1's k/v written at its position
+        assert np.abs(new_cache.numpy()[0, 1, :, 3, :]).sum() > 0
+        assert np.abs(new_cache.numpy()[0, 1, :, 4, :]).sum() == 0
+
+    def test_block_mha_guarded(self):
+        with pytest.raises(NotImplementedError, match="paged"):
+            IF.block_multihead_attention()
+
+    def test_reference_all_parity(self):
+        import ast
+
+        ref = ("/root/reference/python/paddle/incubate/nn/functional/"
+               "__init__.py")
+        for node in ast.walk(ast.parse(open(ref).read())):
+            if isinstance(node, ast.Assign) and any(
+                    getattr(t, "id", None) == "__all__"
+                    for t in node.targets):
+                ref_all = ast.literal_eval(node.value)
+        missing = [n for n in ref_all if not hasattr(IF, n)]
+        assert not missing, f"incubate.nn.functional missing: {missing}"
+
+
+class TestReviewRegressions:
+    def test_ec_moe_functional_accepts_parameters(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+
+        layer = FusedEcMoe(8, 16, 2, act_type="gelu")
+        x, g = T(rand(2, 4, 8)), T(rand(2, 4, 2, seed=1))
+        got = IF.fused_ec_moe(x, g, layer.bmm_weight0, layer.bmm_bias0,
+                              layer.bmm_weight1, layer.bmm_bias1,
+                              act_type="gelu")
+        np.testing.assert_allclose(got.numpy(), layer(x, g).numpy(),
+                                   rtol=1e-5)
+
+    def test_nonneox_default_tables_concat_layout(self):
+        q = rand(1, 4, 2, 8)
+        d = 8
+        inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+        emb = np.concatenate([np.outer(np.arange(4), inv)] * 2, axis=-1)
+        explicit = IF.fused_rotary_position_embedding(
+            T(q), sin=T(np.sin(emb)), cos=T(np.cos(emb)),
+            use_neox_rotary_style=False)
+        default = IF.fused_rotary_position_embedding(
+            T(q), use_neox_rotary_style=False)
+        np.testing.assert_allclose(default.numpy(), explicit.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.linalg.norm(default.numpy(), axis=-1),
+            np.linalg.norm(q, axis=-1), rtol=1e-4)
+
+    def test_varlen_causal_offset_when_kv_longer(self):
+        b, sq, h, d, sk = 1, 2, 1, 4, 5
+        q, k, v = rand(b, sq, h, d, seed=3), rand(b, sk, h, d, seed=4), \
+            rand(b, sk, h, d, seed=5)
+        out = IF.variable_length_memory_efficient_attention(
+            T(q), T(k), T(v),
+            paddle.to_tensor(np.array([[sq]], np.int32)),
+            paddle.to_tensor(np.array([[sk]], np.int32)), causal=True)
+        # query i sees kv[0 .. sk-sq+i]
+        for i, vis in [(0, 4), (1, 5)]:
+            lg = (q[0, i, 0] @ k[0, :vis, 0].T) / np.sqrt(d)
+            p = np.exp(lg - lg.max())
+            p /= p.sum()
+            np.testing.assert_allclose(out.numpy()[0, i, 0],
+                                       p @ v[0, :vis, 0], rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_fused_multi_transformer_guards_unsupported(self):
+        x = T(rand(1, 2, 8))
+        with pytest.raises(NotImplementedError, match="rotary_embs"):
+            IF.fused_multi_transformer(x, *[None] * 12, rotary_embs=1)

@@ -289,3 +289,24 @@ class TestStaticNNCommon:
                 break
         missing = [n for n in ref_all if not hasattr(static_nn, n)]
         assert not missing, f"static.nn missing vs reference: {missing}"
+
+
+class TestPyFuncBackward:
+    def test_backward_func_defines_gradient(self):
+        import numpy as np
+
+        t = T(np.ones((2, 2)))
+        t.stop_gradient = False
+        out = static_nn.py_func(lambda a: a * 2, t, None,
+                                backward_func=lambda g: g * 7)
+        out.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), 7 * np.ones((2, 2)))
+
+    def test_embedding_padding_idx_distinct_layers(self):
+        import numpy as np
+
+        static_nn.reset_parameters()
+        ids = paddle.to_tensor(np.array([[0], [1]], np.int64))
+        a = static_nn.embedding(ids, size=(4, 3), padding_idx=0)
+        b = static_nn.embedding(ids, size=(4, 3), padding_idx=1)
+        assert not np.allclose(a.numpy(), b.numpy())
