@@ -66,6 +66,69 @@ def _train_flops_per_token(cfg, n_params, seq):
     return 6.0 * n_params + 12.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
 
 
+def _round_history(metric):
+    """{round_n: value} for a metric across past BENCH_r*.json artifacts
+    (each stores the run's stdout tail: one JSON line per workload)."""
+    import glob
+    import re
+
+    vals = {}
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)", p)
+        if not m:
+            continue
+        try:
+            data = json.load(open(p))
+        except Exception:
+            continue
+        for line in str(data.get("tail", "")).splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            if rec.get("metric") == metric and rec.get("value"):
+                vals[int(m.group(1))] = rec["value"]
+    return vals
+
+
+def _emit(rec, step=None, batch=None, items_per_batch=None):
+    """Print one bench JSON line, enriched with:
+
+    - ``mfu`` + ``model_tflops_per_sec`` from XLA HLO cost analysis of the
+      fused step (when ``step``/``batch`` given and the record has no
+      hand-accounted mfu already) — VERDICT r4 weak-2;
+    - ``vs_prev_round`` / ``vs_baseline`` ratios against this framework's
+      own BENCH_r*.json history (the reference publishes no numbers, so the
+      trend is self-referential and says so).
+    """
+    if step is not None and rec.get("mfu") is None:
+        try:
+            flops = step.lowered_flops(*batch)
+        except Exception:
+            flops = None
+        peak = _chip_peak_flops()
+        if flops and peak:
+            per_item = flops / (items_per_batch or 1)
+            achieved = rec["value"] * per_item
+            rec["mfu"] = round(achieved / peak, 4)
+            rec["model_tflops_per_sec"] = round(achieved / 1e12, 1)
+            rec["mfu_accounting"] = "xla_hlo_cost_analysis"
+    hist = _round_history(rec["metric"])
+    rec["vs_prev_round"] = (round(rec["value"] / hist[max(hist)], 3)
+                            if hist else None)
+    if rec.get("vs_baseline") is None and hist:
+        first_round = min(hist)
+        rec["vs_baseline"] = round(rec["value"] / hist[first_round], 3)
+        rec["baseline_note"] = (
+            f"vs_baseline is vs round-{first_round} self-measurement "
+            f"({hist[first_round]}); reference publishes no in-tree numbers")
+    print(json.dumps(rec))
+
+
 def _bench_loop(step, make_batch, batch_sizes, steps, warmup, rebuild):
     """Shared sweep-then-measure loop; returns (items/sec, batch_size)."""
     import time
@@ -137,13 +200,13 @@ def bench_resnet50(on_tpu):
         return x, y
 
     ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
-    print(json.dumps({
+    _emit({
         "metric": "resnet50_train_images_per_sec" if on_tpu
                   else "resnet18_cpu_train_images_per_sec",
         "value": round(ips, 1), "unit": "images/s", "vs_baseline": None,
         "batch_size": bs, "image_size": img,
         "baseline_note": "reference publishes no in-tree numbers",
-    }))
+    }, step=step, batch=make_batch(bs), items_per_batch=bs)
 
 
 def bench_deepfm(on_tpu):
@@ -187,12 +250,13 @@ def bench_deepfm(on_tpu):
         return ids, dense, label
 
     ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
-    print(json.dumps({
+    _emit({
         "metric": "deepfm_train_examples_per_sec",
         "value": round(ips, 1), "unit": "examples/s", "vs_baseline": None,
         "batch_size": bs, "vocab": vocab,
-        "baseline_note": "reference publishes no in-tree numbers",
-    }))
+        "baseline_note": "reference publishes no in-tree numbers; MFU is "
+                         "expected tiny (embedding-bound workload)",
+    }, step=step, batch=make_batch(bs), items_per_batch=bs)
 
 
 def bench_ppyoloe(on_tpu):
@@ -236,13 +300,13 @@ def bench_ppyoloe(on_tpu):
         return x, gt_b, gt_l
 
     ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
-    print(json.dumps({
+    _emit({
         "metric": "ppyoloe_s_train_images_per_sec" if on_tpu
                   else "ppyoloe_tiny_cpu_train_images_per_sec",
         "value": round(ips, 1), "unit": "images/s", "vs_baseline": None,
         "batch_size": bs, "image_size": img,
         "baseline_note": "reference publishes no in-tree numbers",
-    }))
+    }, step=step, batch=make_batch(bs), items_per_batch=bs)
 
 
 def bench_bert(on_tpu):
@@ -271,8 +335,14 @@ def bench_bert(on_tpu):
                                      parameters=m.parameters())
         raw = paddle.incubate.fused_train_step(m, opt,
                                                loss_fn=lambda o: o[0])
+
         # labels must travel by keyword (position 2 is token_type_ids)
-        return lambda ids, labels: raw(ids, labels=labels)
+        def wrapped(ids, labels):
+            return raw(ids, labels=labels)
+
+        wrapped.lowered_flops = (
+            lambda ids, labels: raw.lowered_flops(ids, labels=labels))
+        return wrapped
 
     step = build()
 
@@ -285,13 +355,13 @@ def bench_bert(on_tpu):
 
     ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup,
                           build)
-    print(json.dumps({
+    _emit({
         "metric": "bert_base_finetune_tokens_per_sec" if on_tpu
                   else "bert_tiny_cpu_finetune_tokens_per_sec",
         "value": round(ips * seq, 1), "unit": "tokens/s",
         "vs_baseline": None, "batch_size": bs, "seq_len": seq,
         "baseline_note": "reference publishes no in-tree numbers",
-    }))
+    }, step=step, batch=make_batch(bs), items_per_batch=bs * seq)
 
 
 def main():
@@ -369,7 +439,7 @@ def main():
     peak = _chip_peak_flops()
     mfu = round(achieved / peak, 4) if peak else None
 
-    print(json.dumps({
+    _emit({
         "metric": "llama125m_train_tokens_per_sec" if on_tpu
                   else "llama_tiny_cpu_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
@@ -378,12 +448,13 @@ def main():
                        if on_tpu else 1.0,
         "mfu": mfu,
         "model_tflops_per_sec": round(achieved / 1e12, 1),
+        "mfu_accounting": "palm_6N_plus_attention",
         "batch_size": best_bs,
         "seq_len": seq,
         "attn_path": attn_path,
         "baseline_note": "vs_baseline is vs round-1 self-measurement "
                          "(78701.7 tok/s); reference publishes no numbers",
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -226,21 +226,64 @@ class Tensor:
     cast = astype
 
     def to(self, *args, **kwargs):
-        # paddle Tensor.to(device|dtype)
+        # paddle Tensor.to(device|dtype): device strings move data (cpu =
+        # real host offload via device_put; gpu maps to the accelerator),
+        # anything else is a dtype cast
+        out = self
         for a in list(args) + list(kwargs.values()):
-            if isinstance(a, str) and a.lower() in ("cpu", "tpu", "gpu", "axon"):
-                continue  # placement handled globally by XLA
+            if isinstance(a, str) and a.lower().split(":")[0] in (
+                    "cpu", "tpu", "gpu", "axon", "cuda"):
+                out = out._to_device(a.lower().split(":")[0])
+                continue
             try:
-                return self.astype(dtypes.convert_dtype(a))
+                out = out.astype(dtypes.convert_dtype(a))
             except TypeError:
                 continue
-        return self
+        return out
+
+    def _to_device(self, kind):
+        import jax
+
+        # a GSPMD-sharded array must NOT be collapsed onto one device (OOM
+        # for large tables, sharding layout lost) — keep it where it is
+        try:
+            if len(self._data.sharding.device_set) > 1:
+                import warnings
+
+                warnings.warn(
+                    f"Tensor.to({kind!r}) on a multi-device sharded array "
+                    "is a no-op (moving it would gather onto one device); "
+                    "use distributed.checkpoint for host snapshots",
+                    stacklevel=4)
+                return self
+        except AttributeError:
+            pass
+        if kind == "cpu":
+            return Tensor._wrap(jax.device_put(self._data,
+                                               jax.devices("cpu")[0]))
+        # gpu/cuda naming maps onto the accelerator backend on this
+        # framework (one XLA device namespace)
+        try:
+            dev = jax.devices()[0]
+        except Exception:
+            return self
+        if dev.platform == "cpu" and kind in ("gpu", "cuda", "tpu"):
+            import warnings
+
+            warnings.warn(f"Tensor.to({kind!r}): no accelerator backend is "
+                          "available; tensor stays on cpu", stacklevel=3)
+            return self
+        return Tensor._wrap(jax.device_put(self._data, dev))
 
     def cpu(self):
-        return self
+        """Host offload: a copy of this tensor on the CPU device (paddle
+        Tensor.cpu). Note the copy is committed to the host — move it back
+        with ``.cuda()``/``.to('tpu')`` before mixing it into device
+        compute."""
+        return self._to_device("cpu")
 
     def cuda(self, *a, **k):
-        return self
+        return self._to_device("cuda")
 
     def pin_memory(self):
         return self

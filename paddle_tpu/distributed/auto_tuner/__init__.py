@@ -18,7 +18,69 @@ import csv
 import itertools
 import time
 
-__all__ = ["AutoTuner", "GridSearch", "Recorder", "default_candidates"]
+__all__ = ["AutoTuner", "GridSearch", "Recorder", "default_candidates",
+           "get_mem", "transformer_params"]
+
+
+# ---------------------------------------------------------------------------
+# Analytic cost model (reference cost_model.py:16-35 — whose all_params/
+# all_acts are literal `return 1` stubs; this is the real accounting the
+# stubs reserve space for). Units: bytes, converted to GB at the end.
+# ---------------------------------------------------------------------------
+
+def transformer_params(h, l, V):
+    """Parameter count of a GPT/Llama-class decoder: embedding V*h, per
+    layer 4h^2 (attention) + 8h^2 (MLP) + ~13h (norms/biases), final norm."""
+    return V * h + l * (12 * h * h + 13 * h) + h
+
+
+def get_mem(total_cards, parallel_cfg, l, h, a, V, s, gbs,
+            bytes_per_param=2, optimizer_bytes_per_param=12):
+    """Estimated peak per-device GB under a hybrid-parallel config.
+
+    Accounting (bf16 params + fp32 Adam master/moments by default):
+    - weights shard over mp*pp (embedding over mp), bytes_per_param each;
+    - grads: bytes_per_param, sharded additionally by sharding_degree at
+      stage >= 2;
+    - optimizer state (master + 2 moments = 12 B/param fp32): divided by
+      sharding_degree from stage 1 on;
+    - activations per layer per microbatch: s*b*h*(34 + 5*a*s/h) bytes at
+      2 B/elem (Korthikanti et al. 2022 eq. 2), layers/pp per stage, mp
+      divides; full recompute keeps only the ~2*s*b*h layer boundaries.
+      vpp holds (1 + (pp-1)/(pp*vpp)) times one stage's activations.
+    """
+    mp = int(parallel_cfg.get("mp_degree", 1))
+    pp = int(parallel_cfg.get("pp_degree", 1))
+    sharding = int(parallel_cfg.get("sharding_degree", 1))
+    stage = int(parallel_cfg.get("sharding_stage", 1))
+    b = int(parallel_cfg.get("micro_batch_size", 1))
+    vpp = int(parallel_cfg.get("vpp_degree", 1))
+    recompute = bool(parallel_cfg.get("use_recompute", False))
+
+    n_params = transformer_params(h, l, V)
+    local_params = n_params / (mp * pp)
+
+    param_bytes = local_params * bytes_per_param
+    grad_bytes = local_params * bytes_per_param
+    opt_bytes = local_params * optimizer_bytes_per_param
+    if stage >= 1:
+        opt_bytes /= sharding
+    if stage >= 2:
+        grad_bytes /= sharding
+    if stage >= 3:
+        param_bytes /= sharding
+
+    layers_per_stage = max(l // pp, 1)
+    if recompute:
+        act_per_layer = 2.0 * s * b * h / mp
+    else:
+        act_per_layer = s * b * h * (34.0 + 5.0 * a * s / h) / mp
+    vpp_ratio = 1.0 + (pp - 1.0) / (pp * vpp) if vpp > 1 else 1.0
+    # 1F1B: a stage holds up to `pp` in-flight microbatches of activations
+    in_flight = min(pp, max(int(gbs // max(b * sharding, 1)), 1))
+    act_bytes = act_per_layer * layers_per_stage * vpp_ratio * in_flight
+
+    return (param_bytes + grad_bytes + opt_bytes + act_bytes) / (2 ** 30)
 
 
 def _divisors(n):
@@ -151,9 +213,29 @@ class AutoTuner:
     def search_once(self):
         return self.searcher.search_once()
 
+    def estimate_mem_gb(self, cfg):
+        """Analytic per-device memory estimate for a config, or None when
+        the tuner_cfg lacks the model dims (hidden_size etc.)."""
+        c = self.cfg
+        dims = {k: c.get(k) for k in ("num_layers", "hidden_size",
+                                      "num_attention_heads", "vocab_size",
+                                      "seq_length", "global_batch_size")}
+        if not all(dims.values()):
+            return None
+        return get_mem(
+            int(c.get("num_gpus", c.get("num_devices", 1))), cfg,
+            l=int(dims["num_layers"]), h=int(dims["hidden_size"]),
+            a=int(dims["num_attention_heads"]), V=int(dims["vocab_size"]),
+            s=int(dims["seq_length"]), gbs=int(dims["global_batch_size"]))
+
     def tune(self, max_search_time=None):
-        """Run all trials; returns (best_cfg, recorder)."""
+        """Run all trials; returns (best_cfg, recorder). Configs whose
+        analytic memory estimate exceeds ``memory_limit_gb`` (when set) are
+        pruned WITHOUT trialing and recorded with pruned='mem_estimate'
+        (reference cost_model.py:16 intent; recorder keeps the audit
+        trail)."""
         assert self.trial_fn is not None, "provide trial_fn to tune()"
+        budget = self.cfg.get("memory_limit_gb")
         t0 = time.time()
         while True:
             if max_search_time and time.time() - t0 > max_search_time:
@@ -161,12 +243,20 @@ class AutoTuner:
             cfg = self.search_once()
             if cfg is None:
                 break
+            est = self.estimate_mem_gb(cfg)
+            if budget is not None and est is not None and est > budget:
+                self.recorder.add_cfg(**cfg, mem_estimate_gb=round(est, 3),
+                                      pruned="mem_estimate",
+                                      **{self.recorder.metric: None})
+                continue
             self.cur_task_id += 1
             try:
                 metric = self.trial_fn(dict(cfg))
             except Exception:
                 metric = None
-            self.recorder.add_cfg(**cfg,
-                                  **{self.recorder.metric: metric})
+            rec = dict(cfg, **{self.recorder.metric: metric})
+            if est is not None:
+                rec["mem_estimate_gb"] = round(est, 3)
+            self.recorder.add_cfg(**rec)
         best, err = self.recorder.get_best()
         return best, self.recorder

@@ -26,13 +26,58 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 
 import numpy as np
 import jax
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle"]
+
+
+class AsyncSaveHandle:
+    """Completion handle for ``save_state_dict(..., async_save=True)``.
+
+    The device->host snapshot happens synchronously inside save_state_dict
+    (so training may mutate/donate the buffers immediately after it
+    returns); only the disk write runs on this background thread. Orbax
+    (the TPU-idiomatic checkpointer) calls the same shape
+    ``AsyncCheckpointer.save`` + ``wait_until_finished``.
+    """
+
+    def __init__(self, thread, errbox):
+        self._thread = thread
+        self._errbox = errbox
+
+    def done(self):
+        return not self._thread.is_alive()
+
+    def wait(self, timeout=None):
+        """Block until the write completes; re-raises a write failure (once
+        — a waited handle is retired, so a later unrelated save does not
+        re-raise it)."""
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("async checkpoint write still in flight")
+        if self in _IN_FLIGHT:
+            _IN_FLIGHT.remove(self)
+        if self._errbox:
+            err = self._errbox[0]
+            self._errbox = []
+            raise err
+    result = wait
+
+
+_IN_FLIGHT: list = []  # AsyncSaveHandle s not yet waited on
+
+
+def _drain_in_flight():
+    """A new save waits for prior async writes (reference
+    save_state_dict.py:104 waits on its async executor the same way) so two
+    saves to one path can't interleave."""
+    while _IN_FLIGHT:
+        _IN_FLIGHT.pop().wait()
 
 _UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
@@ -58,7 +103,11 @@ def _storable(data):
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
-    """Reference save_state_dict.py:104."""
+    """Reference save_state_dict.py:104. With ``async_save=True`` the
+    device->host snapshot is taken before returning and the disk write runs
+    on a background thread; returns an :class:`AsyncSaveHandle` (sync saves
+    return None)."""
+    _drain_in_flight()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     nprocs = jax.process_count()
@@ -109,14 +158,37 @@ def save_state_dict(state_dict, path, process_group=None,
             "dtype": true_dtype,
             "shards": saved,
         }
-    np.savez(os.path.join(path, f"rank{rank}.npz"), **payload)
-    with open(os.path.join(path, f"rank{rank}.meta.json"), "w") as f:
-        json.dump(fragment, f)
-    if rank == coordinator_rank:
-        # API-parity marker only (the coordinator's own fragment); load
-        # always merges the rank*.meta.json fragments and never reads this
-        with open(os.path.join(path, "metadata.json"), "w") as f:
+    def write():
+        # payload arrays are host copies (np.asarray above) — training may
+        # have moved on; write shards first, metadata fragments last so a
+        # reader that sees the fragment also sees its shards
+        np.savez(os.path.join(path, f"rank{rank}.npz"), **payload)
+        with open(os.path.join(path, f"rank{rank}.meta.json"), "w") as f:
             json.dump(fragment, f)
+        if rank == coordinator_rank:
+            # API-parity marker only (the coordinator's own fragment); load
+            # always merges rank*.meta.json fragments and never reads this
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(fragment, f)
+
+    if not async_save:
+        write()
+        return None
+
+    errbox = []
+
+    def run():
+        try:
+            write()
+        except BaseException as e:  # surfaced on handle.wait()
+            errbox.append(e)
+
+    thread = threading.Thread(target=run, name="ckpt-async-save",
+                              daemon=True)
+    thread.start()
+    handle = AsyncSaveHandle(thread, errbox)
+    _IN_FLIGHT.append(handle)
+    return handle
 
 
 def _merged_metadata(path):

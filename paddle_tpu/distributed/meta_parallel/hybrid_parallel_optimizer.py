@@ -92,9 +92,23 @@ class HybridParallelOptimizer:
                     "DistributedStrategy.a_sync targets async parameter "
                     "servers; the TPU PS analog is synchronous — ignored",
                     stacklevel=3)
+        # Only ClipGradByGlobalNorm needs the cross-group treatment; ByNorm
+        # and ByValue are per-tensor-local math that is identical under any
+        # sharding, so they pass through untouched (reference
+        # hybrid_parallel_optimizer.py:254 wraps only ClipGradByGlobalNorm
+        # and warns for the rest).
         if optimizer._grad_clip is not None and hcg is not None:
-            optimizer._grad_clip = HybridParallelClipGrad(
-                optimizer._grad_clip, hcg)
+            if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+                optimizer._grad_clip = HybridParallelClipGrad(
+                    optimizer._grad_clip, hcg)
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"{type(optimizer._grad_clip).__name__} is per-tensor "
+                    "math and needs no hybrid-parallel treatment; it is "
+                    "applied as-is (only ClipGradByGlobalNorm is wrapped "
+                    "into the cross-group global norm)", stacklevel=3)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)

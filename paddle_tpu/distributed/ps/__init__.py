@@ -56,11 +56,13 @@ class SparseEmbedding(Layer):
     """
 
     def __init__(self, num_embeddings, embedding_dim, axis=("dp",),
-                 padding_idx=None, weight_attr=None, mesh=None, name=None):
+                 padding_idx=None, weight_attr=None, mesh=None, name=None,
+                 entry=None):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._entry = entry
         mesh, axes = _table_mesh(mesh, axis)
         self._mesh = mesh
         self._axes = axes
@@ -82,7 +84,77 @@ class SparseEmbedding(Layer):
             self.weight._data = jax.device_put(self.weight._data, sharding)
             self.weight._placement = (mesh, spec)
 
+        if entry is not None:
+            self._init_entry(entry)
+
+    # ---- admission filtering (scoped-down CTR accessor) ----------------
+    # Reference: paddle/fluid/distributed/ps/table/ctr_accessor.cc — the PS
+    # table admits a sparse feature into training by show-count threshold
+    # (CountFilterEntry) or by probability on first sight (ProbabilityEntry);
+    # un-admitted rows serve their init values and take no updates. Here the
+    # same gate is a per-row admitted mask: forward counts the batch's ids
+    # eagerly, and a gradient hook on the table zeroes un-admitted rows, so
+    # the scatter-add push skips them and they stay at init.
+    def _init_entry(self, entry):
+        import jax.numpy as jnp
+
+        rows = self.weight.shape[0]
+        kind = getattr(entry, "_name", None)
+        if kind not in ("count_filter_entry", "probability_entry"):
+            raise TypeError(
+                "entry must be a CountFilterEntry or ProbabilityEntry, got "
+                f"{type(entry).__name__}")
+        self._entry_kind = kind
+        self._counts = jnp.zeros((rows,), jnp.int32)
+        self._admitted = jnp.zeros((rows,), jnp.bool_)
+        self.weight.register_hook(self._mask_grad)
+
+    def _mask_grad(self, grad):
+        mask = self._admitted.astype(grad._data.dtype)
+        from ...core.tensor import Tensor
+
+        return Tensor._wrap(grad._data * mask[:, None])
+
+    def _observe(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        ids = (x._data if hasattr(x, "_data") else jnp.asarray(x)) \
+            .reshape(-1).astype(jnp.int32)
+        if self._entry_kind == "count_filter_entry":
+            self._counts = self._counts.at[ids].add(1)
+            self._admitted = self._counts >= self._entry._count
+        else:  # probability_entry: draw once, on first sight
+            from ...core import rng
+
+            first_seen = (self._counts == 0).take(ids)
+            self._counts = self._counts.at[ids].add(1)
+            draw = jax.random.bernoulli(
+                rng.DEFAULT_GENERATOR.next_key(),
+                self._entry._probability, ids.shape)
+            newly = jnp.zeros_like(self._admitted).at[ids].max(
+                jnp.logical_and(first_seen, draw))
+            self._admitted = jnp.logical_or(self._admitted, newly)
+
     def forward(self, x):
+        if self._entry is not None and self.training:
+            from ...core import state
+
+            if state.in_trace():
+                # the count/admit gate is eager host-side state, and the
+                # grad hook rides the eager tape — a traced/fused step
+                # (to_static, fused_train_step) bypasses BOTH. Never
+                # silently: train filtered tables with the eager loop.
+                import warnings
+
+                warnings.warn(
+                    "SparseEmbedding admission filtering (entry=...) is "
+                    "BYPASSED inside a traced/fused train step: id counting "
+                    "and the gradient gate only run in the eager loop. "
+                    "Train this table eagerly, or drop the entry filter.",
+                    stacklevel=2)
+            else:  # counting is an eager host-side gate
+                self._observe(x)
         # plain gather; GSPMD turns it into masked local gather + all-reduce
         # when the table is sharded (the PS pull)
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
@@ -119,7 +191,8 @@ def sparse_embedding(input, size, padding_idx=None, param_attr=None,
     layer = _FUNCTIONAL_TABLES.get(key)
     if layer is None:
         layer = SparseEmbedding(size[0], size[1], padding_idx=padding_idx,
-                                weight_attr=param_attr)
+                                weight_attr=param_attr,
+                                entry=kwargs.get("entry"))
         _FUNCTIONAL_TABLES[key] = layer
     return layer(input)
 

@@ -264,12 +264,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         fused_rotary_position_embedding._kernel = _kernel
 
     kern = fused_rotary_position_embedding._kernel
-    outs = tuple(
+    # reference contract (fused_rotary_position_embedding.py:126): always a
+    # 3-tuple (out_q, out_k, out_v), None for absent inputs
+    return tuple(
         kern(t, sin, cos, neox=use_neox_rotary_style)
         if t is not None else None
         for t in (q, k, v))
-    present = [o for o in outs if o is not None]
-    return present[0] if len(present) == 1 else tuple(present)
 
 
 def fused_multi_head_attention(x, qkv_weight, linear_weight,

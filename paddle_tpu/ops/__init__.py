@@ -202,9 +202,27 @@ def _install():
         return jax.random.uniform(rng.next_key(), self._data.shape,
                                   minval=min, maxval=max)
 
+    def _bernoulli_sample(self, p=0.5, name=None):
+        import jax
+
+        from ..core import rng
+
+        return jax.random.bernoulli(rng.next_key(), p, self._data.shape)
+
+    def _log_normal_sample(self, mean=1.0, std=2.0, name=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import rng
+
+        return jnp.exp(mean + std * jax.random.normal(rng.next_key(),
+                                                      self._data.shape))
+
     _inplace_random("normal_", _normal_sample)
     _inplace_random("cauchy_", _cauchy_sample)
     _inplace_random("geometric_", _geometric_sample)
+    _inplace_random("bernoulli_", _bernoulli_sample)
+    _inplace_random("log_normal_", _log_normal_sample)
     if not hasattr(Tensor, "exponential_"):
         _inplace_random("exponential_", _exponential_sample)
     if not hasattr(Tensor, "uniform_"):

@@ -648,3 +648,93 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
     ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)
     vals = jnp.take_along_axis(probs, ids, axis=-1)
     return Tensor(vals), Tensor(ids.astype(jnp.int64))
+
+
+__all__ += ["sinc", "sinc_", "igamma", "igammac", "log_normal",
+            "standard_gamma"]
+
+
+@op("sinc")
+def sinc(x, name=None):
+    """Normalized sinc: sin(pi x)/(pi x), 1 at x = 0 (paddle.sinc; newer
+    than this reference snapshot — kept for tensor-API parity with current
+    paddle)."""
+    x = jnp.asarray(x)
+    return jnp.sinc(x.astype(jnp.result_type(x, jnp.float32)))
+
+
+@op("igamma")
+def igamma(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (paddle.igamma
+    convention: x is the shape parameter, y the integral's lower limit)."""
+    import jax.scipy.special as jss
+
+    x = jnp.asarray(x)
+    f = jnp.result_type(x, jnp.float32)
+    return jss.gammaincc(x.astype(f), jnp.asarray(y).astype(f))
+
+
+@op("igammac")
+def igammac(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) — the complement of
+    :func:`igamma` (paddle.igammac convention)."""
+    import jax.scipy.special as jss
+
+    x = jnp.asarray(x)
+    f = jnp.result_type(x, jnp.float32)
+    return jss.gammainc(x.astype(f), jnp.asarray(y).astype(f))
+
+
+# mean/std travel as ARRAY args (not closure state): the dispatch layer's
+# jit cache keys on (op name, static kwargs), so anything value-like must be
+# an operand or successive calls would replay the first call's closure
+@op("log_normal_sample")
+def _log_normal_sample(key, mean, std, shape=()):
+    return jnp.exp(mean + std * jax.random.normal(key, tuple(shape)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """Sample exp(N(mean, std^2)) (paddle.log_normal; tensor/random.py
+    family). ``mean``/``std`` parameterize the UNDERLYING normal."""
+    from ..core import rng
+
+    shape = [1] if shape is None else [int(s) for s in shape]
+    out = _log_normal_sample(rng.next_key(),
+                             jnp.float32(mean), jnp.float32(std),
+                             shape=tuple(shape))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op("standard_gamma_sample")
+def _standard_gamma_sample(x, key):
+    return jax.random.gamma(key, jnp.asarray(x))
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(shape=x, scale=1) elementwise (paddle.standard_gamma,
+    tensor/random.py family)."""
+    from ..core import rng
+
+    return _standard_gamma_sample(x, rng.next_key())
+
+
+def sinc_(x, name=None):
+    """In-place sinc (paddle.sinc_)."""
+    out = sinc(x)
+    x._data = out._data if isinstance(out, Tensor) else out
+    return x
+
+
+__all__ += ["bernoulli_", "log_normal_"]
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place Bernoulli re-init (paddle.bernoulli_; tensor/random.py
+    family): x <- Bernoulli(p) sample of x's shape/dtype."""
+    return Tensor.bernoulli_(x, p=p)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place log-normal re-init (paddle.log_normal_): x <-
+    exp(N(mean, std^2)) sample of x's shape/dtype."""
+    return Tensor.log_normal_(x, mean=mean, std=std)

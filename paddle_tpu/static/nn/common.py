@@ -448,15 +448,28 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
     from ...autograd import PyLayer
 
+    skip = set()
+    if skip_vars_in_backward_input is not None:
+        sv = (skip_vars_in_backward_input
+              if isinstance(skip_vars_in_backward_input, (list, tuple))
+              else [skip_vars_in_backward_input])
+        skip = {id(v) for v in sv}
+
     class _PyFunc(PyLayer):
         @staticmethod
         def forward(ctx, *args):
             res = func(*args)
-            return res if res is not None else out
+            res = res if res is not None else out
+            outs = res if isinstance(res, (list, tuple)) else [res]
+            # reference contract (common.py:3123): backward_func receives
+            # (x..., out..., dout...), minus skip_vars_in_backward_input
+            ctx._pyfunc_fwd = ([a for a in args if id(a) not in skip]
+                               + [o for o in outs if id(o) not in skip])
+            return res
 
         @staticmethod
         def backward(ctx, *grads):
-            return backward_func(*grads)
+            return backward_func(*ctx._pyfunc_fwd, *grads)
 
     return _PyFunc.apply(*xs)
 

@@ -304,3 +304,56 @@ class TestAutogradFunctional:
         want = 2 * np.eye(3)
         want[0, 1] = want[1, 0] = 1
         np.testing.assert_allclose(H.numpy(), want)
+
+
+class TestSpecialFnLongtail:
+    """VERDICT r4 missing-7: igamma/igammac, sinc, in-place RNG
+    (bernoulli_, log_normal_), log_normal/standard_gamma samplers."""
+
+    def test_sinc_matches_numpy(self):
+        x = np.array([0.0, 0.5, -1.0, 2.5, -3.25], np.float32)
+        np.testing.assert_allclose(paddle.sinc(T(x)).numpy(), np.sinc(x),
+                                   rtol=1e-5, atol=1e-6)
+        t = T(x)
+        t.sinc_()
+        np.testing.assert_allclose(t.numpy(), np.sinc(x), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_igamma_igammac_vs_scipy(self):
+        import scipy.special as sp
+
+        a = np.array([0.5, 1.0, 2.0, 5.0], np.float32)
+        y = np.array([0.1, 1.0, 2.5, 4.0], np.float32)
+        np.testing.assert_allclose(paddle.igamma(T(a), T(y)).numpy(),
+                                   sp.gammaincc(a, y), rtol=1e-3)
+        np.testing.assert_allclose(paddle.igammac(T(a), T(y)).numpy(),
+                                   sp.gammainc(a, y), rtol=1e-3)
+        # complementarity: P + Q = 1
+        s = paddle.igamma(T(a), T(y)).numpy() + \
+            paddle.igammac(T(a), T(y)).numpy()
+        np.testing.assert_allclose(s, np.ones_like(a), rtol=1e-3)
+
+    def test_inplace_rng_distributions(self):
+        paddle.seed(7)
+        t = paddle.zeros([20000], dtype="float32")
+        out = t.bernoulli_(p=0.25)
+        assert out is t
+        m = float(t.numpy().mean())
+        assert abs(m - 0.25) < 0.02
+        t2 = paddle.zeros([20000], dtype="float32")
+        paddle.log_normal_(t2, mean=0.5, std=0.3)
+        logs = np.log(t2.numpy())
+        assert abs(float(logs.mean()) - 0.5) < 0.02
+        assert abs(float(logs.std()) - 0.3) < 0.02
+
+    def test_samplers(self):
+        paddle.seed(11)
+        ln = paddle.log_normal(mean=0.0, std=0.5, shape=[8000])
+        assert abs(float(np.log(ln.numpy()).mean())) < 0.02
+        g = paddle.standard_gamma(
+            T(np.full((8000,), 2.0, np.float32)))
+        assert abs(float(g.numpy().mean()) - 2.0) < 0.15
+        # elementwise shape parameter respected
+        g2 = paddle.standard_gamma(
+            T(np.full((8000,), 8.0, np.float32)))
+        assert float(g2.numpy().mean()) > float(g.numpy().mean())

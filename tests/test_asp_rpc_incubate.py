@@ -95,6 +95,7 @@ def _set_quit():
     return "bye"
 
 
+@pytest.mark.slow
 class TestRpc:
     @pytest.fixture()
     def rpc(self):

@@ -8,11 +8,15 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models import (
+
     BertForMaskedLM,
     BertForSequenceClassification,
     BertModel,
     bert_tiny,
 )
+
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture

@@ -2,10 +2,14 @@
 loss decreases (reference model: test/book/ smoke tests)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
+
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
 
 
 class BasicBlock(nn.Layer):

@@ -205,6 +205,7 @@ class TestCreationAttr:
         assert paddle.finfo("bfloat16").bits == 16
         assert paddle.iinfo("int32").max == 2**31 - 1
 
+    @pytest.mark.slow
     def test_random_families(self):
         paddle.seed(7)
         b = paddle.binomial(T(np.full(1000, 10.0, np.float32)),
@@ -281,6 +282,7 @@ class TestTopLevelInfra:
         with pytest.raises(TypeError):
             paddle.check_shape("notashape", "op")
 
+    @pytest.mark.slow
     def test_flops_and_summary(self, capsys):
         import paddle_tpu.nn as nn
 

@@ -6,6 +6,7 @@ expert-choice MoE routing, and the namespace audit.
 import ast
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.incubate.nn as inn
@@ -51,6 +52,7 @@ class TestFusedLayers:
                 np.testing.assert_allclose(out.numpy().mean(-1), 0.0,
                                            atol=1e-4)
 
+    @pytest.mark.slow
     def test_encoder_stack_trains(self):
         paddle.seed(2)
         enc = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)

@@ -9,6 +9,9 @@ import sys
 
 import pytest
 
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 COLLECTIVE_SCRIPT = """
@@ -32,6 +35,7 @@ open(os.path.join({out!r}, f"rank{{rank}}.ok"), "w").write(str(gathered))
 
 FLAKY_SCRIPT = """
 import os, sys
+
 flag = os.path.join({out!r}, "attempted")
 if not os.path.exists(flag):
     open(flag, "w").write("x")

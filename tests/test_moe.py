@@ -11,10 +11,14 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.distributed.collective import Group
 from paddle_tpu.incubate.distributed.models.moe import (
+
     MoELayer,
     moe_capacity,
     top_k_capacity_gating,
 )
+
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
 
 D, E, T = 16, 4, 32
 

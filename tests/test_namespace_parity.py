@@ -192,6 +192,7 @@ class TestDistributedCompat:
                          num_partitions=2)
         assert emb.shape == [2, 2, 4]
 
+    @pytest.mark.slow
     def test_dist_model_to_static(self):
         import paddle_tpu.distributed as dist
         import paddle_tpu.nn as nn

@@ -169,6 +169,7 @@ class TestBoundedPrefetchAndNormalizeCollate:
         assert x.numpy().dtype == np.float32
 
 
+@pytest.mark.slow
 class TestProcessWorkers:
     """use_process_workers=True: spawn workers run __getitem__/collate off
     the parent GIL (VERDICT r4 item 10; reference io/dataloader/worker.py)."""
@@ -248,6 +249,7 @@ class _InitProbeDataset:
         return np.float32(_PROBE["v"])
 
 
+@pytest.mark.slow
 class TestProcessWorkersEarlyExit:
     def test_break_does_not_deadlock(self):
         """Early consumer exit must tear the pool down (advisor r4: the
@@ -276,6 +278,7 @@ class _BigDataset:
         return np.full((256, 1024), float(i), "float32")  # 1MB/sample
 
 
+@pytest.mark.slow
 class TestProcessWorkersSharedMemory:
     def test_shm_transport_values(self):
         from paddle_tpu.io import DataLoader

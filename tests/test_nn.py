@@ -227,6 +227,7 @@ class TestLayers:
         assert total < 1.0 + 1e-4
 
 
+@pytest.mark.slow
 class TestLlamaGenerate:
     """KV-cache autoregressive decode (PaddleNLP generate analog)."""
 

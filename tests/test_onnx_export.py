@@ -60,6 +60,7 @@ class TestOnnxExport:
         data = np.frombuffer(w[9][0], np.float32).reshape(dims)
         np.testing.assert_allclose(data, model.weight.numpy(), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_cnn_graph(self, tmp_path):
         paddle.seed(2)
         model = nn.Sequential(

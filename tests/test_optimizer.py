@@ -38,6 +38,7 @@ class TestOptimizers:
         got, target = quad_problem(cls, **kw)
         np.testing.assert_allclose(got, target, atol=0.15)
 
+    @pytest.mark.slow
     def test_adam_vs_torch(self):
         torch = pytest.importorskip("torch")
         w0 = np.random.randn(4, 3).astype(np.float32)

@@ -17,9 +17,13 @@ from paddle_tpu.models.llama import _rope_apply, _rope_cache
 from paddle_tpu.nn.functional.flash_attention import _sdpa_ref, _use_pallas
 from paddle_tpu.ops.pallas import flash_attention as fa_mod
 from paddle_tpu.ops.pallas.flash_attention import (
+
     _flash_attention_arrays,
     _flash_attention_rope_arrays,
 )
+
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
 
 B, S, H, D = 2, 256, 4, 64
 

@@ -77,6 +77,7 @@ class TestMoEFFNKernel:
                                        err_msg=f"grad wrt {name}")
 
 
+@pytest.mark.slow
 class TestLlamaMoEWiring:
     def test_moe_layer_fused_matches_unfused(self, monkeypatch):
         import paddle_tpu as paddle

@@ -68,6 +68,7 @@ class TestFusedAddRMSNormParity:
         np.testing.assert_allclose(out, ref_out, rtol=1e-6, atol=1e-6)
         np.testing.assert_allclose(r, ref_r, rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.slow
     def test_bwd_matches_unfused(self):
         x, y, w = _data(np.float32)
 
@@ -102,6 +103,7 @@ class TestFusedAddRMSNormParity:
         assert tx.grad is not None and tx.grad.shape == tx.shape
 
 
+@pytest.mark.slow
 class TestLlamaWiring:
     def test_decoder_layer_fused_matches_unfused(self, monkeypatch):
         import paddle_tpu as paddle

@@ -68,6 +68,7 @@ def data():
 
 
 class TestStageScan:
+    @pytest.mark.slow
     def test_loss_and_grad_parity_vs_single_stage(self):
         paddle.seed(7)
         pl = PipelineLayer(layers=make_descs(), num_stages=2,
@@ -97,6 +98,7 @@ class TestStageScan:
         assert place[0].isdisjoint(place[2])
         assert len(place[0]) == 4 and len(place[2]) == 4
 
+    @pytest.mark.slow
     def test_four_stage_pipeline(self):
         paddle.seed(8)
         pl = PipelineLayer(layers=make_descs(), num_stages=4,
@@ -111,6 +113,7 @@ class TestStageScan:
         assert all(place[i].isdisjoint(place[j])
                    for i in range(4) for j in range(4) if i != j)
 
+    @pytest.mark.slow
     def test_interleaved_vpp_parity_and_placement(self):
         paddle.seed(9)
         pl = PipelineLayer(layers=make_descs(), num_stages=2,
@@ -135,6 +138,7 @@ class TestStageScan:
         assert place[1] == place[3]
         assert place[0].isdisjoint(place[1])
 
+    @pytest.mark.slow
     def test_shared_layer_desc_tied_embeddings(self):
         """SharedLayerDesc tied weights: grads from both uses accumulate
         into the same Tensor (reference pp_layers.py:76 + the shared-
@@ -183,6 +187,7 @@ class TestStageScan:
                               num_micro=3, num_virtual=2)
 
 
+@pytest.mark.slow
 class TestFleetPipelineIntegration:
     @pytest.fixture(scope="class")
     def pp_hcg(self):

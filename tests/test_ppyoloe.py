@@ -11,6 +11,9 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision.models import PPYOLOE, PPYOLOEConfig
 
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tiny_model():

@@ -59,6 +59,7 @@ class TestQATStructure:
         assert isinstance(model[0], QuantedLinear)
 
 
+@pytest.mark.slow
 class TestQATTraining:
     def test_qat_trains_and_matches_fp32(self):
         """VERDICT r4 item 6: QAT training converges and the quantized

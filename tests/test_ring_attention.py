@@ -20,9 +20,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import paddle_tpu as paddle
 from paddle_tpu.nn.functional.flash_attention import _sdpa_ref
 from paddle_tpu.nn.functional.ring_attention import (
+
     _ring_local,
     ring_flash_attention,
 )
+
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
 
 B, S, H, D = 2, 64, 4, 16
 N_DEV = 8

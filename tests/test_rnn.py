@@ -36,6 +36,7 @@ def _x():
 
 
 class TestLSTM:
+    @pytest.mark.slow
     def test_parity_vs_torch_bidirectional_2layer(self):
         lstm = nn.LSTM(I, H, num_layers=2, direction="bidirectional")
         tl = torch.nn.LSTM(I, H, num_layers=2, bidirectional=True,
@@ -74,6 +75,7 @@ class TestLSTM:
 
 
 class TestGRU:
+    @pytest.mark.slow
     def test_parity_vs_torch(self):
         gru = nn.GRU(I, H)
         tg = torch.nn.GRU(I, H, batch_first=True)
@@ -104,6 +106,7 @@ class TestCellsAndWrappers:
         assert tuple(out.shape) == (B, H)
         assert tuple(c.shape) == (B, H)
 
+    @pytest.mark.slow
     def test_rnn_wrapper_matches_fused(self):
         """Generic RNN(cell) unrolled loop == fused-scan SimpleRNN given the
         same weights."""
@@ -141,6 +144,7 @@ class TestCellsAndWrappers:
         sm = paddle.jit.to_static(m)
         np.testing.assert_allclose(sm(x).numpy(), eager, atol=1e-5)
 
+    @pytest.mark.slow
     def test_dropout_between_layers_only_in_train(self):
         rnn = nn.LSTM(I, H, num_layers=2, dropout=0.5)
         x = paddle.to_tensor(_x())

@@ -9,6 +9,9 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models, ops, transforms
 from paddle_tpu.vision.datasets import FakeData
 
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
+
 
 def _logits_shape(model, in_shape, n=2):
     x = paddle.to_tensor(np.random.randn(n, *in_shape).astype("float32"))

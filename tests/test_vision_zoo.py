@@ -13,6 +13,9 @@ import pytest
 import paddle_tpu as paddle
 import paddle_tpu.vision.models as M
 
+# heavyweight module (model zoo / e2e / subprocess): slow tier
+pytestmark = pytest.mark.slow
+
 
 def _img(hw, bs=1):
     return paddle.to_tensor(
