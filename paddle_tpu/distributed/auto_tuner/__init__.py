@@ -76,8 +76,12 @@ def get_mem(total_cards, parallel_cfg, l, h, a, V, s, gbs,
     else:
         act_per_layer = s * b * h * (34.0 + 5.0 * a * s / h) / mp
     vpp_ratio = 1.0 + (pp - 1.0) / (pp * vpp) if vpp > 1 else 1.0
-    # 1F1B: a stage holds up to `pp` in-flight microbatches of activations
-    in_flight = min(pp, max(int(gbs // max(b * sharding, 1)), 1))
+    # 1F1B: a stage holds up to `pp` in-flight microbatches of activations,
+    # bounded by the microbatches each PIPELINE actually runs: the global
+    # batch splits over dp*sharding replicas first, then into microbatches
+    dp = int(parallel_cfg.get("dp_degree", 1))
+    num_micro = max(int(gbs // max(b * dp * sharding, 1)), 1)
+    in_flight = min(pp, num_micro)
     act_bytes = act_per_layer * layers_per_stage * vpp_ratio * in_flight
 
     return (param_bytes + grad_bytes + opt_bytes + act_bytes) / (2 ** 30)

@@ -46,9 +46,10 @@ class AsyncSaveHandle:
     ``AsyncCheckpointer.save`` + ``wait_until_finished``.
     """
 
-    def __init__(self, thread, errbox):
+    def __init__(self, thread, errbox, path=None):
         self._thread = thread
         self._errbox = errbox
+        self._path = path
 
     def done(self):
         return not self._thread.is_alive()
@@ -75,9 +76,26 @@ _IN_FLIGHT: list = []  # AsyncSaveHandle s not yet waited on
 def _drain_in_flight():
     """A new save waits for prior async writes (reference
     save_state_dict.py:104 waits on its async executor the same way) so two
-    saves to one path can't interleave."""
+    saves to one path can't interleave. A PRIOR save's write failure is
+    surfaced as a loud warning, not an exception — it must not abort the
+    new, unrelated save (the user can still catch it via that handle's own
+    ``wait()``)."""
+    import warnings
+
     while _IN_FLIGHT:
-        _IN_FLIGHT.pop().wait()
+        h = _IN_FLIGHT.pop()
+        try:
+            h.wait()
+        except Exception as e:
+            warnings.warn(
+                f"a previous async checkpoint save to {h._path!r} FAILED: "
+                f"{type(e).__name__}: {e} — that checkpoint is incomplete",
+                stacklevel=3)
+
+
+import atexit  # noqa: E402
+
+atexit.register(_drain_in_flight)  # never exit with a write mid-file
 
 _UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
@@ -183,10 +201,12 @@ def save_state_dict(state_dict, path, process_group=None,
         except BaseException as e:  # surfaced on handle.wait()
             errbox.append(e)
 
+    # non-daemon: interpreter exit joins the thread instead of killing the
+    # write mid-file (plus the atexit drain above for belt and braces)
     thread = threading.Thread(target=run, name="ckpt-async-save",
-                              daemon=True)
+                              daemon=False)
     thread.start()
-    handle = AsyncSaveHandle(thread, errbox)
+    handle = AsyncSaveHandle(thread, errbox, path=path)
     _IN_FLIGHT.append(handle)
     return handle
 

@@ -187,12 +187,18 @@ def sparse_embedding(input, size, padding_idx=None, param_attr=None,
     ``sparse_embedding.get_table(...)`` and pass its ``.weight`` to the
     optimizer explicitly; ``sparse_embedding.reset()`` clears all tables
     (fresh model)."""
-    key = _table_key(name, size, padding_idx)
+    entry = kwargs.get("entry")
+    # the entry filter is part of the table's identity: an entry-less call
+    # must not reuse (or silently create) a filtered table
+    entry_key = (None if entry is None
+                 else (getattr(entry, "_name", type(entry).__name__),
+                       getattr(entry, "_count",
+                               getattr(entry, "_probability", None))))
+    key = _table_key(name, size, padding_idx) + (entry_key,)
     layer = _FUNCTIONAL_TABLES.get(key)
     if layer is None:
         layer = SparseEmbedding(size[0], size[1], padding_idx=padding_idx,
-                                weight_attr=param_attr,
-                                entry=kwargs.get("entry"))
+                                weight_attr=param_attr, entry=entry)
         _FUNCTIONAL_TABLES[key] = layer
     return layer(input)
 

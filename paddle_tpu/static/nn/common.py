@@ -455,6 +455,9 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
               else [skip_vars_in_backward_input])
         skip = {id(v) for v in sv}
 
+    out_templates = (list(out) if isinstance(out, (list, tuple))
+                     else [out]) if out is not None else []
+
     class _PyFunc(PyLayer):
         @staticmethod
         def forward(ctx, *args):
@@ -462,9 +465,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             res = res if res is not None else out
             outs = res if isinstance(res, (list, tuple)) else [res]
             # reference contract (common.py:3123): backward_func receives
-            # (x..., out..., dout...), minus skip_vars_in_backward_input
+            # (x..., out..., dout...), minus skip_vars_in_backward_input.
+            # Outputs are matched by POSITION against the out templates as
+            # well as identity: func returns fresh tensors, so users skip
+            # by naming the template they passed as `out`.
+            keep_outs = []
+            for i, o in enumerate(outs):
+                tmpl = out_templates[i] if i < len(out_templates) else None
+                if id(o) in skip or (tmpl is not None and id(tmpl) in skip):
+                    continue
+                keep_outs.append(o)
             ctx._pyfunc_fwd = ([a for a in args if id(a) not in skip]
-                               + [o for o in outs if id(o) not in skip])
+                               + keep_outs)
             return res
 
         @staticmethod

@@ -14,6 +14,7 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
+from paddle_tpu import inference
 from paddle_tpu.inference import Config, PrecisionType, create_predictor
 from paddle_tpu.static import InputSpec
 
@@ -104,3 +105,48 @@ class TestPredictor:
     def test_missing_model_errors(self):
         with pytest.raises(ValueError):
             create_predictor(Config())
+
+
+class TestConvertToMixedPrecision:
+    """Precision-rewrite pass (reference inference/wrapper.py:79): weights
+    stored at bf16, program re-exported as call(cast(weights), inputs)."""
+
+    def test_bf16_conversion_roundtrip(self, tmp_path):
+        import os
+        import pickle
+
+        import ml_dtypes
+
+        m = nn.Sequential(nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 4))
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 32).astype(np.float32) * 3)
+        paddle.jit.save(m, str(tmp_path / "model"),
+                        input_spec=[paddle.static.InputSpec([2, 32],
+                                                            "float32")])
+        inference.convert_to_mixed_precision(
+            str(tmp_path / "model.pdmodel"),
+            str(tmp_path / "model.pdiparams"),
+            str(tmp_path / "mixed.pdmodel"),
+            str(tmp_path / "mixed.pdiparams"),
+            mixed_precision="bfloat16")
+        pl = pickle.load(open(tmp_path / "mixed.pdmodel", "rb"))
+        assert all(c.dtype == ml_dtypes.bfloat16 for c in pl["consts"])
+
+        pred = inference.create_predictor(
+            inference.Config(str(tmp_path / "mixed")))
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x.numpy())
+        out = pred.run()[0]
+        # oracle: eager model with bf16-roundtripped weights
+        for p in m.parameters():
+            p.set_value(paddle.to_tensor(
+                p.numpy().astype(ml_dtypes.bfloat16).astype(np.float32)))
+        np.testing.assert_allclose(out, m(x).numpy(), rtol=1e-5, atol=1e-6)
+        assert os.path.exists(tmp_path / "mixed.pdiparams")
+
+    def test_int8_guarded(self, tmp_path):
+        with pytest.raises(NotImplementedError, match="quantization"):
+            inference.convert_to_mixed_precision(
+                "a.pdmodel", "a.pdiparams", "b.pdmodel", "b.pdiparams",
+                mixed_precision="int8")
