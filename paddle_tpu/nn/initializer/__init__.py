@@ -20,7 +20,8 @@ from ...core import rng
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+    "Assign", "Orthogonal", "Dirac", "Bilinear", "calculate_gain",
+    "set_global_initializer",
 ]
 
 
@@ -210,3 +211,31 @@ def default_weight_init():
 
 def default_bias_init():
     return _GLOBAL_BIAS_INIT or Constant(0.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel initializer for transposed-conv
+    upsampling weights (reference nn/initializer/Bilinear.py:30): each
+    [kh, kw] slice is the separable triangle kernel
+    (1-|x/f-c|)(1-|y/f-c|), f = ceil(kw/2), c = (2f-1-f%2)/(2f).
+    The reference computes y with FLOAT division ((i / size) % size,
+    Bilinear.py:119) rather than the classic integer row index; that
+    behavior is reproduced bit-for-bit so weights match the reference."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(
+                "Bilinear initializer expects a 4-D conv weight "
+                f"[oc, ic, kh, kw], got shape {list(shape)}")
+        if shape[2] != shape[3]:
+            raise ValueError("shape[2] must be equal to shape[3].")
+        n = int(np.prod(shape))
+        size = shape[3]
+        f = np.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        idx = np.arange(n)
+        x = idx % size
+        y = (idx / size) % size  # float y: reference quirk, see docstring
+        weight = ((1 - np.abs(x / f - c))
+                  * (1 - np.abs(y / f - c))).astype(np.float32)
+        return jnp.asarray(weight.reshape(shape), dtype)

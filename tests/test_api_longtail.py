@@ -357,3 +357,20 @@ class TestSpecialFnLongtail:
         g2 = paddle.standard_gamma(
             T(np.full((8000,), 8.0, np.float32)))
         assert float(g2.numpy().mean()) > float(g.numpy().mean())
+
+
+class TestBilinearInitializer:
+    def test_matches_reference_formula(self):
+        init = paddle.nn.initializer.Bilinear()
+        w = np.asarray(init((2, 1, 4, 4), "float32"))
+        size, f, c = 4, 2.0, 0.75
+        want = np.zeros(2 * 1 * 4 * 4, np.float32)
+        for i in range(want.size):
+            x = i % size
+            y = (i / size) % size  # reference Bilinear.py:119 float-y quirk
+            want[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        np.testing.assert_allclose(w, want.reshape(2, 1, 4, 4), rtol=1e-6)
+
+    def test_non_4d_raises(self):
+        with pytest.raises(ValueError, match="4-D"):
+            paddle.nn.initializer.Bilinear()((3, 3), "float32")
