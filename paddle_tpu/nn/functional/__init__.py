@@ -13,6 +13,7 @@ from .flash_attention import (  # noqa: F401
 )
 from . import flash_attention as flash_attention_mod  # noqa: F401
 from .ring_attention import ring_flash_attention  # noqa: F401
+from .ulysses_attention import sep_all_to_all_attention  # noqa: F401
 
 from ...ops.manipulation import gather, gather_nd, scatter, scatter_nd_add  # noqa: F401
 from ...ops.creation import one_hot  # noqa: F401

@@ -1,0 +1,52 @@
+"""Shared plumbing for the sequence-parallel attention entry points
+(ring_attention.py, ulysses_attention.py): mesh resolution from the fleet
+singleton, the in-place sequence-sharded placement, and the scale-aware
+single-device fallback contract.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+
+def resolve_sp_mesh(mesh, axis):
+    """The mesh to run on, or None when the axis is unavailable (callers
+    then take the single-device fallback)."""
+    if mesh is None:
+        from ...distributed.fleet.fleet import fleet_singleton
+
+        try:
+            mesh = fleet_singleton.get_hybrid_communicate_group().mesh
+        except Exception:
+            mesh = None
+    if mesh is None or axis not in getattr(mesh, "shape", {}) \
+            or mesh.shape[axis] <= 1:
+        return None
+    return mesh
+
+
+def place_seq_sharded(t, mesh, axis):
+    """Re-layout IN PLACE (same value, sharded over the sequence axis) so
+    the autograd tape identity is preserved — a wrapped copy would receive
+    the leaf gradients instead of the caller's tensor."""
+    if isinstance(t, Tensor) and not isinstance(t._data, jax.core.Tracer):
+        sharding = NamedSharding(mesh, P(None, axis, None, None))
+        t._data = jax.device_put(t._data, sharding)
+    return t
+
+
+def single_device_fallback(query, key, value, causal, scale):
+    """No mesh axis: run ordinary attention with the SAME scale semantics
+    the sharded path would use (a custom scale must not silently revert to
+    1/sqrt(d) just because the deployment is single-device)."""
+    from .flash_attention import _sdpa_ref, scaled_dot_product_attention
+
+    if scale is None:
+        # default scale: keep the Pallas-capable fast path
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    return _sdpa_ref(query, key, value, causal=bool(causal),
+                     scale=float(scale))

@@ -15,15 +15,11 @@ the ring backward.
 
 from __future__ import annotations
 
-import functools
 import math
 
-import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from ...core.tensor import Tensor
+from jax.sharding import PartitionSpec as P
 
 __all__ = ["ring_flash_attention"]
 
@@ -94,32 +90,18 @@ def ring_flash_attention(query, key, value, mesh=None, axis="sep",
     """Context-parallel attention: [B, S, H, D] with S sharded over
     ``axis``. Falls back to single-device flash/SDPA when no mesh axis is
     available (so models can call it unconditionally)."""
-    from .flash_attention import scaled_dot_product_attention
+    from ._seq_parallel import (
+        place_seq_sharded,
+        resolve_sp_mesh,
+        single_device_fallback,
+    )
 
+    mesh = resolve_sp_mesh(mesh, axis)
     if mesh is None:
-        from ...distributed.fleet.fleet import fleet_singleton
-
-        try:
-            mesh = fleet_singleton.get_hybrid_communicate_group().mesh
-        except Exception:
-            mesh = None
-    if mesh is None or axis not in getattr(mesh, "shape", {}) \
-            or mesh.shape[axis] <= 1:
-        return scaled_dot_product_attention(query, key, value,
-                                            is_causal=causal)
+        return single_device_fallback(query, key, value, causal, scale)
     s = float(scale if scale is not None
               else 1.0 / math.sqrt(query.shape[-1]))
-
-    def place(t):
-        # re-layout IN PLACE (same value, sharded over the sep axis) so the
-        # autograd tape identity is preserved — a wrapped copy would receive
-        # the leaf gradients instead of the caller's tensor
-        if isinstance(t, Tensor) and not isinstance(t._data,
-                                                    jax.core.Tracer):
-            sharding = NamedSharding(mesh, P(None, axis, None, None))
-            t._data = jax.device_put(t._data, sharding)
-        return t
-
+    place = lambda t: place_seq_sharded(t, mesh, axis)
     # dispatch op: jit-cached, tape-recorded (grads ring backward via the
     # ppermute transpose inside jax.vjp)
     return _ring_op(place(query), place(key), place(value), mesh=mesh,

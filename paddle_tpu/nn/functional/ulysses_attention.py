@@ -1,0 +1,96 @@
+"""Ulysses-style all-to-all sequence-parallel attention.
+
+Companion to ring attention (ring_attention.py) for the long-context story
+(SURVEY §5.7): the sequence is sharded over a mesh axis; an ``all_to_all``
+re-shards from sequence-parallel [B, S/P, H, D] to HEAD-parallel
+[B, S, H/P, D], each device runs ordinary full-sequence attention over its
+head group, and a second ``all_to_all`` restores sequence sharding
+(DeepSpeed-Ulysses; the reference's sep axis carries the same layout
+contract, with the attention compute living out-of-tree in PaddleNLP).
+
+Trade-off vs ring: Ulysses moves 2×(q+k+v+o)/P bytes in two bursts over ICI
+and keeps attention as ONE dense kernel per device (best when heads >> P
+and the flash kernel dominates); ring moves k+v per step in P-1 overlapped
+hops and never materializes the full sequence (best when S/P is the memory
+binding constraint). Both are reverse-differentiable by construction
+(all_to_all/ppermute transpose to themselves).
+
+Constraint: num_heads (and kv heads under GQA) must be divisible by the
+axis size — the same constraint DeepSpeed-Ulysses carries.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ._seq_parallel import (
+    place_seq_sharded,
+    resolve_sp_mesh,
+    single_device_fallback,
+)
+
+__all__ = ["sep_all_to_all_attention"]
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Shard body: q/k/v [B, S_loc, H, D] (seq-sharded)."""
+    from .flash_attention import _sdpa_ref
+
+    # seq-parallel -> head-parallel: split heads over the axis, gather seq
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)              # [B, S, H/P, D]
+    kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    # full-sequence dense attention on the head slice — the ONE sdpa
+    # implementation (GQA broadcast, causal mask, f32 softmax) shared with
+    # the single-device path
+    out = _sdpa_ref.raw_fn(qh, kh, vh, causal=causal,
+                           scale=scale).astype(q.dtype)
+    # head-parallel -> seq-parallel: split seq, gather heads back
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)            # [B, S_loc, H, D]
+
+
+from ...core.dispatch import op as _op
+
+
+@_op("sep_all_to_all_attention")
+def _ulysses_op(q, k, v, mesh=None, axis="sep", causal=False, scale=1.0):
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        lambda q_, k_, v_: _ulysses_local(q_, k_, v_, axis_name=axis,
+                                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis}, check_vma=False)(q, k, v)
+
+
+def sep_all_to_all_attention(query, key, value, mesh=None, axis="sep",
+                             causal=False, scale=None):
+    """Sequence-parallel attention via head/sequence all_to_all re-shard:
+    [B, S, H, D] with S sharded over ``axis``. Falls back to single-device
+    flash/SDPA when no mesh axis is available (so models can call it
+    unconditionally), mirroring :func:`ring_flash_attention`'s contract.
+    """
+    mesh = resolve_sp_mesh(mesh, axis)
+    if mesh is None:
+        return single_device_fallback(query, key, value, causal, scale)
+    n = mesh.shape[axis]
+    seq = query.shape[1]
+    h = query.shape[2]
+    kvh = key.shape[2]
+    if h % n or kvh % n or seq % n:
+        raise ValueError(
+            f"sep_all_to_all_attention needs num_heads AND seq_len "
+            f"divisible by the '{axis}' axis size: heads={h}, "
+            f"kv_heads={kvh}, seq={seq}, axis={n}. Use "
+            "ring_flash_attention for head counts the axis cannot split.")
+    s = float(scale if scale is not None
+              else 1.0 / math.sqrt(query.shape[-1]))
+    place = lambda t: place_seq_sharded(t, mesh, axis)
+    return _ulysses_op(place(query), place(key), place(value), mesh=mesh,
+                       axis=axis, causal=bool(causal), scale=s)
