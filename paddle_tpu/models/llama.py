@@ -50,6 +50,10 @@ class LlamaConfig:
     # context parallelism: attention over a seq shard per device, K/V
     # rotated around the 'sep' mesh axis (nn/functional/ring_attention.py)
     use_ring_attention: bool = False
+    # alternative sequence parallelism: Ulysses all_to_all head/seq
+    # re-shard (nn/functional/ulysses_attention.py) — num_heads and
+    # seq_len must each be divisible BY the 'sep' axis size
+    use_sep_attention: bool = False
     # MoE (expert-parallel axis); 0 = dense
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -97,6 +101,7 @@ class LlamaAttention(Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.head_dim
         self.use_ring_attention = c.use_ring_attention
+        self.use_sep_attention = c.use_sep_attention
         self._ring_mesh = None  # optional explicit mesh (else fleet hcg)
         std = 0.02
         init = Normal(0.0, std)
@@ -128,6 +133,12 @@ class LlamaAttention(Layer):
 
             out = ring_flash_attention(q, k, v, mesh=self._ring_mesh,
                                        axis="sep", causal=True)
+        elif self.use_sep_attention and attn_mask is None:
+            from ..nn.functional.ulysses_attention import (
+                sep_all_to_all_attention)
+
+            out = sep_all_to_all_attention(q, k, v, mesh=self._ring_mesh,
+                                           axis="sep", causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=attn_mask is None)
@@ -140,6 +151,7 @@ class LlamaAttention(Layer):
         import os
 
         if (attn_mask is not None or self.use_ring_attention
+                or self.use_sep_attention
                 or os.environ.get("PT_ATTN_EINSUM", "0") != "1"):
             return None
         b, s = x.shape[0], x.shape[1]
@@ -166,7 +178,8 @@ class LlamaAttention(Layer):
     def forward_pre_rope(self, x, cos, sin, attn_mask=None):
         """Projection + rope-fused flash attention (rope applied inside the
         Pallas kernel); returns None when the fused path is unavailable."""
-        if attn_mask is not None or self.use_ring_attention:
+        if attn_mask is not None or self.use_ring_attention \
+                or self.use_sep_attention:
             return None
         b, s = x.shape[0], x.shape[1]
         # gate BEFORE the projections: otherwise the eager fallback pays the

@@ -15,8 +15,10 @@ hops and never materializes the full sequence (best when S/P is the memory
 binding constraint). Both are reverse-differentiable by construction
 (all_to_all/ppermute transpose to themselves).
 
-Constraint: num_heads (and kv heads under GQA) must be divisible by the
-axis size — the same constraint DeepSpeed-Ulysses carries.
+Constraint: num_heads and seq_len must be divisible by the axis size (the
+same constraint DeepSpeed-Ulysses carries). GQA kv heads that the axis
+cannot split are broadcast to full head count up front — axis
+compatibility at the cost of the GQA bandwidth saving.
 """
 
 from __future__ import annotations
@@ -83,12 +85,31 @@ def sep_all_to_all_attention(query, key, value, mesh=None, axis="sep",
     seq = query.shape[1]
     h = query.shape[2]
     kvh = key.shape[2]
-    if h % n or kvh % n or seq % n:
+    if h % n or seq % n:
         raise ValueError(
             f"sep_all_to_all_attention needs num_heads AND seq_len "
-            f"divisible by the '{axis}' axis size: heads={h}, "
-            f"kv_heads={kvh}, seq={seq}, axis={n}. Use "
-            "ring_flash_attention for head counts the axis cannot split.")
+            f"divisible by the '{axis}' axis size: heads={h}, seq={seq}, "
+            f"axis={n}. Use ring_flash_attention for head counts the axis "
+            "cannot split.")
+    if kvh % n:
+        # GQA with kv heads the axis cannot split: broadcast kv heads up
+        # front (DeepSpeed-Ulysses does the same; trades GQA bandwidth for
+        # axis compatibility) — but only to the SMALLEST multiple the axis
+        # can split that still groups q heads evenly, not all the way to h
+        # (kv all_to_all bytes scale with the broadcast factor).
+        # repeat_interleave keeps the q-head grouping the dense GQA
+        # reference uses.
+        if h % kvh:
+            raise ValueError(
+                f"GQA head grouping broken: heads={h} not a multiple of "
+                f"kv_heads={kvh}")
+        rep = n // math.gcd(kvh, n)
+        if h % (kvh * rep):
+            rep = h // kvh  # full broadcast keeps grouping valid always
+        from ...ops.manipulation import repeat_interleave
+
+        key = repeat_interleave(key, rep, axis=2)
+        value = repeat_interleave(value, rep, axis=2)
     s = float(scale if scale is not None
               else 1.0 / math.sqrt(query.shape[-1]))
     place = lambda t: place_seq_sharded(t, mesh, axis)

@@ -410,6 +410,15 @@ def _ppyoloe_loss(cls_logits, reg_dists, pred_boxes, gt_boxes, gt_labels,
     gt_boxes = gt_boxes.astype(jnp.float32)
     valid_gt = gt_labels >= 0  # [B, G]
 
+    # The task-aligned ASSIGNMENT is a constant w.r.t. this step's params
+    # (the reference assigner runs under @paddle.no_grad,
+    # ppdet atss/task_aligned assigners) — stop gradients at its inputs so
+    # XLA never builds the backward of the [B, G, N] iou/sort/argmax
+    # machinery. Losses below still differentiate through cls_logits /
+    # pred_boxes where they appear OUTSIDE the assignment.
+    scores_sg = jax.lax.stop_gradient(scores)
+    pred_boxes_sg = jax.lax.stop_gradient(pred_boxes.astype(jnp.float32))
+
     # centers inside gt
     cx = anchors[None, None, :, 0]  # [1, 1, N]
     cy = anchors[None, None, :, 1]
@@ -417,11 +426,11 @@ def _ppyoloe_loss(cls_logits, reg_dists, pred_boxes, gt_boxes, gt_labels,
               & (cy >= gt_boxes[..., 1, None])
               & (cy <= gt_boxes[..., 3, None]))  # [B, G, N]
 
-    ious = _iou_xyxy(gt_boxes, pred_boxes)  # [B, G, N]
+    ious = _iou_xyxy(gt_boxes, pred_boxes_sg)  # [B, G, N]
     lbl = jnp.clip(gt_labels, 0)
     # [B, nc, N] gathered at idx [B, G, 1] over axis 1 -> [B, G, N]
     cls_score_for_gt = jnp.take_along_axis(
-        jnp.transpose(scores, (0, 2, 1)), lbl[:, :, None], axis=1)
+        jnp.transpose(scores_sg, (0, 2, 1)), lbl[:, :, None], axis=1)
     align = (cls_score_for_gt ** 1.0) * (ious ** 6.0)
     align = jnp.where(inside & valid_gt[..., None], align, -1.0)
 

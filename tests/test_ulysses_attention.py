@@ -160,3 +160,67 @@ class TestFallbackScale:
         with pytest.raises(ValueError, match="seq"):
             paddle.nn.functional.sep_all_to_all_attention(
                 q, q, q, mesh=mesh, axis="sep")
+
+
+class TestLlamaSepWiring:
+    def test_llama_config_uses_sep_attention(self, mesh):
+        """A Llama configured with use_sep_attention must produce the same
+        logits as the dense model (seq sharded over the sep axis)."""
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny()
+        paddle.seed(7)
+        dense = LlamaForCausalLM(cfg)
+        cfg_sep = llama_tiny(use_sep_attention=True)
+        paddle.seed(7)
+        sep = LlamaForCausalLM(cfg_sep)
+        for layer in sep.llama.layers:
+            layer.self_attn._ring_mesh = mesh
+
+        ids = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (2, 64)).astype(np.int32))
+        out_d = dense(ids).numpy()
+        out_s = sep(ids).numpy()
+        np.testing.assert_allclose(out_s, out_d, rtol=2e-3, atol=2e-3)
+
+
+class TestGQABroadcastPath:
+    """kv heads the axis cannot split (kvh % n != 0): the minimal-broadcast
+    path must match the dense GQA reference for forward AND gradients
+    (review: the broadcast path previously had no direct coverage)."""
+
+    def test_fwd_and_grad_with_broadcast(self, mesh):
+        q, k, v = _qkv(5, kv_heads=2)  # 2 kv heads, sep axis 4 -> broadcast
+        tq, tk, tv = (paddle.to_tensor(t) for t in (q, k, v))
+        for t in (tq, tk, tv):
+            t.stop_gradient = False
+        out = paddle.nn.functional.sep_all_to_all_attention(
+            tq, tk, tv, mesh=mesh, axis="sep", causal=True)
+        ref = _sdpa_ref.raw_fn(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
+                                   atol=2e-5)
+        (out * out).sum().backward()
+        # k gradient oracle: dense reference (repeat's vjp sums the groups)
+        gk = jax.grad(lambda a, b, c: (
+            _sdpa_ref.raw_fn(a, b, c, causal=True) ** 2).sum(),
+            argnums=1)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(tk.grad.numpy(), np.asarray(gk),
+                                   rtol=5e-4, atol=5e-5)
+        gv = jax.grad(lambda a, b, c: (
+            _sdpa_ref.raw_fn(a, b, c, causal=True) ** 2).sum(),
+            argnums=2)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(tv.grad.numpy(), np.asarray(gv),
+                                   rtol=5e-4, atol=5e-5)
+
+    def test_minimal_broadcast_factor(self, mesh):
+        # kvh=2, n=4 -> rep = n/gcd(2,4) = 2 (NOT h/kvh = 4): verify the
+        # math by checking parity still holds when h=8 (groups of 4->2)
+        q, k, v = _qkv(6, kv_heads=2)
+        out = paddle.nn.functional.sep_all_to_all_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mesh=mesh, axis="sep", causal=False)
+        ref = _sdpa_ref.raw_fn(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=False)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
+                                   atol=2e-5)
