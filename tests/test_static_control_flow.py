@@ -312,3 +312,78 @@ class TestPyFuncBackward:
         a = static_nn.embedding(ids, size=(4, 3), padding_idx=0)
         b = static_nn.embedding(ids, size=(4, 3), padding_idx=1)
         assert not np.allclose(a.numpy(), b.numpy())
+
+
+class TestNestedControlFlow:
+    """Nesting combinations under to_static (mirrors the reference's
+    test/dygraph_to_static nested-loop/ifelse suites)."""
+
+    def test_cond_inside_while_traced(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            def b(i, acc):
+                acc2 = static_nn.cond(acc.sum() > 10,
+                                      lambda: acc * 0.5,
+                                      lambda: acc + 1)
+                return [i + 1, acc2]
+
+            i0 = paddle.zeros([], dtype="int32")
+            _, out = static_nn.while_loop(lambda i, a: i < n, b, [i0, x])
+            return out
+
+        x = T(np.ones((4,)))
+        # 1 ->+1 2 ->+1 3 ->(sum 12>10) 1.5 -> 2.5 -> 3.5 -> 1.75
+        np.testing.assert_allclose(
+            f(x, paddle.to_tensor(np.array(6, np.int32))).numpy(),
+            [1.75] * 4)
+
+    def test_while_inside_cond_both_branches(self):
+        @paddle.jit.to_static
+        def g(x):
+            def loop():
+                i0 = paddle.zeros([], dtype="int32")
+                _, acc = static_nn.while_loop(
+                    lambda i, a: i < 3, lambda i, a: [i + 1, a * 2],
+                    [i0, x])
+                return acc
+
+            return static_nn.cond(x.sum() > 0, loop, lambda: x)
+
+        np.testing.assert_allclose(g(T(np.ones(4))).numpy(), [8.0] * 4)
+        np.testing.assert_allclose(g(T(-np.ones(4))).numpy(), [-1.0] * 4)
+
+    def test_cond_inside_switch_case(self):
+        @paddle.jit.to_static
+        def h(idx, x):
+            return static_nn.switch_case(idx, {
+                0: lambda: static_nn.cond(x.sum() > 0, lambda: x + 1,
+                                          lambda: x - 1),
+                1: lambda: x * 10,
+            }, default=lambda: x * 0)
+
+        x = T(np.ones(4))
+        np.testing.assert_allclose(
+            h(paddle.to_tensor(np.array(0, np.int32)), x).numpy(), [2.0] * 4)
+        np.testing.assert_allclose(
+            h(paddle.to_tensor(np.array(1, np.int32)), x).numpy(),
+            [10.0] * 4)
+
+    def test_grad_through_nested_cond(self):
+        class M(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                h = self.lin(x)
+                return static_nn.cond(
+                    h.sum() > 0,
+                    lambda: static_nn.cond(x.sum() > 2,
+                                           lambda: h * 2, lambda: h * 3),
+                    lambda: h * 4)
+
+        m = M()
+        x = T(np.ones((2, 4)))
+        m(x).sum().backward()
+        assert float(np.abs(m.lin.weight.grad.numpy()).sum()) > 0
