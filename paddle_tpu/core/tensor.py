@@ -241,6 +241,17 @@ class Tensor:
                 continue
         return out
 
+    def _copy_with_meta(self, arr):
+        """Wrap a device-moved copy carrying this tensor's user-visible
+        metadata: paddle preserves stop_gradient (a trainable tensor moved
+        across devices must NOT come back silently detached), persistable
+        and name across device copies."""
+        t = Tensor._wrap(arr)
+        t.stop_gradient = self.stop_gradient
+        t.persistable = self.persistable
+        t.name = self.name
+        return t
+
     def _to_device(self, kind):
         import jax
 
@@ -259,8 +270,8 @@ class Tensor:
         except AttributeError:
             pass
         if kind == "cpu":
-            return Tensor._wrap(jax.device_put(self._data,
-                                               jax.devices("cpu")[0]))
+            return self._copy_with_meta(jax.device_put(
+                self._data, jax.devices("cpu")[0]))
         # gpu/cuda naming maps onto the accelerator backend on this
         # framework (one XLA device namespace)
         try:
@@ -273,7 +284,7 @@ class Tensor:
             warnings.warn(f"Tensor.to({kind!r}): no accelerator backend is "
                           "available; tensor stays on cpu", stacklevel=3)
             return self
-        return Tensor._wrap(jax.device_put(self._data, dev))
+        return self._copy_with_meta(jax.device_put(self._data, dev))
 
     def cpu(self):
         """Host offload: a copy of this tensor on the CPU device (paddle

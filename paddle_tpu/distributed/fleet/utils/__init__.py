@@ -1,7 +1,24 @@
 """fleet.utils (reference: fleet/utils/__init__.py)."""
 
 from . import sequence_parallel_utils  # noqa: F401
-from ..recompute import recompute, recompute_sequential  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
+from ..recompute import (recompute, recompute_hybrid,  # noqa: F401
+                         recompute_sequential)
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient",
+           "recompute_sequential", "recompute_hybrid"]
+
+
+class DistributedInfer:
+    """Reference: fleet/utils/ps_util.py DistributedInfer — rewires a
+    parameter-server training program for distributed inference. The PS
+    pull/push machinery it patches does not exist on this framework."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise NotImplementedError(
+            "DistributedInfer targets the parameter-server inference path; "
+            "use paddle.jit.save + sharded load (distributed.checkpoint) "
+            "for distributed inference on this framework")
 
 
 class HybridParallelInferenceHelper:

@@ -1,0 +1,256 @@
+"""Filesystem clients for fleet checkpoint/data plumbing.
+
+Reference: python/paddle/distributed/fleet/utils/fs.py — ``FS`` (abstract),
+``LocalFS`` (host filesystem) and ``HDFSClient`` (hadoop CLI wrapper).
+``LocalFS`` is fully functional; ``HDFSClient`` shells out to the hadoop
+binary when one is configured and raises a clear error otherwise (TPU pods
+normally mount GCS/NFS paths that LocalFS covers directly).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    """Abstract filesystem interface (reference fs.py FS)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Host filesystem client (reference fs.py LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        """(dirs, files) directly under ``fs_path``."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for entry in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, entry)):
+                dirs.append(entry)
+            else:
+                files.append(entry)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self._rm(fs_path)
+        return self._rmr(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        return self.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        """All sub-directory names directly under ``fs_path``."""
+        if not self.is_exist(fs_path):
+            return []
+        return [entry for entry in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, entry))]
+
+
+class HDFSClient(FS):
+    """Hadoop CLI wrapper (reference fs.py HDFSClient). Requires a local
+    hadoop installation; every call shells out to ``hadoop fs``."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base_cmd = [os.path.join(hadoop_home, "bin/hadoop"), "fs"]
+        if configs:
+            for k, v in configs.items():
+                self._base_cmd += ["-D", f"{k}={v}"]
+        self._time_out = time_out
+
+    def _run(self, *args):
+        cmd = self._base_cmd + list(args)
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=max(1, self._time_out // 1000))
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop binary not found ({cmd[0]}); HDFSClient needs a "
+                "local hadoop install — use LocalFS for host/NFS/GCS-mount "
+                "paths") from e
+        except subprocess.TimeoutExpired as e:
+            # keep the fs contract: callers handle ExecuteError, never a
+            # raw subprocess exception
+            raise ExecuteError(
+                f"{' '.join(cmd)} timed out after {self._time_out}ms") from e
+        if proc.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)} failed: {proc.stderr}")
+        return proc.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            fields = line.split()
+            if len(fields) < 8:
+                continue
+            name = os.path.basename(fields[-1])
+            (dirs if fields[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        if overwrite:
+            self._run("-put", "-f", local_path, fs_path)
+        else:
+            self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            # hadoop -get refuses existing targets; honor overwrite locally
+            if os.path.isdir(local_path):
+                import shutil
+
+                shutil.rmtree(local_path)
+            else:
+                os.remove(local_path)
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        if self.is_exist(fs_path):
+            self._run("-rm", "-r", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        elif self.is_exist(fs_dst_path):
+            # `hadoop fs -mv` onto an existing dir silently nests the
+            # source inside it; enforce the FS contract instead
+            raise FSFileExistsError(fs_dst_path)
+        self.rename(fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        self._run("-touchz", fs_path)

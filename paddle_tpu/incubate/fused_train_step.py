@@ -38,13 +38,35 @@ class FusedTrainStep:
     ``step_lr_scheduler=False`` to keep the standard paddle pattern where the
     loop steps the scheduler itself."""
 
-    def __init__(self, model, optimizer, loss_fn=None, step_lr_scheduler=True):
+    _instance_count = 0
+
+    def __init__(self, model, optimizer, loss_fn=None, step_lr_scheduler=True,
+                 shape_buckets=None, bucket_args=None):
+        from ..jit.cache import BucketSpec
+
         from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
 
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._step_lr_scheduler = step_lr_scheduler
+        # pad-up shape buckets (paddle.jit semantics): data inputs are
+        # zero-padded to the nearest registered boundary before dispatch so
+        # a variable-length stream costs O(buckets) compiles, and the
+        # compile/hit counters surface in paddle.jit.cache_stats().
+        # bucket_args (positional indices / kw names) pins WHICH inputs pad;
+        # default is the dominant-length rule — see paddle.jit.to_static.
+        self._shape_buckets = BucketSpec.normalize(shape_buckets)
+        self._bucket_args = (None if bucket_args is None
+                             else frozenset(bucket_args))
+        # per-instance stats row: each FusedTrainStep owns its own jax.jit
+        # cache, so merging instances of one model class would both blur the
+        # counters and false-trigger the recompile-cliff warning (9 steps
+        # compiling once each is not a cliff)
+        FusedTrainStep._instance_count += 1
+        self._stats_name = (f"fused_train_step[{type(model).__name__}"
+                            f"#{FusedTrainStep._instance_count}]")
+        self._seen_sigs = set()
         self._names = sorted(params_dict(model))
         self._tensors = dict(model.named_parameters())
         # trainable params only (stop_gradient=True params stay frozen)
@@ -190,10 +212,7 @@ class FusedTrainStep:
         XLA's HLO cost analysis on the lowered program — self-measured, no
         hand-derived formula. Returns None when the backend provides no
         estimate. Used by bench.py for MFU accounting."""
-        darrs = tuple(d._data if isinstance(d, Tensor) else jnp.asarray(d)
-                      for d in data)
-        karrs = {k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
-                 for k, v in kwdata.items()}
+        darrs, karrs = self._prepare_arrays(data, kwdata, record=False)
         try:
             lowered = self._jitted.lower(
                 self._params, self._m1, self._m2, jnp.float32(1),
@@ -209,13 +228,66 @@ class FusedTrainStep:
         except Exception:
             return None
 
-    def __call__(self, *data, **kwdata):
-        self._step_count += 1
-        lr = jnp.float32(self.optimizer.get_lr())
+    def _prepare_arrays(self, data, kwdata, record=True):
+        """Unwrap call inputs to jax arrays, padding each up to its shape
+        bucket when buckets are registered (per-step or global).
+        ``record=False`` keeps estimation-only callers (lowered_flops) out
+        of the dispatch telemetry."""
+        from ..jit import cache as jit_cache
+
         darrs = tuple(d._data if isinstance(d, Tensor) else jnp.asarray(d)
                       for d in data)
         karrs = {k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
                  for k, v in kwdata.items()}
+        spec = (self._shape_buckets if self._shape_buckets is not None
+                else jit_cache.get_shape_buckets())
+        if spec is not None:
+            # selection: bucket_args pins the padded inputs explicitly;
+            # otherwise the dominant-length rule (jit_cache
+            # .infer_call_lengths) — the first input carrying the bucketed
+            # axis defines the call's length and only matching inputs pad,
+            # so [B, 1] labels / [B, n_features] dense vectors pass through
+            # instead of gaining fabricated zeros. Use bucket_args when a
+            # fixed field's width can coincide with a sequence length.
+            sel = self._bucket_args
+            lengths = (jit_cache.infer_call_lengths(
+                list(darrs) + list(karrs.values()), spec)
+                if sel is None else None)
+            n_pad = 0
+            padded = []
+            for i, a in enumerate(darrs):
+                if sel is None or i in sel:
+                    a, p = jit_cache.pad_array_to_bucket(a, spec, lengths)
+                    n_pad += p
+                padded.append(a)
+            darrs = tuple(padded)
+            for k, a in karrs.items():
+                if sel is None or k in sel:
+                    a, p = jit_cache.pad_array_to_bucket(a, spec, lengths)
+                    n_pad += p
+                    karrs[k] = a
+            if record:
+                jit_cache.record_bucket_pads(self._stats_name, n_pad)
+        return darrs, karrs
+
+    def _count_dispatch(self, darrs, karrs):
+        """Compile-vs-hit telemetry: a shape signature not seen before means
+        jax.jit traces + XLA-compiles a fresh executable this dispatch."""
+        from ..jit import cache as jit_cache
+
+        sig = jit_cache.shape_signature(
+            list(darrs) + [karrs[k] for k in sorted(karrs)])
+        if sig in self._seen_sigs:
+            jit_cache.record_hit(self._stats_name)
+        else:
+            self._seen_sigs.add(sig)
+            jit_cache.record_compile(self._stats_name, sig)
+
+    def __call__(self, *data, **kwdata):
+        self._step_count += 1
+        lr = jnp.float32(self.optimizer.get_lr())
+        darrs, karrs = self._prepare_arrays(data, kwdata)
+        self._count_dispatch(darrs, karrs)
         loss, self._params, self._m1, self._m2 = self._jitted(
             self._params, self._m1, self._m2,
             jnp.float32(self._step_count), lr, darrs, karrs)
@@ -229,9 +301,16 @@ class FusedTrainStep:
         return Tensor._wrap(loss)
 
 
-def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True):
+def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True,
+                     shape_buckets=None, bucket_args=None):
     """Build a fused (single-dispatch, donated) train step callable:
     ``step(*inputs) -> loss``. See FusedTrainStep — with the default
     ``step_lr_scheduler=True`` the step owns LR-scheduler stepping; do not
-    also step it in the loop."""
-    return FusedTrainStep(model, optimizer, loss_fn, step_lr_scheduler)
+    also step it in the loop. ``shape_buckets`` pads inputs up to registered
+    boundaries before dispatch (paddle.jit bucket semantics) so variable
+    shapes cost O(buckets) compiles; ``bucket_args`` (positional indices /
+    kw names) pins which inputs pad when the dominant-length auto rule is
+    ambiguous."""
+    return FusedTrainStep(model, optimizer, loss_fn, step_lr_scheduler,
+                          shape_buckets=shape_buckets,
+                          bucket_args=bucket_args)

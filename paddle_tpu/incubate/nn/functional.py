@@ -292,14 +292,16 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     if transpose_qkv_wb:
         assert num_heads > 0, "num_heads required when transpose_qkv_wb"
         n_heads = num_heads
+        head_dim = embed_dim // n_heads        # [E, 3E] layout implies it
         qkv_w = qkv_weight                     # [E, 3E]
         bias_flat = qkv_bias                   # [3E] or None
     else:
+        # the 4-D layout carries head_dim explicitly and the reference
+        # permits head_dim != embed_dim // num_heads here — keep it
         _, n_heads, head_dim, _ = qkv_weight.shape
         qkv_w = qkv_weight.reshape([3 * n_heads * head_dim, embed_dim]).t()
         bias_flat = (qkv_bias.reshape([3 * n_heads * head_dim])
                      if qkv_bias is not None else None)
-    head_dim = embed_dim // n_heads
 
     residual = x
     h = x

@@ -722,3 +722,9 @@ class ConcatDataset(Dataset):
 
 
 __all__ += ["SubsetRandomSampler", "ConcatDataset"]
+
+# shape-bucketed batching (anti-recompile input pipeline; imported last —
+# bucketing.py subclasses BatchSampler defined above)
+from .bucketing import BucketedBatchSampler, PadToBucket  # noqa: E402,F401
+
+__all__ += ["BucketedBatchSampler", "PadToBucket"]

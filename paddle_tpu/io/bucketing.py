@@ -1,0 +1,269 @@
+"""Shape-bucketed batching: the input-pipeline half of the anti-recompile
+subsystem.
+
+The reference framework absorbs variable-length samples with LoD tensors fed
+through DataFeed (paddle/fluid/framework/data_feed.cc); this XLA-native
+design pads instead, and unpadded variable-length streams trigger one XLA
+compile per distinct shape. ``BucketedBatchSampler`` groups samples by length
+into a small set of buckets and ``PadToBucket`` pads every batch up to its
+bucket boundary (emitting a validity mask), so a whole epoch of varying
+lengths flows through O(buckets) compiled executables — the GSPMD/PaLM-style
+static-shape training pipeline. The jit-side half
+(``paddle.jit.set_shape_buckets`` / ``to_static(shape_buckets=...)``) covers
+callers that cannot change their data pipeline; this module is the
+no-wasted-flops form (batches of similar length pad less).
+
+Both classes compose with the existing ``DataLoader`` machinery unchanged:
+the sampler is a drop-in ``batch_sampler=`` (thread and process workers see
+only index lists) and the collate is a picklable ``collate_fn=`` (spawn
+workers ship it once; shm transport sees plain numpy arrays when
+``as_tensor=False``).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import BatchSampler
+
+__all__ = ["BucketedBatchSampler", "PadToBucket"]
+
+
+def _sample_length(sample):
+    """Default length of one sample: leading dim of its FIRST array field
+    (the conventional ids-first layout). Scalars/strings have no length."""
+    if isinstance(sample, Tensor):
+        return int(sample.shape[0]) if sample.ndim else None
+    if isinstance(sample, np.ndarray):
+        return int(sample.shape[0]) if sample.ndim else None
+    if isinstance(sample, (list, tuple)):
+        for field in sample:
+            n = _sample_length(field)
+            if n is not None:
+                return n
+        return None
+    if isinstance(sample, dict):
+        for field in sample.values():
+            n = _sample_length(field)
+            if n is not None:
+                return n
+        return None
+    return None
+
+
+class BucketedBatchSampler(BatchSampler):
+    """Group sample indices by length into pad-up buckets; every yielded
+    batch draws from ONE bucket, so the padded batch shapes an epoch
+    produces number at most ``len(boundaries) + 1``.
+
+    Arguments:
+        dataset: map-style dataset (indexable).
+        batch_size: samples per batch.
+        boundaries: strictly increasing bucket upper bounds, e.g.
+            ``[64, 128, 256]`` — a sample of length L lands in the first
+            bucket with boundary >= L. Longer samples go to an overflow
+            bucket (batched together but unbucketed in shape: each distinct
+            overflow length still costs a compile, which
+            ``paddle.jit.cache_stats()`` makes visible).
+        lengths: optional per-sample lengths (any sequence). When omitted
+            the dataset is scanned once with ``length_fn`` — pass
+            precomputed lengths for datasets where ``__getitem__`` is
+            expensive.
+        length_fn: sample -> length; defaults to the leading dim of the
+            sample's first array field.
+        shuffle: shuffle samples inside each bucket AND the order of the
+            yielded batches each epoch.
+        drop_last: drop each bucket's trailing partial batch.
+        seed: base seed for shuffling (epoch-invariant streams when set).
+    """
+
+    def __init__(self, dataset=None, batch_size=1, boundaries=None,
+                 lengths=None, length_fn=None, shuffle=False, drop_last=False,
+                 seed=None):
+        if boundaries is None:
+            raise ValueError("BucketedBatchSampler requires bucket "
+                             "boundaries, e.g. boundaries=[64, 128, 256]")
+        self.boundaries = tuple(sorted(int(b) for b in boundaries))
+        if len(set(self.boundaries)) != len(self.boundaries):
+            raise ValueError(f"duplicate boundary in {boundaries}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self._epoch = 0
+        if lengths is None:
+            fn = length_fn or _sample_length
+            lengths = []
+            for i in range(len(dataset)):
+                n = fn(dataset[i])
+                if n is None:
+                    raise ValueError(
+                        f"could not infer a length for sample {i}; pass "
+                        "lengths= or length_fn=")
+                lengths.append(n)
+        self.lengths = [int(x) for x in lengths]
+        # bucket id per sample; len(boundaries) = overflow
+        self._bucket_of = [bisect.bisect_left(self.boundaries, n)
+                           for n in self.lengths]
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    def bucket_histogram(self):
+        """{boundary_or_'overflow': sample_count} — pipeline telemetry
+        (how well the boundaries fit the data)."""
+        hist = {}
+        for b in self._bucket_of:
+            key = (self.boundaries[b] if b < len(self.boundaries)
+                   else "overflow")
+            hist[key] = hist.get(key, 0) + 1
+        return hist
+
+    def __iter__(self):
+        buckets: dict[int, list[int]] = {}
+        order = range(len(self.lengths))
+        rng = None
+        if self.shuffle:
+            rng = np.random.RandomState(
+                None if self.seed is None else self.seed + self._epoch)
+            order = rng.permutation(len(self.lengths))
+        for i in order:
+            buckets.setdefault(self._bucket_of[i], []).append(int(i))
+        batches = []
+        for b in sorted(buckets):
+            idxs = buckets[b]
+            for lo in range(0, len(idxs), self.batch_size):
+                batch = idxs[lo:lo + self.batch_size]
+                if len(batch) < self.batch_size and self.drop_last:
+                    continue
+                batches.append(batch)
+        if self.shuffle:
+            batches = [batches[i] for i in rng.permutation(len(batches))]
+        return iter(batches)
+
+    def __len__(self):
+        counts: dict[int, int] = {}
+        for b in self._bucket_of:
+            counts[b] = counts.get(b, 0) + 1
+        if self.drop_last:
+            return sum(c // self.batch_size for c in counts.values())
+        return sum((c + self.batch_size - 1) // self.batch_size
+                   for c in counts.values())
+
+
+class PadToBucket:
+    """Collate: stack samples, zero-padding each variable-length array field
+    up to the batch's bucket boundary, and append a validity mask.
+
+    Field selection: an array field is padded when its leading dim equals
+    the sample's length (``length_fn``, default: leading dim of the first
+    array field). Pass ``pad_fields`` (tuple indices or dict keys) to make
+    the selection explicit for layouts where fixed-size fields could
+    coincide with the length.
+
+    The mask (1 = real position, 0 = padding, shape ``[B, bucket]``) is
+    appended as the last tuple field / under ``mask_key`` for dict samples.
+    It composes with the jit layer: downstream masked losses make the
+    zero-padding mathematically inert, which is exactly the contract
+    ``paddle.jit`` bucket padding assumes.
+
+    ``as_tensor=False`` keeps the output numpy — required under process
+    workers (the parent cannot unpickle device arrays cheaply, and the shm
+    transport moves numpy only).
+    """
+
+    def __init__(self, boundaries, pad_value=0, with_mask=True,
+                 mask_dtype="float32", mask_key="mask", length_fn=None,
+                 pad_fields=None, as_tensor=True):
+        self.boundaries = tuple(sorted(int(b) for b in boundaries))
+        self.pad_value = pad_value
+        self.with_mask = with_mask
+        self.mask_dtype = mask_dtype
+        self.mask_key = mask_key
+        self.length_fn = length_fn or _sample_length
+        self.pad_fields = pad_fields
+        self.as_tensor = as_tensor
+
+    def _bucket(self, max_len):
+        i = bisect.bisect_left(self.boundaries, max_len)
+        return self.boundaries[i] if i < len(self.boundaries) else max_len
+
+    def _pad_stack(self, arrays, target):
+        out = np.full((len(arrays), target) + tuple(arrays[0].shape[1:]),
+                      self.pad_value, dtype=arrays[0].dtype)
+        for j, a in enumerate(arrays):
+            out[j, :a.shape[0]] = a
+        return out
+
+    def _finish(self, arr):
+        return Tensor(arr) if self.as_tensor else arr
+
+    def __call__(self, samples):
+        samples = [self._to_numpy_tree(s) for s in samples]
+        lengths = [self.length_fn(s) for s in samples]
+        if any(n is None for n in lengths):
+            raise ValueError("PadToBucket could not infer sample lengths; "
+                             "pass length_fn=")
+        target = self._bucket(max(lengths))
+        mask = None
+        if self.with_mask:
+            mask = np.zeros((len(samples), target), dtype=self.mask_dtype)
+            for j, n in enumerate(lengths):
+                mask[j, :min(n, target)] = 1
+
+        first = samples[0]
+        if isinstance(first, dict):
+            out = {k: self._collate_field(
+                       [s[k] for s in samples], lengths,
+                       target, pad=self._should_pad(k, first[k], lengths))
+                   for k in first}
+            if mask is not None:
+                out[self.mask_key] = self._finish(mask)
+            return out
+        if isinstance(first, (list, tuple)):
+            fields = list(zip(*samples))
+            out = [self._collate_field(
+                       list(f), lengths, target,
+                       pad=self._should_pad(i, first[i], lengths))
+                   for i, f in enumerate(fields)]
+            if mask is not None:
+                out.append(self._finish(mask))
+            return out
+        out = self._collate_field(samples, lengths, target, pad=True)
+        if mask is not None:
+            return [out, self._finish(mask)]
+        return out
+
+    # -- helpers --------------------------------------------------------
+    def _to_numpy_tree(self, s):
+        if isinstance(s, Tensor):
+            return np.asarray(s._data)
+        if isinstance(s, (list, tuple)):
+            return type(s)(self._to_numpy_tree(v) for v in s)
+        if isinstance(s, dict):
+            return {k: self._to_numpy_tree(v) for k, v in s.items()}
+        return s
+
+    def _should_pad(self, field_id, field_value, lengths):
+        if self.pad_fields is not None:
+            return field_id in self.pad_fields
+        if not isinstance(field_value, np.ndarray) or field_value.ndim == 0:
+            return False
+        # auto: a field is length-like when its leading dim tracks the
+        # sample length (checked on the first sample)
+        return int(field_value.shape[0]) == lengths[0]
+
+    def _collate_field(self, arrays, lengths, target, pad):
+        if isinstance(arrays[0], np.ndarray):
+            if pad and arrays[0].ndim >= 1:
+                return self._finish(self._pad_stack(arrays, target))
+            return self._finish(np.stack(arrays))
+        if isinstance(arrays[0], (int, float, np.number)):
+            return self._finish(np.asarray(arrays))
+        if isinstance(arrays[0], str):
+            return list(arrays)
+        return list(arrays)

@@ -34,10 +34,16 @@ from ..core.engine import Edge, GradNode
 from ..core.flags import flag_value, register_flag
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
+from ..profiler.utils import RecordEvent
 from ..static.input_spec import InputSpec
+from . import cache as cache_mod
+from .cache import (BucketSpec, cache_stats, get_shape_buckets,  # noqa: F401
+                    reset_cache_stats, set_shape_buckets)
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
-           "enable_to_static", "ignore_module"]
+           "enable_to_static", "ignore_module", "cache_stats",
+           "reset_cache_stats", "set_shape_buckets", "get_shape_buckets",
+           "BucketSpec"]
 
 _TO_STATIC_ENABLED = True
 
@@ -135,38 +141,71 @@ class StaticFunction:
     ``concrete_program``/``rollback`` style helpers exposed minimally.
     """
 
-    def __init__(self, function, input_spec=None, instance=None, **kwargs):
+    def __init__(self, function, input_spec=None, instance=None,
+                 shape_buckets=None, bucket_args=None, **kwargs):
         self._dygraph_function = function
         self._input_spec = input_spec
         self._instance = instance
         self._cache: dict = {}
+        self._shape_buckets = BucketSpec.normalize(shape_buckets)
+        # None = dominant-length auto rule; a set of positional indices /
+        # kw names = pad exactly those inputs (the escape hatch when a
+        # fixed-size field's width can coincide with a sequence length)
+        self._bucket_args = (None if bucket_args is None
+                             else frozenset(bucket_args))
         functools.update_wrapper(self, function)
 
     def __get__(self, instance, owner):
         if instance is None:
             return self
         bound = StaticFunction(self._dygraph_function, self._input_spec,
-                               instance=instance)
+                               instance=instance,
+                               shape_buckets=self._shape_buckets,
+                               bucket_args=self._bucket_args)
         bound._cache = self._cache
         return bound
 
     # ---- cache key ----
-    def _key(self, layer, args, kwargs):
-        def spec(x):
+    def _key(self, layer, args, kwargs, bucket_spec=None, lengths=None,
+             selected=None):
+        """``bucket_spec``/``lengths``/``selected``: shape-level bucketing
+        — the key is computed from the shapes the compiled executable WOULD
+        see, without materializing any padding (the eager-fallback lookup
+        stays allocation-free). Must mirror the bucketize selection exactly:
+        dominant-length rule when ``selected`` is None, otherwise per-leaf
+        pad-up inside the explicitly selected top-level inputs."""
+
+        def spec(x, active=True):
             if isinstance(x, Tensor):
-                return ("T", tuple(x._data.shape), str(x.dtype),
-                        x.stop_gradient)
+                shape = tuple(x._data.shape)
+                if bucket_spec is not None and active and x.stop_gradient:
+                    if selected is None:
+                        shape = cache_mod.bucketed_call_shape(
+                            shape, bucket_spec, lengths)
+                    else:
+                        shape = cache_mod.bucketed_call_shape(
+                            shape, bucket_spec,
+                            cache_mod.infer_call_lengths([x._data],
+                                                         bucket_spec))
+                return ("T", shape, str(x.dtype), x.stop_gradient)
             if isinstance(x, (np.ndarray, jax.Array)):
                 return ("A", tuple(x.shape), str(x.dtype))
             if isinstance(x, (list, tuple)):
-                return tuple(spec(v) for v in x)
+                return tuple(spec(v, active) for v in x)
             if isinstance(x, dict):
-                return tuple(sorted((k, spec(v)) for k, v in x.items()))
+                return tuple(sorted((k, spec(v, active))
+                                    for k, v in x.items()))
             return ("P", x)
 
+        args_spec = tuple(
+            spec(a, selected is None or i in selected)
+            for i, a in enumerate(args))
+        kwargs_spec = tuple(sorted(
+            (k, spec(v, selected is None or k in selected))
+            for k, v in kwargs.items()))
         training = layer.training if isinstance(layer, Layer) else None
         return (id(layer) if layer is not None else 0, training,
-                state.STATE.amp_level, spec(args), spec(kwargs))
+                state.STATE.amp_level, args_spec, kwargs_spec)
 
     def _collect_layer(self):
         inst = self._instance
@@ -181,14 +220,63 @@ class StaticFunction:
             return self._dygraph_function(self._instance, *args, **kwargs)
         return self._dygraph_function(*args, **kwargs)
 
+    @property
+    def _stats_name(self):
+        # qualified name so two layers' `forward` methods don't share a
+        # cache_stats row
+        return getattr(self, "__qualname__", None) or self.__name__
+
+    def _call_eager_counted(self, *args, **kwargs):
+        """Eager (uncompiled) execution of a fallen-back shape key: counted
+        in cache_stats and marked as a profiler span so the 10-100x
+        per-call cliff is visible, not silent."""
+        span = cache_mod.record_eager_fallback(self._stats_name)
+        try:
+            return self._call_eager(*args, **kwargs)
+        finally:
+            span.end()
+
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED:
             return self._call_eager(*args, **kwargs)
+        # eager fallbacks must see the ORIGINAL inputs: padding only pays
+        # inside a compiled executable, and would change user-visible shapes
+        orig_args, orig_kwargs = args, kwargs
+        spec = (self._shape_buckets if self._shape_buckets is not None
+                else get_shape_buckets())
+        selected = self._bucket_args
+        lengths = (cache_mod.infer_tree_lengths((args, kwargs), spec)
+                   if spec is not None and selected is None else None)
         layer = self._collect_layer()
-        key = self._key(layer, args, kwargs)
+        # key from shape-level bucketing: every length inside a bucket
+        # shares one executable, and a known-eager key short-circuits
+        # below WITHOUT ever materializing pad copies
+        key = self._key(layer, args, kwargs, spec, lengths, selected)
         entry = self._cache.get(key)
         if entry == "eager":  # earlier fallback for this shape key
-            return self._call_eager(*args, **kwargs)
+            return self._call_eager_counted(*orig_args, **orig_kwargs)
+        if spec is not None:
+            if selected is None:
+                (args, kwargs), n_pad = cache_mod.bucketize_tree(
+                    (args, kwargs), spec, lengths)
+            else:
+                n_pad = 0
+                new_args = list(args)
+                for i in range(len(new_args)):
+                    if i in selected:
+                        new_args[i], n = cache_mod.bucketize_tree(
+                            new_args[i], spec, per_leaf=True)
+                        n_pad += n
+                args = tuple(new_args)
+                kwargs = dict(kwargs)
+                for k in list(kwargs):
+                    if k in selected:
+                        kwargs[k], n = cache_mod.bucketize_tree(
+                            kwargs[k], spec, per_leaf=True)
+                        n_pad += n
+            cache_mod.record_bucket_pads(self._stats_name, n_pad)
+        if entry is not None:
+            cache_mod.record_hit(self._stats_name)
 
         # flatten dynamic (tensor) leaves out of args
         flat_args, arg_tree = jax.tree.flatten(
@@ -203,7 +291,11 @@ class StaticFunction:
 
         if entry is None:
             try:
-                entry = self._trace(layer, arg_tree, flat_args, dyn_idx)
+                with RecordEvent(f"jit::compile::{self.__name__}"):
+                    entry = self._trace(layer, arg_tree, flat_args, dyn_idx)
+                cache_mod.record_compile(
+                    self._stats_name,
+                    cache_mod.shape_signature(dyn_arrays))
             except _TRACER_LEAK_ERRORS as e:
                 msg = _tracer_leak_message(self.__name__, e)
                 if not flag_value("to_static_fallback", True):
@@ -221,7 +313,7 @@ class StaticFunction:
             self._cache[key] = entry
 
         if entry == "eager":
-            return self._call_eager(*args, **kwargs)
+            return self._call_eager_counted(*orig_args, **orig_kwargs)
 
         params = entry.params
         key_arr = rng_mod.DEFAULT_GENERATOR.next_key()
@@ -338,8 +430,23 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """Reference: python/paddle/jit/api.py:171."""
+              backend=None, shape_buckets=None, bucket_args=None, **kwargs):
+    """Reference: python/paddle/jit/api.py:171.
+
+    ``shape_buckets`` (extension): pad-up bucket boundaries applied to the
+    inputs before the compile-cache lookup — ``[64, 128, 256]`` buckets axis
+    1, ``{axis: boundaries}`` is explicit. Caps the compile count for
+    variable-length streams at O(buckets); see paddle.jit.set_shape_buckets
+    for the process-global form and paddle.jit.cache_stats() for telemetry.
+
+    ``bucket_args``: which inputs to pad. Default (None) is the
+    dominant-length rule — the first tensor carrying the bucketed axis
+    defines the call's length, and only tensors matching it pad. Pass an
+    iterable of positional indices / kw names when a fixed-size field's
+    width can coincide with a sequence length (e.g. 13 dense features and
+    seq_len 13), which would otherwise mis-pad that field on exactly that
+    length.
+    """
 
     def decorate(fn):
         if isinstance(fn, Layer):
@@ -347,12 +454,15 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             # we return a layer-like callable
             if getattr(type(fn).forward, "_not_to_static", False):
                 return fn
-            sf = StaticFunction(type(fn).forward, input_spec, instance=fn)
+            sf = StaticFunction(type(fn).forward, input_spec, instance=fn,
+                                shape_buckets=shape_buckets,
+                                bucket_args=bucket_args)
             fn.forward = sf
             return fn
         if getattr(fn, "_not_to_static", False):
             return fn
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, shape_buckets=shape_buckets,
+                              bucket_args=bucket_args)
 
     if function is not None:
         return decorate(function)

@@ -7,8 +7,8 @@ namespace re-exports.
 TPU-native redesign: the reference's static builders create parameters
 inside the Program's startup block; here static mode is eager-with-tape
 (static/__init__.py), so each builder keeps its parameters in a persistent
-layer registry keyed by (api, name, weight shape) — repeat calls with the
-same key reuse the same parameters, matching the Program's
+layer registry keyed by (api, name, weight shape, attr digest) — repeat
+calls with the same key reuse the same parameters, matching the Program's
 create-once-then-run semantics. ``paddle.static.nn.reset_parameters()``
 clears the registry (a fresh startup program).
 
@@ -19,6 +19,8 @@ with that guidance.
 """
 
 from __future__ import annotations
+
+import weakref
 
 import numpy as np
 
@@ -62,8 +64,50 @@ def _hp(v):
     return v
 
 
-def _get_layer(api, name, key, build):
-    k = (api, name, _hp(key))
+_ATTR_DIGEST_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _attr_digest(v):
+    """Hashable digest of a weight_attr/bias_attr/param_attr config (None,
+    bool, str name, ParamAttr, Initializer, regularizer, Assign arrays).
+    Folded into the registry key so two same-shape unnamed calls with
+    DIFFERENT initializers get distinct parameters — attrs are
+    math-affecting hyperparameters like every other key component."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_attr_digest(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _attr_digest(x)) for k, x in v.items()))
+    if isinstance(v, Tensor):
+        v = np.asarray(v._data)
+    if isinstance(v, np.ndarray) or (hasattr(v, "shape")
+                                     and hasattr(v, "dtype")):
+        v = np.asarray(v)
+        return ("ndarray", v.shape, str(v.dtype), hash(v.tobytes()))
+    state = getattr(v, "__dict__", None)
+    if state:
+        # memoize per live object: an Assign initializer wrapping a large
+        # pretrained matrix would otherwise be re-hashed (O(bytes)) on
+        # EVERY builder call, and builders run once per forward step.
+        # Mutating an attr object after first use is not supported (same
+        # contract as reusing it across layers).
+        try:
+            return _ATTR_DIGEST_MEMO[v]
+        except (KeyError, TypeError):
+            pass
+        dig = (type(v).__name__,) + tuple(
+            (k, _attr_digest(x)) for k, x in sorted(state.items()))
+        try:
+            _ATTR_DIGEST_MEMO[v] = dig
+        except TypeError:
+            pass
+        return dig
+    return type(v).__name__
+
+
+def _get_layer(api, name, key, build, attrs=()):
+    k = (api, name, _hp(key), _attr_digest(attrs))
     layer = _REGISTRY.get(k)
     if layer is None:
         # Layer creation must be CONCRETE even when the builder is first hit
@@ -115,7 +159,8 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
         layer = _get_layer(
             "fc", name, (i, in_features, size),
             lambda: nn.Linear(in_features, size, weight_attr=weight_attr,
-                              bias_attr=bias_attr if i == 0 else False))
+                              bias_attr=bias_attr if i == 0 else False),
+            attrs=(weight_attr, bias_attr))
         outs.append(layer(flat))
     out = outs[0]
     for o in outs[1:]:
@@ -131,7 +176,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     layer = _get_layer(
         "embedding", name, (tuple(size), padding_idx, is_sparse),
         lambda: nn.Embedding(size[0], size[1], padding_idx=padding_idx,
-                             sparse=is_sparse, weight_attr=param_attr))
+                             sparse=is_sparse, weight_attr=param_attr),
+        attrs=(param_attr,))
     return layer(input)
 
 
@@ -165,7 +211,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
         lambda: nn.BatchNorm(num_channels, momentum=momentum,
                              epsilon=epsilon, weight_attr=param_attr,
                              bias_attr=bias_attr, data_format=data_layout,
-                             use_global_stats=use_global_stats))
+                             use_global_stats=use_global_stats),
+        attrs=(param_attr, bias_attr))
     layer.training = not is_test
     out = layer(input)
     if act is not None:
@@ -182,7 +229,8 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
         "layer_norm", name, (tuple(normalized_shape), epsilon, scale, shift),
         lambda: nn.LayerNorm(normalized_shape, epsilon=epsilon,
                              weight_attr=param_attr if scale else False,
-                             bias_attr=bias_attr if shift else False))
+                             bias_attr=bias_attr if shift else False),
+        attrs=(param_attr, bias_attr))
     out = layer(input)
     if act is not None:
         out = getattr(F, act)(out)
@@ -198,7 +246,8 @@ def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
         "group_norm", name, (groups, num_channels, data_layout, epsilon),
         lambda: nn.GroupNorm(groups, num_channels, epsilon=epsilon,
                              weight_attr=param_attr, bias_attr=bias_attr,
-                             data_format=data_layout))
+                             data_format=data_layout),
+        attrs=(param_attr, bias_attr))
     out = layer(input)
     if act is not None:
         out = getattr(F, act)(out)
@@ -214,7 +263,8 @@ def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
     layer = _get_layer(
         "instance_norm", name, (num_channels, len(input.shape), epsilon),
         lambda: cls(num_channels, epsilon=epsilon, weight_attr=param_attr,
-                    bias_attr=bias_attr))
+                    bias_attr=bias_attr),
+        attrs=(param_attr, bias_attr))
     return layer(input)
 
 
@@ -253,7 +303,8 @@ def _conv_nd(api, cls, input, num_filters, filter_size, stride, padding,
         api, name, (in_ch, num_filters, tuple(np.atleast_1d(filter_size)),
                     data_format, stride, padding, dilation, groups,
                     output_padding),
-        lambda: cls(in_ch, num_filters, filter_size, **kw))
+        lambda: cls(in_ch, num_filters, filter_size, **kw),
+        attrs=(param_attr, bias_attr))
     out = layer(input)
     if act is not None:
         out = getattr(F, act)(out)
@@ -318,7 +369,8 @@ def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
     layer = _get_layer(
         "prelu", name, (mode, num),
         lambda: nn.PReLU(num_parameters=num, weight_attr=param_attr,
-                         data_format=data_format))
+                         data_format=data_format),
+        attrs=(param_attr,))
     return layer(x)
 
 
@@ -328,7 +380,8 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
     layer = _get_layer(
         "bilinear_tensor_product", name, (x.shape[-1], y.shape[-1], size),
         lambda: nn.Bilinear(x.shape[-1], y.shape[-1], size,
-                            weight_attr=param_attr, bias_attr=bias_attr))
+                            weight_attr=param_attr, bias_attr=bias_attr),
+        attrs=(param_attr, bias_attr))
     out = layer(x, y)
     if act is not None:
         out = getattr(F, act)(out)
@@ -360,7 +413,8 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
                              padding=padding, dilation=dilation,
                              groups=groups,
                              deformable_groups=deformable_groups,
-                             weight_attr=param_attr, bias_attr=bias_attr))
+                             weight_attr=param_attr, bias_attr=bias_attr),
+        attrs=(param_attr, bias_attr))
     return layer(x, offset, mask)
 
 
@@ -382,7 +436,8 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     layer = _get_layer(
         "nce", name, (num_total_classes, dim),
         lambda: nn.Linear(dim, num_total_classes, weight_attr=param_attr,
-                          bias_attr=bias_attr))
+                          bias_attr=bias_attr),
+        attrs=(param_attr, bias_attr))
     logits = layer(input)  # [B, C]
     label_flat = label.reshape([-1])
     key = rng_mod.DEFAULT_GENERATOR.next_key()
@@ -403,7 +458,8 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
 def _row_conv_fn(x_a, w_a):
     import jax.numpy as jnp
 
-    # x: [B, T, D] (or [T, D]); slide a future-context window over T
+    # x: [B, T, D] (or [T, D]); w: [k, D] per-feature filter — slide a
+    # future-context window over T, each feature with its own weights
     squeeze = x_a.ndim == 2
     if squeeze:
         x_a = x_a[None]
@@ -419,14 +475,17 @@ _row_conv_op = _dispatch_op("row_conv")(_row_conv_fn)
 def row_conv(input, future_context_size, param_attr=None, act=None):
     """Lookahead row convolution (DeepSpeech2).
 
-    Reference: python/paddle/static/nn/common.py:3386. out[t] = sum_{i=0..k}
-    in[t+i] * w[i] — a depthwise conv over the future context window."""
+    Reference: python/paddle/static/nn/common.py:3386. out[t, d] =
+    sum_{i=0..k-1} in[t+i, d] * w[i, d] — a depthwise conv over the future
+    context window with the reference's [future_context_size + 1, D]
+    per-feature filter."""
     d = input.shape[-1]
     k = future_context_size + 1
     layer = _get_layer(
         "row_conv", None, (d, k),
-        lambda: nn.Linear(k, 1, bias_attr=False, weight_attr=param_attr))
-    out = _row_conv_op(input, layer.weight.reshape([k]))
+        lambda: nn.Linear(k, d, bias_attr=False, weight_attr=param_attr),
+        attrs=(param_attr,))
+    out = _row_conv_op(input, layer.weight)
     if act is not None:
         out = getattr(F, act)(out)
     return out

@@ -1,0 +1,218 @@
+"""Shape-bucketing A/B harness + probe (ISSUE 1 tentpole, PERF.md
+discipline).
+
+Drives ONE variable-length token stream through a fused BERT-style train
+step under three input-pipeline policies:
+
+  naive     exact-length padding, shuffled batches — one XLA compile per
+            distinct batch shape (the recompile-per-shape cliff)
+  jit       same naive batches, buckets registered on the jit side only
+            (paddle.jit pad-up semantics) — compile count capped, but pad
+            waste is whatever the bucket rounding costs
+  pipeline  BucketedBatchSampler + PadToBucket — compile count capped AND
+            batches pad only to their own bucket (least wasted flops)
+
+Each arm reports wall tokens/s over REAL tokens actually processed
+(counted in-loop, so drop_last'ed partial batches never inflate the
+number) with compile time included — the cliff is the effect under test —
+plus the compile/hit/pad counters from paddle.jit.cache_stats().
+
+The harness (``varlen_dataset`` / ``build_step`` / ``run_stream``) is also
+imported by bench.py's ``bert_varlen`` workload so the bench line and this
+probe can never drift apart.
+
+Usage:
+  python scripts/bench_bucketing.py [--boundaries 96,160,232]
+      [--lengths 72:232:16] [--batch-size 32] [--epochs 2] [--tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def varlen_dataset(cfg, lengths, samples_per_len, seed=0):
+    """Map-style (ids[L], label) dataset covering every length in
+    ``lengths`` ``samples_per_len`` times."""
+    from paddle_tpu import io
+
+    rng = np.random.RandomState(seed)
+
+    class VarLenDS(io.Dataset):
+        def __init__(self):
+            self.samples = [
+                (rng.randint(1, cfg.vocab_size, (L,)).astype(np.int64),
+                 np.int64(rng.randint(0, cfg.num_labels)))
+                for L in lengths for _ in range(samples_per_len)]
+
+        def __len__(self):
+            return len(self.samples)
+
+        def __getitem__(self, i):
+            return self.samples[i]
+
+    return VarLenDS()
+
+
+def build_step(cfg, on_tpu, shape_buckets=None):
+    """Fused BERT fine-tune train step (AdamW, bf16 on TPU)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import BertForSequenceClassification
+
+    m = BertForSequenceClassification(cfg)
+    if on_tpu:
+        m.bfloat16()
+    m.train()
+    opt = paddle.optimizer.AdamW(learning_rate=2e-5,
+                                 parameters=m.parameters())
+    return paddle.incubate.fused_train_step(
+        m, opt, loss_fn=lambda o: o[0], shape_buckets=shape_buckets)
+
+
+def run_stream(raw, ds, bs, boundaries, arm, epochs):
+    """Drive the whole stream through ``raw`` under one pipeline policy.
+
+    Tokens (real AND padded) are counted in the loop over the batches that
+    actually dispatch — drop_last'ed samples never enter either count, so
+    tokens/s and pad_waste stay honest for any batch-size/bucket sizing.
+    """
+    from paddle_tpu import io, jit
+
+    jit.reset_cache_stats()
+    spec = jit.BucketSpec.normalize(boundaries)
+    if arm == "pipeline":
+        sampler = io.BucketedBatchSampler(
+            ds, batch_size=bs, boundaries=boundaries, shuffle=True,
+            seed=0, drop_last=True)
+        collate = io.PadToBucket(boundaries, with_mask=False)
+        hist = sampler.bucket_histogram()
+    else:
+        sampler = io.BatchSampler(ds, batch_size=bs, shuffle=True,
+                                  drop_last=True)
+        collate = io.PadToBucket([], with_mask=False)  # exact-length pad
+        hist = None
+    loader = io.DataLoader(ds, batch_sampler=sampler, collate_fn=collate)
+    loss, real_tokens, padded_tokens = None, 0, 0
+    t0 = time.perf_counter()
+    for epoch in range(epochs):
+        if hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+        for ids, labels in loader:
+            # samples draw ids from [1, vocab) and pad with 0, so nonzero
+            # entries are exactly the real tokens of THIS batch
+            real_tokens += int((ids.numpy() != 0).sum())
+            w = ids.shape[1]
+            if arm == "jit":
+                # jit-side pad-up happens inside the step; account the
+                # width the executable actually sees, computed through the
+                # code under test (BucketSpec), not a re-implementation
+                w = spec.bucketed_dim(1, w)
+            padded_tokens += ids.shape[0] * w
+            loss = raw(ids.astype("int32"), labels=labels)
+    float(loss.numpy())
+    wall = time.perf_counter() - t0
+    stats = jit.cache_stats(raw._stats_name) or {}
+    rec = {
+        "arm": arm,
+        "tokens_per_sec": round(real_tokens / wall, 1),
+        "wall_s": round(wall, 2),
+        "real_tokens": real_tokens,
+        "pad_waste": round(1.0 - real_tokens / max(padded_tokens, 1), 4),
+        "compiles": stats.get("compiles", 0),
+        "hits": stats.get("hits", 0),
+        "bucket_pads": stats.get("bucket_pads", 0),
+        "per_shape_misses": stats.get("per_shape_misses", {}),
+    }
+    if hist is not None:
+        rec["bucket_histogram"] = {str(k): v for k, v in hist.items()}
+    return rec
+
+
+def default_sizing(tiny):
+    """(cfg, bs, lengths, boundaries, samples_per_len) shared by this probe
+    and bench.py bert_varlen."""
+    from paddle_tpu.models import bert_base, bert_tiny
+
+    cfg = bert_tiny() if tiny else bert_base()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    bs = 4 if tiny else 32
+    lengths = list(range(8, 28, 2)) if tiny else list(range(72, 232, 16))
+    boundaries = [12, 20, 28] if tiny else [96, 160, 232]
+    samples_per_len = bs * (1 if tiny else 2)
+    return cfg, bs, lengths, boundaries, samples_per_len
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--boundaries", default=None,
+                   help="comma-separated bucket boundaries")
+    p.add_argument("--lengths", default=None,
+                   help="lo:hi:step sample-length range")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--samples-per-len", type=int, default=None)
+    p.add_argument("--tiny", action="store_true",
+                   help="force bert_tiny sizing (default on CPU)")
+    args = p.parse_args()
+
+    import paddle_tpu as paddle
+
+    on_tpu = True
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        pass
+    tiny = args.tiny or not on_tpu
+
+    cfg, bs, lengths, boundaries, samples_per_len = default_sizing(tiny)
+    if args.batch_size:
+        bs = args.batch_size
+    if args.lengths:
+        lo, hi, step = (int(x) for x in args.lengths.split(":"))
+        lengths = list(range(lo, hi, step))
+    if args.boundaries:
+        boundaries = [int(x) for x in args.boundaries.split(",")]
+    if args.samples_per_len:
+        samples_per_len = args.samples_per_len
+
+    paddle.seed(0)
+    ds = varlen_dataset(cfg, lengths, samples_per_len)
+
+    print(json.dumps({
+        "config": {"model": "bert_tiny" if tiny else "bert_base",
+                   "batch_size": bs,
+                   "lengths": f"{lengths[0]}..{lengths[-1]}",
+                   "distinct_lengths": len(lengths),
+                   "boundaries": boundaries, "epochs": args.epochs,
+                   "samples": len(ds)}}))
+    arms = {}
+    for arm in ("naive", "jit", "pipeline"):
+        raw = build_step(cfg, on_tpu,
+                         shape_buckets=boundaries if arm == "jit" else None)
+        arms[arm] = run_stream(raw, ds, bs, boundaries, arm, args.epochs)
+        print(json.dumps(arms[arm]))
+    print(json.dumps({
+        "summary": {
+            "speedup_jit_vs_naive": round(
+                arms["jit"]["tokens_per_sec"]
+                / arms["naive"]["tokens_per_sec"], 3),
+            "speedup_pipeline_vs_naive": round(
+                arms["pipeline"]["tokens_per_sec"]
+                / arms["naive"]["tokens_per_sec"], 3),
+            "compiles": {a: arms[a]["compiles"] for a in arms},
+        }}))
+
+
+if __name__ == "__main__":
+    main()

@@ -366,3 +366,80 @@ class TestAmpDebugging:
         assert report[0]["max_abs_err"] > 0.0  # bf16 really differs
         import os
         assert os.path.exists(csvf)
+
+
+class TestRound6AdviceFixes:
+    def test_row_conv_per_feature_filter(self):
+        """row_conv must use the reference [future_context+1, D] filter:
+        each feature dim has its own context weights."""
+        from paddle_tpu.static import nn as snn
+        from paddle_tpu.static.nn import common as snn_common
+
+        snn.reset_parameters()
+        B, T, D, fc_size = 2, 6, 4, 2
+        x = paddle.to_tensor(np.random.randn(B, T, D).astype("float32"))
+        out = snn.row_conv(x, fc_size)
+        assert out.shape == [B, T, D]
+        params = snn_common.parameters()
+        assert len(params) == 1
+        w = params[0]
+        assert list(w.shape) == [fc_size + 1, D]
+        # oracle: out[b, t, d] = sum_i x[b, t+i, d] * w[i, d]
+        xn, wn = x.numpy(), w.numpy()
+        k = fc_size + 1
+        pad = np.concatenate([xn, np.zeros((B, k - 1, D), np.float32)], 1)
+        ref = sum(pad[:, i:i + T] * wn[i] for i in range(k))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+        snn.reset_parameters()
+
+    def test_to_device_preserves_flags(self):
+        t = paddle.to_tensor(np.random.randn(3, 3).astype("float32"))
+        t.stop_gradient = False
+        t.persistable = True
+        moved = t.cpu()
+        assert moved.stop_gradient is False
+        assert moved.persistable is True
+        assert moved.name == t.name
+        np.testing.assert_array_equal(moved.numpy(), t.numpy())
+
+    def test_fused_mha_keeps_explicit_head_dim(self):
+        """Non-transpose qkv layout: head_dim comes from qkv_weight.shape
+        and may differ from embed_dim // num_heads."""
+        import paddle_tpu.incubate.nn.functional as IF
+
+        b, s, e = 2, 5, 8
+        n_heads, head_dim = 2, 6  # != e // n_heads
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(b, s, e).astype("float32"))
+        qkv_w = paddle.to_tensor(
+            rng.randn(3, n_heads, head_dim, e).astype("float32") * 0.1)
+        lin_w = paddle.to_tensor(
+            rng.randn(n_heads * head_dim, e).astype("float32") * 0.1)
+        out = IF.fused_multi_head_attention(
+            x, qkv_w, lin_w, pre_layer_norm=True,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        assert out.shape == [b, s, e]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_builder_registry_distinguishes_attrs(self):
+        """Same-shape unnamed builder calls with different initializers
+        must NOT share parameters."""
+        from paddle_tpu.static import nn as snn
+        from paddle_tpu.static.nn import common as snn_common
+
+        snn.reset_parameters()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        zeros = paddle.ParamAttr(
+            initializer=nn.initializer.Constant(0.0))
+        ones = paddle.ParamAttr(
+            initializer=nn.initializer.Constant(1.0))
+        out0 = snn.fc(x, 3, weight_attr=zeros, bias_attr=False)
+        out1 = snn.fc(x, 3, weight_attr=ones, bias_attr=False)
+        assert len(snn_common.parameters()) == 2
+        np.testing.assert_array_equal(out0.numpy(), 0.0)
+        np.testing.assert_allclose(out1.numpy(), 4.0, rtol=1e-6)
+        # repeat call with the SAME attr config still reuses its layer
+        out0b = snn.fc(x, 3, weight_attr=zeros, bias_attr=False)
+        assert len(snn_common.parameters()) == 2
+        np.testing.assert_array_equal(out0b.numpy(), out0.numpy())
+        snn.reset_parameters()
