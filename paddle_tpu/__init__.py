@@ -59,7 +59,7 @@ from . import audio  # noqa: F401
 from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
-from .framework.io import load, save  # noqa: F401
+from .framework.io import CheckpointCorruptionError, load, save  # noqa: F401
 
 
 def in_dynamic_mode():
@@ -96,6 +96,7 @@ from .core.rng import (  # noqa: F401,E402
     set_rng_state as set_cuda_rng_state,
 )
 from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .distributed.checkpoint.manager import CheckpointManager  # noqa: F401,E402
 
 #: paddle.dtype — callable canonicalizer (the reference exposes the VarType
 #: class; under JAX a dtype IS its canonical string/np form)

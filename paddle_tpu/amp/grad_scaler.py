@@ -113,9 +113,24 @@ class AmpScaler:
         }
 
     def load_state_dict(self, sd):
-        self._scale = sd.get("scale", self._scale)
-        self._good_steps = sd.get("good_steps", 0)
-        self._bad_steps = sd.get("bad_steps", 0)
+        """Complete round-trip of state_dict: a resumed job keeps not just
+        the current scale but its whole scaling *schedule* (ratios, window
+        lengths, dynamic on/off) — dropping those silently reverts a tuned
+        job to constructor defaults after every restart."""
+        def _f(v):
+            return float(v.item()) if hasattr(v, "item") else float(v)
+
+        self._scale = _f(sd.get("scale", self._scale))
+        self._incr_ratio = _f(sd.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = _f(sd.get("decr_ratio", self._decr_ratio))
+        self._incr_every_n_steps = int(
+            sd.get("incr_every_n_steps", self._incr_every_n_steps))
+        self._decr_every_n_nan_or_inf = int(
+            sd.get("decr_every_n_nan_or_inf", self._decr_every_n_nan_or_inf))
+        self._use_dynamic = bool(
+            sd.get("use_dynamic_loss_scaling", self._use_dynamic))
+        self._good_steps = int(sd.get("good_steps", 0))
+        self._bad_steps = int(sd.get("bad_steps", 0))
 
     set_state_dict = load_state_dict
 

@@ -46,9 +46,14 @@ class _Flag:
         return self.type(v)
 
     def set(self, v):
+        old = self.value
         self.value = self._coerce(v)
         if self.on_change is not None:
-            self.on_change(self.value)
+            try:
+                self.on_change(self.value)
+            except BaseException:
+                self.value = old  # a rejecting validator must not leave
+                raise             # the invalid value installed
 
 
 _REGISTRY: dict[str, _Flag] = {}
@@ -129,6 +134,29 @@ register_flag(
 register_flag(
     "benchmark", False,
     help="block on every eager op (device sync) for accurate per-op timing")
+
+register_flag(
+    "ckpt_save_retries", 3,
+    help="retries for transient OSErrors on checkpoint writes (paddle.save, "
+         "distributed shard writes, LocalFS renames) with exponential "
+         "backoff + jitter; 0 disables retrying")
+
+
+def _validate_nan_action(v):
+    if v not in ("none", "warn", "skip", "raise"):
+        raise ValueError(
+            f"FLAGS_check_nan_inf_action must be one of "
+            f"none/warn/skip/raise, got {v!r}")
+
+
+register_flag(
+    "check_nan_inf_action", "none",
+    help="FusedTrainStep step-guard action when loss/grads go non-finite: "
+         "'none' (guard off, no per-step host sync), 'warn' (warn and apply "
+         "the update), 'skip' (discard the update, keep params/moments, "
+         "back off an attached GradScaler), 'raise' (discard the update and "
+         "raise FloatingPointError)",
+    on_change=_validate_nan_action)
 
 # ---- accepted-but-inert reference flags (XLA owns this machinery) ----------
 

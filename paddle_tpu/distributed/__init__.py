@@ -23,6 +23,7 @@ __all__ = [
     "shard_layer", "shard_optimizer", "dtensor_from_fn", "unshard_dtensor",
     "ProcessMesh", "Shard", "Replicate", "Partial", "get_mesh", "set_mesh",
     "spawn", "launch", "save_state_dict", "load_state_dict",
+    "CheckpointManager",
 ]
 
 _initialized = False
@@ -105,7 +106,8 @@ from .parallel import DataParallel  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
 from . import ps  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
-from .checkpoint import load_state_dict, save_state_dict  # noqa: E402,F401
+from .checkpoint import (  # noqa: E402,F401
+    CheckpointManager, load_state_dict, save_state_dict)
 from .collective import destroy_process_group, is_available  # noqa: E402,F401
 from .compat import (  # noqa: E402,F401
     CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,

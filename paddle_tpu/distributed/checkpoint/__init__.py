@@ -20,6 +20,16 @@ same-mesh load.
 Extended dtypes (bfloat16, float8_*) are stored as same-width unsigned
 integers — ``np.savez`` silently degrades ml_dtypes arrays to void — and
 reinterpreted on load via the dtype string recorded in the metadata.
+
+Commit protocol (v3 layout): every shard payload and metadata fragment is
+written tmp → fsync → atomic rename, each shard entry records a CRC32 of its
+raw bytes in the rank's metadata fragment, and the coordinator writes a
+``COMMIT`` sentinel (recording the saving world size) strictly last. A
+directory without ``COMMIT`` is a torn save: ``load_state_dict`` raises
+:class:`CheckpointCorruptionError` instead of silently zero-filling, and
+``CheckpointManager.latest_valid_step`` skips it. CRC mismatches and
+unreadable npz members raise the same typed error. Transient ``OSError``s
+during the write retry with backoff (``FLAGS_ckpt_save_retries``).
 """
 
 from __future__ import annotations
@@ -27,13 +37,19 @@ from __future__ import annotations
 import json
 import os
 import threading
+import zlib
 
 import numpy as np
 import jax
 
 from ...core.tensor import Tensor
+from ...framework.io import CheckpointCorruptionError
 
-__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle"]
+__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
+           "CheckpointManager", "CheckpointCorruptionError", "is_committed",
+           "verify_checkpoint"]
+
+COMMIT_FILE = "COMMIT"
 
 
 class AsyncSaveHandle:
@@ -119,6 +135,35 @@ def _storable(data):
     return data.view(_UINT_FOR_WIDTH[dt.itemsize]), dt.name
 
 
+def _atomic_json(obj, dest, fire_site=None):
+    from ...utils.retry import atomic_write
+
+    atomic_write(dest, lambda f: f.write(json.dumps(obj).encode()),
+                 fire_site=fire_site)
+
+
+def _write_commit(path, world_size=1):
+    """Publish the COMMIT sentinel — written strictly after every shard and
+    metadata fragment of this save is durable. Records the saving world size
+    so readers can detect a missing rank's fragment."""
+    _atomic_json({"version": 3, "world_size": int(world_size)},
+                 os.path.join(path, COMMIT_FILE))
+
+
+def is_committed(path):
+    """True iff ``path`` holds a committed checkpoint (COMMIT present and
+    parseable)."""
+    return _read_commit(path) is not None
+
+
+def _read_commit(path):
+    try:
+        with open(os.path.join(path, COMMIT_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     """Reference save_state_dict.py:104. With ``async_save=True`` the
@@ -129,7 +174,24 @@ def save_state_dict(state_dict, path, process_group=None,
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     nprocs = jax.process_count()
+    if async_save and nprocs > 1:
+        # the commit protocol needs collectives (prepare barrier + success
+        # allgather) and collectives from a background thread can interleave
+        # with main-thread training collectives across processes — downgrade
+        # to a synchronous save rather than risk a cross-host hang
+        import warnings
+
+        warnings.warn(
+            "async_save is downgraded to a synchronous save in "
+            "multi-process runs (the commit protocol's collectives must "
+            "stay on the main thread)", stacklevel=2)
+        async_save = False
     if rank == coordinator_rank:
+        # retract the previous save's COMMIT first: while this save is
+        # rewriting shards the directory must not read as committed
+        commit_p = os.path.join(path, COMMIT_FILE)
+        if os.path.exists(commit_p):
+            os.remove(commit_p)
         # remove fragments from a previous save with more ranks — they are
         # not overwritten below and _merged_metadata would read stale shards
         import re
@@ -138,7 +200,14 @@ def save_state_dict(state_dict, path, process_group=None,
             m = re.match(r"rank(\d+)\.(npz|meta\.json)$", fn)
             if m and int(m.group(1)) >= nprocs:
                 os.remove(os.path.join(path, fn))
-    fragment = {"state": {}, "version": 2, "rank": rank,
+    if nprocs > 1:
+        # no rank may overwrite shards until the coordinator has retracted
+        # the previous COMMIT — otherwise a coordinator killed pre-retract
+        # leaves an old COMMIT certifying a mix of old and new shards
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_prepare:{path}")
+    fragment = {"state": {}, "version": 3, "rank": rank,
                 "world_size": nprocs}
     payload = {}
     for name, t in state_dict.items():
@@ -165,29 +234,63 @@ def save_state_dict(state_dict, path, process_group=None,
                          for s in sh.index]
                 data, true_dtype = _storable(np.asarray(sh.data))
                 payload[key] = data
-                saved.append({"key": key, "index": index})
+                saved.append({"key": key, "index": index,
+                              "crc32": zlib.crc32(data.tobytes())})
         else:
             key = f"{name}@r{rank}full"
             data, true_dtype = _storable(np.asarray(arr))
             payload[key] = data
-            saved.append({"key": key, "index": None})
+            saved.append({"key": key, "index": None,
+                          "crc32": zlib.crc32(data.tobytes())})
         fragment["state"][name] = {
             "global_shape": list(np.shape(arr)),
             "dtype": true_dtype,
             "shards": saved,
         }
     def write():
+        from ...utils.retry import atomic_write, retry_os
+
         # payload arrays are host copies (np.asarray above) — training may
-        # have moved on; write shards first, metadata fragments last so a
-        # reader that sees the fragment also sees its shards
-        np.savez(os.path.join(path, f"rank{rank}.npz"), **payload)
-        with open(os.path.join(path, f"rank{rank}.meta.json"), "w") as f:
-            json.dump(fragment, f)
-        if rank == coordinator_rank:
+        # have moved on; write order is the commit protocol: shards, then
+        # metadata fragments, then COMMIT — a reader that sees COMMIT sees
+        # everything, and each file lands via tmp+fsync+rename
+        err = None
+        try:
+            retry_os(lambda: atomic_write(
+                os.path.join(path, f"rank{rank}.npz"),
+                lambda f: np.savez(f, **payload),
+                fire_site="ckpt.shard_write"))
+            retry_os(lambda: _atomic_json(
+                fragment, os.path.join(path, f"rank{rank}.meta.json")))
+        except Exception as e:
+            err = e  # must still reach the collective below — a rank that
+            #          bails early would hang every other rank
+        if nprocs > 1:
+            # COMMIT certifies EVERY rank's files, so the coordinator may
+            # only commit after all ranks report a durable write (Orbax
+            # runs the same sync before its commit marker); the allgather
+            # doubles as the barrier and carries each rank's success flag.
+            # Single-host saves skip the collective entirely.
+            from jax.experimental import multihost_utils
+
+            all_ok = bool(np.all(multihost_utils.process_allgather(
+                np.asarray([err is None]))))
+        else:
+            all_ok = err is None
+        if err is not None:
+            raise err
+        if not all_ok:
+            # another rank's write failed: nothing was committed — surface
+            # that on every rank instead of returning as if the save landed
+            raise CheckpointCorruptionError(
+                f"checkpoint save at {path} failed on another process; "
+                "COMMIT was not written")
+        if rank == coordinator_rank and all_ok:
             # API-parity marker only (the coordinator's own fragment); load
             # always merges rank*.meta.json fragments and never reads this
-            with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump(fragment, f)
+            retry_os(lambda: _atomic_json(
+                fragment, os.path.join(path, "metadata.json")))
+            retry_os(lambda: _write_commit(path, world_size=nprocs))
 
     if not async_save:
         write()
@@ -212,23 +315,36 @@ def save_state_dict(state_dict, path, process_group=None,
 
 
 def _merged_metadata(path):
-    """Union of every rank's metadata fragment (shard lists concatenated)."""
-    merged = {"state": {}}
+    """Union of every rank's metadata fragment (shard lists concatenated).
+    Also records the max fragment ``version`` and the set of fragment ranks
+    under private ``_version`` / ``_ranks`` keys for commit verification."""
+    merged = {"state": {}, "_version": 1, "_ranks": set()}
     names = sorted(fn for fn in os.listdir(path)
                    if fn.endswith(".meta.json"))
     if not names:
         with open(os.path.join(path, "metadata.json")) as f:
             meta = json.load(f)
         if meta.get("version", 1) >= 2:
-            # v2 metadata.json is one rank's fragment, not a merged view —
+            # v2+ metadata.json is one rank's fragment, not a merged view —
             # loading from it alone would silently zero other ranks' shards
-            raise RuntimeError(
+            raise CheckpointCorruptionError(
                 f"checkpoint at {path} is missing its rank*.meta.json "
-                "fragments (v2 layout); copy the full checkpoint directory")
+                "fragments (v2+ layout); copy the full checkpoint directory")
+        meta.setdefault("_version", meta.get("version", 1))
+        meta.setdefault("_ranks", set())
         return meta
     for fn in names:
-        with open(os.path.join(path, fn)) as f:
-            frag = json.load(f)
+        try:
+            with open(os.path.join(path, fn)) as f:
+                frag = json.load(f)
+        except ValueError as e:
+            raise CheckpointCorruptionError(
+                f"metadata fragment {fn!r} in checkpoint {path} is not "
+                f"valid JSON ({e}); the save was torn mid-write") from e
+        merged["_version"] = max(merged["_version"],
+                                 int(frag.get("version", 1)))
+        if "rank" in frag:
+            merged["_ranks"].add(int(frag["rank"]))
         for name, info in frag["state"].items():
             if name not in merged["state"]:
                 merged["state"][name] = {
@@ -240,46 +356,147 @@ def _merged_metadata(path):
     return merged
 
 
+def _check_commit(path, metadata):
+    """v3 checkpoints must carry COMMIT, and every fragment rank of the
+    saving world must be present — anything less is a torn save."""
+    if metadata.get("_version", 1) < 3:
+        return  # pre-commit-protocol layout: nothing to verify
+    commit = _read_commit(path)
+    if commit is None:
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path} has no COMMIT sentinel — the save was "
+            "killed before completing; resume from the newest committed "
+            "step (CheckpointManager.latest_valid_step skips this one)")
+    world = int(commit.get("world_size", 1))
+    missing = set(range(world)) - metadata.get("_ranks", set())
+    if missing:
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path} was saved by {world} processes but the "
+            f"metadata fragments of rank(s) {sorted(missing)} are missing")
+
+
+class _ShardReader:
+    """Lazy npz access with typed corruption errors and CRC verification."""
+
+    def __init__(self, path):
+        self.path = path
+        self._files = []
+        for fn in sorted(os.listdir(path)):
+            if not fn.endswith(".npz"):
+                continue
+            try:
+                self._files.append(np.load(os.path.join(path, fn)))
+            except Exception as e:
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard file {fn!r} in {path} is unreadable "
+                    f"({type(e).__name__}: {e})") from e
+
+    def read(self, shard, dtype):
+        key = shard["key"]
+        for f in self._files:
+            if key not in f:
+                continue
+            try:
+                data = f[key]
+            except Exception as e:  # zipfile/zlib CRC or truncation errors
+                raise CheckpointCorruptionError(
+                    f"shard {key!r} in checkpoint {self.path} is corrupt "
+                    f"({type(e).__name__}: {e})") from e
+            want = shard.get("crc32")
+            if want is not None and zlib.crc32(data.tobytes()) != want:
+                raise CheckpointCorruptionError(
+                    f"shard {key!r} in checkpoint {self.path} failed CRC32 "
+                    "verification — the bytes on disk do not match what "
+                    "was saved")
+            if data.dtype != dtype:
+                data = data.view(dtype)
+            return data
+        raise CheckpointCorruptionError(
+            f"shard {key!r} named by the metadata of checkpoint "
+            f"{self.path} is absent from every shard file")
+
+    def close(self):
+        for f in self._files:
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files = []
+
+
+def verify_checkpoint(path):
+    """Full integrity pass: commit sentinel, fragment completeness, and
+    CRC32 of every shard. Returns the merged metadata on success; raises
+    :class:`CheckpointCorruptionError` (or ``FileNotFoundError``) on any
+    torn/corrupt state."""
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint directory at {path!r}")
+    try:
+        metadata = _merged_metadata(path)
+    except FileNotFoundError:
+        # no sharded payload at all (a pickle/writer-only save): the files
+        # are whole by the atomic-rename guarantee, COMMIT alone decides
+        if is_committed(path):
+            return {"state": {}}
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path} has neither shard metadata nor a "
+            "COMMIT sentinel — nothing verifiable was saved there")
+    _check_commit(path, metadata)
+    reader = _ShardReader(path)
+    try:
+        for name, info in metadata["state"].items():
+            dtype = _np_dtype(info["dtype"])
+            for sh in info["shards"]:
+                reader.read(sh, dtype)
+    finally:
+        reader.close()
+    return metadata
+
+
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, offload=False):
     """Reference load_state_dict.py:365 — fills `state_dict` tensors in
-    place, resharding to each tensor's current placement."""
+    place, resharding to each tensor's current placement. Verifies the
+    commit protocol (COMMIT sentinel + fragment completeness, v3 layouts)
+    and each shard's CRC32, raising :class:`CheckpointCorruptionError` on a
+    torn or corrupt save instead of returning garbage."""
+    if not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"no checkpoint directory at {path!r}; "
+            "CheckpointManager.latest_valid_step() locates the newest "
+            "committed step under a checkpoint root")
     metadata = _merged_metadata(path)
-    files = [np.load(os.path.join(path, fn))
-             for fn in sorted(os.listdir(path)) if fn.endswith(".npz")]
+    _check_commit(path, metadata)
+    reader = _ShardReader(path)
+    try:
+        for name, t in state_dict.items():
+            if name not in metadata["state"]:
+                continue
+            info = metadata["state"][name]
+            dtype = _np_dtype(info["dtype"])
+            full = np.zeros(info["global_shape"], dtype=dtype)
+            if full.ndim == 0:
+                full = np.asarray(reader.read(info["shards"][0], dtype))
+            else:
+                for sh in info["shards"]:
+                    data = reader.read(sh, dtype)
+                    if sh["index"] is None:
+                        full = np.asarray(data)
+                    else:
+                        idx = tuple(slice(a, b) for a, b in sh["index"])
+                        full[idx] = data
+            arr = t._data
+            target_sharding = getattr(arr, "sharding", None)
+            import jax.numpy as jnp
 
-    def find(key, dtype):
-        for f in files:
-            if key in f:
-                data = f[key]
-                if data.dtype != dtype:
-                    data = data.view(dtype)
-                return data
-        raise KeyError(key)
-
-    for name, t in state_dict.items():
-        if name not in metadata["state"]:
-            continue
-        info = metadata["state"][name]
-        dtype = _np_dtype(info["dtype"])
-        full = np.zeros(info["global_shape"], dtype=dtype)
-        if full.ndim == 0:
-            full = np.asarray(find(info["shards"][0]["key"], dtype))
-        else:
-            for sh in info["shards"]:
-                data = find(sh["key"], dtype)
-                if sh["index"] is None:
-                    full = np.asarray(data)
-                else:
-                    idx = tuple(slice(a, b) for a, b in sh["index"])
-                    full[idx] = data
-        arr = t._data
-        target_sharding = getattr(arr, "sharding", None)
-        import jax.numpy as jnp
-
-        new = jnp.asarray(full).astype(arr.dtype)
-        if target_sharding is not None and isinstance(
-                target_sharding, jax.sharding.NamedSharding):
-            new = jax.device_put(new.reshape(arr.shape), target_sharding)
-        t._rebind(new.reshape(arr.shape))
+            new = jnp.asarray(full).astype(arr.dtype)
+            if target_sharding is not None and isinstance(
+                    target_sharding, jax.sharding.NamedSharding):
+                new = jax.device_put(new.reshape(arr.shape), target_sharding)
+            t._rebind(new.reshape(arr.shape))
+    finally:
+        reader.close()
     return state_dict
+
+
+from .manager import CheckpointManager  # noqa: E402  (needs the fns above)

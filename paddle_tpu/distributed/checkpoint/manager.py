@@ -1,0 +1,239 @@
+"""Checkpoint lifecycle manager: step-stamped saves, retention, auto-resume.
+
+Reference analog: the reference Paddle's fleet checkpoint flow (coordinator-
+gathered metadata + elastic auto-restart at the latest save). Here the same
+lifecycle is a single object over the v3 commit-protocol layout written by
+:func:`paddle_tpu.distributed.save_state_dict`:
+
+    root/
+      step_100/   rank0.npz  rank0.meta.json  metadata.json  COMMIT
+                  optimizer.pdopt  scaler.pdscaler
+      step_200/   ...                                   <- newest committed
+
+- ``save(step, model=…, optimizer=…, scaler=…)`` writes the auxiliary
+  pickles first (atomic, via ``paddle.save``) and the model shards +
+  ``COMMIT`` last, so the sentinel certifies the whole directory.
+- ``latest_valid_step()`` is the crash-recovery query: the newest step whose
+  directory is committed (optionally CRC-verified), skipping torn saves.
+- ``auto_resume(model, optimizer, scaler)`` restores all three from that
+  step (the optimizer's global step rides in its own state dict) and
+  returns the step number, or ``None`` when nothing valid exists.
+- Retention keeps the last ``keep_last_n`` committed steps and never
+  deletes the newest committed one; with ``async_save`` it is deferred
+  until the in-flight :class:`AsyncSaveHandle` lands (the next ``save`` or
+  an explicit ``wait`` drains it), so a checkpoint is never pruned while
+  its successor is still being written.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+
+import jax
+
+from ...framework import io as _fio
+from . import (_write_commit, is_committed, load_state_dict, save_state_dict,
+               verify_checkpoint)
+from ...framework.io import CheckpointCorruptionError
+
+__all__ = ["CheckpointManager"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_OPT_FILE = "optimizer.pdopt"
+_SCALER_FILE = "scaler.pdscaler"
+
+
+class CheckpointManager:
+    def __init__(self, root, keep_last_n=None, async_save=False):
+        if keep_last_n is not None and int(keep_last_n) < 1:
+            raise ValueError("keep_last_n must be >= 1 (the newest committed "
+                             "checkpoint is never deleted)")
+        self.root = str(root)
+        self.keep_last_n = None if keep_last_n is None else int(keep_last_n)
+        self.async_save = bool(async_save)
+        self._pending = None  # in-flight (step, AsyncSaveHandle)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ---- layout ---------------------------------------------------------
+    def step_dir(self, step):
+        return os.path.join(self.root, f"step_{int(step)}")
+
+    def steps(self):
+        """All step-stamped directories under the root, sorted ascending
+        (committed or not)."""
+        out = []
+        for entry in os.listdir(self.root):
+            m = _STEP_RE.match(entry)
+            if m and os.path.isdir(os.path.join(self.root, entry)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def committed_steps(self):
+        return [s for s in self.steps() if is_committed(self.step_dir(s))]
+
+    def _recover_quarantines(self):
+        """A crash mid-resave leaves the only committed copy of a step
+        under ``step_{n}.replaced.*`` while ``step_{n}`` itself is torn —
+        restore it so resume finds it. Coordinator-only (shared fs)."""
+        if jax.process_index() != 0 or self._pending is not None:
+            return
+        for entry in os.listdir(self.root):
+            base, sep, _ = entry.partition(".replaced.")
+            if not sep or not _STEP_RE.match(base):
+                continue
+            q = os.path.join(self.root, entry)
+            d = os.path.join(self.root, base)
+            if not os.path.isdir(q) or not is_committed(q):
+                continue
+            if is_committed(d):
+                continue  # the resave landed; retention sweeps the copy
+            if os.path.isdir(d):
+                shutil.rmtree(d)  # the torn resave attempt
+            os.rename(q, d)
+
+    def latest_valid_step(self, verify=False):
+        """Newest step whose directory is committed; ``verify=True`` also
+        CRC-checks every shard, walking further back past corrupt saves.
+        Restores a quarantined committed copy of a step whose re-save was
+        torn by a crash. Returns ``None`` when no valid checkpoint
+        exists."""
+        self._recover_quarantines()
+        for s in reversed(self.committed_steps()):
+            if not verify:
+                return s
+            try:
+                verify_checkpoint(self.step_dir(s))
+                return s
+            except (CheckpointCorruptionError, FileNotFoundError):
+                continue
+        return None
+
+    # ---- save -----------------------------------------------------------
+    def save(self, step, model=None, optimizer=None, scaler=None,
+             state_dict=None, writer=None, async_save=None):
+        """Write a committed checkpoint for ``step``. ``model`` /
+        ``state_dict`` go through the sharded writer (COMMIT last);
+        ``optimizer`` / ``scaler`` state dicts are pickled atomically before
+        the shards; ``writer(dir_path)`` lets callers drop extra files into
+        the directory under the same commit (hapi's ModelCheckpoint uses
+        this). Returns the :class:`AsyncSaveHandle` for async saves, else
+        ``None``."""
+        self.wait()  # land the previous async write + run its retention
+        if async_save is None:
+            async_save = self.async_save
+        d = self.step_dir(step)
+        # directory lifecycle (quarantine / cleanup / aux pickles) is
+        # coordinator-only: in a multi-process save every rank enters here,
+        # and racing renames/rmtrees would corrupt the very directory the
+        # shard writes are about to target
+        if jax.process_index() == 0:
+            if os.path.isdir(d):
+                if is_committed(d):
+                    # never destroy committed data before its replacement
+                    # commits: quarantine it out of the step_{n} namespace
+                    # (atomic rename); retention sweeps it once the new
+                    # save lands, and a crash mid-resave leaves it
+                    # recoverable via _recover_quarantines
+                    os.rename(d, f"{d}.replaced.{os.getpid()}")
+                else:
+                    shutil.rmtree(d)  # torn attempt at the same step
+            os.makedirs(d, exist_ok=True)
+            if optimizer is not None:
+                _fio.save(optimizer.state_dict(), os.path.join(d, _OPT_FILE))
+            if scaler is not None:
+                _fio.save(scaler.state_dict(),
+                          os.path.join(d, _SCALER_FILE))
+            if writer is not None:
+                writer(d)
+        if jax.process_count() > 1:
+            # other ranks must not start shard writes into a directory the
+            # coordinator is still quarantining/cleaning
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ckpt_prepare:{d}")
+            os.makedirs(d, exist_ok=True)  # non-shared-fs local mkdir
+        sd = {}
+        if model is not None:
+            sd.update(model.state_dict())
+        if state_dict:
+            sd.update(state_dict)
+        if sd:
+            handle = save_state_dict(sd, d, async_save=async_save)
+            if handle is not None:
+                self._pending = (int(step), handle)
+                return handle
+        else:
+            _write_commit(d)  # pickle/writer-only save: commit it here
+        self._retain()
+        return None
+
+    def wait(self):
+        """Block until the in-flight async save lands (re-raising its write
+        failure), then run the retention it deferred."""
+        if self._pending is None:
+            return
+        _step, handle = self._pending
+        self._pending = None
+        handle.wait()
+        self._retain()
+
+    def _retain(self):
+        """keep-last-N over committed steps; runs only right after a save
+        lands (never with a write in flight) and only on the coordinator.
+        Uncommitted (torn) directories are garbage and are swept too, as
+        are ``*.replaced.*`` quarantines — those only once their re-save
+        landed, or once retention is enabled and a newer commit exists
+        (which is always true here). The newest committed step always
+        survives."""
+        if jax.process_index() != 0:
+            return
+        committed = self.committed_steps()
+        newest = committed[-1] if committed else None
+        for entry in os.listdir(self.root):
+            base, sep, _ = entry.partition(".replaced.")
+            m = _STEP_RE.match(base)
+            if not sep or not m:
+                continue
+            # a quarantine is prunable only once it is redundant: its
+            # re-save landed, or a newer committed step supersedes it —
+            # never while it holds the only committed copy of its step
+            if is_committed(os.path.join(self.root, base)) or (
+                    newest is not None and newest > int(m.group(1))):
+                shutil.rmtree(os.path.join(self.root, entry),
+                              ignore_errors=True)
+        if self.keep_last_n is None:
+            return
+        victims = [s for s in self.steps() if s not in committed]
+        keep = max(1, self.keep_last_n)
+        victims += committed[:-keep]
+        for s in victims:
+            shutil.rmtree(self.step_dir(s), ignore_errors=True)
+
+    # ---- resume ---------------------------------------------------------
+    def auto_resume(self, model=None, optimizer=None, scaler=None,
+                    verify=False):
+        """Restore ``model`` + ``optimizer`` + ``scaler`` from the newest
+        valid checkpoint and return its step (the optimizer's global step /
+        LR schedule ride in its state dict; the scaler's loss-scale schedule
+        in its own). Returns ``None`` — touching nothing — when no committed
+        checkpoint exists, so cold starts and warm restarts share one call.
+        ``verify=True`` CRC-walks candidate steps before loading (load
+        itself re-verifies what it reads — the deep pre-pass costs a second
+        read of the chosen checkpoint and is for resuming past bit-rot)."""
+        self.wait()
+        step = self.latest_valid_step(verify=verify)
+        if step is None:
+            return None
+        d = self.step_dir(step)
+        if model is not None and any(
+                fn.endswith(".npz") for fn in os.listdir(d)):
+            load_state_dict(model.state_dict(), d)
+        opt_p = os.path.join(d, _OPT_FILE)
+        if optimizer is not None and os.path.exists(opt_p):
+            optimizer.set_state_dict(_fio.load(opt_p))
+        sc_p = os.path.join(d, _SCALER_FILE)
+        if scaler is not None and os.path.exists(sc_p):
+            scaler.load_state_dict(_fio.load(sc_p))
+        return step

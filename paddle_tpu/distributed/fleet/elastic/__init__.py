@@ -36,19 +36,30 @@ class ElasticStatus:
     EXIT = "exit"
 
 
+def _expired(ts, ttl, now):
+    return ttl is not None and ttl > 0 and now - ts > ttl
+
+
 class MemoryStore:
-    """In-process host registry (test double for the coordination service)."""
+    """In-process host registry (test double for the coordination service).
+    ``register(host, ttl=…)`` is a lease: a host that stops re-registering
+    (heartbeating) within ``ttl`` seconds is pruned on the next ``hosts()``
+    read — a dead host expires instead of holding membership forever."""
 
     def __init__(self):
         self._hosts = {}
 
     def register(self, host, ttl=None):
-        self._hosts[host] = time.time()
+        self._hosts[host] = (time.time(), ttl)
 
     def deregister(self, host):
         self._hosts.pop(host, None)
 
     def hosts(self):
+        now = time.time()
+        for h in [h for h, (ts, ttl) in self._hosts.items()
+                  if _expired(ts, ttl, now)]:
+            del self._hosts[h]
         return sorted(self._hosts)
 
 
@@ -97,7 +108,7 @@ class FileStore:
     def register(self, host, ttl=None):
         with self._locked():
             d = self._read()
-            d[host] = time.time()
+            d[host] = [time.time(), ttl]
             self._write(d)
 
     def deregister(self, host):
@@ -106,8 +117,26 @@ class FileStore:
             d.pop(host, None)
             self._write(d)
 
+    @staticmethod
+    def _entry(v):
+        # pre-TTL files stored a bare timestamp; treat those as no-expiry
+        return (v, None) if isinstance(v, (int, float)) else (v[0], v[1])
+
     def hosts(self):
-        return sorted(self._read())
+        d = self._read()
+        now = time.time()
+        dead = [h for h, v in d.items() if _expired(*self._entry(v), now)]
+        if dead:
+            # prune-on-read: rewrite under the lock so every reader
+            # converges on the same membership
+            with self._locked():
+                d = self._read()
+                for h in list(d):
+                    if _expired(*self._entry(d[h]), now):
+                        del d[h]
+                self._write(d)
+        return sorted(h for h, v in d.items()
+                      if not _expired(*self._entry(v), now))
 
 
 def _parse_np(np_spec):
@@ -123,18 +152,27 @@ def _parse_np(np_spec):
 class ElasticManager:
     """Membership -> decision engine (ref manager.py:126)."""
 
-    def __init__(self, np_spec, host=None, store=None, scale_interval=5):
+    def __init__(self, np_spec, host=None, store=None, scale_interval=5,
+                 host_ttl=None):
         self.min_np, self.max_np = _parse_np(np_spec)
         self.elastic = self.min_np != self.max_np  # level 2 vs FAULT_TOLERANCE
         self.host = host or os.environ.get("POD_IP", "127.0.0.1")
         self.store = store or MemoryStore()
         self.scale_interval = scale_interval
+        # host_ttl turns registration into a lease: a host that stops
+        # heartbeating (re-calling register()) within host_ttl seconds is
+        # expired from hosts() on read, so watch() sees the membership
+        # shrink and decides RESTART/HOLD/ERROR — a dead host can no longer
+        # hold its slot forever (ref manager.py etcd lease TTL)
+        self.host_ttl = host_ttl
         self.np = self.max_np if not self.elastic else self.min_np
         self._last_hosts = None
 
     # ---- membership -----------------------------------------------------
     def register(self):
-        self.store.register(self.host)
+        self.store.register(self.host, ttl=self.host_ttl)
+
+    heartbeat = register  # lease renewal is just re-registration
 
     def exit(self, completed=True):
         self.store.deregister(self.host)

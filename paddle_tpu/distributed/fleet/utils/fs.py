@@ -72,7 +72,11 @@ class FS:
 
 
 class LocalFS(FS):
-    """Host filesystem client (reference fs.py LocalFS)."""
+    """Host filesystem client (reference fs.py LocalFS). ``rename``/``mv``
+    (the checkpoint-publish operations) retry transient OSErrors with the
+    shared exponential-backoff shape (``FLAGS_ckpt_save_retries``) — on NFS
+    and FUSE mounts a rename can fail transiently under server load — and
+    carry the ``fs.rename`` fault-injection site."""
 
     def ls_dir(self, fs_path):
         """(dirs, files) directly under ``fs_path``."""
@@ -91,7 +95,14 @@ class LocalFS(FS):
         os.makedirs(fs_path, exist_ok=True)
 
     def rename(self, fs_src_path, fs_dst_path):
-        os.rename(fs_src_path, fs_dst_path)
+        from ....utils import fault_injection
+        from ....utils.retry import retry_os
+
+        def attempt():
+            fault_injection.fire("fs.rename")
+            os.rename(fs_src_path, fs_dst_path)
+
+        retry_os(attempt)
 
     def _rmr(self, fs_path):
         shutil.rmtree(fs_path)

@@ -3,6 +3,13 @@
 Reference: python/paddle/framework/io.py (save :721, load :960) — pickle of
 nested state structures with tensors converted to numpy. Files written by this
 module are plain pickles of numpy-fied pytrees, readable anywhere.
+
+Durability: ``save`` writes tmp-file → flush+fsync → atomic ``os.replace``,
+so the destination path only ever holds a complete pickle — a crash mid-save
+leaves the previous file (or nothing) in place, never a torn one. Transient
+``OSError``s retry with exponential backoff + jitter
+(``FLAGS_ckpt_save_retries``). ``load`` turns a truncated/corrupt file into a
+typed :class:`CheckpointCorruptionError` instead of a raw pickle stack trace.
 """
 
 from __future__ import annotations
@@ -14,9 +21,16 @@ import numpy as np
 
 from ..core.tensor import Parameter, Tensor
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "CheckpointCorruptionError"]
 
 _PROTO = 4
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint file/shard failed deserialization or checksum
+    verification: the bytes on disk are not a complete save. Recover from
+    the newest committed checkpoint (``CheckpointManager.latest_valid_step``
+    skips torn/corrupt step directories)."""
 
 
 def _to_saveable(obj):
@@ -57,14 +71,32 @@ def _from_saveable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=_PROTO, **configs):
+    from ..utils.retry import atomic_write, retry_os
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    payload = _to_saveable(obj)
+    retry_os(lambda: atomic_write(
+        path, lambda f: pickle.dump(payload, f, protocol=protocol),
+        fire_site="io.save"))
 
 
 def load(path, return_numpy=False, **configs):
-    with open(path, "rb") as f:
-        data = pickle.load(f)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"checkpoint file {path!r} does not exist — paddle.save writes "
+            "exactly the path it is given (no extension is appended); if "
+            "this was a step checkpoint, use "
+            "CheckpointManager.latest_valid_step() to locate the newest "
+            "committed save")
+    try:
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+    except (pickle.UnpicklingError, EOFError, UnicodeDecodeError,
+            MemoryError, ValueError) as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint file {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e}); it was likely produced by a crash "
+            "mid-save — recover from the newest committed checkpoint") from e
     return _from_saveable(data, return_numpy=return_numpy)

@@ -137,17 +137,39 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """Periodic save (ref callbacks.py ModelCheckpoint)."""
+    """Periodic save (ref callbacks.py ModelCheckpoint).
 
-    def __init__(self, save_freq=1, save_dir=None):
+    Every save goes through the atomic checkpoint writer (``paddle.save``:
+    tmp → fsync → rename), so a crash mid-epoch-save never tears an
+    existing checkpoint. With ``keep_last_n`` the epoch saves are managed
+    by :class:`paddle.CheckpointManager` instead of loose files: each epoch
+    lands in a committed ``step_{epoch}/`` directory and only the newest N
+    are retained (the newest committed one is never deleted)."""
+
+    def __init__(self, save_freq=1, save_dir=None, keep_last_n=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_last_n = keep_last_n
+        self._manager = None
+
+    def _get_manager(self):
+        if self._manager is None:
+            from ..distributed.checkpoint.manager import CheckpointManager
+
+            self._manager = CheckpointManager(self.save_dir,
+                                              keep_last_n=self.keep_last_n)
+        return self._manager
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and (epoch + 1) % self.save_freq == 0:
-            path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+        if not (self.save_dir and (epoch + 1) % self.save_freq == 0):
+            return
+        if self.keep_last_n is None:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+        else:
+            self._get_manager().save(
+                epoch,
+                writer=lambda d: self.model.save(os.path.join(d, "model")))
 
     def on_train_end(self, logs=None):
         if self.save_dir:

@@ -36,12 +36,21 @@ class FusedTrainStep:
     invocation, and the caller must NOT also call ``lr_scheduler.step()`` in
     the training loop (that would advance the schedule twice per step). Pass
     ``step_lr_scheduler=False`` to keep the standard paddle pattern where the
-    loop steps the scheduler itself."""
+    loop steps the scheduler itself.
+
+    Checkpointing: while a FusedTrainStep trains, the moment buffers and
+    bias-correction step live HERE (in-graph, donated), not in the wrapped
+    optimizer's accumulators — so checkpoint the step object itself:
+    ``CheckpointManager.save(step, model=model, optimizer=fused_step)`` and
+    ``auto_resume(model, fused_step)`` (state_dict/set_state_dict are
+    duck-type compatible, keyed by structured parameter names). Externally
+    restored weights (any ``_rebind`` outside the step) are adopted on the
+    next call."""
 
     _instance_count = 0
 
     def __init__(self, model, optimizer, loss_fn=None, step_lr_scheduler=True,
-                 shape_buckets=None, bucket_args=None):
+                 shape_buckets=None, bucket_args=None, grad_scaler=None):
         from ..jit.cache import BucketSpec
 
         from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
@@ -50,6 +59,15 @@ class FusedTrainStep:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._step_lr_scheduler = step_lr_scheduler
+        # step anomaly guard (FLAGS_check_nan_inf_action) + optional fused
+        # dynamic loss scaling: with a grad_scaler the loss is scaled and the
+        # grads unscaled in-graph (one executable, same as the reference's
+        # check_finite_and_unscale fusion), the step OWNS scaler bookkeeping
+        # (do not also call scaler.step/update in the loop), and a non-finite
+        # step both skips the update and backs off the scale
+        self._scaler = grad_scaler
+        self._guard = {"total": 0, "skipped": 0, "consecutive_skips": 0,
+                       "warned": 0}
         # pad-up shape buckets (paddle.jit semantics): data inputs are
         # zero-padded to the nearest registered boundary before dispatch so
         # a variable-length stream costs O(buckets) compiles, and the
@@ -134,10 +152,16 @@ class FusedTrainStep:
                 f"fused_train_step fuses ClipGradByGlobalNorm only; the "
                 f"optimizer has {type(clip).__name__} — use the eager step "
                 "for other clip types")
-        self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
+        # guard mode is a static arg ("off": no finite check in the graph
+        # at all, "flag": compute the all-finite flag only, "protect": flag
+        # + skip-step select): flipping FLAGS_check_nan_inf_action between
+        # modes mid-run costs one recompile, steady state costs none and
+        # the guard-off path stays exactly the pre-guard program
+        self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2),
+                               static_argnums=(8,))
 
     # -- pure step ------------------------------------------------------
-    def _loss(self, params, data, kwdata):
+    def _loss(self, params, data, kwdata, scale):
         all_params = dict(params)
         # frozen params participate in forward with their current values
         for n, t in self._tensors.items():
@@ -145,13 +169,29 @@ class FusedTrainStep:
                 all_params[n] = t._data
         out = functional_call(self.model, all_params, *data, **kwdata)
         if self.loss_fn is not None:
-            return self.loss_fn(out)
-        if isinstance(out, (tuple, list)):
-            return out[0]
-        return out
+            out = self.loss_fn(out)
+        elif isinstance(out, (tuple, list)):
+            out = out[0]
+        return out * scale  # loss scaling fused in-graph (scale==1 => no-op)
 
-    def _step_impl(self, params, m1, m2, step, lr, data, kwdata):
-        loss, grads = jax.value_and_grad(self._loss)(params, data, kwdata)
+    def _step_impl(self, params, m1, m2, step, lr, scale, data, kwdata,
+                   guard):
+        loss, grads = jax.value_and_grad(self._loss)(params, data, kwdata,
+                                                     scale)
+        # unscale: grads of the scaled loss divided by scale are the true
+        # grads (reference check_finite_and_unscale), and the finite check
+        # runs post-unscale exactly like AmpScaler.unscale_
+        inv = 1.0 / scale
+        loss = loss * inv
+        grads = jax.tree.map(lambda g: (_f32(g) * inv).astype(g.dtype),
+                             grads)
+        if guard == "off":
+            all_finite = jnp.bool_(True)  # constant: no reduction in-graph
+        else:
+            all_finite = jnp.all(jnp.isfinite(loss))
+            for g in jax.tree.leaves(grads):
+                all_finite = jnp.logical_and(all_finite,
+                                             jnp.all(jnp.isfinite(g)))
         if self._clip_norm is not None:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(_f32(g) ** 2) for g in jax.tree.leaves(grads)))
@@ -184,10 +224,10 @@ class FusedTrainStep:
             out = {n: upd(params[n], grads[n], m1[n], m2[n],
                           self._wds[n], self._lr_ratios[n])
                    for n in params}
-            return (loss, {n: v[0] for n, v in out.items()},
-                    {n: v[1] for n, v in out.items()},
-                    {n: v[2] for n, v in out.items()})
-        if kind == "momentum":
+            new_p = {n: v[0] for n, v in out.items()}
+            new_m1 = {n: v[1] for n, v in out.items()}
+            new_m2 = {n: v[2] for n, v in out.items()}
+        elif kind == "momentum":
             mu = jnp.float32(opt._momentum)
 
             def updm(p, g, v, wd):
@@ -197,14 +237,28 @@ class FusedTrainStep:
 
             out = {n: updm(params[n], grads[n], m1[n], self._wds[n])
                    for n in params}
-            return (loss, {n: v[0] for n, v in out.items()},
-                    {n: v[1] for n, v in out.items()}, m2)
-        # sgd
-        new = {n: (_f32(params[n])
-                   - lr * (_f32(grads[n]) + self._wds[n] * _f32(params[n]))
-                   ).astype(params[n].dtype)
-               for n in params}
-        return loss, new, m1, m2
+            new_p = {n: v[0] for n, v in out.items()}
+            new_m1 = {n: v[1] for n, v in out.items()}
+            new_m2 = m2
+        else:  # sgd
+            new_p = {n: (_f32(params[n])
+                         - lr * (_f32(grads[n])
+                                 + self._wds[n] * _f32(params[n]))
+                         ).astype(params[n].dtype)
+                     for n in params}
+            new_m1, new_m2 = m1, m2
+        if guard == "protect":
+            # skip-step semantics: a non-finite step leaves params AND
+            # moments untouched (one jnp.where per buffer — XLA fuses the
+            # select into the update, no extra memory traffic)
+            def keep(new, old):
+                return {n: jnp.where(all_finite, new[n], old[n])
+                        for n in new}
+
+            new_p = keep(new_p, params)
+            new_m1 = keep(new_m1, m1) if new_m1 is not m1 else m1
+            new_m2 = keep(new_m2, m2) if new_m2 is not m2 else m2
+        return loss, all_finite, new_p, new_m1, new_m2
 
     # -- public ---------------------------------------------------------
     def lowered_flops(self, *data, **kwdata):
@@ -216,7 +270,7 @@ class FusedTrainStep:
         try:
             lowered = self._jitted.lower(
                 self._params, self._m1, self._m2, jnp.float32(1),
-                jnp.float32(1e-3), darrs, karrs)
+                jnp.float32(1e-3), jnp.float32(1), darrs, karrs, "off")
             cost = lowered.cost_analysis()
             if not (hasattr(cost, "get") and cost.get("flops")):
                 # some backends only report cost post-compile; with the
@@ -283,18 +337,135 @@ class FusedTrainStep:
             self._seen_sigs.add(sig)
             jit_cache.record_compile(self._stats_name, sig)
 
+    def state_dict(self):
+        """Checkpointable state of the fused step: the in-graph moment
+        buffers and the bias-correction step count (weights live in the
+        model; this object is the optimizer-state owner while it trains).
+        Duck-type-compatible with ``CheckpointManager.save(optimizer=...)``
+        / ``auto_resume(optimizer=...)``."""
+        import numpy as np
+
+        sd = {"step_count": self._step_count}
+        for prefix, store in (("m1", self._m1), ("m2", self._m2)):
+            for n, v in store.items():
+                sd[f"{prefix}.{n}"] = np.asarray(v)
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = int(sd.get("step_count", self._step_count))
+        for prefix, store in (("m1", self._m1), ("m2", self._m2)):
+            for n in store:
+                key = f"{prefix}.{n}"
+                if key in sd:
+                    v = sd[key]
+                    store[n] = jnp.asarray(
+                        v._data if isinstance(v, Tensor) else v)
+
+    load_state_dict = set_state_dict
+
+    def _adopt_external_rebinds(self):
+        """A checkpoint resume (``CheckpointManager.auto_resume`` /
+        ``set_state_dict``) rebinds the model's parameter Tensors outside
+        this step's control; detect that (pointer comparison per param) and
+        adopt the new arrays, else the next dispatch would clobber the
+        restored weights with this step's stale internal copies."""
+        for n in self._names:
+            t = self._tensors[n]._data
+            if t is not self._params[n]:
+                self._params[n] = t
+
+    def guard_stats(self):
+        """Step-anomaly-guard counters: ``total`` dispatched steps,
+        ``skipped`` updates discarded for non-finite loss/grads,
+        ``consecutive_skips`` current streak (a growing streak means the run
+        is in a NaN spiral, not a one-off overflow), ``warned`` warn-mode
+        events."""
+        return dict(self._guard)
+
+    def _poison_nan(self, darrs, karrs):
+        """train.grad_nan injection: NaN-fill the first floating-point
+        input so loss/grads go non-finite this step (shape/dtype signature
+        unchanged — no recompile)."""
+        darrs = list(darrs)
+        for i, a in enumerate(darrs):
+            if jnp.issubdtype(a.dtype, jnp.inexact):
+                darrs[i] = jnp.full_like(a, jnp.nan)
+                return tuple(darrs), karrs
+        for k in sorted(karrs):
+            if jnp.issubdtype(karrs[k].dtype, jnp.inexact):
+                karrs = dict(karrs)
+                karrs[k] = jnp.full_like(karrs[k], jnp.nan)
+                return tuple(darrs), karrs
+        return tuple(darrs), karrs
+
     def __call__(self, *data, **kwdata):
+        from ..core.flags import flag_value
+        from ..utils import fault_injection
+
         self._step_count += 1
+        self._guard["total"] += 1
+        action = str(flag_value("check_nan_inf_action", "none"))
+        # a disabled scaler (GradScaler(enable=False)) must behave exactly
+        # like no scaler: no host sync, no silent skip semantics
+        scaler = (self._scaler if self._scaler is not None
+                  and self._scaler.is_enable() else None)
+        # guard host-syncs the finite flag when an action wants it or a
+        # scaler needs the signal; "protect" discards non-finite updates
+        # in-graph (always on with a scaler: GradScaler.step semantics);
+        # "off" compiles the guard out entirely
+        guard_active = action != "none" or scaler is not None
+        protect = scaler is not None or action in ("skip", "raise")
+        guard = "protect" if protect else ("flag" if guard_active else "off")
+        scale_val = 1.0 if scaler is None else float(scaler._scale)
         lr = jnp.float32(self.optimizer.get_lr())
+        self._adopt_external_rebinds()
         darrs, karrs = self._prepare_arrays(data, kwdata)
+        if fault_injection.should_fire("train.grad_nan"):
+            darrs, karrs = self._poison_nan(darrs, karrs)
         self._count_dispatch(darrs, karrs)
-        loss, self._params, self._m1, self._m2 = self._jitted(
+        loss, finite, self._params, self._m1, self._m2 = self._jitted(
             self._params, self._m1, self._m2,
-            jnp.float32(self._step_count), lr, darrs, karrs)
+            jnp.float32(self._step_count), lr, jnp.float32(scale_val),
+            darrs, karrs, guard)
         # donation invalidated the old buffers — rebind the live Tensors
         for n in self._names:
             self._tensors[n]._rebind(self._params[n])
-        if self._step_lr_scheduler:
+        skipped = False
+        if guard_active:
+            ok = bool(finite)  # the guard's single host sync
+            if not ok:
+                if action == "warn":
+                    import warnings
+
+                    self._guard["warned"] += 1
+                    warnings.warn(
+                        f"non-finite loss/grads at step {self._step_count}"
+                        + ("" if protect else " — update applied anyway "
+                           "(FLAGS_check_nan_inf_action=warn)"),
+                        stacklevel=2)
+                if protect:
+                    skipped = True
+                    self._guard["skipped"] += 1
+                    self._guard["consecutive_skips"] += 1
+                    # the discarded step must not advance bias correction
+                    self._step_count -= 1
+                if scaler is not None:
+                    # found_inf -> dynamic backoff (scale decays, good-step
+                    # streak resets), mirroring scaler.update() after a
+                    # skipped scaler.step()
+                    scaler._found_inf = True
+                    scaler.update()
+                if action == "raise":
+                    raise FloatingPointError(
+                        f"non-finite loss/grads at step "
+                        f"{self._step_count + 1}; update discarded "
+                        "(FLAGS_check_nan_inf_action=raise)")
+            else:
+                self._guard["consecutive_skips"] = 0
+                if scaler is not None:
+                    scaler._found_inf = False
+                    scaler.update()  # good-step bookkeeping (may grow scale)
+        if self._step_lr_scheduler and not skipped:
             sched = getattr(self.optimizer, "_learning_rate", None)
             if hasattr(sched, "step"):
                 sched.step()
@@ -302,7 +473,7 @@ class FusedTrainStep:
 
 
 def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True,
-                     shape_buckets=None, bucket_args=None):
+                     shape_buckets=None, bucket_args=None, grad_scaler=None):
     """Build a fused (single-dispatch, donated) train step callable:
     ``step(*inputs) -> loss``. See FusedTrainStep — with the default
     ``step_lr_scheduler=True`` the step owns LR-scheduler stepping; do not
@@ -310,7 +481,10 @@ def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True,
     boundaries before dispatch (paddle.jit bucket semantics) so variable
     shapes cost O(buckets) compiles; ``bucket_args`` (positional indices /
     kw names) pins which inputs pad when the dominant-length auto rule is
-    ambiguous."""
+    ambiguous. ``grad_scaler`` fuses dynamic loss scaling in-graph and arms
+    the step anomaly guard (see FLAGS_check_nan_inf_action): a non-finite
+    step is discarded and the scale backs off, all inside the single
+    dispatch plus one host sync for the finite flag."""
     return FusedTrainStep(model, optimizer, loss_fn, step_lr_scheduler,
                           shape_buckets=shape_buckets,
-                          bucket_args=bucket_args)
+                          bucket_args=bucket_args, grad_scaler=grad_scaler)

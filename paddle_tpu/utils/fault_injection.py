@@ -1,0 +1,138 @@
+"""Deterministic fault injection for the fault-tolerance surface.
+
+Reference inspiration: the reference Paddle exercises its elastic/checkpoint
+recovery paths with unit-test fakes (fake etcd stores, forced
+check_finite_and_unscale overflows). Here every failure mode the durability
+layer defends against is a *named site* that production code calls into; a
+test (or a chaos drill) arms a site with :func:`inject` and the exact same
+code path that would fail in production fails on demand, deterministically.
+
+Sites wired into the framework:
+
+- ``ckpt.shard_write``  — distributed.checkpoint shard write, fired after the
+  shard payload hits the tmp file but before the atomic rename (the "process
+  killed mid-save" window: data on disk, checkpoint not visible/committed).
+- ``io.save``           — paddle.save pickle write, same window.
+- ``train.grad_nan``    — FusedTrainStep input poisoning: the step's first
+  floating-point input becomes NaN, so loss/grads go non-finite and the step
+  guard must react.
+- ``fs.rename``         — fleet.utils.fs.LocalFS.rename, fired before the
+  os.rename (exercises the transient-OSError retry/backoff path).
+
+Arming a site is scoped and seeded::
+
+    with inject("ckpt.shard_write"):            # every call raises
+        ...
+    with inject("io.save", max_fires=1, exc=OSError):  # first call only
+        ...
+    with inject("train.grad_nan", every_n=3):   # calls 3, 6, 9, ...
+        ...
+    with inject("fs.rename", prob=0.5, seed=7): # seeded coin per call
+        ...
+
+Sites are process-global (checkpoint writes run on background threads and
+must see the armed injector); nesting the same site restores the previous
+injector on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+
+__all__ = ["SITES", "InjectedFault", "inject", "fire", "should_fire"]
+
+SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename")
+
+
+class InjectedFault(OSError):
+    """Default injected exception. Subclasses OSError on purpose: the
+    durability layer treats OSErrors as transient and retries them, so an
+    armed site exercises the full backoff path before the failure wins."""
+
+
+class _Injector:
+    __slots__ = ("site", "every_n", "prob", "exc", "max_fires", "_rng",
+                 "calls", "fires")
+
+    def __init__(self, site, every_n=None, prob=None, exc=None, seed=0,
+                 max_fires=None):
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}; known: {SITES}")
+        if every_n is not None and prob is not None:
+            raise ValueError("pass at most one of every_n / prob")
+        if every_n is not None and every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        self.site = site
+        self.every_n = every_n
+        self.prob = prob
+        self.exc = exc
+        self.max_fires = max_fires
+        self._rng = random.Random(seed)
+        self.calls = 0
+        self.fires = 0
+
+    def should_fire(self):
+        self.calls += 1
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.prob is not None:
+            hit = self._rng.random() < self.prob
+        elif self.every_n is not None:
+            hit = self.calls % self.every_n == 0
+        else:
+            hit = True  # armed with no rate: every call fires
+        if hit:
+            self.fires += 1
+        return hit
+
+    def make_exc(self):
+        exc = self.exc
+        if exc is None:
+            return InjectedFault(f"injected fault at site {self.site!r} "
+                                 f"(call #{self.calls})")
+        if isinstance(exc, BaseException):
+            return exc
+        return exc(f"injected fault at site {self.site!r} "
+                   f"(call #{self.calls})")
+
+
+_ACTIVE: dict[str, _Injector] = {}
+
+
+@contextlib.contextmanager
+def inject(site, every_n=None, prob=None, exc=None, seed=0, max_fires=None):
+    """Arm ``site`` for the duration of the block. Exactly one of
+    ``every_n`` (fire on calls n, 2n, ...) or ``prob`` (seeded Bernoulli per
+    call) selects the rate; neither means every call fires. ``max_fires``
+    caps total fires (e.g. ``max_fires=1`` = one transient failure, then
+    healthy — the retry path must recover). ``exc`` is an exception class or
+    instance for raising sites; boolean sites (``train.grad_nan``) ignore it.
+    Yields the injector, whose ``calls``/``fires`` counters are readable
+    after the block."""
+    inj = _Injector(site, every_n=every_n, prob=prob, exc=exc, seed=seed,
+                    max_fires=max_fires)
+    prev = _ACTIVE.get(site)
+    _ACTIVE[site] = inj
+    try:
+        yield inj
+    finally:
+        if prev is None:
+            _ACTIVE.pop(site, None)
+        else:
+            _ACTIVE[site] = prev
+
+
+def should_fire(site):
+    """Boolean probe for non-raising sites (``train.grad_nan``). False when
+    the site is unarmed — the production fast path is one dict lookup."""
+    inj = _ACTIVE.get(site)
+    return inj is not None and inj.should_fire()
+
+
+def fire(site):
+    """Raising probe for write-path sites: no-op when unarmed, raises the
+    armed exception when the injector decides this call fails."""
+    inj = _ACTIVE.get(site)
+    if inj is not None and inj.should_fire():
+        raise inj.make_exc()

@@ -1,0 +1,75 @@
+"""Transient-failure retry with exponential backoff + jitter.
+
+One backoff shape for every durability path: ``framework/io.save``,
+``distributed/checkpoint`` shard writes, and ``fleet.utils.fs.LocalFS``
+renames all funnel through :func:`retry_os`, so the retry budget is tuned in
+one place (``FLAGS_ckpt_save_retries``). The reference Paddle hand-rolls the
+same shape per call site (e.g. HDFSClient's sleep_inter loop); centralizing
+it keeps the checkpoint lifecycle's failure semantics uniform.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+__all__ = ["retry_os", "atomic_write"]
+
+# deterministic failures: retrying can't fix a missing path, a permission
+# wall, or a path-type mismatch — surface them immediately, no backoff
+_NON_TRANSIENT = (FileNotFoundError, PermissionError, FileExistsError,
+                  IsADirectoryError, NotADirectoryError)
+
+
+def retry_os(fn, retries=None, base_delay=0.01, max_delay=0.5, jitter=0.5,
+             rng=None, retry_on=(OSError,)):
+    """Call ``fn()``; on a *transient* exception in ``retry_on`` retry up to
+    ``retries`` times (default ``FLAGS_ckpt_save_retries``), sleeping
+    ``min(max_delay, base_delay * 2**attempt) * (1 + jitter * U[0,1))``
+    between attempts. Deterministic OSErrors (missing path, permissions,
+    path-type mismatch) are never retried. The final failure re-raises the
+    original exception. Pass a seeded ``rng`` (anything with ``.random()``)
+    for deterministic jitter in tests."""
+    if retries is None:
+        from ..core.flags import flag_value
+
+        retries = int(flag_value("ckpt_save_retries", 3))
+    if rng is None:
+        rng = random
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if isinstance(e, _NON_TRANSIENT) or attempt >= retries:
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            time.sleep(delay * (1.0 + jitter * rng.random()))
+            attempt += 1
+
+
+def atomic_write(dest, write_body, fire_site=None):
+    """The one atomic-publication shape: tmp file → ``write_body(f)`` →
+    (injection point) → flush+fsync → ``os.replace``. The destination only
+    ever holds complete bytes; any failure removes the tmp file and leaves
+    the previous destination untouched. ``fire_site`` names the
+    fault-injection site sitting in the "killed mid-save" window (data
+    written, nothing published)."""
+    from . import fault_injection
+
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_body(f)
+            if fire_site is not None:
+                fault_injection.fire(fire_site)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
