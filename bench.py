@@ -424,6 +424,55 @@ def bench_bert_varlen(on_tpu):
     })
 
 
+def bench_overlap(on_tpu):
+    """Host–device overlap A/B (ISSUE 3 tentpole): the SAME slow-host-
+    loader token stream (per-item delay simulating tokenize/augment/IO)
+    driven through identically-seeded fused BERT steps twice — inline
+    iteration + per-step float(loss) fetch vs DevicePrefetcher +
+    FusedTrainStep.drive deferred fetch. The harness lives in
+    scripts/bench_overlap.py (single source, also the standalone probe and
+    the slow-tier acceptance test). Compile time is excluded via one
+    warmup step per arm (identical executables in both arms — the overlap,
+    not the compile, is the effect under test); per-step losses must be
+    bit-identical across arms."""
+    import sys
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.flags import flag_value
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import bench_overlap as bo
+
+    paddle.seed(0)
+    np.random.seed(0)
+    cfg, bs, seq, steps, delay = bo.default_sizing(tiny=not on_tpu)
+    sync = bo.run_arm("sync", cfg, on_tpu, bs, seq, steps, delay)
+    pipe = bo.run_arm("pipelined", cfg, on_tpu, bs, seq, steps, delay)
+    pf = pipe.get("prefetch") or {}
+    _emit({
+        "metric": "overlap_pipelined_tokens_per_sec" if on_tpu
+                  else "overlap_cpu_pipelined_tokens_per_sec",
+        "value": pipe["tokens_per_sec"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "tokens_per_sec_sync": sync["tokens_per_sec"],
+        "overlap_speedup": round(pipe["tokens_per_sec"]
+                                 / sync["tokens_per_sec"], 3),
+        "loss_bit_equal": sync["loss"] == pipe["loss"],
+        "host_syncs_sync": sync["host_syncs"],
+        "host_syncs_pipelined": pipe["host_syncs"],
+        "avg_queue_depth": pf.get("avg_queue_depth"),
+        "host_blocked_ms": pf.get("host_blocked_ms"),
+        "prefetch_depth": int(flag_value("prefetch_depth", 2)),
+        "batch_size": bs, "seq_len": seq, "steps": steps,
+        "per_item_delay_s": delay,
+        "baseline_note": "A/B over one slow-host-loader stream; warmup "
+                         "compile excluded (identical in both arms); "
+                         "deferred-fetch losses must be bit-equal to "
+                         "per-step fetch",
+    })
+
+
 def main():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_125m
@@ -540,6 +589,8 @@ if __name__ == "__main__":
         bench_bert_varlen(_on_tpu)
     elif workload == "ppyoloe":
         bench_ppyoloe(_on_tpu)
+    elif workload == "overlap":
+        bench_overlap(_on_tpu)
     elif workload == "llama":
         main()
     elif workload == "all":
@@ -549,6 +600,7 @@ if __name__ == "__main__":
                    lambda: bench_deepfm(_on_tpu),
                    lambda: bench_bert(_on_tpu),
                    lambda: bench_bert_varlen(_on_tpu),
+                   lambda: bench_overlap(_on_tpu),
                    lambda: bench_ppyoloe(_on_tpu)):
             try:
                 fn()
@@ -557,4 +609,4 @@ if __name__ == "__main__":
         main()
     else:
         sys.exit(f"unknown workload {workload!r}; expected llama | resnet50 "
-                 "| deepfm | bert | bert_varlen | ppyoloe | all")
+                 "| deepfm | bert | bert_varlen | ppyoloe | overlap | all")

@@ -149,6 +149,29 @@ def _validate_nan_action(v):
             f"none/warn/skip/raise, got {v!r}")
 
 
+def _validate_positive_int(name):
+    def check(v):
+        if int(v) < 1:
+            raise ValueError(f"FLAGS_{name} must be >= 1, got {v!r}")
+    return check
+
+
+register_flag(
+    "prefetch_depth", 2,
+    help="DevicePrefetcher double-buffer depth: how many batches the "
+         "transfer thread stages ahead (host bucket-pad + device_put) "
+         "while the device computes the current one; >= 1. Depth 2 is the "
+         "classic double buffer — batch N+1 transfers during batch N's "
+         "compute",
+    on_change=_validate_positive_int("prefetch_depth"))
+register_flag(
+    "metric_fetch_interval", 10,
+    help="default log_every for FusedTrainStep.drive: loss/guard metrics "
+         "accumulate on device and are fetched every N steps (each fetch "
+         "is an ~8-15 ms host round-trip over the axon tunnel; N=1 "
+         "restores per-step fetch)",
+    on_change=_validate_positive_int("metric_fetch_interval"))
+
 register_flag(
     "check_nan_inf_action", "none",
     help="FusedTrainStep step-guard action when loss/grads go non-finite: "

@@ -5,4 +5,4 @@ from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
     ReduceLROnPlateau,
 )
-from .model import Model  # noqa: F401
+from .model import DeferredScalar, Model  # noqa: F401

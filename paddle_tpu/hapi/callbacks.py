@@ -96,6 +96,14 @@ def _fmt(v):
         return f"{v:.4f}"
     if isinstance(v, (list, tuple, np.ndarray)):
         return "[" + ", ".join(_fmt(x) for x in np.ravel(v)) + "]"
+    if hasattr(v, "__float__"):
+        # deferred device scalar (hapi lazy loss): the device→host fetch
+        # happens here, at the logging boundary. Non-scalar values (a
+        # multi-element Tensor in a custom metric) keep the str() fallback.
+        try:
+            return f"{float(v):.4f}"
+        except (TypeError, ValueError):
+            return str(v)
     return str(v)
 
 

@@ -21,7 +21,92 @@ from ..io import DataLoader, Dataset
 from ..metric import Metric
 from .callbacks import config_callbacks
 
-__all__ = ["Model"]
+__all__ = ["Model", "DeferredScalar"]
+
+
+class DeferredScalar:
+    """Lazy device scalar returned by ``train_batch``/``eval_batch``: holds
+    the device value and materializes (ONE host round-trip — ~8–15 ms over
+    the axon tunnel, PERF.md) only when converted via ``float()`` /
+    ``numpy()`` / formatting. Until then it rides through logs dicts and
+    callback plumbing without forcing a per-step device→host sync; the
+    logging boundary (``log_freq``) is where conversion actually happens."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def item(self):
+        return float(self)
+
+    def __format__(self, spec):
+        return format(float(self), spec)
+
+    def __repr__(self):
+        return repr(float(self))
+
+    # arithmetic/comparison compatibility with the plain float these APIs
+    # used to return — each materializes (the caller chose the boundary)
+    def __add__(self, o):
+        return float(self) + o
+
+    def __radd__(self, o):
+        return o + float(self)
+
+    def __sub__(self, o):
+        return float(self) - o
+
+    def __rsub__(self, o):
+        return o - float(self)
+
+    def __mul__(self, o):
+        return float(self) * o
+
+    def __rmul__(self, o):
+        return o * float(self)
+
+    def __truediv__(self, o):
+        return float(self) / o
+
+    def __rtruediv__(self, o):
+        return o / float(self)
+
+    def __neg__(self):
+        return -float(self)
+
+    def __abs__(self):
+        return abs(float(self))
+
+    def __lt__(self, o):
+        return float(self) < o
+
+    def __le__(self, o):
+        return float(self) <= o
+
+    def __gt__(self, o):
+        return float(self) > o
+
+    def __ge__(self, o):
+        return float(self) >= o
+
+    def __eq__(self, o):
+        return float(self) == o
+
+    def __ne__(self, o):
+        return float(self) != o
+
+    __hash__ = None  # mutable-ish device handle; hash like a list, not a float
 
 
 def _to_list(x):
@@ -73,7 +158,10 @@ class Model:
     # -- single-batch APIs ----------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         """ref model.py train_batch — one fwd/bwd(/step); returns
-        ([loss], [metric results])."""
+        ([loss], [metric results]). The loss is a :class:`DeferredScalar`
+        — a lazy device value that materializes on ``float()`` — so a
+        tight loop over train_batch does not pay a device→host round-trip
+        per step (fetch happens at the logging boundary)."""
         assert self._optimizer is not None, "call prepare() first"
         self.network.train()
         inputs = _tensorize(inputs)
@@ -97,7 +185,7 @@ class Model:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
         metrics = self._update_metrics(outs, labels)
-        return [float(loss.numpy())], metrics
+        return [DeferredScalar(loss)], metrics
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -106,7 +194,7 @@ class Model:
         outs = self.network(*inputs)
         loss = self._compute_loss(outs, labels)
         metrics = self._update_metrics(outs, labels)
-        return ([float(loss.numpy())] if loss is not None else [], metrics)
+        return ([DeferredScalar(loss)] if loss is not None else [], metrics)
 
     def predict_batch(self, inputs):
         self.network.eval()
@@ -169,13 +257,30 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        """ref model.py:1756."""
+            accumulate_grad_batches=1, num_iters=None, prefetch=True):
+        """ref model.py:1756.
+
+        Host–device overlap: train batches stream through a
+        ``paddle.io.DevicePrefetcher`` (``prefetch=False`` disables) so
+        host batch production + H2D transfer overlap the step's compute,
+        and per-step losses stay lazy (:class:`DeferredScalar`) so the
+        loop pays a device→host round-trip only at logging boundaries
+        (``log_freq``; prepared Metrics still fetch per step — metric
+        update is host-side accumulation by contract)."""
         assert self._optimizer is not None, "call prepare() first"
         loader = self._as_loader(train_data, batch_size, shuffle,
                                  num_workers, drop_last)
         eval_loader = self._as_loader(eval_data, batch_size, False,
                                       num_workers, False)
+        stream = loader
+        if prefetch and loader is not None:
+            from ..io.prefetch import DevicePrefetcher
+
+            if not isinstance(loader, DevicePrefetcher):
+                stream = DevicePrefetcher(
+                    loader,
+                    name=f"hapi.fit[{type(self.network).__name__}]"
+                         ".prefetch")
         try:
             steps = len(loader)
         except TypeError:
@@ -192,7 +297,7 @@ class Model:
             cbks.on_epoch_begin(epoch)
             self._reset_metrics()
             logs = {}
-            for step, batch in enumerate(loader):
+            for step, batch in enumerate(stream):
                 cbks.on_train_batch_begin(step)
                 batch = _to_list(batch)
                 ins, labs = self._split_batch(batch)
@@ -227,8 +332,19 @@ class Model:
             l, _ = self.eval_batch(ins, labs)
             losses.extend(l)
             cbks.on_eval_batch_end(step)
-        logs = {**({"eval_loss": float(np.mean(losses))} if losses else {}),
-                **self._metric_logs("eval_")}
+        # lazy eval losses materialize HERE, at the eval logging boundary —
+        # stacked on device first so the whole eval pays ONE host
+        # round-trip, not one per batch
+        if losses:
+            import jax.numpy as jnp
+
+            stacked = np.asarray(jnp.stack(
+                [jnp.asarray(l._data if isinstance(l, DeferredScalar)
+                             else float(l), jnp.float32) for l in losses]))
+            eval_loss = {"eval_loss": float(stacked.mean())}
+        else:
+            eval_loss = {}
+        logs = {**eval_loss, **self._metric_logs("eval_")}
         # EarlyStopping monitors unprefixed names too
         logs.update({k[len("eval_"):]: v for k, v in logs.items()
                      if k.startswith("eval_")})

@@ -9,6 +9,16 @@ per step (to_static forward, backward, optimizer); this collapses them
 into one jit with parameter/moment buffer donation, so weights are
 updated in place in HBM and per-step dispatch overhead is one call.
 
+Host–device overlap: loss, the finite flag and the bias-correction step
+count are device-resident (threaded through the executable as one donated
+accumulator), so nothing forces a device→host round-trip per step. The
+``drive(loader, steps, log_every=...)`` multi-step driver exploits that:
+batches stream through a ``paddle.io.DevicePrefetcher`` (H2D overlapped
+with compute), dispatches queue back-to-back, and metrics are fetched
+every ``log_every`` steps (``FLAGS_metric_fetch_interval``) — amortizing
+the ~8–15 ms axon-tunnel sync PERF.md measured, with a trajectory
+bit-identical to per-step fetch (skip-step semantics are in-graph).
+
 Supported optimizers: SGD, Momentum, Adam, AdamW (the bench/optimizer
 hot set). Learning-rate schedulers are honored by passing the current lr
 as a traced scalar. ClipGradByGlobalNorm is fused in-graph when set on
@@ -93,6 +103,14 @@ class FusedTrainStep:
                        and not self._tensors[n].stop_gradient]
         self._params = {n: self._tensors[n]._data for n in self._names}
         self._step_count = 0
+        # device-resident step metrics, threaded through the executable as
+        # one donated tuple: (bias-correction step count, running loss sum,
+        # skipped-step count). The step count lives ON DEVICE — in protect
+        # mode it advances only on finite steps IN-GRAPH — so a deferred
+        # metric fetch (drive/log_every) is bit-identical to per-step
+        # fetch even across NaN-skipped windows. self._step_count stays as
+        # the host mirror for telemetry (synced at fetch boundaries).
+        self._acc = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
 
         opt = optimizer
         if isinstance(opt, AdamW):
@@ -157,7 +175,8 @@ class FusedTrainStep:
         # + skip-step select): flipping FLAGS_check_nan_inf_action between
         # modes mid-run costs one recompile, steady state costs none and
         # the guard-off path stays exactly the pre-guard program
-        self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2),
+        self._jitted = jax.jit(self._step_impl,
+                               donate_argnums=(0, 1, 2, 3),
                                static_argnums=(8,))
 
     # -- pure step ------------------------------------------------------
@@ -174,8 +193,10 @@ class FusedTrainStep:
             out = out[0]
         return out * scale  # loss scaling fused in-graph (scale==1 => no-op)
 
-    def _step_impl(self, params, m1, m2, step, lr, scale, data, kwdata,
+    def _step_impl(self, params, m1, m2, acc, lr, scale, data, kwdata,
                    guard):
+        step_prev, loss_sum, skips = acc
+        step = step_prev + 1.0  # bias-correction count for THIS step
         loss, grads = jax.value_and_grad(self._loss)(params, data, kwdata,
                                                      scale)
         # unscale: grads of the scaled loss divided by scale are the true
@@ -250,7 +271,9 @@ class FusedTrainStep:
         if guard == "protect":
             # skip-step semantics: a non-finite step leaves params AND
             # moments untouched (one jnp.where per buffer — XLA fuses the
-            # select into the update, no extra memory traffic)
+            # select into the update, no extra memory traffic), and the
+            # bias-correction count does not advance — all in-graph, so no
+            # host fetch is needed for the discard to be correct
             def keep(new, old):
                 return {n: jnp.where(all_finite, new[n], old[n])
                         for n in new}
@@ -258,7 +281,16 @@ class FusedTrainStep:
             new_p = keep(new_p, params)
             new_m1 = keep(new_m1, m1) if new_m1 is not m1 else m1
             new_m2 = keep(new_m2, m2) if new_m2 is not m2 else m2
-        return loss, all_finite, new_p, new_m1, new_m2
+            new_step = jnp.where(all_finite, step, step_prev)
+            new_skips = skips + jnp.where(all_finite, 0.0, 1.0)
+            # a skipped step must not poison the running loss sum with NaN
+            loss_inc = jnp.where(all_finite, _f32(loss), 0.0)
+        else:
+            new_step = step
+            new_skips = skips
+            loss_inc = _f32(loss)
+        new_acc = (new_step, loss_sum + loss_inc, new_skips)
+        return loss, all_finite, new_acc, new_p, new_m1, new_m2
 
     # -- public ---------------------------------------------------------
     def lowered_flops(self, *data, **kwdata):
@@ -269,7 +301,8 @@ class FusedTrainStep:
         darrs, karrs = self._prepare_arrays(data, kwdata, record=False)
         try:
             lowered = self._jitted.lower(
-                self._params, self._m1, self._m2, jnp.float32(1),
+                self._params, self._m1, self._m2,
+                (jnp.float32(0), jnp.float32(0), jnp.float32(0)),
                 jnp.float32(1e-3), jnp.float32(1), darrs, karrs, "off")
             cost = lowered.cost_analysis()
             if not (hasattr(cost, "get") and cost.get("flops")):
@@ -345,7 +378,10 @@ class FusedTrainStep:
         / ``auto_resume(optimizer=...)``."""
         import numpy as np
 
-        sd = {"step_count": self._step_count}
+        # the authoritative step count is the device accumulator (the host
+        # mirror can lag inside a deferred-fetch window) — one host sync
+        # here, at the checkpoint boundary
+        sd = {"step_count": int(np.asarray(self._acc[0]))}
         for prefix, store in (("m1", self._m1), ("m2", self._m2)):
             for n, v in store.items():
                 sd[f"{prefix}.{n}"] = np.asarray(v)
@@ -353,6 +389,8 @@ class FusedTrainStep:
 
     def set_state_dict(self, sd):
         self._step_count = int(sd.get("step_count", self._step_count))
+        self._acc = (jnp.float32(self._step_count), self._acc[1],
+                     self._acc[2])
         for prefix, store in (("m1", self._m1), ("m2", self._m2)):
             for n in store:
                 key = f"{prefix}.{n}"
@@ -373,6 +411,21 @@ class FusedTrainStep:
             t = self._tensors[n]._data
             if t is not self._params[n]:
                 self._params[n] = t
+
+    def device_metrics(self):
+        """The device-resident accumulator, fetched in ONE host sync:
+        ``{"step_count", "loss_sum", "skipped"}``. ``loss_sum`` is the
+        running sum of applied per-step losses (non-finite skipped steps
+        excluded in protect mode), ``skipped`` counts in-graph discards.
+        Authoritative at any time — including inside a deferred-fetch
+        window, where the host mirrors (``guard_stats``) lag until the
+        next boundary."""
+        import numpy as np
+
+        vals = np.asarray(jnp.stack([jnp.asarray(a, jnp.float32)
+                                     for a in self._acc]))
+        return {"step_count": int(vals[0]), "loss_sum": float(vals[1]),
+                "skipped": int(vals[2])}
 
     def guard_stats(self):
         """Step-anomaly-guard counters: ``total`` dispatched steps,
@@ -398,9 +451,30 @@ class FusedTrainStep:
                 return tuple(darrs), karrs
         return tuple(darrs), karrs
 
+    def _dispatch(self, data, kwdata, guard, scale_val):
+        """One asynchronous dispatch of the fused executable: prepare and
+        bucket-pad inputs, fire, rebind donated buffers. Returns the lazy
+        (loss, finite) device values — NO host sync happens here; that is
+        the caller's choice (per-step in ``__call__``, per-window in
+        ``drive``)."""
+        from ..utils import fault_injection
+
+        lr = jnp.float32(self.optimizer.get_lr())
+        self._adopt_external_rebinds()
+        darrs, karrs = self._prepare_arrays(data, kwdata)
+        if fault_injection.should_fire("train.grad_nan"):
+            darrs, karrs = self._poison_nan(darrs, karrs)
+        self._count_dispatch(darrs, karrs)
+        loss, finite, self._acc, self._params, self._m1, self._m2 = \
+            self._jitted(self._params, self._m1, self._m2, self._acc, lr,
+                         jnp.float32(scale_val), darrs, karrs, guard)
+        # donation invalidated the old buffers — rebind the live Tensors
+        for n in self._names:
+            self._tensors[n]._rebind(self._params[n])
+        return loss, finite
+
     def __call__(self, *data, **kwdata):
         from ..core.flags import flag_value
-        from ..utils import fault_injection
 
         self._step_count += 1
         self._guard["total"] += 1
@@ -417,19 +491,7 @@ class FusedTrainStep:
         protect = scaler is not None or action in ("skip", "raise")
         guard = "protect" if protect else ("flag" if guard_active else "off")
         scale_val = 1.0 if scaler is None else float(scaler._scale)
-        lr = jnp.float32(self.optimizer.get_lr())
-        self._adopt_external_rebinds()
-        darrs, karrs = self._prepare_arrays(data, kwdata)
-        if fault_injection.should_fire("train.grad_nan"):
-            darrs, karrs = self._poison_nan(darrs, karrs)
-        self._count_dispatch(darrs, karrs)
-        loss, finite, self._params, self._m1, self._m2 = self._jitted(
-            self._params, self._m1, self._m2,
-            jnp.float32(self._step_count), lr, jnp.float32(scale_val),
-            darrs, karrs, guard)
-        # donation invalidated the old buffers — rebind the live Tensors
-        for n in self._names:
-            self._tensors[n]._rebind(self._params[n])
+        loss, finite = self._dispatch(data, kwdata, guard, scale_val)
         skipped = False
         if guard_active:
             ok = bool(finite)  # the guard's single host sync
@@ -470,6 +532,232 @@ class FusedTrainStep:
             if hasattr(sched, "step"):
                 sched.step()
         return Tensor._wrap(loss)
+
+    # -- multi-step driver ----------------------------------------------
+    @staticmethod
+    def _call_form(batch):
+        """A loader batch as this step's call arguments: tuples/lists are
+        positional, dicts travel by keyword, anything else is one arg."""
+        if isinstance(batch, dict):
+            return (), batch
+        if isinstance(batch, (list, tuple)):
+            return tuple(batch), {}
+        return (batch,), {}
+
+    def drive(self, data, steps=None, log_every=None, prefetch=None,
+              prefetch_depth=None, on_window=None):
+        """Multi-step driver: dispatch fused steps back-to-back with NO
+        per-step host sync, so the device executable queue stays deep while
+        the input side is double-buffered by a :class:`DevicePrefetcher`.
+
+        Per step the host does only: pull a staged batch, dispatch, enqueue
+        the lazy (loss, finite) handles. Every ``log_every`` steps
+        (default ``FLAGS_metric_fetch_interval``) the window is fetched in
+        O(1) host round-trips — one ``jnp.stack`` of the window losses (+
+        one of the finite flags when the guard is armed) — and the guard's
+        host bookkeeping (warn/skip counters, ``raise``) is replayed.
+        Skip-step semantics need no host involvement at all: a non-finite
+        step's update AND its bias-correction advance are discarded
+        in-graph, so the deferred trajectory is bit-identical to per-step
+        fetch.
+
+        ``data`` is any batch iterable (DataLoader, list of batches, or an
+        existing DevicePrefetcher). ``prefetch=False`` disables the
+        wrapping; by default batches are staged through a prefetcher that
+        inherits this step's shape buckets / bucket_args so pre-padded
+        shapes hit the same executables (zero extra compiles).
+
+        Deferred-mode differences, stated honestly: an attached enabled
+        GradScaler forces the per-step-fetch path (the scale for step N+1
+        depends on step N's finite flag); an LR scheduler advances every
+        step including ones later found non-finite (the skip signal is not
+        on host until the boundary); ``action='raise'`` raises at the fetch
+        boundary, with the offending updates already discarded in-graph.
+        Checkpoint at fetch boundaries (e.g. from ``on_window``) —
+        ``state_dict`` reads the authoritative device step count.
+
+        Returns ``{"steps", "loss" (per-step floats), "skipped",
+        "windows", "host_syncs", "log_every", "deferred", "prefetch"}``.
+        """
+        from ..core.flags import flag_value
+        from ..io.prefetch import DevicePrefetcher
+
+        if log_every is None:
+            log_every = int(flag_value("metric_fetch_interval", 10))
+        log_every = max(1, int(log_every))
+        stream = data
+        made_prefetcher = None
+        if prefetch is None:
+            prefetch = not isinstance(data, DevicePrefetcher)
+        if prefetch and not isinstance(data, DevicePrefetcher):
+            import itertools
+
+            # cap the SOURCE at steps too: otherwise the transfer thread
+            # reads ahead of the cap and discards up to depth+1 batches a
+            # one-shot iterator's owner still wanted
+            source = (itertools.islice(iter(data), steps)
+                      if steps is not None else data)
+            made_prefetcher = DevicePrefetcher(
+                source, depth=prefetch_depth,
+                shape_buckets=self._shape_buckets,
+                bucket_args=self._bucket_args,
+                name=f"{self._stats_name}.prefetch")
+            stream = made_prefetcher
+        history = {"steps": 0, "loss": [], "skipped": 0, "windows": 0,
+                   "host_syncs": 0, "log_every": log_every,
+                   "deferred": True, "prefetch": None}
+
+        scaler = (self._scaler if self._scaler is not None
+                  and self._scaler.is_enable() else None)
+        if scaler is not None:
+            # dynamic loss scaling consumes the finite flag every step —
+            # fall back to the per-step path (prefetch still overlaps H2D)
+            import numpy as np
+
+            history["deferred"] = False
+            skipped_before = self._guard["skipped"]
+            win_start, win_skips = 0, self._guard["skipped"]
+            it = iter(stream)
+
+            def scaler_window_end():
+                # on_window still fires at every log boundary (it is the
+                # documented checkpoint hook), just with per-step-fetched
+                # values instead of a deferred stack
+                nonlocal win_start, win_skips
+                chunk = np.float32(history["loss"][win_start:])
+                history["windows"] += 1
+                if on_window is not None:
+                    on_window({"losses": chunk,
+                               "mean_loss": float(chunk.mean()),
+                               "non_finite": (self._guard["skipped"]
+                                              - win_skips),
+                               "step": history["steps"]})
+                win_start = len(history["loss"])
+                win_skips = self._guard["skipped"]
+
+            while steps is None or history["steps"] < steps:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                args, kw = self._call_form(batch)
+                loss = self(*args, **kw)
+                history["steps"] += 1
+                history["loss"].append(float(loss.numpy()))
+                history["host_syncs"] += 2  # finite flag + loss value
+                if history["steps"] % log_every == 0:
+                    scaler_window_end()
+            if len(history["loss"]) > win_start:
+                scaler_window_end()
+            history["skipped"] = self._guard["skipped"] - skipped_before
+            if made_prefetcher is not None:
+                history["prefetch"] = made_prefetcher.stats()
+            return history
+
+        # guard mode is pinned for the whole drive (one executable); flag
+        # changes take effect at the next drive()/__call__
+        action = str(flag_value("check_nan_inf_action", "none"))
+        protect = action in ("skip", "raise")
+        guard = "protect" if protect else ("flag" if action != "none"
+                                           else "off")
+        window = []
+        sched = (getattr(self.optimizer, "_learning_rate", None)
+                 if self._step_lr_scheduler else None)
+        try:
+            it = iter(stream)
+            # count checked BEFORE pulling: a one-shot iterator keeps its
+            # remaining batches when steps caps the run
+            while steps is None or history["steps"] < steps:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                args, kw = self._call_form(batch)
+                self._step_count += 1
+                self._guard["total"] += 1
+                loss, finite = self._dispatch(args, kw, guard, 1.0)
+                window.append((loss, finite))
+                history["steps"] += 1
+                if hasattr(sched, "step"):
+                    sched.step()
+                if len(window) >= log_every:
+                    # swap-clear BEFORE flushing: if the flush raises
+                    # (action='raise'), the trailing flush below must not
+                    # replay the same window's bookkeeping
+                    full, window = window, []
+                    self._flush_window(full, action, protect, history,
+                                       on_window)
+            # trailing partial window: flushed only on clean exit — an
+            # exception escaping the loop must propagate, not be replaced
+            # by a boundary FloatingPointError (the device state is already
+            # correct either way; in-graph semantics never needed the host)
+            if window:
+                self._flush_window(window, action, protect, history,
+                                   on_window)
+        except BaseException:
+            # the unfetched window's finite flags are lost with the
+            # exception — resync the host mirrors from the authoritative
+            # device accumulator so guard_stats()/step numbering stay
+            # exact for the rest of the process
+            if protect:
+                try:
+                    dm = self.device_metrics()
+                    self._step_count = dm["step_count"]
+                    self._guard["skipped"] = dm["skipped"]
+                except Exception:
+                    pass
+            raise
+        finally:
+            if made_prefetcher is not None:
+                history["prefetch"] = made_prefetcher.stats()
+        return history
+
+    def _flush_window(self, window, action, protect, history, on_window):
+        """Fetch one deferred window (O(1) host round-trips) and replay the
+        per-step guard bookkeeping that per-step fetch would have done."""
+        import warnings
+
+        import numpy as np
+
+        losses = np.asarray(
+            jnp.stack([jnp.asarray(l, jnp.float32) for l, _ in window]))
+        history["host_syncs"] += 1
+        finite = None
+        if action != "none":
+            finite = np.asarray(jnp.stack([f for _, f in window]))
+            history["host_syncs"] += 1
+        n_bad = 0
+        if finite is not None:
+            for ok in finite:
+                if ok:
+                    self._guard["consecutive_skips"] = 0
+                else:
+                    n_bad += 1
+                    if action == "warn":
+                        self._guard["warned"] += 1
+                    if protect:
+                        self._guard["skipped"] += 1
+                        self._guard["consecutive_skips"] += 1
+                        self._step_count -= 1  # device step did not advance
+            if n_bad and action == "warn":
+                warnings.warn(
+                    f"non-finite loss/grads on {n_bad} step(s) in the last "
+                    f"{len(window)}-step window — updates applied anyway "
+                    "(FLAGS_check_nan_inf_action=warn, deferred fetch)",
+                    stacklevel=3)
+        history["loss"].extend(float(v) for v in losses)
+        if protect:
+            history["skipped"] += n_bad
+        history["windows"] += 1
+        if on_window is not None:
+            on_window({"losses": losses, "mean_loss": float(losses.mean()),
+                       "non_finite": n_bad, "step": history["steps"]})
+        if n_bad and action == "raise":
+            raise FloatingPointError(
+                f"non-finite loss/grads on {n_bad} step(s) detected at the "
+                "metric-fetch boundary; the updates were already discarded "
+                "in-graph (FLAGS_check_nan_inf_action=raise, deferred "
+                "fetch)")
 
 
 def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True,

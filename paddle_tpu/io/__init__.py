@@ -728,3 +728,10 @@ __all__ += ["SubsetRandomSampler", "ConcatDataset"]
 from .bucketing import BucketedBatchSampler, PadToBucket  # noqa: E402,F401
 
 __all__ += ["BucketedBatchSampler", "PadToBucket"]
+
+# double-buffered host->device prefetch (overlap layer; composes with the
+# bucketing above: staged batches are padded to bucket shapes off the
+# critical path)
+from .prefetch import DevicePrefetcher  # noqa: E402,F401
+
+__all__ += ["DevicePrefetcher"]

@@ -246,7 +246,8 @@ class FunctionCacheStats:
     """Per-entry-point compile-cache counters (one per function name)."""
 
     __slots__ = ("name", "compiles", "hits", "eager_fallbacks",
-                 "bucket_pads", "per_shape_misses", "_warned")
+                 "bucket_pads", "per_shape_misses", "_warned",
+                 "host_blocked_ms", "queue_depth_sum", "queue_depth_n")
 
     def __init__(self, name):
         self.name = name
@@ -256,6 +257,13 @@ class FunctionCacheStats:
         self.bucket_pads = 0
         self.per_shape_misses = {}
         self._warned = False
+        # host-device overlap telemetry (DevicePrefetcher / drive): how
+        # long the consumer blocked waiting on the transfer thread, and the
+        # staged-batch queue depth sampled at each get (depth ~0 means the
+        # host is the bottleneck, depth ~prefetch_depth means the device is)
+        self.host_blocked_ms = 0.0
+        self.queue_depth_sum = 0
+        self.queue_depth_n = 0
 
     def as_dict(self):
         return {
@@ -264,6 +272,10 @@ class FunctionCacheStats:
             "eager_fallbacks": self.eager_fallbacks,
             "bucket_pads": self.bucket_pads,
             "per_shape_misses": dict(self.per_shape_misses),
+            "host_blocked_ms": round(self.host_blocked_ms, 3),
+            "avg_queue_depth": (
+                round(self.queue_depth_sum / self.queue_depth_n, 3)
+                if self.queue_depth_n else None),
         }
 
 
@@ -332,6 +344,22 @@ def record_bucket_pads(name, n):
             _stats_for(name).bucket_pads += n
 
 
+def record_host_blocked(name, ms):
+    """Count milliseconds the consumer spent blocked on the host input
+    path (waiting for the prefetch thread to deliver a staged batch)."""
+    with _LOCK:
+        _stats_for(name).host_blocked_ms += float(ms)
+
+
+def record_queue_depth(name, depth):
+    """Sample the staged-batch queue depth at a consumer get — the direct
+    gauge of who is the bottleneck (0 = host-bound, max = device-bound)."""
+    with _LOCK:
+        s = _stats_for(name)
+        s.queue_depth_sum += int(depth)
+        s.queue_depth_n += 1
+
+
 def cache_stats(name=None):
     """Compile-cache telemetry for every jitted entry point.
 
@@ -341,7 +369,10 @@ def cache_stats(name=None):
     served by an already-compiled executable, ``eager_fallbacks`` counts
     uncompiled per-call executions (the 10-100x cliff), and
     ``per_shape_misses`` maps each missing input-shape signature to how many
-    compiles it caused."""
+    compiles it caused. ``host_blocked_ms`` / ``avg_queue_depth`` are the
+    host-device overlap gauges recorded by ``io.DevicePrefetcher`` (time
+    the consumer waited on the transfer thread; staged-queue depth at each
+    get — 0 means host-bound, prefetch_depth means device-bound)."""
     with _LOCK:
         if name is not None:
             s = _STATS.get(name)

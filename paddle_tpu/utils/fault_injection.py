@@ -18,6 +18,9 @@ Sites wired into the framework:
   guard must react.
 - ``fs.rename``         — fleet.utils.fs.LocalFS.rename, fired before the
   os.rename (exercises the transient-OSError retry/backoff path).
+- ``io.prefetch``       — DevicePrefetcher transfer thread, fired before a
+  batch is staged (bucket-pad + device_put): the thread dies and the
+  consumer must fall back to synchronous transfers without losing a batch.
 
 Arming a site is scoped and seeded::
 
@@ -42,7 +45,8 @@ import random
 
 __all__ = ["SITES", "InjectedFault", "inject", "fire", "should_fire"]
 
-SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename")
+SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
+         "io.prefetch")
 
 
 class InjectedFault(OSError):

@@ -1,0 +1,470 @@
+"""Host–device overlap layer (ISSUE 3): DevicePrefetcher staging +
+fallback, FusedTrainStep deferred metric fetch (drive), guard semantics
+across deferred windows, bucket integration (zero extra compiles), and the
+hapi lazy-loss path."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import io, jit
+from paddle_tpu.hapi import DeferredScalar
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_overlap_state():
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf_action": "none"})
+    paddle.set_flags({"FLAGS_prefetch_depth": 2})
+    paddle.set_flags({"FLAGS_metric_fetch_interval": 10})
+    jit.set_shape_buckets(None)
+    jit.reset_cache_stats()
+
+
+def _mlp_step(shape_buckets=None, in_dim=8):
+    paddle.seed(11)
+    model = nn.Sequential(nn.Linear(in_dim, 16), nn.Tanh(),
+                          nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-2)
+    step = paddle.incubate.fused_train_step(
+        model, opt, loss_fn=lambda o: (o ** 2).mean(),
+        shape_buckets=shape_buckets)
+    return model, step
+
+
+def _batches(n, bs=8, feat=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(bs, feat).astype("float32"),) for _ in range(n)]
+
+
+def _params(model):
+    return {n: np.asarray(p._data) for n, p in model.named_parameters()}
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher
+# ---------------------------------------------------------------------------
+
+class TestDevicePrefetcher:
+    def test_delivers_all_batches_in_order(self):
+        batches = [(np.full((2, 3), i, np.float32),) for i in range(9)]
+        out = list(io.DevicePrefetcher(batches, depth=3))
+        assert len(out) == 9
+        for i, (t,) in enumerate(out):
+            assert t.__class__.__name__ == "Tensor"
+            np.testing.assert_array_equal(t.numpy(),
+                                          np.full((2, 3), i, np.float32))
+
+    def test_wraps_dataloader_and_is_reiterable(self):
+        class DS(io.Dataset):
+            def __getitem__(self, i):
+                return np.float32([i, i + 1])
+
+            def __len__(self):
+                return 8
+
+        loader = io.DataLoader(DS(), batch_size=4, shuffle=False)
+        pf = io.DevicePrefetcher(loader)
+        assert len(pf) == len(loader)
+        for _ in range(2):  # fresh transfer thread per epoch
+            epochs = [b.numpy() for b in pf]
+            assert len(epochs) == 2
+
+    def test_overlap_wall_clock(self):
+        """A per-item host delay must overlap consumer work: pipelined
+        wall-clock < 0.7x synchronous (ISSUE 3 acceptance shape)."""
+        d, n = 0.03, 10
+
+        class SlowDS(io.Dataset):
+            def __getitem__(self, i):
+                time.sleep(d)
+                return np.float32([i])
+
+            def __len__(self):
+                return n
+
+        def consume(it):
+            t0 = time.perf_counter()
+            for _ in it:
+                time.sleep(d)  # stands in for device compute
+            return time.perf_counter() - t0
+
+        loader = io.DataLoader(SlowDS(), batch_size=1)
+        sync = consume(iter(loader))
+        pipelined = consume(iter(io.DevicePrefetcher(loader, depth=2)))
+        assert pipelined < 0.7 * sync, (pipelined, sync)
+
+    def test_stats_and_cache_telemetry(self):
+        batches = _batches(6)
+        pf = io.DevicePrefetcher(batches, depth=2)
+        list(pf)
+        s = pf.stats()
+        assert s["prefetched"] == 6 and s["batches"] == 6
+        assert not s["fallback"]
+        row = jit.cache_stats(pf._stats_name)
+        assert row["host_blocked_ms"] >= 0.0
+        assert row["avg_queue_depth"] is not None
+
+    def test_depth_flag_and_validation(self):
+        assert io.DevicePrefetcher([], ).depth == 2  # FLAGS_prefetch_depth
+        paddle.set_flags({"FLAGS_prefetch_depth": 4})
+        assert io.DevicePrefetcher([]).depth == 4
+        with pytest.raises(ValueError):
+            io.DevicePrefetcher([], depth=0)
+        with pytest.raises(ValueError):
+            paddle.set_flags({"FLAGS_prefetch_depth": 0})
+
+    def test_transfer_thread_death_falls_back_without_losing_batches(self):
+        batches = [(np.full((2, 2), i, np.float32),) for i in range(8)]
+        pf = io.DevicePrefetcher(batches, depth=2)
+        with fi.inject("io.prefetch", max_fires=1):
+            with pytest.warns(RuntimeWarning, match="falling back"):
+                out = list(pf)
+        assert len(out) == 8  # the batch the dead thread held is recovered
+        for i, (t,) in enumerate(out):
+            np.testing.assert_array_equal(t.numpy(),
+                                          np.full((2, 2), i, np.float32))
+        s = pf.stats()
+        assert s["fallback"] and s["sync_fallback"] >= 1
+
+    def test_training_completes_through_prefetch_fault(self):
+        _, step = _mlp_step()
+        with fi.inject("io.prefetch", max_fires=1):
+            with pytest.warns(RuntimeWarning, match="falling back"):
+                hist = step.drive(_batches(10), log_every=4)
+        assert hist["steps"] == 10
+        assert all(np.isfinite(hist["loss"]))
+
+    def test_source_error_propagates(self):
+        def gen():
+            yield (np.zeros((2, 2), np.float32),)
+            raise ValueError("loader broke")
+
+        with pytest.raises(ValueError, match="loader broke"):
+            list(io.DevicePrefetcher(gen()))
+
+
+# ---------------------------------------------------------------------------
+# deferred metric fetch (drive)
+# ---------------------------------------------------------------------------
+
+class TestDeferredFetch:
+    def test_drive_bit_equal_to_per_step_fetch_over_50_steps(self):
+        batches = _batches(50)
+
+        model_a, step_a = _mlp_step()
+        losses_a = [float(step_a(*b).numpy()) for b in batches]
+
+        model_b, step_b = _mlp_step()
+        hist = step_b.drive(batches, log_every=10)
+        assert hist["steps"] == 50 and hist["windows"] == 5
+        assert hist["deferred"] is True
+        np.testing.assert_array_equal(np.float64(losses_a),
+                                      np.float64(hist["loss"]))
+        pa, pb = _params(model_a), _params(model_b)
+        for n in pa:
+            np.testing.assert_array_equal(pa[n], pb[n], err_msg=n)
+
+    def test_drive_respects_steps_log_every_and_syncs(self):
+        _, step = _mlp_step()
+        seen = []
+        hist = step.drive(_batches(10), steps=7, log_every=3,
+                          on_window=lambda w: seen.append(w))
+        assert hist["steps"] == 7
+        assert hist["windows"] == 3  # 3 + 3 + 1
+        # action=none: one fetch per window (stacked losses), no finite
+        # flags to read
+        assert hist["host_syncs"] == 3
+        assert [len(w["losses"]) for w in seen] == [3, 3, 1]
+
+    def test_metric_fetch_interval_flag_is_the_default(self):
+        paddle.set_flags({"FLAGS_metric_fetch_interval": 4})
+        _, step = _mlp_step()
+        hist = step.drive(_batches(8))
+        assert hist["log_every"] == 4 and hist["windows"] == 2
+        with pytest.raises(ValueError):
+            paddle.set_flags({"FLAGS_metric_fetch_interval": 0})
+
+    def test_drive_with_grad_scaler_falls_back_to_per_step(self):
+        paddle.seed(11)
+        model = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=1e-2)
+        scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+        step = paddle.incubate.fused_train_step(
+            model, opt, loss_fn=lambda o: (o ** 2).mean(),
+            grad_scaler=scaler)
+        hist = step.drive(_batches(6), log_every=3)
+        assert hist["deferred"] is False
+        assert hist["steps"] == 6 and len(hist["loss"]) == 6
+
+    def test_drive_does_not_overconsume_a_one_shot_iterator(self):
+        _, step = _mlp_step()
+        it = iter(_batches(5))
+        hist = step.drive(it, steps=3, log_every=2, prefetch=False)
+        assert hist["steps"] == 3
+        # the remaining batches are still the caller's
+        assert len(list(it)) == 2
+
+    def test_drive_prefetcher_source_capped_at_steps(self):
+        # with the default prefetcher, the transfer thread must not read
+        # past the steps cap either (islice'd source)
+        _, step = _mlp_step()
+        it = iter(_batches(8))
+        hist = step.drive(it, steps=3, log_every=2)
+        assert hist["steps"] == 3
+        assert len(list(it)) == 5
+
+    def test_drive_scaler_path_still_fires_on_window(self):
+        paddle.seed(11)
+        model = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=1e-2)
+        scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+        step = paddle.incubate.fused_train_step(
+            model, opt, loss_fn=lambda o: (o ** 2).mean(),
+            grad_scaler=scaler)
+        seen = []
+        hist = step.drive(_batches(7), log_every=3,
+                          on_window=lambda w: seen.append(w))
+        assert hist["deferred"] is False
+        assert hist["windows"] == 3  # 3 + 3 + 1
+        assert [len(w["losses"]) for w in seen] == [3, 3, 1]
+        assert seen[-1]["step"] == 7
+
+    def test_drive_reuses_one_prefetch_stats_row(self):
+        _, step = _mlp_step()
+        step.drive(_batches(4), log_every=2)
+        step.drive(_batches(4), log_every=2)
+        name = f"{step._stats_name}.prefetch"
+        assert jit.cache_stats(name) is not None
+        rows = [n for n in jit.cache_stats() if n.endswith(".prefetch")]
+        assert rows == [name]
+
+    def test_device_metrics_one_sync_authoritative(self):
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+        _, step = _mlp_step()
+        with fi.inject("train.grad_nan", every_n=7):
+            hist = step.drive(_batches(21), log_every=10)
+        dm = step.device_metrics()
+        assert dm["step_count"] == 18 and dm["skipped"] == 3
+        # skipped steps never poisoned the running sum
+        assert np.isfinite(dm["loss_sum"])
+        finite_losses = [l for l in hist["loss"] if np.isfinite(l)]
+        np.testing.assert_allclose(dm["loss_sum"], np.sum(
+            np.float32(finite_losses), dtype=np.float64), rtol=1e-5)
+
+    def test_state_dict_step_count_at_fetch_boundary(self):
+        _, step_a = _mlp_step()
+        for b in _batches(7):
+            step_a(*b)
+        _, step_b = _mlp_step()
+        step_b.drive(_batches(7), log_every=3)
+        assert step_a.state_dict()["step_count"] == 7
+        assert step_b.state_dict()["step_count"] == 7
+
+
+# ---------------------------------------------------------------------------
+# guard semantics across a deferred window
+# ---------------------------------------------------------------------------
+
+class TestGuardDeferred:
+    def test_skip_semantics_bit_equal_across_deferred_window(self):
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+        batches = _batches(21)
+
+        model_a, step_a = _mlp_step()
+        with fi.inject("train.grad_nan", every_n=7):
+            for b in batches:
+                step_a(*b)
+
+        model_b, step_b = _mlp_step()
+        with fi.inject("train.grad_nan", every_n=7):
+            hist = step_b.drive(batches, log_every=10)
+
+        assert step_a.guard_stats()["skipped"] == 3
+        assert step_b.guard_stats()["skipped"] == 3
+        assert hist["skipped"] == 3
+        # skipped steps must not advance bias correction in either mode
+        assert step_a.state_dict()["step_count"] == 18
+        assert step_b.state_dict()["step_count"] == 18
+        pa, pb = _params(model_a), _params(model_b)
+        for n in pa:
+            assert np.isfinite(pa[n]).all()
+            np.testing.assert_array_equal(pa[n], pb[n], err_msg=n)
+
+    def test_raise_fires_at_the_fetch_boundary_with_params_intact(self):
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "raise"})
+        model, step = _mlp_step()
+        before = _params(model)
+        with fi.inject("train.grad_nan"):
+            with pytest.raises(FloatingPointError, match="deferred"):
+                step.drive(_batches(5), log_every=5)
+        # every poisoned update was discarded in-graph before the raise
+        after = _params(model)
+        for n in before:
+            np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+        assert step.guard_stats()["skipped"] == 5
+
+    def test_warn_warns_once_per_window_counts_per_step(self):
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "warn"})
+        _, step = _mlp_step()
+        with fi.inject("train.grad_nan", every_n=2):
+            with pytest.warns(UserWarning, match="deferred fetch"):
+                hist = step.drive(_batches(6), log_every=6)
+        # warn APPLIES the poisoned update, so params go NaN at step 2 and
+        # every later step is non-finite too — 5 warn events, exactly what
+        # the per-step-fetch path would count
+        assert step.guard_stats()["warned"] == 5
+        assert hist["skipped"] == 0  # warn applies the update
+
+
+# ---------------------------------------------------------------------------
+# bucket integration: prefetch pads on the host thread, zero extra compiles
+# ---------------------------------------------------------------------------
+
+class TestBucketIntegration:
+    def _varlen_batches(self, n, seed=0):
+        rng = np.random.RandomState(seed)
+        lengths = [5, 9, 14]
+        return [(rng.randn(4, lengths[i % 3], 4).astype("float32"),)
+                for i in range(n)]
+
+    def test_prefetch_zero_extra_compiles(self):
+        boundaries = [8, 16]
+        batches = self._varlen_batches(9)
+
+        _, step_a = _mlp_step(shape_buckets=boundaries, in_dim=4)
+        for b in batches:
+            step_a(*b)
+        stats_a = jit.cache_stats(step_a._stats_name)
+
+        _, step_b = _mlp_step(shape_buckets=boundaries, in_dim=4)
+        hist = step_b.drive(batches, log_every=3)
+        stats_b = jit.cache_stats(step_b._stats_name)
+
+        # the overlap arm compiles exactly as often as the direct arm:
+        # prefetched batches arrive already padded to bucket shapes
+        assert stats_b["compiles"] == stats_a["compiles"] == 2
+        assert set(stats_b["per_shape_misses"]) == \
+            set(stats_a["per_shape_misses"])
+        # and the padding happened on the transfer thread, not in the step
+        assert hist["prefetch"]["bucket_pads"] > 0
+        assert stats_b["bucket_pads"] == 0
+        assert stats_a["bucket_pads"] > 0
+
+    def test_prefetcher_honors_global_spec_at_stage_time(self):
+        jit.set_shape_buckets([8, 16], axis=1)
+        batches = self._varlen_batches(3)
+        out = list(io.DevicePrefetcher(batches))
+        assert [t.shape[1] for (t,) in out] == [8, 16, 16]
+
+
+# ---------------------------------------------------------------------------
+# hapi deferred logging
+# ---------------------------------------------------------------------------
+
+class ToyDS(io.Dataset):
+    def __init__(self, n=32, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype("float32")
+        self.y = (self.x.sum(1) > 0).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TestHapiDeferred:
+    def _model(self):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        return net, model
+
+    def test_train_batch_returns_lazy_loss(self):
+        _, model = self._model()
+        x = np.random.randn(4, 8).astype("float32")
+        y = np.random.randint(0, 2, (4,)).astype("int64")
+        losses, _ = model.train_batch([x], [y])
+        assert isinstance(losses[0], DeferredScalar)
+        v = float(losses[0])  # materializes here
+        assert np.isfinite(v)
+        assert np.asarray(losses[0]).shape == ()
+        assert f"{losses[0]:.4f}" == f"{v:.4f}"
+        # float-compatible like the plain float these APIs used to return
+        assert losses[0] + 1.0 == v + 1.0
+        assert 2.0 * losses[0] == 2.0 * v
+        assert sum([losses[0], losses[0]]) == v + v
+        assert (losses[0] < v + 1.0) and (losses[0] >= v)
+        assert losses[0] == v
+
+    def test_eval_batch_returns_lazy_loss(self):
+        _, model = self._model()
+        x = np.random.randn(4, 8).astype("float32")
+        y = np.random.randint(0, 2, (4,)).astype("int64")
+        losses, _ = model.eval_batch([x], [y])
+        assert isinstance(losses[0], DeferredScalar)
+        assert np.isfinite(float(losses[0]))
+
+    def test_fit_prefetch_matches_no_prefetch_bitwise(self):
+        paddle.seed(3)
+        net1, model1 = self._model()
+        paddle.seed(3)
+        net2, model2 = self._model()
+        ds = ToyDS(32)
+        model1.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+                   prefetch=True)
+        model2.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+                   prefetch=False)
+        for (n, p1), (_, p2) in zip(net1.named_parameters(),
+                                    net2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy(),
+                                          err_msg=n)
+
+    def test_fit_logs_format_at_boundaries(self, capsys):
+        _, model = self._model()
+        model.fit(ToyDS(16), batch_size=8, epochs=1, verbose=2, log_freq=1)
+        out = capsys.readouterr().out
+        assert "loss:" in out
+        # formatted as a number, not an object repr
+        assert "DeferredScalar" not in out and "Tensor" not in out
+
+    def test_evaluate_still_returns_floats(self):
+        _, model = self._model()
+        res = model.evaluate(ToyDS(16), batch_size=8, verbose=0)
+        assert isinstance(res["eval_loss"], float)
+
+
+# ---------------------------------------------------------------------------
+# slow-tier A/B acceptance (scripts/bench_overlap.py harness)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_overlap_ab_speedup_and_loss_parity():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import bench_overlap as bo
+
+    cfg, bs, seq, steps, delay = bo.default_sizing(tiny=True)
+    sync = bo.run_arm("sync", cfg, False, bs, seq, steps, delay)
+    pipe = bo.run_arm("pipelined", cfg, False, bs, seq, steps, delay,
+                      log_every=10)
+    # ISSUE 3 acceptance: pipelined >= 1.3x sync under a slow host loader,
+    # deferred-fetch losses bit-equal to per-step fetch
+    assert pipe["tokens_per_sec"] >= 1.3 * sync["tokens_per_sec"], \
+        (pipe["tokens_per_sec"], sync["tokens_per_sec"])
+    assert pipe["loss"] == sync["loss"]
+    assert pipe["host_syncs"] < sync["host_syncs"]
