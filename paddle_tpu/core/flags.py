@@ -172,6 +172,56 @@ register_flag(
          "restores per-step fetch)",
     on_change=_validate_positive_int("metric_fetch_interval"))
 
+def _validate_non_negative(name):
+    def check(v):
+        if float(v) < 0:
+            raise ValueError(f"FLAGS_{name} must be >= 0, got {v!r}")
+    return check
+
+
+# ---- supervision / elastic restart flags -----------------------------------
+# The launcher-side flags are read by the supervisor PROCESS (the
+# `python -m paddle_tpu.distributed.launch` parent), so set them via the
+# FLAGS_* environment variable of the launch command — paddle.set_flags in
+# the training script runs in a different process and cannot reach them.
+
+register_flag(
+    "worker_hang_timeout_s", 0.0,
+    help="launcher watchdog: kill + restart the local worker group when the "
+         "stalest worker heartbeat (written by FusedTrainStep.drive at every "
+         "metric-fetch window boundary) is older than this many seconds; "
+         "0 disables hang detection. Launcher-side: set via env on the "
+         "launch command",
+    on_change=_validate_non_negative("worker_hang_timeout_s"))
+register_flag(
+    "step_timeout_s", 0.0,
+    help="in-process stall watchdog: FusedTrainStep.drive arms a wall-clock "
+         "timer around its fetch points and raises TrainStallError when a "
+         "step makes no progress for this many seconds (a wedged collective "
+         "surfaces as a crash the supervisor can restart); 0 disables",
+    on_change=_validate_non_negative("step_timeout_s"))
+register_flag(
+    "restart_window_s", 3600.0,
+    help="rolling window of the launcher's leaky-bucket restart budget: "
+         "--max_restart crash restarts are allowed per this many seconds "
+         "(old crashes age out instead of consuming budget forever); "
+         "0 makes the budget lifetime-scoped. Launcher-side env flag",
+    on_change=_validate_non_negative("restart_window_s"))
+register_flag(
+    "restart_backoff_s", 1.0,
+    help="base delay of the launcher's exponential restart backoff "
+         "(doubled per crash currently in the budget window, capped at "
+         "30s); clean preemptions relaunch immediately. Launcher-side "
+         "env flag",
+    on_change=_validate_non_negative("restart_backoff_s"))
+register_flag(
+    "worker_term_grace_s", 10.0,
+    help="grace period between the launcher's SIGTERM and SIGKILL when "
+         "killing a worker group, and the wait for remaining workers to "
+         "finish their preemption checkpoint after one exits preempted. "
+         "Launcher-side env flag",
+    on_change=_validate_non_negative("worker_term_grace_s"))
+
 register_flag(
     "check_nan_inf_action", "none",
     help="FusedTrainStep step-guard action when loss/grads go non-finite: "

@@ -47,9 +47,79 @@ from ...framework.io import CheckpointCorruptionError
 
 __all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
            "CheckpointManager", "CheckpointCorruptionError", "is_committed",
-           "verify_checkpoint"]
+           "verify_checkpoint", "sync_processes", "allgather_success"]
 
 COMMIT_FILE = "COMMIT"
+
+
+# ---------------------------------------------------------------------------
+# cross-process sync primitives
+#
+# The commit protocol needs a barrier (no rank overwrites shards before the
+# coordinator retracted the old COMMIT) and a success allgather (COMMIT only
+# after every rank's write landed). XLA collectives
+# (multihost_utils.sync_global_devices / process_allgather) are NOT
+# available on the multi-process CPU backend — and a checkpoint barrier has
+# no business running through the compiler anyway — so these go through
+# jax.distributed's coordination service (the same service that did the
+# rendezvous), falling back to the XLA path only when no coordination
+# client exists.
+# ---------------------------------------------------------------------------
+
+import itertools  # noqa: E402
+
+_SYNC_SEQ = itertools.count()  # ranks sync in program order, so a local
+#                                counter stays aligned across processes
+_SYNC_TIMEOUT_MS = 600_000
+
+
+def _coord_client():
+    try:
+        from jax._src import distributed as jdist
+
+        return jdist.global_state.client
+    except Exception:
+        return None
+
+
+def sync_processes(tag):
+    """Backend-agnostic cross-process barrier (no-op single-process)."""
+    if jax.process_count() <= 1:
+        return
+    client = _coord_client()
+    if client is None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+        return
+    name = f"pt_ckpt_sync:{next(_SYNC_SEQ)}:{zlib.crc32(tag.encode())}"
+    client.wait_at_barrier(name, _SYNC_TIMEOUT_MS)
+
+
+def allgather_success(ok, tag):
+    """True iff EVERY process reports ``ok``; doubles as a barrier."""
+    if jax.process_count() <= 1:
+        return bool(ok)
+    client = _coord_client()
+    if client is None:
+        from jax.experimental import multihost_utils
+
+        return bool(np.all(multihost_utils.process_allgather(
+            np.asarray([bool(ok)]))))
+    key = f"pt_ckpt_ok:{next(_SYNC_SEQ)}:{zlib.crc32(tag.encode())}"
+    client.key_value_set(f"{key}/{jax.process_index()}", "1" if ok else "0")
+    client.wait_at_barrier(f"{key}.b", _SYNC_TIMEOUT_MS)
+    vals = client.key_value_dir_get(f"{key}/")
+    # clean the store once every rank has read: a long job checkpointing
+    # for weeks must not grow the coordinator's memory one key per save
+    client.wait_at_barrier(f"{key}.d", _SYNC_TIMEOUT_MS)
+    if jax.process_index() == 0:
+        try:
+            client.key_value_delete(f"{key}/")
+        except Exception:
+            pass  # older runtimes without delete: stale keys are harmless
+    return (len(vals) == jax.process_count()
+            and all(v == "1" for _, v in vals))
 
 
 class AsyncSaveHandle:
@@ -204,9 +274,7 @@ def save_state_dict(state_dict, path, process_group=None,
         # no rank may overwrite shards until the coordinator has retracted
         # the previous COMMIT — otherwise a coordinator killed pre-retract
         # leaves an old COMMIT certifying a mix of old and new shards
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"ckpt_prepare:{path}")
+        sync_processes(f"ckpt_prepare:{path}")
     fragment = {"state": {}, "version": 3, "rank": rank,
                 "world_size": nprocs}
     payload = {}
@@ -270,11 +338,8 @@ def save_state_dict(state_dict, path, process_group=None,
             # only commit after all ranks report a durable write (Orbax
             # runs the same sync before its commit marker); the allgather
             # doubles as the barrier and carries each rank's success flag.
-            # Single-host saves skip the collective entirely.
-            from jax.experimental import multihost_utils
-
-            all_ok = bool(np.all(multihost_utils.process_allgather(
-                np.asarray([err is None]))))
+            # Single-host saves skip the sync entirely.
+            all_ok = allgather_success(err is None, f"write:{path}")
         else:
             all_ok = err is None
         if err is not None:

@@ -43,6 +43,22 @@ __all__ = ["CheckpointManager"]
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _OPT_FILE = "optimizer.pdopt"
 _SCALER_FILE = "scaler.pdscaler"
+_SAMPLER_FILE = "sampler.pdsampler"
+
+
+def _resolve_sampler(obj):
+    """Accept a BucketedBatchSampler, a DataLoader, or a DevicePrefetcher
+    as ``sampler=`` — whatever layer of the input pipeline the caller
+    holds — and unwrap to the object owning the resumable stream state."""
+    from ...io import resolve_resumable
+
+    r = resolve_resumable(obj)
+    if r is None:
+        raise TypeError(
+            f"{type(obj).__name__} is not a resumable data stream: it "
+            "must expose (or wrap something exposing) state_dict/"
+            "set_state_dict/advance — see io.BucketedBatchSampler")
+    return r
 
 
 class CheckpointManager:
@@ -112,14 +128,17 @@ class CheckpointManager:
 
     # ---- save -----------------------------------------------------------
     def save(self, step, model=None, optimizer=None, scaler=None,
-             state_dict=None, writer=None, async_save=None):
+             state_dict=None, writer=None, async_save=None, sampler=None):
         """Write a committed checkpoint for ``step``. ``model`` /
         ``state_dict`` go through the sharded writer (COMMIT last);
-        ``optimizer`` / ``scaler`` state dicts are pickled atomically before
-        the shards; ``writer(dir_path)`` lets callers drop extra files into
-        the directory under the same commit (hapi's ModelCheckpoint uses
-        this). Returns the :class:`AsyncSaveHandle` for async saves, else
-        ``None``."""
+        ``optimizer`` / ``scaler`` / ``sampler`` state dicts are pickled
+        atomically before the shards (``sampler`` accepts the batch
+        sampler, its DataLoader, or a DevicePrefetcher — the resumable
+        data-stream cursor is persisted so a restart replays the exact
+        remaining batch sequence); ``writer(dir_path)`` lets callers drop
+        extra files into the directory under the same commit (hapi's
+        ModelCheckpoint uses this). Returns the :class:`AsyncSaveHandle`
+        for async saves, else ``None``."""
         self.wait()  # land the previous async write + run its retention
         if async_save is None:
             async_save = self.async_save
@@ -145,14 +164,17 @@ class CheckpointManager:
             if scaler is not None:
                 _fio.save(scaler.state_dict(),
                           os.path.join(d, _SCALER_FILE))
+            if sampler is not None:
+                _fio.save(_resolve_sampler(sampler).state_dict(),
+                          os.path.join(d, _SAMPLER_FILE))
             if writer is not None:
                 writer(d)
         if jax.process_count() > 1:
             # other ranks must not start shard writes into a directory the
             # coordinator is still quarantining/cleaning
-            from jax.experimental import multihost_utils
+            from . import sync_processes
 
-            multihost_utils.sync_global_devices(f"ckpt_prepare:{d}")
+            sync_processes(f"ckpt_mgr_prepare:{d}")
             os.makedirs(d, exist_ok=True)  # non-shared-fs local mkdir
         sd = {}
         if model is not None:
@@ -213,11 +235,14 @@ class CheckpointManager:
 
     # ---- resume ---------------------------------------------------------
     def auto_resume(self, model=None, optimizer=None, scaler=None,
-                    verify=False):
-        """Restore ``model`` + ``optimizer`` + ``scaler`` from the newest
-        valid checkpoint and return its step (the optimizer's global step /
-        LR schedule ride in its state dict; the scaler's loss-scale schedule
-        in its own). Returns ``None`` — touching nothing — when no committed
+                    verify=False, sampler=None):
+        """Restore ``model`` + ``optimizer`` + ``scaler`` + ``sampler``
+        from the newest valid checkpoint and return its step (the
+        optimizer's global step / LR schedule ride in its state dict; the
+        scaler's loss-scale schedule in its own; the sampler's epoch +
+        consumed-batch cursor + shuffle seed in ``sampler.pdsampler`` —
+        restoring it makes the restart replay the *exact* remaining batch
+        sequence). Returns ``None`` — touching nothing — when no committed
         checkpoint exists, so cold starts and warm restarts share one call.
         ``verify=True`` CRC-walks candidate steps before loading (load
         itself re-verifies what it reads — the deep pre-pass costs a second
@@ -236,4 +261,7 @@ class CheckpointManager:
         sc_p = os.path.join(d, _SCALER_FILE)
         if scaler is not None and os.path.exists(sc_p):
             scaler.load_state_dict(_fio.load(sc_p))
+        sp_p = os.path.join(d, _SAMPLER_FILE)
+        if sampler is not None and os.path.exists(sp_p):
+            _resolve_sampler(sampler).set_state_dict(_fio.load(sp_p))
         return step

@@ -96,11 +96,15 @@ class LocalFS(FS):
 
     def rename(self, fs_src_path, fs_dst_path):
         from ....utils import fault_injection
-        from ....utils.retry import retry_os
+        from ....utils.retry import replace_across_fs, retry_os
 
         def attempt():
             fault_injection.fire("fs.rename")
-            os.rename(fs_src_path, fs_dst_path)
+            # replace_across_fs: atomic same-fs rename, with a copy+fsync+
+            # replace fallback when src and dst sit on different mounts
+            # (EXDEV is deterministic — retrying it would burn the whole
+            # backoff budget and then fail anyway)
+            replace_across_fs(fs_src_path, fs_dst_path)
 
         retry_os(attempt)
 

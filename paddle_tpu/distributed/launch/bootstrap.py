@@ -12,6 +12,11 @@ import sys
 
 
 def main():
+    # first heartbeat BEFORE the heavy imports/rendezvous: the launcher's
+    # hang watchdog must not mistake a long jax init for a wedged worker
+    from . import heartbeat
+
+    heartbeat.write(step=None)
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if nprocs > 1:
         import jax

@@ -1,23 +1,155 @@
 """Collective job controller (reference launch/controllers/collective.py
-+ watcher.py).
++ watcher.py + the elastic manager's supervision loop).
 
 Starts nproc_per_node local workers with the PADDLE_*/MASTER_* env
-contract, tails their exit codes, and on any nonzero exit kills the
-whole local group and (optionally) relaunches it — the reference's
-FAULT_TOLERANCE elastic level. Rendezvous is jax.distributed's
-coordination service at MASTER_ADDR:MASTER_PORT, so there is no HTTP/
-etcd master to run.
+contract and supervises the group:
+
+- **Crash**: any worker exiting with a real nonzero code kills the whole
+  local group and relaunches it (the reference's FAULT_TOLERANCE elastic
+  level; checkpoint auto-resume does the rest).
+- **Hang**: workers heartbeat into ``PADDLE_HEARTBEAT_DIR`` (see
+  ``launch.heartbeat``); when the *stalest* rank's heartbeat is older than
+  ``FLAGS_worker_hang_timeout_s`` the group is SIGTERM→SIGKILL'd and
+  restarted like a crash — a rank wedged in a collective can no longer
+  hold the job forever.
+- **Clean preemption**: a worker exiting with ``PREEMPT_EXIT_CODE`` (123,
+  raised by ``FusedTrainStep.drive``'s SIGTERM handler after it committed
+  a checkpoint) relaunches WITHOUT consuming restart budget — scheduler
+  evictions are not crashes.
+- **Crash-loop breaker**: the restart budget is a leaky bucket
+  (``--max_restart`` crash restarts per ``FLAGS_restart_window_s`` rolling
+  window, exponential backoff between relaunches) instead of a lifetime
+  counter, so a week-old transient doesn't block recovery from today's
+  node loss while a tight crash loop still exhausts quickly and raises a
+  typed :class:`CrashLoopError`.
+
+Each restart round of a single-node auto-selected master picks a fresh
+coordinator port: the dead coordinator's socket can sit in TIME_WAIT and
+make the next rendezvous fail spuriously. Rendezvous is jax.distributed's
+coordination service at MASTER_ADDR:MASTER_PORT, so there is no HTTP/etcd
+master to run.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import socket
 import subprocess
 import sys
+import tempfile
 import time
 
-__all__ = ["CollectiveController"]
+from ....core.flags import flag_value
+from ..heartbeat import PREEMPT_EXIT_CODE, stale as _hb_stale
+
+__all__ = ["CollectiveController", "RestartBudget", "CrashLoopError",
+           "HANG_EXIT_CODE", "PREEMPT_EXIT_CODE"]
+
+# the controller's own code for "group killed for stale heartbeats" — no
+# worker produced an exit code, so one is synthesized (124 = timeout(1))
+HANG_EXIT_CODE = 124
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class CrashLoopError(RuntimeError):
+    """The job kept crashing after its restart budget was exhausted
+    (``--max_restart`` restarts within ``FLAGS_restart_window_s``).
+    Carries the final worker exit code and total restarts performed, so
+    the CLI can propagate the real failure instead of looping forever."""
+
+    def __init__(self, msg, exit_code=1, restarts=0):
+        super().__init__(msg)
+        self.exit_code = exit_code
+        self.restarts = restarts
+
+
+class RestartBudget:
+    """Leaky-bucket crash-loop breaker: at most ``max_restarts`` crash
+    restarts within a rolling ``window_s`` window (old crashes age out),
+    with exponential backoff between relaunches — delay doubles with each
+    crash currently in the bucket, capped, so a tight crash loop slows
+    down instead of hammering the scheduler. Clean preemptions go through
+    :attr:`preemptions` and never touch the bucket. ``clock``/``sleep``
+    are injectable for tests."""
+
+    def __init__(self, max_restarts, window_s=None, backoff_base_s=None,
+                 backoff_cap_s=30.0, clock=time.monotonic, sleep=time.sleep):
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(
+            flag_value("restart_window_s", 3600.0)
+            if window_s is None else window_s)
+        self.backoff_base_s = float(
+            flag_value("restart_backoff_s", 1.0)
+            if backoff_base_s is None else backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._events: list[float] = []
+        self._preempt_events: list[float] = []
+        self.total_restarts = 0
+        self.preemptions = 0
+
+    def _prune(self, now):
+        if self.window_s > 0:
+            self._events = [t for t in self._events
+                            if now - t <= self.window_s]
+
+    @property
+    def used(self):
+        """Crash restarts currently counted against the budget (in-window)."""
+        self._prune(self._clock())
+        return len(self._events)
+
+    # clean preemptions are budget-free but not UNBOUNDED: a worker that
+    # exits 123 over and over without the cluster ever letting it run is
+    # indistinguishable from a crash loop, so past this many per rolling
+    # window further preemptions are charged like crashes
+    PREEMPT_CAP_PER_WINDOW = 16
+
+    def try_acquire(self):
+        """Record one crash restart; False when the bucket is full (the
+        caller must stop relaunching)."""
+        now = self._clock()
+        self._prune(now)
+        if len(self._events) >= self.max_restarts:
+            return False
+        self._events.append(now)
+        self.total_restarts += 1
+        return True
+
+    def note_preemption(self):
+        """Record one clean-preemption relaunch in its own leaky window —
+        never the crash bucket, and with NO backoff (clean preemptions
+        relaunch immediately, as the flag docs promise). False once the
+        per-window cap is exceeded: a job exiting 123 over and over
+        without progress is a crash loop wearing a polite exit code, and
+        the caller should charge further preemptions as crashes (whose
+        path brings the backoff)."""
+        now = self._clock()
+        if self.window_s > 0:
+            self._preempt_events = [t for t in self._preempt_events
+                                    if now - t <= self.window_s]
+        if len(self._preempt_events) >= self.PREEMPT_CAP_PER_WINDOW:
+            return False
+        self._preempt_events.append(now)
+        self.preemptions += 1
+        return True
+
+    def backoff(self):
+        """Sleep the current backoff (exponential in in-window crash
+        count, capped) and return the delay actually applied."""
+        n = max(1, len(self._events))
+        delay = min(self.backoff_cap_s,
+                    self.backoff_base_s * (2 ** (n - 1)))
+        if delay > 0:
+            self._sleep(delay)
+        return delay
 
 
 class CollectiveController:
@@ -27,6 +159,15 @@ class CollectiveController:
         self.world_size = args.nnodes * self.nproc
         self.procs: list[subprocess.Popen] = []
         self._log_files = []
+        self._spawn_time = None
+        # heartbeat rendezvous: under log_dir when given (inspectable after
+        # the run), else a tmpdir — workers find it via PADDLE_HEARTBEAT_DIR
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            self._hb_dir = os.path.join(args.log_dir, "heartbeats")
+        else:
+            self._hb_dir = tempfile.mkdtemp(prefix="paddle_hb.")
+        os.makedirs(self._hb_dir, exist_ok=True)
 
     # -- env contract ----------------------------------------------------
     def _worker_env(self, local_rank):
@@ -43,6 +184,7 @@ class CollectiveController:
             "PADDLE_LOCAL_SIZE": str(self.nproc),
             "PADDLE_NNODES": str(self.args.nnodes),
             "PADDLE_NODE_RANK": str(self.args.rank),
+            "PADDLE_HEARTBEAT_DIR": self._hb_dir,
         })
         if self.args.devices:
             devs = self.args.devices.split(",")
@@ -67,7 +209,15 @@ class CollectiveController:
     # -- lifecycle -------------------------------------------------------
     def _spawn_all(self):
         self._close_logs()  # previous restart round's handles
+        # stale heartbeats from the previous round must not mask (or fake)
+        # this round's liveness — every round starts from a clean slate
+        for fn in os.listdir(self._hb_dir):
+            try:
+                os.remove(os.path.join(self._hb_dir, fn))
+            except OSError:
+                pass
         self.procs = []
+        self._spawn_time = time.time()
         for lr in range(self.nproc):
             out = None
             if self.args.log_dir:
@@ -80,10 +230,11 @@ class CollectiveController:
                 stdout=out, stderr=(subprocess.STDOUT if out else None)))
 
     def _kill_all(self):
+        grace = float(flag_value("worker_term_grace_s", 10.0) or 10.0)
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
+        deadline = time.time() + grace
         for p in self.procs:
             try:
                 p.wait(timeout=max(deadline - time.time(), 0.1))
@@ -92,34 +243,99 @@ class CollectiveController:
                 p.wait()
 
     def _watch(self):
-        """Block until the group finishes; return the first nonzero exit
-        code, or 0 when every worker succeeded."""
+        """Block until the group's round resolves. Returns 0 (all
+        succeeded), PREEMPT_EXIT_CODE (>=1 worker preempted cleanly, none
+        crashed), HANG_EXIT_CODE (heartbeats went stale — group killed),
+        or the first real nonzero exit code (group killed)."""
+        hang_timeout = float(flag_value("worker_hang_timeout_s", 0) or 0)
+        grace = float(flag_value("worker_term_grace_s", 10.0) or 10.0)
+        preempt_seen = None
         while True:
             codes = [p.poll() for p in self.procs]
-            for rc in codes:
-                if rc is not None and rc != 0:
+            crash = next((rc for rc in codes if rc is not None
+                          and rc not in (0, PREEMPT_EXIT_CODE)), None)
+            if crash is not None:
+                self._kill_all()
+                return crash
+            if all(rc is not None for rc in codes):
+                return (PREEMPT_EXIT_CODE
+                        if any(rc == PREEMPT_EXIT_CODE for rc in codes)
+                        else 0)
+            if any(rc == PREEMPT_EXIT_CODE for rc in codes):
+                # part of the group preempted cleanly; give the remaining
+                # ranks one grace window to land their own preemption
+                # checkpoint before reaping the round
+                if preempt_seen is None:
+                    preempt_seen = time.time()
+                elif time.time() - preempt_seen > grace:
                     self._kill_all()
-                    return rc
-            if all(rc == 0 for rc in codes):
-                return 0
+                    return PREEMPT_EXIT_CODE
+            # judge only the still-running ranks: a finished or preempted
+            # worker's aging heartbeat file must not condemn the live ones
+            live = [self.args.rank * self.nproc + lr
+                    for lr, p in enumerate(self.procs) if p.poll() is None]
+            if hang_timeout > 0 and live and _hb_stale(
+                    self._hb_dir, hang_timeout, since=self._spawn_time,
+                    ranks=live):
+                print("[launch] worker heartbeats stale (no progress for "
+                      f"{hang_timeout:g}s) — killing the hung group",
+                      file=sys.stderr)
+                self._kill_all()
+                return HANG_EXIT_CODE
             time.sleep(0.2)
 
+    def _refresh_master(self):
+        """Fresh coordinator port per restart round for auto-selected
+        single-node masters: the dead round's port can linger in TIME_WAIT
+        and collide with the new rendezvous."""
+        if getattr(self.args, "master_auto", False) and self.args.nnodes == 1:
+            addr = self.args.master.rsplit(":", 1)[0]
+            self.args.master = f"{addr}:{_free_port()}"
+
     def run(self):
-        restarts = 0
-        while True:
-            self._spawn_all()
-            rc = self._watch()
-            if rc == 0:
-                self._close_logs()
-                return 0
-            if restarts < self.args.max_restart:
-                restarts += 1
-                print(f"[launch] worker failed rc={rc}; restart "
-                      f"{restarts}/{self.args.max_restart}",
-                      file=sys.stderr)
-                continue
+        budget = RestartBudget(self.args.max_restart)
+        try:
+            while True:
+                self._spawn_all()
+                rc = self._watch()
+                if rc == 0:
+                    return 0
+                if rc == PREEMPT_EXIT_CODE and budget.note_preemption():
+                    print(f"[launch] clean preemption (exit "
+                          f"{PREEMPT_EXIT_CODE}); relaunching — restart "
+                          f"budget untouched ({budget.used}/"
+                          f"{budget.max_restarts} used)", file=sys.stderr)
+                    self._refresh_master()
+                    continue
+                if rc == PREEMPT_EXIT_CODE:
+                    reason = (f"preempt-looping (> "
+                              f"{budget.PREEMPT_CAP_PER_WINDOW} clean "
+                              f"preemptions per {budget.window_s:.0f}s "
+                              "window) — charging further preemptions as "
+                              "crashes")
+                else:
+                    reason = ("hang (stale heartbeats past "
+                              "FLAGS_worker_hang_timeout_s)"
+                              if rc == HANG_EXIT_CODE else f"rc={rc}")
+                if budget.try_acquire():
+                    self._refresh_master()
+                    delay = budget.backoff()
+                    print(f"[launch] worker failed ({reason}); restart "
+                          f"{budget.used}/{budget.max_restarts} "
+                          f"(backoff {delay:.1f}s)", file=sys.stderr)
+                    continue
+                raise CrashLoopError(
+                    f"crash loop: worker failed ({reason}) with the "
+                    f"restart budget exhausted ({budget.max_restarts} "
+                    f"restarts per {budget.window_s:.0f}s window, "
+                    f"{budget.total_restarts} performed); giving up",
+                    exit_code=rc, restarts=budget.total_restarts)
+        finally:
             self._close_logs()
-            return rc
+            if not self.args.log_dir:  # tmpdir heartbeat rendezvous
+                import shutil
+
+                shutil.rmtree(self._hb_dir, ignore_errors=True)
 
     def _close_logs(self):
         for f in self._log_files:

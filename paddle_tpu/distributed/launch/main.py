@@ -15,10 +15,10 @@ node 0 and --rank for this node; every node runs the same command.
 from __future__ import annotations
 
 import argparse
-import socket
 import sys
 
-from .controllers.collective import CollectiveController
+from .controllers.collective import (CollectiveController, CrashLoopError,
+                                     _free_port)
 
 __all__ = ["main", "parse_args"]
 
@@ -39,8 +39,12 @@ def parse_args(argv=None):
     p.add_argument("--log_dir", default=None,
                    help="write per-worker logs to DIR/workerlog.N")
     p.add_argument("--max_restart", type=int, default=0,
-                   help="relaunch the whole local group up to K times if "
-                        "any worker exits nonzero (fault tolerance)")
+                   help="leaky-bucket restart budget: relaunch the whole "
+                        "local group after a crash or hang up to K times "
+                        "per FLAGS_restart_window_s rolling window, with "
+                        "exponential backoff (FLAGS_restart_backoff_s). "
+                        "Clean preemptions (a worker exiting 123 after a "
+                        "graceful SIGTERM checkpoint) relaunch for free")
     p.add_argument("--devices", default=None,
                    help="comma list of local device ids to expose "
                         "(sets JAX_VISIBLE_DEVICES per worker)")
@@ -49,25 +53,28 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def main(argv=None):
     args = parse_args(argv)
+    args.master_auto = False
     if args.master is None:
         if args.nnodes > 1:
             raise SystemExit(
                 "--master IP:PORT is required for multi-node jobs "
                 "(point every node at node 0)")
+        # auto-selected master: the controller picks a FRESH port each
+        # restart round (master_auto) so rendezvous never collides with
+        # the dead coordinator's TIME_WAIT socket
         args.master = f"127.0.0.1:{_free_port()}"
+        args.master_auto = True
     elif ":" not in args.master or not args.master.rsplit(":", 1)[1].isdigit():
         raise SystemExit(
             f"--master must be IP:PORT, got {args.master!r}")
     ctrl = CollectiveController(args)
-    return ctrl.run()
+    try:
+        return ctrl.run()
+    except CrashLoopError as e:
+        print(f"[launch] {e}", file=sys.stderr)
+        return e.exit_code
 
 
 if __name__ == "__main__":

@@ -266,7 +266,13 @@ class Model:
         and per-step losses stay lazy (:class:`DeferredScalar`) so the
         loop pays a device→host round-trip only at logging boundaries
         (``log_freq``; prepared Metrics still fetch per step — metric
-        update is host-side accumulation by contract)."""
+        update is host-side accumulation by contract).
+
+        Graceful preemption: a SIGTERM received while fitting stops at the
+        next batch boundary, runs ``on_train_end`` callbacks (so a
+        configured ModelCheckpoint saves), and raises
+        ``SystemExit(123)`` — the elastic launcher's clean-preemption
+        contract (relaunch without consuming restart budget)."""
         assert self._optimizer is not None, "call prepare() first"
         loader = self._as_loader(train_data, batch_size, shuffle,
                                  num_workers, drop_last)
@@ -290,35 +296,60 @@ class Model:
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
             save_dir=save_dir, metrics=self._metrics)
 
+        from ..distributed.launch import heartbeat as _hb
+
         self.stop_training = False
         cbks.on_train_begin()
         it = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            self._reset_metrics()
-            logs = {}
-            for step, batch in enumerate(stream):
-                cbks.on_train_batch_begin(step)
-                batch = _to_list(batch)
-                ins, labs = self._split_batch(batch)
-                update = (step + 1) % accumulate_grad_batches == 0
-                losses, _ = self.train_batch(ins, labs, update=update)
-                logs = {"loss": losses[0], **self._metric_logs()}
-                cbks.set_params({**cbks.callbacks[0].params,
-                                 "last_step": step})
-                cbks.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    break
-            cbks.on_epoch_end(epoch, logs)
+        logs = {}
+        # graceful preemption: a scheduler SIGTERM stops the loop at the
+        # next batch boundary, runs the callbacks' end-of-training hooks
+        # (ModelCheckpoint saves), and exits with the clean-preemption code
+        # the elastic launcher relaunches budget-free
+        with _hb.trap_preemption() as _preempt:
+            try:
+                for epoch in range(epochs):
+                    cbks.on_epoch_begin(epoch)
+                    self._reset_metrics()
+                    logs = {}
+                    for step, batch in enumerate(stream):
+                        cbks.on_train_batch_begin(step)
+                        batch = _to_list(batch)
+                        ins, labs = self._split_batch(batch)
+                        update = (step + 1) % accumulate_grad_batches == 0
+                        losses, _ = self.train_batch(ins, labs,
+                                                     update=update)
+                        logs = {"loss": losses[0], **self._metric_logs()}
+                        cbks.set_params({**cbks.callbacks[0].params,
+                                         "last_step": step})
+                        cbks.on_train_batch_end(step, logs)
+                        it += 1
+                        # feed the launcher's hang watchdog (no-op when
+                        # unsupervised: one env lookup)
+                        _hb.write(step=it)
+                        if _preempt.triggered:
+                            self.stop_training = True
+                            break
+                        if num_iters is not None and it >= num_iters:
+                            break
+                    cbks.on_epoch_end(epoch, logs)
 
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self._run_eval(eval_loader, cbks)
-            if self.stop_training:
-                break
-            if num_iters is not None and it >= num_iters:
-                break
-        cbks.on_train_end(logs)
+                    if eval_loader is not None and not _preempt.triggered \
+                            and (epoch + 1) % eval_freq == 0:
+                        self._run_eval(eval_loader, cbks)
+                    if self.stop_training:
+                        break
+                    if num_iters is not None and it >= num_iters:
+                        break
+            finally:
+                # a consumer abandoning iteration (error, num_iters cap,
+                # preemption) must not leak the prefetcher's staging
+                # thread — close() drains and joins it
+                if stream is not loader and hasattr(stream, "close"):
+                    stream.close()
+            cbks.on_train_end(logs)
+            if _preempt.triggered:
+                raise SystemExit(_hb.PREEMPT_EXIT_CODE)
         return self
 
     def _run_eval(self, loader, cbks):

@@ -35,6 +35,11 @@ from ..utils import functional_call, params_dict
 
 __all__ = ["FusedTrainStep", "fused_train_step"]
 
+# how long the train.stall chaos site blocks: long enough that either the
+# in-process stall guard (FLAGS_step_timeout_s) or the launcher's heartbeat
+# watchdog (FLAGS_worker_hang_timeout_s) must be the thing that ends it
+_STALL_SLEEP_S = 3600.0
+
 
 def _f32(x):
     return x.astype(jnp.float32)
@@ -545,7 +550,8 @@ class FusedTrainStep:
         return (batch,), {}
 
     def drive(self, data, steps=None, log_every=None, prefetch=None,
-              prefetch_depth=None, on_window=None):
+              prefetch_depth=None, on_window=None, checkpoint=None,
+              sampler=None, heartbeat=True, handle_preemption=True):
         """Multi-step driver: dispatch fused steps back-to-back with NO
         per-step host sync, so the device executable queue stays deep while
         the input side is double-buffered by a :class:`DevicePrefetcher`.
@@ -576,8 +582,39 @@ class FusedTrainStep:
         Checkpoint at fetch boundaries (e.g. from ``on_window``) —
         ``state_dict`` reads the authoritative device step count.
 
+        Supervision (the elastic-launcher contract):
+
+        - **Heartbeats** (``heartbeat=True``): when launched under
+          ``paddle_tpu.distributed.launch`` (``PADDLE_HEARTBEAT_DIR``
+          set), a heartbeat file is written at drive start and at every
+          window boundary, feeding the launcher's hang watchdog
+          (``FLAGS_worker_hang_timeout_s``). Unsupervised runs pay one
+          env lookup.
+        - **Graceful preemption** (``handle_preemption=True``): SIGTERM is
+          trapped; the loop finishes the in-flight fetch window, writes a
+          committed checkpoint through ``checkpoint`` (a
+          ``CheckpointManager`` — saving this step's model, its own
+          optimizer state, and ``sampler``'s stream cursor), then raises
+          ``SystemExit(PREEMPT_EXIT_CODE)`` (123), which the launcher
+          relaunches WITHOUT consuming restart budget. Stopping only at
+          window boundaries keeps multi-process ranks checkpointing at the
+          same global step (windows are step-aligned across ranks).
+        - **Stall detection** (``FLAGS_step_timeout_s`` > 0): a wall-clock
+          guard around the fetch points raises a typed
+          :class:`~paddle_tpu.core.exceptions.TrainStallError` when a step
+          wedges, so a dead collective becomes a restartable crash instead
+          of an infinite block.
+        - **Resumable data** (``sampler=``, or auto-detected from ``data``
+          when ``checkpoint`` is given): each trained batch advances the
+          sampler's consumed-batch cursor, so a checkpoint written at a
+          window boundary (``on_window`` or the preemption save) resumes
+          the *exact* remaining batch sequence — prefetch read-ahead never
+          skews it.
+
         Returns ``{"steps", "loss" (per-step floats), "skipped",
         "windows", "host_syncs", "log_every", "deferred", "prefetch"}``.
+        (A preempted drive never returns: it exits via
+        ``SystemExit(PREEMPT_EXIT_CODE)`` after its checkpoint.)
         """
         from ..core.flags import flag_value
         from ..io.prefetch import DevicePrefetcher
@@ -607,12 +644,36 @@ class FusedTrainStep:
                    "host_syncs": 0, "log_every": log_every,
                    "deferred": True, "prefetch": None}
 
+        # resumable-stream cursor: only armed on the resume-enabled path
+        # (an explicit sampler=, or a checkpoint manager to persist into) —
+        # plain perf-driving loops keep their batch streams untouched
+        resumable = None
+        if sampler is not None or checkpoint is not None:
+            from ..io import resolve_resumable
+
+            resumable = resolve_resumable(
+                sampler if sampler is not None else data)
+            if sampler is not None and resumable is None:
+                raise TypeError(
+                    f"sampler={type(sampler).__name__} is not a resumable "
+                    "stream: it must expose (or wrap something exposing) "
+                    "state_dict/set_state_dict/advance")
+        step_timeout = float(flag_value("step_timeout_s", 0) or 0)
+
         scaler = (self._scaler if self._scaler is not None
                   and self._scaler.is_enable() else None)
         if scaler is not None:
             # dynamic loss scaling consumes the finite flag every step —
             # fall back to the per-step path (prefetch still overlaps H2D)
+            import os as _os
+            import signal as _signal
+            import time as _time
+
             import numpy as np
+
+            from ..core.exceptions import stall_guard
+            from ..distributed.launch import heartbeat as hb
+            from ..utils import fault_injection
 
             history["deferred"] = False
             skipped_before = self._guard["skipped"]
@@ -634,28 +695,63 @@ class FusedTrainStep:
                                "step": history["steps"]})
                 win_start = len(history["loss"])
                 win_skips = self._guard["skipped"]
+                if heartbeat:
+                    hb.write(step=self._step_count)
 
-            while steps is None or history["steps"] < steps:
+            with hb.trap_preemption(enable=handle_preemption) as preempt:
+                if heartbeat:
+                    hb.write(step=self._step_count)
                 try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                args, kw = self._call_form(batch)
-                loss = self(*args, **kw)
-                history["steps"] += 1
-                history["loss"].append(float(loss.numpy()))
-                history["host_syncs"] += 2  # finite flag + loss value
-                if history["steps"] % log_every == 0:
-                    scaler_window_end()
-            if len(history["loss"]) > win_start:
-                scaler_window_end()
-            history["skipped"] = self._guard["skipped"] - skipped_before
-            if made_prefetcher is not None:
-                history["prefetch"] = made_prefetcher.stats()
+                    while steps is None or history["steps"] < steps:
+                        if (preempt.triggered
+                                and len(history["loss"]) == win_start):
+                            break  # window boundary: ranks stop aligned
+                        if fault_injection.should_fire("proc.kill"):
+                            _os.kill(_os.getpid(), _signal.SIGKILL)
+                        try:
+                            with stall_guard(step_timeout,
+                                             f"batch fetch after step "
+                                             f"{history['steps']}"):
+                                if fault_injection.should_fire(
+                                        "train.stall"):
+                                    _time.sleep(_STALL_SLEEP_S)
+                                batch = next(it)
+                        except StopIteration:
+                            break
+                        args, kw = self._call_form(batch)
+                        loss = self(*args, **kw)
+                        if resumable is not None:
+                            resumable.advance(1)
+                        history["steps"] += 1
+                        with stall_guard(step_timeout, "loss fetch"):
+                            history["loss"].append(float(loss.numpy()))
+                        history["host_syncs"] += 2  # finite flag + loss
+                        if history["steps"] % log_every == 0:
+                            scaler_window_end()
+                    if len(history["loss"]) > win_start:
+                        scaler_window_end()
+                    history["skipped"] = (self._guard["skipped"]
+                                          - skipped_before)
+                finally:
+                    # an exception (dataset error, action='raise') must
+                    # not leak the staging thread parked on the queue
+                    if made_prefetcher is not None:
+                        made_prefetcher.close()
+                        history["prefetch"] = made_prefetcher.stats()
+                if preempt.triggered:
+                    self._preempt_exit(checkpoint, resumable, heartbeat)
             return history
 
         # guard mode is pinned for the whole drive (one executable); flag
         # changes take effect at the next drive()/__call__
+        import os as _os
+        import signal as _signal
+        import time as _time
+
+        from ..core.exceptions import stall_guard
+        from ..distributed.launch import heartbeat as hb
+        from ..utils import fault_injection
+
         action = str(flag_value("check_nan_inf_action", "none"))
         protect = action in ("skip", "raise")
         guard = "protect" if protect else ("flag" if action != "none"
@@ -663,69 +759,137 @@ class FusedTrainStep:
         window = []
         sched = (getattr(self.optimizer, "_learning_rate", None)
                  if self._step_lr_scheduler else None)
-        try:
-            it = iter(stream)
-            # count checked BEFORE pulling: a one-shot iterator keeps its
-            # remaining batches when steps caps the run
-            while steps is None or history["steps"] < steps:
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                args, kw = self._call_form(batch)
-                self._step_count += 1
-                self._guard["total"] += 1
-                loss, finite = self._dispatch(args, kw, guard, 1.0)
-                window.append((loss, finite))
-                history["steps"] += 1
-                if hasattr(sched, "step"):
-                    sched.step()
-                if len(window) >= log_every:
-                    # swap-clear BEFORE flushing: if the flush raises
-                    # (action='raise'), the trailing flush below must not
-                    # replay the same window's bookkeeping
-                    full, window = window, []
-                    self._flush_window(full, action, protect, history,
-                                       on_window)
-            # trailing partial window: flushed only on clean exit — an
-            # exception escaping the loop must propagate, not be replaced
-            # by a boundary FloatingPointError (the device state is already
-            # correct either way; in-graph semantics never needed the host)
-            if window:
-                self._flush_window(window, action, protect, history,
-                                   on_window)
-        except BaseException:
-            # the unfetched window's finite flags are lost with the
-            # exception — resync the host mirrors from the authoritative
-            # device accumulator so guard_stats()/step numbering stay
-            # exact for the rest of the process
-            if protect:
-                try:
-                    dm = self.device_metrics()
-                    self._step_count = dm["step_count"]
-                    self._guard["skipped"] = dm["skipped"]
-                except Exception:
-                    pass
-            raise
-        finally:
-            if made_prefetcher is not None:
-                history["prefetch"] = made_prefetcher.stats()
+        with hb.trap_preemption(enable=handle_preemption) as preempt:
+            if heartbeat:
+                hb.write(step=self._step_count)
+            try:
+                it = iter(stream)
+                # count checked BEFORE pulling: a one-shot iterator keeps
+                # its remaining batches when steps caps the run
+                while steps is None or history["steps"] < steps:
+                    if preempt.triggered and not window:
+                        # stop only at window boundaries: every rank of a
+                        # multi-process job reaches the same boundary, so
+                        # the preemption checkpoint lands at one global
+                        # step (windows are step-aligned across ranks)
+                        break
+                    if fault_injection.should_fire("proc.kill"):
+                        # chaos site: simulate the OOM-killer/node loss
+                        _os.kill(_os.getpid(), _signal.SIGKILL)
+                    try:
+                        with stall_guard(step_timeout,
+                                         f"batch fetch after step "
+                                         f"{history['steps']}"):
+                            if fault_injection.should_fire("train.stall"):
+                                _time.sleep(_STALL_SLEEP_S)
+                            batch = next(it)
+                    except StopIteration:
+                        break
+                    args, kw = self._call_form(batch)
+                    self._step_count += 1
+                    self._guard["total"] += 1
+                    loss, finite = self._dispatch(args, kw, guard, 1.0)
+                    if resumable is not None:
+                        resumable.advance(1)
+                    window.append((loss, finite))
+                    history["steps"] += 1
+                    if hasattr(sched, "step"):
+                        sched.step()
+                    if len(window) >= log_every:
+                        # swap-clear BEFORE flushing: if the flush raises
+                        # (action='raise'), the trailing flush below must
+                        # not replay the same window's bookkeeping
+                        full, window = window, []
+                        self._flush_window(full, action, protect,
+                                           history, on_window,
+                                           stall_timeout=step_timeout)
+                        if heartbeat:
+                            hb.write(step=self._step_count)
+                # trailing partial window: flushed only on clean exit — an
+                # exception escaping the loop must propagate, not be
+                # replaced by a boundary FloatingPointError (the device
+                # state is already correct either way; in-graph semantics
+                # never needed the host)
+                if window:
+                    self._flush_window(window, action, protect, history,
+                                       on_window,
+                                       stall_timeout=step_timeout)
+                    if heartbeat:
+                        hb.write(step=self._step_count)
+            except BaseException:
+                # the unfetched window's finite flags are lost with the
+                # exception — resync the host mirrors from the
+                # authoritative device accumulator so guard_stats()/step
+                # numbering stay exact for the rest of the process
+                if protect:
+                    try:
+                        dm = self.device_metrics()
+                        self._step_count = dm["step_count"]
+                        self._guard["skipped"] = dm["skipped"]
+                    except Exception:
+                        pass
+                raise
+            finally:
+                if made_prefetcher is not None:
+                    made_prefetcher.close()
+                    history["prefetch"] = made_prefetcher.stats()
+            if preempt.triggered:
+                self._preempt_exit(checkpoint, resumable, heartbeat)
         return history
 
-    def _flush_window(self, window, action, protect, history, on_window):
+    def _preempt_exit(self, checkpoint, resumable, heartbeat):
+        """Graceful-preemption epilogue: the in-flight window is already
+        flushed and the batch cursor is exact, so write one committed
+        checkpoint (model + this step's optimizer state + data-stream
+        cursor), heartbeat a final time, and exit with the distinguished
+        code the supervisor treats as *clean* — relaunch without consuming
+        restart budget."""
+        from ..distributed.launch import heartbeat as hb
+
+        if checkpoint is not None:
+            step_now = self.device_metrics()["step_count"]
+            handle = checkpoint.save(step_now, model=self.model,
+                                     optimizer=self, sampler=resumable)
+            if handle is not None:  # async save: the exit must not tear it
+                checkpoint.wait()
+        else:
+            # the 123 contract promises the supervisor a lossless eviction;
+            # without a manager here that promise rests entirely on the
+            # caller's own on_window checkpointing — say so, loudly, so a
+            # job that never saves cannot silently preempt-loop at step 0
+            import warnings
+
+            warnings.warn(
+                "preempted without checkpoint=: exiting "
+                f"{hb.PREEMPT_EXIT_CODE} (budget-free relaunch) but drive "
+                "saved NOTHING — progress since your last own checkpoint "
+                "(e.g. from on_window) will be retrained after the "
+                "relaunch", RuntimeWarning, stacklevel=2)
+        if heartbeat:
+            hb.write(step=self._step_count)
+        raise SystemExit(hb.PREEMPT_EXIT_CODE)
+
+    def _flush_window(self, window, action, protect, history, on_window,
+                      stall_timeout=0):
         """Fetch one deferred window (O(1) host round-trips) and replay the
-        per-step guard bookkeeping that per-step fetch would have done."""
+        per-step guard bookkeeping that per-step fetch would have done.
+        ``stall_timeout`` arms the stall guard over the device fetches ONLY
+        — ``on_window`` (user code: checkpointing, logging) runs outside
+        it, so a slow checkpoint save is never mistaken for a wedge."""
         import warnings
 
         import numpy as np
 
-        losses = np.asarray(
-            jnp.stack([jnp.asarray(l, jnp.float32) for l, _ in window]))
-        history["host_syncs"] += 1
-        finite = None
-        if action != "none":
-            finite = np.asarray(jnp.stack([f for _, f in window]))
+        from ..core.exceptions import stall_guard
+
+        with stall_guard(stall_timeout, "window metric fetch"):
+            losses = np.asarray(
+                jnp.stack([jnp.asarray(l, jnp.float32) for l, _ in window]))
             history["host_syncs"] += 1
+            finite = None
+            if action != "none":
+                finite = np.asarray(jnp.stack([f for _, f in window]))
+                history["host_syncs"] += 1
         n_bad = 0
         if finite is not None:
             for ok in finite:

@@ -670,6 +670,38 @@ class DataLoader:
     def __call__(self):
         return iter(self)
 
+    # -- resumable stream passthrough (crash recovery) -------------------
+    def _resumable_sampler(self):
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "state_dict"):
+            raise TypeError(
+                "this DataLoader's batch sampler is not resumable; use "
+                "io.BucketedBatchSampler (or any batch_sampler exposing "
+                "state_dict/set_state_dict/advance) to checkpoint the "
+                "data stream position")
+        return bs
+
+    def state_dict(self):
+        """Resume point of the underlying batch sampler (epoch, consumed-
+        batch cursor, shuffle seed) — what ``CheckpointManager.save(...,
+        sampler=loader)`` persists."""
+        return self._resumable_sampler().state_dict()
+
+    def set_state_dict(self, sd):
+        self._resumable_sampler().set_state_dict(sd)
+
+    load_state_dict = set_state_dict
+
+    def advance(self, n=1):
+        """Report ``n`` consumed batches to the batch sampler (the resume
+        cursor counts *trained* batches, never read-ahead)."""
+        self._resumable_sampler().advance(n)
+
+    def set_epoch(self, epoch):
+        bs = self.batch_sampler
+        if bs is not None and hasattr(bs, "set_epoch"):
+            bs.set_epoch(epoch)
+
 
 class SubsetRandomSampler(Sampler):
     """Reference io/sampler.py SubsetRandomSampler."""
@@ -735,3 +767,26 @@ __all__ += ["BucketedBatchSampler", "PadToBucket"]
 from .prefetch import DevicePrefetcher  # noqa: E402,F401
 
 __all__ += ["DevicePrefetcher"]
+
+
+def resolve_resumable(stream):
+    """Unwrap pipeline layers (DevicePrefetcher → its source, DataLoader →
+    its batch sampler) down to the object that owns the resumable stream
+    state, or ``None`` when nothing in the stack supports it. This is how
+    ``CheckpointManager`` and ``FusedTrainStep.drive`` accept a prefetcher,
+    a loader, or the sampler itself interchangeably as ``sampler=``."""
+    obj = stream
+    for _ in range(8):  # defensive bound on pathological nesting
+        if isinstance(obj, DevicePrefetcher):
+            obj = obj.source
+        elif isinstance(obj, DataLoader):
+            obj = obj.batch_sampler
+        else:
+            break
+    if (obj is not None and hasattr(obj, "state_dict")
+            and hasattr(obj, "set_state_dict") and hasattr(obj, "advance")):
+        return obj
+    return None
+
+
+__all__ += ["resolve_resumable"]

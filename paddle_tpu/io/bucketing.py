@@ -77,7 +77,23 @@ class BucketedBatchSampler(BatchSampler):
         shuffle: shuffle samples inside each bucket AND the order of the
             yielded batches each epoch.
         drop_last: drop each bucket's trailing partial batch.
-        seed: base seed for shuffling (epoch-invariant streams when set).
+        seed: base seed for shuffling (epoch ``e`` streams from
+            ``seed + e``). When omitted each epoch draws a fresh random
+            seed — different order every epoch, as before — but the draw
+            is *recorded* (``state_dict()``'s ``epoch_seed``) so a crash
+            mid-epoch still replays the exact in-flight permutation.
+
+    Resumable stream contract (crash recovery): the sampler carries an
+    (epoch, cursor, seed) triple. The *consumer* reports consumption with
+    ``advance(n)`` — one call per trained batch — so read-ahead layers
+    (DataLoader workers, DevicePrefetcher staging) never inflate the
+    cursor with batches that were produced but not yet trained.
+    ``state_dict()/set_state_dict()`` round-trip the triple (persisted by
+    ``CheckpointManager.save(sampler=...)``), and the next ``__iter__``
+    skips the first ``cursor`` batches of the epoch — a restart replays
+    the exact remaining batch sequence. ``set_epoch(e)`` resets the cursor
+    when ``e`` differs from the current epoch (so a resume that re-enters
+    the same epoch keeps its place, and the next epoch starts clean).
     """
 
     def __init__(self, dataset=None, batch_size=1, boundaries=None,
@@ -95,6 +111,15 @@ class BucketedBatchSampler(BatchSampler):
         self.drop_last = drop_last
         self.seed = seed
         self._epoch = 0
+        self._cursor = 0  # batches CONSUMED this epoch (advance())
+        # the seed actually governing the CURRENT epoch's shuffle. Seeded:
+        # seed + epoch (old behavior). Unseeded: a fresh draw per epoch
+        # (old behavior) that is RECORDED here and in state_dict(), so a
+        # crash mid-epoch can still replay the exact in-flight permutation
+        self._epoch_seed = self._draw_epoch_seed()
+        self._seed_restored = False  # pins a set_state_dict-restored seed
+        # against the unseeded fresh-pass redraw (a resume at cursor 0
+        # must still replay the RECORDED permutation)
         if lengths is None:
             fn = length_fn or _sample_length
             lengths = []
@@ -110,8 +135,62 @@ class BucketedBatchSampler(BatchSampler):
         self._bucket_of = [bisect.bisect_left(self.boundaries, n)
                            for n in self.lengths]
 
+    def _draw_epoch_seed(self):
+        if self.seed is not None:
+            return int(self.seed) + self._epoch
+        return int(np.random.randint(0, 2**31 - 1))
+
     def set_epoch(self, epoch):
-        self._epoch = int(epoch)
+        epoch = int(epoch)
+        if epoch != self._epoch:
+            # a NEW epoch starts from its first batch with a fresh stream;
+            # a resume re-entering the restored epoch keeps its place
+            self._cursor = 0
+            self._epoch = epoch
+            self._epoch_seed = self._draw_epoch_seed()
+            self._seed_restored = False
+
+    # -- resumable stream (crash recovery) -------------------------------
+    def advance(self, n=1):
+        """Report that ``n`` more batches of the current epoch were
+        *consumed* (trained on). Called by the training driver — not the
+        loader — so prefetch read-ahead never skews the resume cursor."""
+        self._cursor += int(n)
+
+    def state_dict(self):
+        """Resume point of the batch stream: ``(epoch, cursor, seed)``
+        plus a stream fingerprint (sample count / batch size / boundaries)
+        so a restore into a differently-configured pipeline fails loudly
+        instead of silently replaying the wrong batches."""
+        return {"epoch": self._epoch, "cursor": self._cursor,
+                "epoch_seed": self._epoch_seed,
+                "shuffle": bool(self.shuffle),
+                "num_samples": len(self.lengths),
+                "batch_size": int(self.batch_size),
+                "boundaries": list(self.boundaries)}
+
+    def set_state_dict(self, sd):
+        fingerprint = {"num_samples": len(self.lengths),
+                       "batch_size": int(self.batch_size),
+                       "boundaries": list(self.boundaries),
+                       "shuffle": bool(self.shuffle)}
+        for key, have in fingerprint.items():
+            if key not in sd:
+                continue
+            got = (list(sd[key]) if key == "boundaries"
+                   else type(have)(sd[key]))
+            if got != have:
+                raise ValueError(
+                    f"sampler state mismatch on {key!r}: checkpoint has "
+                    f"{got!r}, this sampler has {have!r} — resuming "
+                    "would replay a different batch sequence")
+        self._epoch = int(sd["epoch"])
+        self._cursor = int(sd["cursor"])
+        if sd.get("epoch_seed") is not None:
+            self._epoch_seed = int(sd["epoch_seed"])
+            self._seed_restored = True
+
+    load_state_dict = set_state_dict
 
     def bucket_histogram(self):
         """{boundary_or_'overflow': sample_count} — pipeline telemetry
@@ -123,13 +202,15 @@ class BucketedBatchSampler(BatchSampler):
             hist[key] = hist.get(key, 0) + 1
         return hist
 
-    def __iter__(self):
+    def _epoch_batches(self):
+        """The full batch sequence of the current epoch — a pure function
+        of the recorded epoch seed, so a restarted process rebuilds the
+        exact same sequence before applying the resume cursor."""
         buckets: dict[int, list[int]] = {}
         order = range(len(self.lengths))
         rng = None
         if self.shuffle:
-            rng = np.random.RandomState(
-                None if self.seed is None else self.seed + self._epoch)
+            rng = np.random.RandomState(self._epoch_seed)
             order = rng.permutation(len(self.lengths))
         for i in order:
             buckets.setdefault(self._bucket_of[i], []).append(int(i))
@@ -143,7 +224,33 @@ class BucketedBatchSampler(BatchSampler):
                 batches.append(batch)
         if self.shuffle:
             batches = [batches[i] for i in rng.permutation(len(batches))]
-        return iter(batches)
+        return batches
+
+    def __iter__(self):
+        # the cursor (batches already consumed this epoch, per advance())
+        # is 0 unless a checkpoint resume restored a mid-epoch position —
+        # consumers that never call advance() see full epochs, unchanged.
+        # A fully-consumed epoch rolls over automatically, so resume-armed
+        # loops that never call set_epoch still make progress (and a
+        # checkpoint taken exactly at an epoch boundary resumes into the
+        # NEXT epoch instead of yielding an empty pass).
+        batches = self._epoch_batches()
+        if batches and self._cursor >= len(batches):
+            self._epoch += 1
+            self._cursor = 0
+            self._epoch_seed = self._draw_epoch_seed()
+            self._seed_restored = False
+            batches = self._epoch_batches()
+        elif (self._cursor == 0 and self.seed is None
+              and not self._seed_restored):
+            # unseeded fresh pass: a new random order every epoch (the
+            # pre-resumability behavior), recorded so a crash mid-pass
+            # still replays this exact permutation. A seed just restored
+            # by set_state_dict is pinned — a resume landing exactly on an
+            # epoch boundary must replay the RECORDED permutation
+            self._epoch_seed = self._draw_epoch_seed()
+            batches = self._epoch_batches()
+        return iter(batches[self._cursor:])
 
     def __len__(self):
         counts: dict[int, int] = {}

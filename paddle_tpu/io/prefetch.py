@@ -125,6 +125,9 @@ class DevicePrefetcher:
         self._stats = {"batches": 0, "prefetched": 0, "sync_fallback": 0,
                        "host_blocked_ms": 0.0, "queue_depth_sum": 0,
                        "bucket_pads": 0}
+        # live iterations' (stop event, thread, queue) triples — what
+        # close() tears down when a consumer abandons iteration mid-epoch
+        self._active: list = []
 
     def __len__(self):
         return len(self.source)
@@ -139,6 +142,39 @@ class DevicePrefetcher:
                                 if d["prefetched"] else None)
         d["fallback"] = self._fell_back
         return d
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self):
+        """Tear down any live staging thread: signal stop, drain the
+        bounded queue (unblocking a transfer thread parked on ``put``),
+        and join. A consumer that breaks out of iteration mid-epoch — or
+        an error path like hapi ``fit``'s — calls this so the daemon
+        thread never outlives the loop. Idempotent, and the prefetcher
+        itself stays re-iterable (a later ``iter()`` starts a fresh
+        thread over a fresh pass of the source)."""
+        for stop, _t, _q in list(self._active):
+            stop.set()
+        for stop, t, q in list(self._active):
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=2.0)
+            # a consumer that resumes its abandoned generator afterwards
+            # must terminate, not block on an empty queue forever
+            try:
+                q.put_nowait((_DONE, None, None))
+            except queue.Full:
+                pass
+        self._active = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     # -- staging ---------------------------------------------------------
     def _active_spec(self):
@@ -256,6 +292,8 @@ class DevicePrefetcher:
         t = threading.Thread(target=worker, daemon=True,
                              name=f"{self._stats_name}-transfer")
         t.start()
+        entry = (stop, t, q)
+        self._active.append(entry)
         pending = None
         try:
             while True:
@@ -284,11 +322,21 @@ class DevicePrefetcher:
                     RuntimeWarning, stacklevel=2)
                 break
         finally:
+            # early break / GeneratorExit / normal end all land here: stop
+            # the transfer thread, drain whatever it staged (unconsumed
+            # batches are DISCARDED — on a checkpoint resume they are
+            # re-staged from the restored sampler cursor, never consumed
+            # twice), and join so no thread outlives the iteration
             stop.set()
             try:
                 while True:
                     q.get_nowait()
             except queue.Empty:
+                pass
+            t.join(timeout=2.0)
+            try:
+                self._active.remove(entry)
+            except ValueError:
                 pass
         # synchronous fallback: finish the epoch on the consumer thread
         # (no injection probe here — this IS the degraded path)

@@ -21,6 +21,16 @@ Sites wired into the framework:
 - ``io.prefetch``       — DevicePrefetcher transfer thread, fired before a
   batch is staged (bucket-pad + device_put): the thread dies and the
   consumer must fall back to synchronous transfers without losing a batch.
+- ``proc.kill``         — FusedTrainStep.drive loop head (boolean site): the
+  worker SIGKILLs itself, simulating an OOM-killer/node loss at step N; the
+  supervisor restarts the group and auto_resume must replay bit-exactly.
+- ``hb.write``          — launch.heartbeat.write, fired before the heartbeat
+  file is written: heartbeating is best-effort, so the write must fail
+  WITHOUT crashing training (write() returns False).
+- ``train.stall``       — FusedTrainStep.drive fetch point (boolean site):
+  the step blocks as if a collective wedged; FLAGS_step_timeout_s surfaces
+  it as TrainStallError (or, with the in-process guard off, the launcher's
+  heartbeat watchdog kills + restarts the group).
 
 Arming a site is scoped and seeded::
 
@@ -46,7 +56,7 @@ import random
 __all__ = ["SITES", "InjectedFault", "inject", "fire", "should_fire"]
 
 SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
-         "io.prefetch")
+         "io.prefetch", "proc.kill", "hb.write", "train.stall")
 
 
 class InjectedFault(OSError):

@@ -6,20 +6,40 @@ renames all funnel through :func:`retry_os`, so the retry budget is tuned in
 one place (``FLAGS_ckpt_save_retries``). The reference Paddle hand-rolls the
 same shape per call site (e.g. HDFSClient's sleep_inter loop); centralizing
 it keeps the checkpoint lifecycle's failure semantics uniform.
+
+Cross-filesystem publication: ``os.rename``/``os.replace`` across mount
+points fails with ``EXDEV`` *deterministically* — retrying spins through the
+whole budget and then fails anyway, which is why EXDEV is classified
+non-transient here. :func:`replace_across_fs` is the escape hatch the
+publish paths use instead: same-filesystem renames stay one atomic syscall,
+and an EXDEV falls back to copy-to-tmp-on-the-destination-filesystem +
+fsync + ``os.replace`` — the destination still only ever holds complete
+bytes, so checkpoints to a mounted volume (NFS/GCS-FUSE scratch) keep the
+atomic-visibility guarantee.
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import random
+import shutil
 import time
 
-__all__ = ["retry_os", "atomic_write"]
+__all__ = ["retry_os", "atomic_write", "replace_across_fs"]
 
 # deterministic failures: retrying can't fix a missing path, a permission
 # wall, or a path-type mismatch — surface them immediately, no backoff
 _NON_TRANSIENT = (FileNotFoundError, PermissionError, FileExistsError,
                   IsADirectoryError, NotADirectoryError)
+# errno-classified deterministic failures (no dedicated exception subclass):
+# EXDEV (cross-device rename) needs a different *strategy*, not a retry
+_NON_TRANSIENT_ERRNOS = frozenset({errno.EXDEV, errno.ENOSPC})
+
+
+def _is_non_transient(e):
+    return (isinstance(e, _NON_TRANSIENT)
+            or getattr(e, "errno", None) in _NON_TRANSIENT_ERRNOS)
 
 
 def retry_os(fn, retries=None, base_delay=0.01, max_delay=0.5, jitter=0.5,
@@ -28,9 +48,10 @@ def retry_os(fn, retries=None, base_delay=0.01, max_delay=0.5, jitter=0.5,
     ``retries`` times (default ``FLAGS_ckpt_save_retries``), sleeping
     ``min(max_delay, base_delay * 2**attempt) * (1 + jitter * U[0,1))``
     between attempts. Deterministic OSErrors (missing path, permissions,
-    path-type mismatch) are never retried. The final failure re-raises the
-    original exception. Pass a seeded ``rng`` (anything with ``.random()``)
-    for deterministic jitter in tests."""
+    path-type mismatch, cross-device rename, disk full) are never retried.
+    The final failure re-raises the original exception. Pass a seeded
+    ``rng`` (anything with ``.random()``) for deterministic jitter in
+    tests."""
     if retries is None:
         from ..core.flags import flag_value
 
@@ -42,11 +63,66 @@ def retry_os(fn, retries=None, base_delay=0.01, max_delay=0.5, jitter=0.5,
         try:
             return fn()
         except retry_on as e:
-            if isinstance(e, _NON_TRANSIENT) or attempt >= retries:
+            if _is_non_transient(e) or attempt >= retries:
                 raise
             delay = min(max_delay, base_delay * (2 ** attempt))
             time.sleep(delay * (1.0 + jitter * rng.random()))
             attempt += 1
+
+
+def replace_across_fs(src, dst):
+    """``os.replace`` that survives crossing a filesystem boundary. The
+    fast path is the plain atomic rename; on ``EXDEV`` the payload is
+    copied to a tmp name *on the destination filesystem*, fsynced, and
+    published with a same-filesystem ``os.replace`` — so ``dst`` never
+    holds partial bytes even when ``src`` lives on a different mount.
+    Directories fall back to a tree copy published the same way. ``src``
+    is removed after a successful cross-filesystem publish (rename
+    semantics)."""
+    try:
+        os.replace(src, dst)
+        return
+    except OSError as e:
+        if e.errno != errno.EXDEV:
+            raise
+    tmp = f"{dst}.xfs.{os.getpid()}"
+    try:
+        if os.path.isdir(src):
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            shutil.copytree(src, tmp)
+            # copytree does not fsync: without this walk a power loss
+            # after the publish could leave dst as a complete-looking
+            # directory of truncated files (the single-file branch below
+            # fsyncs for the same reason)
+            for root, _dirs, files in os.walk(tmp):
+                for fn in files:
+                    with open(os.path.join(root, fn), "rb") as f:
+                        os.fsync(f.fileno())
+        else:
+            with open(src, "rb") as fsrc, open(tmp, "wb") as fdst:
+                shutil.copyfileobj(fsrc, fdst)
+                fdst.flush()
+                os.fsync(fdst.fileno())
+        os.replace(tmp, dst)
+    except BaseException:
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            else:
+                os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    # publish succeeded; clearing the source is best-effort (a leftover
+    # source never violates the destination's atomicity)
+    try:
+        if os.path.isdir(src):
+            shutil.rmtree(src)
+        else:
+            os.remove(src)
+    except OSError:
+        pass
 
 
 def atomic_write(dest, write_body, fire_site=None):
@@ -55,7 +131,10 @@ def atomic_write(dest, write_body, fire_site=None):
     ever holds complete bytes; any failure removes the tmp file and leaves
     the previous destination untouched. ``fire_site`` names the
     fault-injection site sitting in the "killed mid-save" window (data
-    written, nothing published)."""
+    written, nothing published). The final publish goes through
+    :func:`replace_across_fs`, so a ``dest`` whose directory resolves to a
+    different filesystem than the tmp file (exotic overlay/bind setups)
+    still lands atomically instead of burning the retry budget on EXDEV."""
     from . import fault_injection
 
     tmp = f"{dest}.tmp.{os.getpid()}"
@@ -66,7 +145,7 @@ def atomic_write(dest, write_body, fire_site=None):
                 fault_injection.fire(fire_site)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, dest)
+        replace_across_fs(tmp, dest)
     except BaseException:
         try:
             os.remove(tmp)

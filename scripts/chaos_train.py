@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Chaos drill for the elastic supervision layer: kill, preempt, and hang
+a REAL 2-worker launcher job and prove bit-exact end-to-end recovery.
+
+Orchestrator mode (default — run it directly)::
+
+    python scripts/chaos_train.py [--out DIR] [--scenarios kill,preempt,hang]
+
+runs an uninterrupted 2-worker baseline job, then one chaos job per
+scenario, each under ``python -m paddle_tpu.distributed.launch``:
+
+- ``kill``:    rank 1 SIGKILLs itself mid-epoch (fault site ``proc.kill``)
+               — the supervisor sees the -9 exit, kills the group, and
+               restarts it (consumes restart budget).
+- ``preempt``: every rank receives SIGTERM at a window boundary; drive()
+               finishes the window, writes a committed checkpoint, and
+               exits 123 — the supervisor relaunches WITHOUT consuming
+               restart budget.
+- ``hang``:    rank 1 wedges (fault site ``train.stall``) with the
+               in-process stall guard off; its heartbeats go stale past
+               FLAGS_worker_hang_timeout_s, the watchdog SIGTERM→SIGKILLs
+               the group, and the budgeted restart resumes it.
+
+Every job writes a per-step loss log keyed by GLOBAL step (steps retrained
+after a restart are logged again). The drill asserts, per scenario:
+
+1. the job completes (exit 0) within its restart budget;
+2. every global step's loss is single-valued across incarnations — i.e.
+   replayed steps reproduced bit-identical losses;
+3. the full per-step loss sequence equals the uninterrupted baseline's
+   bit-for-bit;
+4. for ``preempt``: the launcher reported the relaunch as budget-free.
+
+Worker mode is selected automatically when the launcher's env
+(``PADDLE_TRAINER_ID`` + ``CHAOS_OUT``) is present: a deterministic
+bucketed varlen regression trained through ``FusedTrainStep.drive`` with
+checkpoint+sampler persistence at every metric-fetch window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = 2
+WINDOW = 3          # log_every: checkpoint / loss-log cadence
+BATCH = 4
+N_SAMPLES = 48      # -> 12 batches/epoch, 24 global steps
+FEATS = 4
+BOUNDARIES = [8, 16, 32]
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+def worker_main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.io as io
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.launch import heartbeat
+    from paddle_tpu.incubate.fused_train_step import FusedTrainStep
+    from paddle_tpu.utils import fault_injection as fi
+
+    # the gap between the bootstrap heartbeat and drive()'s first window
+    # spans the framework import + first XLA compile — beat once here so a
+    # tight watchdog timeout cannot mistake setup for a hang
+    heartbeat.write(step=None)
+
+    out = os.environ["CHAOS_OUT"]
+    scenario = os.environ.get("CHAOS_SCENARIO", "none")
+    chaos_step = int(os.environ.get("CHAOS_STEP", "0"))
+    chaos_rank = int(os.environ.get("CHAOS_RANK", "-1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    paddle.seed(0)
+    np.random.seed(0)
+
+    # deterministic varlen dataset (same on every rank / incarnation)
+    rng = np.random.RandomState(5)
+    lengths = rng.randint(3, 25, size=N_SAMPLES)
+    xs = [rng.randn(int(n), FEATS).astype("float32") for n in lengths]
+    ys = rng.randn(N_SAMPLES).astype("float32")
+
+    class VarLen(io.Dataset):
+        def __len__(self):
+            return N_SAMPLES
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(FEATS, 1)
+
+        def forward(self, x, y, mask):
+            tok = self.proj(x)[:, :, 0] * mask          # [B, L]
+            pred = tok.sum(axis=1) / mask.sum(axis=1)   # masked mean
+            d = pred - y
+            return (d * d).mean()
+
+    model = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    fstep = FusedTrainStep(model, opt)
+    sampler = io.BucketedBatchSampler(
+        VarLen(), batch_size=BATCH, boundaries=BOUNDARIES, shuffle=True,
+        seed=11, lengths=lengths.tolist(), drop_last=True)
+    loader = io.DataLoader(VarLen(), batch_sampler=sampler,
+                           collate_fn=io.PadToBucket(BOUNDARIES))
+
+    mgr = paddle.CheckpointManager(os.path.join(out, "ckpt"), keep_last_n=3)
+    resumed = mgr.auto_resume(model, fstep, sampler=loader)
+    base = 0 if resumed is None else int(resumed)
+    start_epoch = loader.state_dict()["epoch"]
+
+    log = open(os.path.join(out, f"loss.rank{rank}.log"), "a")
+    marker = os.path.join(out, f"fired.{scenario}.{rank}")
+
+    def on_window(win):
+        gstep_end = base + win["step"]
+        for i, l in enumerate(win["losses"]):
+            gs = gstep_end - len(win["losses"]) + i + 1
+            log.write(f"{gs} {float(l)!r}\n")
+        log.flush()
+        os.fsync(log.fileno())
+        mgr.save(int(fstep.device_metrics()["step_count"]), model=model,
+                 optimizer=fstep, sampler=loader)
+        if (scenario == "preempt" and gstep_end >= chaos_step
+                and not os.path.exists(marker)):
+            open(marker, "w").write("x")
+            # a real scheduler would deliver SIGTERM asynchronously; at a
+            # window boundary every rank is at the same global step, so
+            # the group's preemption checkpoints agree
+            signal.raise_signal(signal.SIGTERM)
+
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        hit = (scenario in ("kill", "hang") and rank == chaos_rank
+               and chaos_step > base and not os.path.exists(marker))
+        if hit:
+            # marker first: the fault below ends this incarnation, and the
+            # restarted worker must not re-arm it
+            open(marker, "w").write("x")
+            site = "proc.kill" if scenario == "kill" else "train.stall"
+            stack.enter_context(
+                fi.inject(site, every_n=chaos_step - base))
+        for epoch in range(start_epoch, EPOCHS):
+            loader.set_epoch(epoch)  # resets cursor unless resuming into it
+            res = fstep.drive(loader, log_every=WINDOW, on_window=on_window,
+                              checkpoint=mgr, sampler=loader)
+            base += res["steps"]
+
+    open(os.path.join(out, f"done.rank{rank}"), "w").write(str(base))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def _job_env(out, scenario, chaos_step=0, chaos_rank=-1, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "CHAOS_OUT": out,
+        "CHAOS_SCENARIO": scenario,
+        "CHAOS_STEP": str(chaos_step),
+        "CHAOS_RANK": str(chaos_rank),
+        "FLAGS_restart_backoff_s": "0.1",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the TPU tunnel
+    env.update(extra or {})
+    return env
+
+
+def run_job(out, scenario, chaos_step=0, chaos_rank=-1, max_restart=0,
+            extra_env=None, timeout=600):
+    os.makedirs(out, exist_ok=True)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node=2", f"--max_restart={max_restart}",
+           f"--log_dir={os.path.join(out, 'logs')}",
+           os.path.abspath(__file__)]
+    t0 = time.time()
+    r = subprocess.run(cmd, env=_job_env(out, scenario, chaos_step,
+                                         chaos_rank, extra_env),
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=timeout)
+    r.elapsed = time.time() - t0
+    return r
+
+
+def read_losses(out, rank=0):
+    """{global_step: loss_repr}; raises if any step was re-trained with a
+    DIFFERENT loss (the bit-exactness the recovery path guarantees)."""
+    seen = {}
+    path = os.path.join(out, f"loss.rank{rank}.log")
+    with open(path) as f:
+        for line in f:
+            step_s, val = line.split(" ", 1)
+            step, val = int(step_s), val.strip()
+            if step in seen and seen[step] != val:
+                raise AssertionError(
+                    f"step {step} retrained with a DIFFERENT loss: "
+                    f"{seen[step]} vs {val} (not bit-exact)")
+            seen[step] = val
+    return dict(sorted(seen.items()))
+
+
+def check(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+    print(f"  ok: {msg}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--scenarios", default="kill,preempt,hang")
+    args = ap.parse_args(argv)
+    out_root = args.out or tempfile.mkdtemp(prefix="chaos_train.")
+    scenarios = [s for s in args.scenarios.split(",") if s]
+
+    print(f"[chaos] scratch: {out_root}")
+    print("[chaos] baseline (uninterrupted 2-worker run)...")
+    base_out = os.path.join(out_root, "baseline")
+    r = run_job(base_out, "none")
+    check(r.returncode == 0,
+          f"baseline exits 0 (got {r.returncode}): {r.stderr[-800:]}")
+    baseline = read_losses(base_out)
+    check(baseline and sorted(baseline) == list(range(1, len(baseline) + 1)),
+          f"baseline logged a contiguous {len(baseline)}-step sequence")
+
+    results = {}
+    for sc in scenarios:
+        out = os.path.join(out_root, sc)
+        print(f"[chaos] scenario {sc!r}...")
+        if sc == "kill":
+            r = run_job(out, "kill", chaos_step=8, chaos_rank=1,
+                        max_restart=2)
+        elif sc == "preempt":
+            r = run_job(out, "preempt", chaos_step=2 * WINDOW,
+                        max_restart=0)
+        elif sc == "hang":
+            # timeout must exceed (model build + first XLA compile +
+            # auto_resume) between heartbeats on a loaded CI box, while
+            # staying far below the 3600s stall itself
+            r = run_job(out, "hang", chaos_step=7, chaos_rank=1,
+                        max_restart=2,
+                        extra_env={"FLAGS_worker_hang_timeout_s": "12",
+                                   "FLAGS_worker_term_grace_s": "2"})
+        else:
+            raise SystemExit(f"unknown scenario {sc!r}")
+        check(r.returncode == 0,
+              f"{sc}: job completes within budget (rc={r.returncode}): "
+              f"{r.stderr[-800:]}")
+        losses = read_losses(out)
+        check(losses == baseline,
+              f"{sc}: loss sequence bit-identical to baseline "
+              f"({len(losses)} steps)")
+        if sc == "preempt":
+            check("restart budget untouched" in r.stderr,
+                  "preempt: relaunch consumed zero restart budget")
+            check("worker failed" not in r.stderr,
+                  "preempt: no crash restarts")
+        if sc == "kill":
+            check("restart 1/" in r.stderr, "kill: consumed restart budget")
+        if sc == "hang":
+            check("heartbeats stale" in r.stderr,
+                  "hang: watchdog detected the stall")
+        results[sc] = r.elapsed
+        print(f"  done in {r.elapsed:.1f}s")
+
+    print("[chaos] ALL SCENARIOS PASSED:",
+          ", ".join(f"{k}={v:.1f}s" for k, v in results.items()))
+    return 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("CHAOS_OUT") and os.environ.get("PADDLE_TRAINER_ID"):
+        sys.exit(worker_main())
+    sys.exit(main())

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Lint: every fault-injection site must be exercised by at least one test.
+
+``paddle_tpu.utils.fault_injection.SITES`` is the registry of named failure
+points the durability/supervision layers defend against. A site nobody
+injects is a recovery path nobody runs — this lint greps ``tests/`` (and
+``scripts/chaos_train.py``, the launcher-level chaos drill) for each site
+string and fails listing any that appear in no test. Wired as a tier-1
+test (tests/test_supervision.py), so a new site cannot ship untested.
+
+Deliberately import-free: SITES is parsed from the module source, so the
+lint runs in milliseconds without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SITES_SOURCE = os.path.join(REPO, "paddle_tpu", "utils",
+                            "fault_injection.py")
+# non-test files that legitimately exercise sites end to end
+EXTRA_EXERCISERS = (os.path.join(REPO, "scripts", "chaos_train.py"),)
+
+
+def registered_sites(source_path=SITES_SOURCE):
+    """The SITES tuple, parsed (not imported) from fault_injection.py."""
+    with open(source_path) as f:
+        src = f.read()
+    m = re.search(r"^SITES\s*=\s*(\(.*?\))", src, re.S | re.M)
+    if not m:
+        raise RuntimeError(f"could not locate SITES in {source_path}")
+    sites = ast.literal_eval(m.group(1))
+    if not sites:
+        raise RuntimeError("SITES parsed empty — lint would be vacuous")
+    return sites
+
+
+def find_missing(sites=None, tests_dir=None, extra=EXTRA_EXERCISERS):
+    """Sites not mentioned (as a string literal) by any test file."""
+    if sites is None:
+        sites = registered_sites()
+    tests_dir = tests_dir or os.path.join(REPO, "tests")
+    haystack = []
+    for d in [tests_dir]:
+        for root, _dirs, files in os.walk(d):
+            for fn in files:
+                if fn.endswith(".py"):
+                    haystack.append(os.path.join(root, fn))
+    haystack += [p for p in extra if os.path.exists(p)]
+    corpus = ""
+    for path in haystack:
+        with open(path, errors="replace") as f:
+            corpus += f.read()
+    return [s for s in sites if f'"{s}"' not in corpus
+            and f"'{s}'" not in corpus]
+
+
+def main(argv=None):
+    missing = find_missing()
+    if missing:
+        print("fault sites with NO exercising test (add one per site, "
+              "e.g. `with fault_injection.inject(<site>): ...`):",
+              file=sys.stderr)
+        for s in missing:
+            print(f"  - {s}", file=sys.stderr)
+        return 1
+    print(f"ok: all {len(registered_sites())} fault sites are exercised "
+          "by tests")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -628,3 +628,119 @@ class TestInjectorSemantics:
                 fi.fire("io.save")  # inner injector: never fires
             with pytest.raises(ValueError):
                 fi.fire("io.save")  # outer restored
+
+
+# ---------------------------------------------------------------------------
+# kill -9 durability: a REAL SIGKILL mid-CheckpointManager.save
+# ---------------------------------------------------------------------------
+
+import signal  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one script, three phases: seed a committed step-1 checkpoint (+ a side
+# dump of its exact bytes), SIGKILL ourselves mid-save of step 2 at an
+# injected fault site, then verify the lifecycle recovered.
+KILL9_SCRIPT = '''
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.utils import fault_injection as fi
+
+root, mode, site = {root!r}, sys.argv[1], sys.argv[2]
+paddle.seed(7)
+model = nn.Linear(4, 3)
+opt = paddle.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+mgr = paddle.CheckpointManager(root, keep_last_n=None)
+
+
+def perturb():
+    # deterministic change so step-2 state differs from step-1
+    for t in model.parameters():
+        t.set_value(t.numpy() + 1.0)
+
+
+def side_dump(name):
+    np.savez(os.path.join(root, name),
+             **{{n: np.asarray(t.numpy())
+                for n, t in model.state_dict().items()}})
+
+
+if mode == "seed":
+    mgr.save(1, model=model, optimizer=opt)
+    side_dump("side1.npz")
+elif mode == "kill":
+    assert mgr.auto_resume(model, opt) == 1
+    perturb()
+
+    class Killer(BaseException):
+        def __init__(self, *a):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    with fi.inject(site, exc=Killer):
+        mgr.save(2, model=model, optimizer=opt)
+    raise SystemExit(99)  # unreachable: the save must have died
+elif mode == "resave":
+    assert mgr.auto_resume(model, opt) == 1
+    perturb()
+    mgr.save(2, model=model, optimizer=opt)
+    side_dump("side2.npz")
+elif mode == "verify":
+    expect_step, side = int(sys.argv[3]), sys.argv[4]
+    step = mgr.auto_resume(model, opt)
+    assert step == expect_step, (step, expect_step)
+    ref = np.load(os.path.join(root, side))
+    for n, t in model.state_dict().items():
+        got = np.asarray(t.numpy())
+        assert np.array_equal(got, ref[n]), n
+    print("VERIFIED", step)
+'''
+
+
+@pytest.mark.slow
+class TestKillNineDurability:
+    def _run(self, root, *argv):
+        script = os.path.join(root, "kill9.py")
+        if not os.path.exists(script):
+            with open(script, "w") as f:
+                f.write(KILL9_SCRIPT.format(repo=REPO, root=root))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        return subprocess.run([sys.executable, script, *argv], env=env,
+                              capture_output=True, text=True, timeout=180)
+
+    def test_sigkill_mid_save_never_regresses_latest_valid_step(
+            self, tmp_path):
+        root = str(tmp_path)
+        r = self._run(root, "seed", "-")
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        for site in ("io.save", "ckpt.shard_write"):
+            r = self._run(root, "kill", site)
+            # the writer died to a REAL SIGKILL mid-save...
+            assert r.returncode == -signal.SIGKILL, (site, r.returncode,
+                                                     r.stderr[-1500:])
+            # ...and a fresh process still resumes step 1 bit-exactly
+            r = self._run(root, "verify", site, "1", "side1.npz")
+            assert r.returncode == 0, (site, r.stderr[-2000:])
+            assert "VERIFIED 1" in r.stdout
+
+    def test_post_kill_resave_moves_forward_bit_exactly(self, tmp_path):
+        root = str(tmp_path)
+        assert self._run(root, "seed", "-").returncode == 0
+        assert self._run(root, "kill", "io.save").returncode == \
+            -signal.SIGKILL
+        # recovery is not just "don't regress": the next healthy save
+        # advances the lifecycle and restores bit-exactly
+        r = self._run(root, "resave", "-")
+        assert r.returncode == 0, r.stderr[-2000:]
+        r = self._run(root, "verify", "-", "2", "side2.npz")
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "VERIFIED 2" in r.stdout
