@@ -60,7 +60,9 @@ from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .framework.io import CheckpointCorruptionError, load, save  # noqa: F401
-from .core.exceptions import TrainStallError  # noqa: F401
+from .core.exceptions import (  # noqa: F401
+    TrainDivergenceError, TrainStallError,
+)
 
 
 def in_dynamic_mode():

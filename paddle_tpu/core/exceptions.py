@@ -20,7 +20,27 @@ import contextlib
 import signal
 import threading
 
-__all__ = ["TrainStallError", "stall_guard"]
+__all__ = ["TrainDivergenceError", "TrainStallError", "stall_guard"]
+
+
+class TrainDivergenceError(RuntimeError):
+    """Training is finite-but-wrong and the divergence sentinel ran out of
+    graceful responses: the loss-spike / grad-explosion detector
+    (``FLAGS_sentinel_action``) either exhausted its rollback budget
+    (``FLAGS_sentinel_rollback_budget`` rollbacks per rolling
+    ``FLAGS_sentinel_budget_window_s`` window), was configured to raise on
+    the first verdict, or had no healthy checkpoint to roll back to.
+
+    ``history`` carries the sentinel's spike records (one dict per spike
+    verdict: step, window mean loss, z-score, grad-norm peak, reasons) and
+    ``rollbacks`` the number of rollbacks already performed — enough for a
+    supervisor or a human to reconstruct the divergence post-mortem without
+    the (possibly dead) process's logs."""
+
+    def __init__(self, msg, history=None, rollbacks=0):
+        super().__init__(msg)
+        self.history = list(history or [])
+        self.rollbacks = int(rollbacks)
 
 
 class TrainStallError(RuntimeError):

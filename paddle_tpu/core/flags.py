@@ -140,6 +140,15 @@ register_flag(
     help="retries for transient OSErrors on checkpoint writes (paddle.save, "
          "distributed shard writes, LocalFS renames) with exponential "
          "backoff + jitter; 0 disables retrying")
+register_flag(
+    "ckpt_quarantine_keep", -1,
+    help="CheckpointManager retention bound on *.replaced.* quarantine "
+         "dirs that still hold the only committed copy of their step "
+         "(redundant quarantines are always swept): -1 (default) keeps "
+         "all — the PR-2 never-delete behavior — while N >= 0 keeps only "
+         "the N newest such quarantines. N >= 1 is recommended when "
+         "bounding: 0 sweeps even the newest, which can discard the only "
+         "committed copy of a step whose re-save keeps getting torn")
 
 
 def _validate_nan_action(v):
@@ -221,6 +230,109 @@ register_flag(
          "finish their preemption checkpoint after one exits preempted. "
          "Launcher-side env flag",
     on_change=_validate_non_negative("worker_term_grace_s"))
+
+# ---- divergence sentinel flags ---------------------------------------------
+# Configure the TrainingSentinel layer (paddle.incubate.TrainingSentinel):
+# loss-spike / grad-explosion detection at metric-fetch window boundaries in
+# FusedTrainStep.drive (zero added per-step host syncs — detection rides the
+# deferred-window fetch) and its graceful-degradation response ladder.
+
+def _validate_sentinel_action(v):
+    if v not in ("none", "warn", "skip", "rollback", "raise"):
+        raise ValueError(
+            f"FLAGS_sentinel_action must be one of "
+            f"none/warn/skip/rollback/raise, got {v!r}")
+
+
+def _validate_unit_interval(name):
+    def check(v):
+        if not (0.0 < float(v) < 1.0):
+            raise ValueError(f"FLAGS_{name} must be in (0, 1), got {v!r}")
+    return check
+
+
+def _validate_unit_interval_inclusive_one(v):
+    if not (0.0 < float(v) <= 1.0):
+        raise ValueError(
+            f"FLAGS_sentinel_lr_cooldown must be in (0, 1], got {v!r}")
+
+
+register_flag(
+    "sentinel_action", "none",
+    help="divergence-sentinel response when a training window is judged a "
+         "spike: 'none' (sentinel off), 'warn' (RuntimeWarning, continue), "
+         "'skip' (warn + drop the next window of batches — assumes a "
+         "contiguous poisoned input region; the bad window's updates stay "
+         "applied), 'rollback' (restore model+optimizer+sampler from the "
+         "last HEALTHY checkpoint, skip the offending batches, optional LR "
+         "cooldown, budgeted), 'raise' (typed TrainDivergenceError at the "
+         "first verdict)",
+    on_change=_validate_sentinel_action)
+register_flag(
+    "sentinel_zscore", 6.0,
+    help="spike threshold: a window whose mean loss sits more than this "
+         "many EMA standard deviations ABOVE the running EMA mean is a "
+         "spike (one-sided; armed after FLAGS_sentinel_warmup_windows "
+         "clean windows); <= 0 disables the z-score detector",
+)
+register_flag(
+    "sentinel_ema_beta", 0.9,
+    help="EMA decay for the sentinel's running mean/variance of window "
+         "mean losses (higher = longer memory, slower to absorb genuine "
+         "regime changes); spike windows never update the EMA, so one "
+         "spike cannot normalize the next",
+    on_change=_validate_unit_interval("sentinel_ema_beta"))
+register_flag(
+    "sentinel_warmup_windows", 3,
+    help="clean windows the sentinel observes before the z-score detector "
+         "arms (the EMA baseline must exist before deviations from it mean "
+         "anything); the grad-norm ceiling and patience detectors are "
+         "active from the first window",
+    on_change=_validate_positive_int("sentinel_warmup_windows"))
+register_flag(
+    "sentinel_grad_norm_ceiling", 0.0,
+    help="absolute ceiling on the window's peak global grad norm (tracked "
+         "device-side in the fused step's donated accumulator — no extra "
+         "per-step host sync): any window whose peak exceeds it is a "
+         "spike; 0 disables and skips the in-graph norm reduction when "
+         "grad clipping is not already computing it",
+    on_change=_validate_non_negative("sentinel_grad_norm_ceiling"))
+register_flag(
+    "sentinel_patience", 0,
+    help="divergence-trend detector: this many CONSECUTIVE windows of "
+         "strictly rising mean loss is a spike verdict even when no "
+         "single window clears the z-score bar (slow divergence); 0 "
+         "disables",
+    on_change=_validate_non_negative("sentinel_patience"))
+register_flag(
+    "sentinel_rollback_budget", 3,
+    help="leaky-bucket cap on sentinel rollbacks: at most this many "
+         "rollbacks per rolling FLAGS_sentinel_budget_window_s window "
+         "(mirroring the launcher's RestartBudget); exhaustion raises "
+         "TrainDivergenceError carrying the spike history",
+    on_change=_validate_positive_int("sentinel_rollback_budget"))
+register_flag(
+    "sentinel_budget_window_s", 3600.0,
+    help="rolling window of the sentinel's rollback budget (old rollbacks "
+         "age out instead of consuming budget forever); 0 makes the "
+         "budget lifetime-scoped",
+    on_change=_validate_non_negative("sentinel_budget_window_s"))
+register_flag(
+    "sentinel_lr_cooldown", 1.0,
+    help="learning-rate multiplier applied after each sentinel rollback "
+         "(the restored step's LR scale times this; e.g. 0.5 halves the "
+         "LR past the spike region); 1.0 disables. Applied as a scale on "
+         "top of the optimizer's own schedule, persisted in the fused "
+         "step's state dict",
+    on_change=_validate_unit_interval_inclusive_one)
+register_flag(
+    "sentinel_healthy_windows", 2,
+    help="clean windows that must pass beyond a committed checkpoint step "
+         "before CheckpointManager tags it HEALTHY (rollback only ever "
+         "targets healthy steps, so a checkpoint written during an "
+         "undetected spike cannot become a rollback target); a bad window "
+         "resets every pending count",
+    on_change=_validate_positive_int("sentinel_healthy_windows"))
 
 register_flag(
     "check_nan_inf_action", "none",

@@ -47,7 +47,8 @@ from ...framework.io import CheckpointCorruptionError
 
 __all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
            "CheckpointManager", "CheckpointCorruptionError", "is_committed",
-           "verify_checkpoint", "sync_processes", "allgather_success"]
+           "verify_checkpoint", "sync_processes", "allgather_success",
+           "allgather_ints"]
 
 COMMIT_FILE = "COMMIT"
 
@@ -97,17 +98,32 @@ def sync_processes(tag):
 
 
 def allgather_success(ok, tag):
-    """True iff EVERY process reports ``ok``; doubles as a barrier."""
+    """True iff EVERY process reports ``ok`` (a missing rank counts as
+    failure); doubles as a barrier. Thin wrapper over the one
+    coordination-service gather transport, :func:`allgather_ints`."""
+    return all(v == 1 for v in allgather_ints(1 if ok else 0, tag))
+
+
+def allgather_ints(value, tag):
+    """Every process's ``value`` (an int), index-aligned by rank (a rank
+    that never published stays ``None``); doubles as a barrier. The one
+    gather transport over the coordination service — ``allgather_success``
+    and the divergence sentinel's agreement checks (spike verdict,
+    rollback TARGET step, budget admit bit) all ride it: a shared
+    filesystem's attribute cache can show different ranks different
+    HEALTHY markers, so the target must be agreed before any rank
+    restores."""
+    value = int(value)
     if jax.process_count() <= 1:
-        return bool(ok)
+        return [value]
     client = _coord_client()
     if client is None:
         from jax.experimental import multihost_utils
 
-        return bool(np.all(multihost_utils.process_allgather(
-            np.asarray([bool(ok)]))))
-    key = f"pt_ckpt_ok:{next(_SYNC_SEQ)}:{zlib.crc32(tag.encode())}"
-    client.key_value_set(f"{key}/{jax.process_index()}", "1" if ok else "0")
+        arr = multihost_utils.process_allgather(np.asarray([value]))
+        return [int(v) for v in np.ravel(arr)]
+    key = f"pt_ckpt_int:{next(_SYNC_SEQ)}:{zlib.crc32(tag.encode())}"
+    client.key_value_set(f"{key}/{jax.process_index()}", str(value))
     client.wait_at_barrier(f"{key}.b", _SYNC_TIMEOUT_MS)
     vals = client.key_value_dir_get(f"{key}/")
     # clean the store once every rank has read: a long job checkpointing
@@ -118,8 +134,10 @@ def allgather_success(ok, tag):
             client.key_value_delete(f"{key}/")
         except Exception:
             pass  # older runtimes without delete: stale keys are harmless
-    return (len(vals) == jax.process_count()
-            and all(v == "1" for _, v in vals))
+    out = [None] * jax.process_count()
+    for path, v in vals:
+        out[int(path.rsplit("/", 1)[-1])] = int(v)
+    return out
 
 
 class AsyncSaveHandle:

@@ -23,6 +23,15 @@ lifecycle is a single object over the v3 commit-protocol layout written by
   until the in-flight :class:`AsyncSaveHandle` lands (the next ``save`` or
   an explicit ``wait`` drains it), so a checkpoint is never pruned while
   its successor is still being written.
+- **Health metadata** (the divergence-sentinel contract): a committed step
+  is *healthy* only once ``k`` clean metric-fetch windows have passed
+  beyond it (``note_window(clean, k)`` — the sentinel calls it at every
+  window boundary; a bad window resets every pending count, so a
+  checkpoint written during an undetected spike can never become a
+  rollback target). ``tag_healthy`` stamps a ``HEALTHY`` marker into the
+  step dir, ``latest_healthy_step()`` is the rollback query, retention
+  never deletes the newest healthy step, and ``drop_steps_after(step)``
+  is the post-rollback sweep of poisoned newer checkpoints.
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 _OPT_FILE = "optimizer.pdopt"
 _SCALER_FILE = "scaler.pdscaler"
 _SAMPLER_FILE = "sampler.pdsampler"
+_HEALTH_FILE = "HEALTHY"
 
 
 def _resolve_sampler(obj):
@@ -70,6 +80,13 @@ class CheckpointManager:
         self.keep_last_n = None if keep_last_n is None else int(keep_last_n)
         self.async_save = bool(async_save)
         self._pending = None  # in-flight (step, AsyncSaveHandle)
+        # health tagging (divergence sentinel): committed steps awaiting
+        # their k clean windows, {step: clean_windows_seen_since_commit}.
+        # In-memory on purpose — a crash loses pending counts and the
+        # restarted process re-earns them, which is conservative (a step
+        # is never tagged healthy on less evidence than k clean windows
+        # observed by ONE process lifetime)
+        self._health_pending: dict[int, int] = {}
         os.makedirs(self.root, exist_ok=True)
 
     # ---- layout ---------------------------------------------------------
@@ -125,6 +142,102 @@ class CheckpointManager:
             except (CheckpointCorruptionError, FileNotFoundError):
                 continue
         return None
+
+    # ---- health metadata (divergence sentinel) --------------------------
+    def is_healthy(self, step):
+        """A step is healthy when it is committed AND carries the HEALTHY
+        tag — i.e. the sentinel saw ``k`` clean windows pass beyond it."""
+        d = self.step_dir(step)
+        return (is_committed(d)
+                and os.path.exists(os.path.join(d, _HEALTH_FILE)))
+
+    def tag_healthy(self, step):
+        """Stamp a committed step as a valid rollback target (atomic
+        marker write; coordinator-only on multi-process filesystems).
+        No-op on an uncommitted/missing step — health can never certify
+        data the commit protocol has not."""
+        if jax.process_index() != 0:
+            return False
+        d = self.step_dir(step)
+        if not is_committed(d):
+            return False
+        marker = os.path.join(d, _HEALTH_FILE)
+        tmp = f"{marker}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("healthy\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, marker)
+        return True
+
+    def note_window(self, clean, k=1):
+        """Sentinel hook, called once per metric-fetch window boundary
+        (after any checkpoint written at that boundary): a **clean**
+        window first credits every pending committed step — promoting
+        those that reach ``k`` clean windows to HEALTHY — and then
+        registers newly committed steps at zero credits (so the step
+        saved *at this very boundary* still needs ``k`` MORE clean
+        windows). A **bad** window resets every pending count to zero:
+        health requires k *consecutive* clean windows beyond the step.
+        Returns the list of steps promoted this call."""
+        promoted = []
+        if not clean:
+            for s in self._health_pending:
+                self._health_pending[s] = 0
+            return promoted
+        k = max(1, int(k))
+        for s in sorted(self._health_pending):
+            self._health_pending[s] += 1
+            if self._health_pending[s] >= k:
+                if self.tag_healthy(s):
+                    promoted.append(s)
+                self._health_pending.pop(s)
+        for s in self.committed_steps():
+            if s not in self._health_pending and not self.is_healthy(s):
+                self._health_pending[s] = 0
+        return promoted
+
+    def latest_healthy_step(self, verify=False):
+        """Newest committed step tagged HEALTHY (``verify=True`` also
+        CRC-walks it, skipping corrupt ones) — the rollback target query.
+        ``None`` when no healthy checkpoint exists yet."""
+        self._recover_quarantines()
+        for s in reversed(self.committed_steps()):
+            if not self.is_healthy(s):
+                continue
+            if not verify:
+                return s
+            try:
+                verify_checkpoint(self.step_dir(s))
+                return s
+            except (CheckpointCorruptionError, FileNotFoundError):
+                continue
+        return None
+
+    def drop_steps_after(self, step):
+        """Post-rollback sweep: delete every step directory (committed or
+        torn) NEWER than ``step`` — they were written past the divergence
+        point and hold poisoned states that must never win a
+        ``latest_valid_step`` race against the healthy restore point.
+        Their quarantine copies go too. Coordinator-only; returns the
+        dropped step numbers."""
+        self.wait()
+        step = int(step)
+        dropped = [s for s in self.steps() if s > step]
+        for s in list(self._health_pending):
+            if s > step:
+                self._health_pending.pop(s)
+        if jax.process_index() != 0:
+            return dropped
+        for s in dropped:
+            shutil.rmtree(self.step_dir(s), ignore_errors=True)
+        for entry in os.listdir(self.root):
+            base, sep, _ = entry.partition(".replaced.")
+            m = _STEP_RE.match(base)
+            if sep and m and int(m.group(1)) > step:
+                shutil.rmtree(os.path.join(self.root, entry),
+                              ignore_errors=True)
+        return dropped
 
     # ---- save -----------------------------------------------------------
     def save(self, step, model=None, optimizer=None, scaler=None,
@@ -207,12 +320,20 @@ class CheckpointManager:
         Uncommitted (torn) directories are garbage and are swept too, as
         are ``*.replaced.*`` quarantines — those only once their re-save
         landed, or once retention is enabled and a newer commit exists
-        (which is always true here). The newest committed step always
-        survives."""
+        (which is always true here). Quarantines that are NOT redundant
+        (they hold the only committed copy of the newest step, accumulated
+        by repeated torn re-saves) are kept by default and bounded by
+        ``FLAGS_ckpt_quarantine_keep`` when set >= 0. The newest committed
+        step always survives, and so does the newest HEALTHY step — the
+        divergence sentinel's only rollback target must outlive any number
+        of newer (possibly poisoned) saves."""
+        from ...core.flags import flag_value
+
         if jax.process_index() != 0:
             return
         committed = self.committed_steps()
         newest = committed[-1] if committed else None
+        survivors = []  # non-redundant quarantines (see below)
         for entry in os.listdir(self.root):
             base, sep, _ = entry.partition(".replaced.")
             m = _STEP_RE.match(base)
@@ -225,17 +346,36 @@ class CheckpointManager:
                     newest is not None and newest > int(m.group(1))):
                 shutil.rmtree(os.path.join(self.root, entry),
                               ignore_errors=True)
+            else:
+                survivors.append(entry)
+        # flag-gated bound on the non-redundant quarantines (PR-2 said
+        # "never delete"; a crash-loop re-saving the same newest step can
+        # still grow them without bound — the flag opts into keeping only
+        # the newest N, default -1 keeps all)
+        qkeep = int(flag_value("ckpt_quarantine_keep", -1))
+        if qkeep >= 0 and len(survivors) > qkeep:
+            def qage(entry):
+                try:
+                    return os.path.getmtime(os.path.join(self.root, entry))
+                except OSError:
+                    return 0.0
+            survivors.sort(key=qage, reverse=True)  # newest first
+            for entry in survivors[qkeep:]:
+                shutil.rmtree(os.path.join(self.root, entry),
+                              ignore_errors=True)
         if self.keep_last_n is None:
             return
+        healthy = [s for s in committed if self.is_healthy(s)]
+        newest_healthy = healthy[-1] if healthy else None
         victims = [s for s in self.steps() if s not in committed]
         keep = max(1, self.keep_last_n)
-        victims += committed[:-keep]
+        victims += [s for s in committed[:-keep] if s != newest_healthy]
         for s in victims:
             shutil.rmtree(self.step_dir(s), ignore_errors=True)
 
     # ---- resume ---------------------------------------------------------
     def auto_resume(self, model=None, optimizer=None, scaler=None,
-                    verify=False, sampler=None):
+                    verify=False, sampler=None, step=None):
         """Restore ``model`` + ``optimizer`` + ``scaler`` + ``sampler``
         from the newest valid checkpoint and return its step (the
         optimizer's global step / LR schedule ride in its state dict; the
@@ -246,9 +386,24 @@ class CheckpointManager:
         checkpoint exists, so cold starts and warm restarts share one call.
         ``verify=True`` CRC-walks candidate steps before loading (load
         itself re-verifies what it reads — the deep pre-pass costs a second
-        read of the chosen checkpoint and is for resuming past bit-rot)."""
+        read of the chosen checkpoint and is for resuming past bit-rot).
+        ``step=`` pins the restore to that exact committed step instead of
+        the newest — the divergence-rollback path restores the
+        ``latest_healthy_step()`` this way, deliberately skipping newer
+        (poisoned) saves; an uncommitted ``step`` raises ValueError."""
         self.wait()
-        step = self.latest_valid_step(verify=verify)
+        if step is None:
+            step = self.latest_valid_step(verify=verify)
+        else:
+            step = int(step)
+            self._recover_quarantines()
+            if not is_committed(self.step_dir(step)):
+                raise ValueError(
+                    f"auto_resume(step={step}): no committed checkpoint "
+                    f"at {self.step_dir(step)} (committed steps: "
+                    f"{self.committed_steps()})")
+            if verify:
+                verify_checkpoint(self.step_dir(step))
         if step is None:
             return None
         d = self.step_dir(step)

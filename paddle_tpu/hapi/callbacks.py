@@ -8,8 +8,9 @@ import time
 
 import numpy as np
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "ReduceLROnPlateau", "config_callbacks"]
+__all__ = ["Callback", "DivergenceSentinel", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping",
+           "ReduceLROnPlateau", "config_callbacks"]
 
 
 class Callback:
@@ -303,6 +304,142 @@ class ReduceLROnPlateau(Callback):
                         print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class DivergenceSentinel(Callback):
+    """hapi face of the divergence sentinel
+    (:class:`paddle.incubate.TrainingSentinel`): the same window-level
+    loss-spike detector ``FusedTrainStep.drive`` runs, driven from the
+    ``fit`` loop's lazy per-batch losses. Losses are buffered as device
+    values and materialized once per ``window`` steps (ONE host sync per
+    window — the per-step loop stays sync-free), judged, and the response
+    ladder runs per ``FLAGS_sentinel_action``:
+
+    - ``warn`` — RuntimeWarning naming the window and z-score.
+    - ``skip`` — hapi's fit has no resumable-cursor contract to skip
+      batches with, so this degrades to ``warn`` (use
+      ``FusedTrainStep.drive`` for true bad-window skip).
+    - ``rollback`` — needs ``manager=`` (a :class:`CheckpointManager`
+      whose steps a :class:`ModelCheckpoint(keep_last_n=...)` writes, or
+      any manager the caller saves through): restores model(+optimizer)
+      from ``latest_healthy_step()``, drops the poisoned newer steps, and
+      continues — budgeted; exhaustion raises
+      :class:`~paddle_tpu.core.exceptions.TrainDivergenceError`. The data
+      stream is NOT rewound (hapi batches are not resumable), so the
+      poisoned batches' region is simply trained past.
+    - ``raise`` — typed ``TrainDivergenceError`` at the first verdict.
+
+    ``manager`` also receives the health bookkeeping
+    (``note_window``): a committed step becomes a rollback target only
+    ``FLAGS_sentinel_healthy_windows`` clean windows after it was
+    written. ``Model.fit`` auto-appends this callback whenever
+    ``FLAGS_sentinel_action`` != 'none' and none was passed."""
+
+    def __init__(self, sentinel=None, window=None, manager=None):
+        super().__init__()
+        self.sentinel = sentinel
+        self.window = window
+        self.manager = manager
+        self._buf = []
+
+    def on_train_begin(self, logs=None):
+        from ..core.flags import flag_value
+        from ..incubate.sentinel import TrainingSentinel
+
+        if self.sentinel is None:
+            # flags are read at fit time, not construction time, so
+            # set_flags between building callbacks and fitting works
+            self.sentinel = TrainingSentinel()
+        if self.window is None:
+            self.window = int(flag_value("metric_fetch_interval", 10))
+        self._buf = []
+
+    def on_train_batch_end(self, step, logs=None):
+        loss = (logs or {}).get("loss")
+        if loss is None or self.sentinel is None or not self.sentinel.armed:
+            return
+        # keep the device handle lazy; materialize per-window, not per-step
+        self._buf.append(getattr(loss, "_data", loss))
+        if len(self._buf) >= self.window:
+            self._judge(step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._buf and self.sentinel is not None and self.sentinel.armed:
+            self._judge(self.params.get("last_step", -1))
+
+    def _judge(self, step):
+        import warnings
+
+        import jax.numpy as jnp
+
+        from ..incubate.sentinel import make_window
+
+        buf, self._buf = self._buf, []
+        losses = np.asarray(jnp.stack(
+            [jnp.asarray(v, jnp.float32) for v in buf]))  # one host sync
+        win = make_window(
+            losses, non_finite=int((~np.isfinite(losses)).sum()),
+            step=step)
+        verdict = self.sentinel.observe(win)
+        # same contract as FusedTrainStep._sentinel_check: no rank
+        # responds alone
+        spiked = self.sentinel.agree_verdict(verdict["verdict"] == "spike")
+        if self.manager is not None and hasattr(self.manager,
+                                                "note_window"):
+            self.manager.note_window(clean=not spiked,
+                                     k=self.sentinel.healthy_windows)
+        if not spiked:
+            return
+        why, where = self.sentinel.describe(verdict)
+        action = self.sentinel.action
+        if action == "raise":
+            self.sentinel.raise_divergence(
+                f"divergence detected ({why}) at {where}")
+        warnings.warn(
+            f"divergence sentinel: spike verdict ({why}) at {where} — "
+            f"responding with FLAGS_sentinel_action={action}"
+            + (" (skip degrades to warn under hapi fit: no resumable "
+               "batch cursor)" if action == "skip" else ""),
+            RuntimeWarning, stacklevel=2)
+        if action != "rollback":
+            return
+        if self.manager is None:
+            self.sentinel.raise_divergence(
+                "FLAGS_sentinel_action=rollback under hapi fit needs "
+                "DivergenceSentinel(manager=a CheckpointManager) whose "
+                "steps a ModelCheckpoint(keep_last_n=...) writes")
+        healthy = self.manager.latest_healthy_step()
+        admit = self.sentinel.agree_rollback(healthy)
+        if healthy is None:
+            self.sentinel.raise_divergence(
+                "no HEALTHY checkpoint to roll back to (a step is tagged "
+                "healthy only after FLAGS_sentinel_healthy_windows clean "
+                "windows pass beyond it)")
+        self.sentinel.acquire_rollback(admit=admit)
+        d = self.manager.step_dir(healthy)
+        if os.path.exists(os.path.join(d, "model.pdparams")):
+            # the ModelCheckpoint(keep_last_n=...) layout: hapi-pickled
+            # model(+optimizer) inside the committed step dir
+            self.model.load(os.path.join(d, "model"))
+        else:
+            self.manager.auto_resume(
+                model=self.model.network,
+                optimizer=getattr(self.model, "_optimizer", None),
+                step=healthy)
+        self.manager.drop_steps_after(healthy)
+        if self.sentinel.lr_cooldown < 1.0:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None and hasattr(opt, "set_lr"):
+                try:
+                    opt.set_lr(opt.get_lr() * self.sentinel.lr_cooldown)
+                except RuntimeError:
+                    # scheduler-driven LR: set_lr is rejected by design —
+                    # the schedule owns the rate; cooldown is a
+                    # drive()-path feature there (_lr_scale)
+                    pass
+        # re-baseline: the restored (earlier, higher-loss) trajectory must
+        # not read as the next spike
+        self.sentinel.notify_rollback()
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

@@ -291,6 +291,27 @@ class Model:
             steps = len(loader)
         except TypeError:
             steps = None
+        # divergence sentinel (FLAGS_sentinel_action != 'none'): fit
+        # exposes the same window-level spike detector drive() runs, as a
+        # callback — an explicitly passed DivergenceSentinel wins
+        from ..core.flags import flag_value
+        from .callbacks import DivergenceSentinel, ModelCheckpoint
+
+        callbacks = list(callbacks or [])
+        if (str(flag_value("sentinel_action", "none")) != "none"
+                and not any(isinstance(c, DivergenceSentinel)
+                            for c in callbacks)):
+            # a managed ModelCheckpoint in the same run provides the
+            # rollback target store — without it, action=rollback would
+            # escalate to raise at the first spike
+            manager = None
+            for c in callbacks:
+                if isinstance(c, ModelCheckpoint) and c.save_dir \
+                        and c.keep_last_n is not None:
+                    manager = c._get_manager()
+                    break
+            callbacks.append(DivergenceSentinel(window=log_freq,
+                                                manager=manager))
         cbks = config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,

@@ -110,12 +110,25 @@ class FusedTrainStep:
         self._step_count = 0
         # device-resident step metrics, threaded through the executable as
         # one donated tuple: (bias-correction step count, running loss sum,
-        # skipped-step count). The step count lives ON DEVICE — in protect
-        # mode it advances only on finite steps IN-GRAPH — so a deferred
-        # metric fetch (drive/log_every) is bit-identical to per-step
-        # fetch even across NaN-skipped windows. self._step_count stays as
-        # the host mirror for telemetry (synced at fetch boundaries).
-        self._acc = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+        # skipped-step count, window peak global grad norm). The step count
+        # lives ON DEVICE — in protect mode it advances only on finite
+        # steps IN-GRAPH — so a deferred metric fetch (drive/log_every) is
+        # bit-identical to per-step fetch even across NaN-skipped windows.
+        # The grad-norm peak feeds the divergence sentinel
+        # (FLAGS_sentinel_grad_norm_ceiling) and is fetched/reset only at
+        # window boundaries — zero per-step host syncs. self._step_count
+        # stays as the host mirror for telemetry (synced at fetch
+        # boundaries).
+        self._acc = (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
+                     jnp.float32(0.0))
+        # divergence-rollback LR cooldown: a scale on top of the
+        # optimizer's own schedule, multiplied by FLAGS_sentinel_lr_cooldown
+        # at each sentinel rollback and persisted in state_dict
+        self._lr_scale = 1.0
+        self._scaler_fallback_warned = False
+        # FLAGS_sentinel_action-created TrainingSentinel, cached across
+        # drive() calls so budget/history/EMA accumulate over epochs
+        self._flag_sentinel = None
 
         opt = optimizer
         if isinstance(opt, AdamW):
@@ -179,10 +192,13 @@ class FusedTrainStep:
         # at all, "flag": compute the all-finite flag only, "protect": flag
         # + skip-step select): flipping FLAGS_check_nan_inf_action between
         # modes mid-run costs one recompile, steady state costs none and
-        # the guard-off path stays exactly the pre-guard program
+        # the guard-off path stays exactly the pre-guard program. The same
+        # holds for track_gnorm (the sentinel's grad-norm ceiling): off
+        # compiles out both the norm reduction (unless grad clipping
+        # already pays it) and the peak update
         self._jitted = jax.jit(self._step_impl,
                                donate_argnums=(0, 1, 2, 3),
-                               static_argnums=(8,))
+                               static_argnums=(8, 9))
 
     # -- pure step ------------------------------------------------------
     def _loss(self, params, data, kwdata, scale):
@@ -199,8 +215,8 @@ class FusedTrainStep:
         return out * scale  # loss scaling fused in-graph (scale==1 => no-op)
 
     def _step_impl(self, params, m1, m2, acc, lr, scale, data, kwdata,
-                   guard):
-        step_prev, loss_sum, skips = acc
+                   guard, track_gnorm):
+        step_prev, loss_sum, skips, gpeak = acc
         step = step_prev + 1.0  # bias-correction count for THIS step
         loss, grads = jax.value_and_grad(self._loss)(params, data, kwdata,
                                                      scale)
@@ -218,9 +234,11 @@ class FusedTrainStep:
             for g in jax.tree.leaves(grads):
                 all_finite = jnp.logical_and(all_finite,
                                              jnp.all(jnp.isfinite(g)))
-        if self._clip_norm is not None:
+        gnorm = None  # pre-clip global grad norm (the explosion signal)
+        if self._clip_norm is not None or track_gnorm:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(_f32(g) ** 2) for g in jax.tree.leaves(grads)))
+        if self._clip_norm is not None:
             factor = jnp.minimum(1.0, self._clip_norm / (gnorm + 1e-12))
             grads = jax.tree.map(lambda g: (_f32(g) * factor).astype(g.dtype),
                                  grads)
@@ -294,7 +312,15 @@ class FusedTrainStep:
             new_step = step
             new_skips = skips
             loss_inc = _f32(loss)
-        new_acc = (new_step, loss_sum + loss_inc, new_skips)
+        if track_gnorm:
+            # window peak; a non-finite norm is the NaN guard's domain,
+            # not the sentinel's ceiling — excluded so a skipped NaN step
+            # cannot wedge the peak at inf/NaN for the rest of the window
+            new_gpeak = jnp.maximum(gpeak, jnp.where(
+                jnp.isfinite(gnorm), _f32(gnorm), 0.0))
+        else:
+            new_gpeak = gpeak
+        new_acc = (new_step, loss_sum + loss_inc, new_skips, new_gpeak)
         return loss, all_finite, new_acc, new_p, new_m1, new_m2
 
     # -- public ---------------------------------------------------------
@@ -307,8 +333,10 @@ class FusedTrainStep:
         try:
             lowered = self._jitted.lower(
                 self._params, self._m1, self._m2,
-                (jnp.float32(0), jnp.float32(0), jnp.float32(0)),
-                jnp.float32(1e-3), jnp.float32(1), darrs, karrs, "off")
+                (jnp.float32(0), jnp.float32(0), jnp.float32(0),
+                 jnp.float32(0)),
+                jnp.float32(1e-3), jnp.float32(1), darrs, karrs, "off",
+                False)
             cost = lowered.cost_analysis()
             if not (hasattr(cost, "get") and cost.get("flops")):
                 # some backends only report cost post-compile; with the
@@ -377,16 +405,27 @@ class FusedTrainStep:
 
     def state_dict(self):
         """Checkpointable state of the fused step: the in-graph moment
-        buffers and the bias-correction step count (weights live in the
-        model; this object is the optimizer-state owner while it trains).
-        Duck-type-compatible with ``CheckpointManager.save(optimizer=...)``
-        / ``auto_resume(optimizer=...)``."""
+        buffers, the bias-correction step count and the sentinel's LR
+        cooldown scale (weights live in the model; this object is the
+        optimizer-state owner while it trains). Duck-type-compatible with
+        ``CheckpointManager.save(optimizer=...)`` /
+        ``auto_resume(optimizer=...)``."""
         import numpy as np
 
         # the authoritative step count is the device accumulator (the host
-        # mirror can lag inside a deferred-fetch window) — one host sync
-        # here, at the checkpoint boundary
-        sd = {"step_count": int(np.asarray(self._acc[0]))}
+        # mirror can lag inside a deferred-fetch window) — guard_stats
+        # (sync=True) flushes the host mirrors from it in one host sync
+        # here, at the checkpoint boundary, so checkpoint-time telemetry
+        # is as authoritative as the checkpoint itself
+        self.guard_stats(sync=True)
+        sd = {"step_count": self._step_count,
+              "lr_scale": float(self._lr_scale)}
+        # the LR scheduler advanced once per dispatched step; without its
+        # state a restore (crash-resume OR divergence rollback) would
+        # resume the schedule N steps ahead of the restored trajectory
+        sched = getattr(self.optimizer, "_learning_rate", None)
+        if hasattr(sched, "state_dict"):
+            sd["lr_sched"] = sched.state_dict()
         for prefix, store in (("m1", self._m1), ("m2", self._m2)):
             for n, v in store.items():
                 sd[f"{prefix}.{n}"] = np.asarray(v)
@@ -394,8 +433,12 @@ class FusedTrainStep:
 
     def set_state_dict(self, sd):
         self._step_count = int(sd.get("step_count", self._step_count))
+        self._lr_scale = float(sd.get("lr_scale", 1.0))
+        sched = getattr(self.optimizer, "_learning_rate", None)
+        if "lr_sched" in sd and hasattr(sched, "set_state_dict"):
+            sched.set_state_dict(sd["lr_sched"])
         self._acc = (jnp.float32(self._step_count), self._acc[1],
-                     self._acc[2])
+                     self._acc[2], self._acc[3])
         for prefix, store in (("m1", self._m1), ("m2", self._m2)):
             for n in store:
                 key = f"{prefix}.{n}"
@@ -419,44 +462,76 @@ class FusedTrainStep:
 
     def device_metrics(self):
         """The device-resident accumulator, fetched in ONE host sync:
-        ``{"step_count", "loss_sum", "skipped"}``. ``loss_sum`` is the
-        running sum of applied per-step losses (non-finite skipped steps
-        excluded in protect mode), ``skipped`` counts in-graph discards.
-        Authoritative at any time — including inside a deferred-fetch
-        window, where the host mirrors (``guard_stats``) lag until the
-        next boundary."""
+        ``{"step_count", "loss_sum", "skipped", "gnorm_peak"}``.
+        ``loss_sum`` is the running sum of applied per-step losses
+        (non-finite skipped steps excluded in protect mode), ``skipped``
+        counts in-graph discards, ``gnorm_peak`` the peak global grad norm
+        since the last window reset (0.0 unless the sentinel's grad-norm
+        tracking is armed). Authoritative at any time — including inside a
+        deferred-fetch window, where the host mirrors (``guard_stats``)
+        lag until the next boundary or an explicit
+        ``guard_stats(sync=True)``."""
         import numpy as np
 
         vals = np.asarray(jnp.stack([jnp.asarray(a, jnp.float32)
                                      for a in self._acc]))
         return {"step_count": int(vals[0]), "loss_sum": float(vals[1]),
-                "skipped": int(vals[2])}
+                "skipped": int(vals[2]), "gnorm_peak": float(vals[3])}
 
-    def guard_stats(self):
+    def guard_stats(self, sync=False):
         """Step-anomaly-guard counters: ``total`` dispatched steps,
         ``skipped`` updates discarded for non-finite loss/grads,
         ``consecutive_skips`` current streak (a growing streak means the run
         is in a NaN spiral, not a one-off overflow), ``warned`` warn-mode
-        events."""
+        events.
+
+        Inside a deferred-fetch window (``drive``) the host mirrors lag
+        the device until the next boundary replays the bookkeeping;
+        ``sync=True`` flushes them NOW from the authoritative device
+        accumulator (one host sync — ``step_count``/``skipped`` become
+        exact; ``consecutive_skips`` is inherently boundary-resolution and
+        is left untouched). ``state_dict`` uses this, so checkpoint-time
+        stats are authoritative."""
+        if sync:
+            dm = self.device_metrics()
+            self._step_count = dm["step_count"]
+            self._guard["skipped"] = dm["skipped"]
         return dict(self._guard)
 
-    def _poison_nan(self, darrs, karrs):
-        """train.grad_nan injection: NaN-fill the first floating-point
-        input so loss/grads go non-finite this step (shape/dtype signature
-        unchanged — no recompile)."""
+    @staticmethod
+    def _poison_first_float(darrs, karrs, fn):
+        """Apply ``fn`` to the first floating-point call input (shape/
+        dtype signature unchanged — no recompile). Shared walker for the
+        input-poisoning fault sites."""
         darrs = list(darrs)
         for i, a in enumerate(darrs):
             if jnp.issubdtype(a.dtype, jnp.inexact):
-                darrs[i] = jnp.full_like(a, jnp.nan)
+                darrs[i] = fn(a)
                 return tuple(darrs), karrs
         for k in sorted(karrs):
             if jnp.issubdtype(karrs[k].dtype, jnp.inexact):
                 karrs = dict(karrs)
-                karrs[k] = jnp.full_like(karrs[k], jnp.nan)
+                karrs[k] = fn(karrs[k])
                 return tuple(darrs), karrs
         return tuple(darrs), karrs
 
-    def _dispatch(self, data, kwdata, guard, scale_val):
+    def _poison_nan(self, darrs, karrs):
+        """train.grad_nan injection: NaN-fill the first floating-point
+        input so loss/grads go non-finite this step."""
+        return self._poison_first_float(
+            darrs, karrs, lambda a: jnp.full_like(a, jnp.nan))
+
+    _SPIKE_SCALE = 1e3
+
+    def _poison_spike(self, darrs, karrs):
+        """train.spike injection: scale the first floating-point input by
+        1e3 so loss/grads go finite-but-huge — the NaN guard stays silent
+        and only the divergence sentinel can catch it."""
+        return self._poison_first_float(
+            darrs, karrs,
+            lambda a: a * jnp.asarray(self._SPIKE_SCALE, a.dtype))
+
+    def _dispatch(self, data, kwdata, guard, scale_val, track_gnorm=False):
         """One asynchronous dispatch of the fused executable: prepare and
         bucket-pad inputs, fire, rebind donated buffers. Returns the lazy
         (loss, finite) device values — NO host sync happens here; that is
@@ -464,15 +539,18 @@ class FusedTrainStep:
         ``drive``)."""
         from ..utils import fault_injection
 
-        lr = jnp.float32(self.optimizer.get_lr())
+        lr = jnp.float32(self.optimizer.get_lr() * self._lr_scale)
         self._adopt_external_rebinds()
         darrs, karrs = self._prepare_arrays(data, kwdata)
         if fault_injection.should_fire("train.grad_nan"):
             darrs, karrs = self._poison_nan(darrs, karrs)
+        if fault_injection.should_fire("train.spike"):
+            darrs, karrs = self._poison_spike(darrs, karrs)
         self._count_dispatch(darrs, karrs)
         loss, finite, self._acc, self._params, self._m1, self._m2 = \
             self._jitted(self._params, self._m1, self._m2, self._acc, lr,
-                         jnp.float32(scale_val), darrs, karrs, guard)
+                         jnp.float32(scale_val), darrs, karrs, guard,
+                         track_gnorm)
         # donation invalidated the old buffers — rebind the live Tensors
         for n in self._names:
             self._tensors[n]._rebind(self._params[n])
@@ -551,7 +629,8 @@ class FusedTrainStep:
 
     def drive(self, data, steps=None, log_every=None, prefetch=None,
               prefetch_depth=None, on_window=None, checkpoint=None,
-              sampler=None, heartbeat=True, handle_preemption=True):
+              sampler=None, heartbeat=True, handle_preemption=True,
+              sentinel=None):
         """Multi-step driver: dispatch fused steps back-to-back with NO
         per-step host sync, so the device executable queue stays deep while
         the input side is double-buffered by a :class:`DevicePrefetcher`.
@@ -610,10 +689,38 @@ class FusedTrainStep:
           window boundary (``on_window`` or the preemption save) resumes
           the *exact* remaining batch sequence — prefetch read-ahead never
           skews it.
+        - **Divergence sentinel** (``FLAGS_sentinel_action`` != 'none', or
+          an explicit ``sentinel=`` :class:`TrainingSentinel`): every
+          fetched window is judged by the loss-spike / grad-explosion /
+          trend detectors — a pure host computation over the values the
+          deferred fetch brings over anyway, so arming it adds ZERO
+          per-step host syncs. On a spike verdict the response ladder
+          runs: ``warn`` (RuntimeWarning), ``skip`` (also drop the next
+          window of batches — a contiguous poisoned input region),
+          ``rollback`` (restore model + this step's optimizer state from
+          ``checkpoint.latest_healthy_step()`` while the sampler cursor
+          stays exactly where the spike left it — every batch consumed
+          since the healthy step, the poisoned window included, is never
+          replayed and the in-flight epoch keeps its recorded shuffle
+          seed; reset the prefetcher's read-ahead, apply the
+          ``FLAGS_sentinel_lr_cooldown`` scale, drop newer poisoned
+          checkpoints, and continue — budgeted by a leaky bucket that
+          raises :class:`TrainDivergenceError` on exhaustion), ``raise``
+          (typed error at the first verdict).
+          Health metadata: each clean window credits the checkpoints
+          ``checkpoint`` has committed (``note_window``), so a step only
+          becomes a rollback target ``FLAGS_sentinel_healthy_windows``
+          clean windows after it was written. Multi-process runs
+          cross-check the verdict through the jax.distributed
+          coordination service before responding, so every rank rolls
+          back identically (a disagreeing rank is a split brain and
+          raises).
 
         Returns ``{"steps", "loss" (per-step floats), "skipped",
-        "windows", "host_syncs", "log_every", "deferred", "prefetch"}``.
-        (A preempted drive never returns: it exits via
+        "windows", "host_syncs", "log_every", "deferred", "prefetch",
+        "rollbacks", "skipped_windows", "sentinel"}`` (``sentinel`` is the
+        sentinel's ``stats()`` snapshot, or None when unarmed). (A
+        preempted drive never returns: it exits via
         ``SystemExit(PREEMPT_EXIT_CODE)`` after its checkpoint.)
         """
         from ..core.flags import flag_value
@@ -622,6 +729,27 @@ class FusedTrainStep:
         if log_every is None:
             log_every = int(flag_value("metric_fetch_interval", 10))
         log_every = max(1, int(log_every))
+        # divergence sentinel: explicit instance wins; else armed from
+        # FLAGS_sentinel_action. Detection rides the window fetch, so an
+        # armed sentinel costs zero additional per-step host syncs. The
+        # flag-created instance is CACHED on this step across drive()
+        # calls — the epoch-loop pattern (one drive per epoch) must keep
+        # accumulating the rollback budget, spike history and EMA
+        # baseline, or the leaky-bucket loop breaker could never fire
+        if sentinel is None:
+            if str(flag_value("sentinel_action", "none")) != "none":
+                from .sentinel import TrainingSentinel
+
+                cached = getattr(self, "_flag_sentinel", None)
+                if cached is None or cached.action != str(
+                        flag_value("sentinel_action", "none")):
+                    cached = TrainingSentinel()
+                    self._flag_sentinel = cached
+                sentinel = cached
+        elif not sentinel.armed:
+            sentinel = None
+        rollback_armed = sentinel is not None and \
+            sentinel.action == "rollback"
         stream = data
         made_prefetcher = None
         if prefetch is None:
@@ -631,9 +759,12 @@ class FusedTrainStep:
 
             # cap the SOURCE at steps too: otherwise the transfer thread
             # reads ahead of the cap and discards up to depth+1 batches a
-            # one-shot iterator's owner still wanted
+            # one-shot iterator's owner still wanted. A rollback-armed
+            # sentinel needs the source RE-ITERABLE from the restored
+            # cursor instead (islice would pin one half-consumed pass),
+            # so there the while-loop's own cap does the bounding
             source = (itertools.islice(iter(data), steps)
-                      if steps is not None else data)
+                      if steps is not None and not rollback_armed else data)
             made_prefetcher = DevicePrefetcher(
                 source, depth=prefetch_depth,
                 shape_buckets=self._shape_buckets,
@@ -642,7 +773,8 @@ class FusedTrainStep:
             stream = made_prefetcher
         history = {"steps": 0, "loss": [], "skipped": 0, "windows": 0,
                    "host_syncs": 0, "log_every": log_every,
-                   "deferred": True, "prefetch": None}
+                   "deferred": True, "prefetch": None, "rollbacks": 0,
+                   "skipped_windows": 0, "sentinel": None}
 
         # resumable-stream cursor: only armed on the resume-enabled path
         # (an explicit sampler=, or a checkpoint manager to persist into) —
@@ -673,30 +805,62 @@ class FusedTrainStep:
 
             from ..core.exceptions import stall_guard
             from ..distributed.launch import heartbeat as hb
+            from ..jit import cache as jit_cache
             from ..utils import fault_injection
 
             history["deferred"] = False
+            # degrade-once semantics (mirroring io.prefetch): say WHY the
+            # deferred fetch is off exactly once per step instance, and
+            # count every degraded drive in jit.cache_stats() so an A/B
+            # bench can see the fallback without scraping warnings
+            jit_cache.record_scaler_fallback(self._stats_name)
+            if not self._scaler_fallback_warned:
+                import warnings
+
+                self._scaler_fallback_warned = True
+                warnings.warn(
+                    "FusedTrainStep.drive: an enabled GradScaler forces "
+                    "per-step metric fetch (the scale for step N+1 "
+                    "consumes step N's finite flag on host), so the "
+                    "FLAGS_metric_fetch_interval deferred-window path is "
+                    "inactive for this drive. Detach the scaler (or "
+                    "construct it with enable=False) and use "
+                    "FLAGS_check_nan_inf_action=skip to keep non-finite "
+                    "protection with deferred fetch; see jit.cache_stats()"
+                    f"['{self._stats_name}']['scaler_fallbacks']",
+                    RuntimeWarning, stacklevel=2)
             skipped_before = self._guard["skipped"]
             win_start, win_skips = 0, self._guard["skipped"]
             it = iter(stream)
 
-            def scaler_window_end():
+            def scaler_window_end(final=False):
                 # on_window still fires at every log boundary (it is the
                 # documented checkpoint hook), just with per-step-fetched
                 # values instead of a deferred stack
-                nonlocal win_start, win_skips
-                chunk = np.float32(history["loss"][win_start:])
+                nonlocal win_start, win_skips, it
+                from .sentinel import make_window
+
                 history["windows"] += 1
+                win = make_window(
+                    history["loss"][win_start:],
+                    non_finite=self._guard["skipped"] - win_skips,
+                    step=history["steps"])
                 if on_window is not None:
-                    on_window({"losses": chunk,
-                               "mean_loss": float(chunk.mean()),
-                               "non_finite": (self._guard["skipped"]
-                                              - win_skips),
-                               "step": history["steps"]})
+                    on_window(win)
                 win_start = len(history["loss"])
                 win_skips = self._guard["skipped"]
                 if heartbeat:
                     hb.write(step=self._step_count)
+                if sentinel is not None:
+                    # trailing window: no stream left to rewind/skip —
+                    # pass it=None like the deferred path, so a rollback
+                    # only restores state for the NEXT drive
+                    new_it = self._sentinel_check(
+                        sentinel, win, history, checkpoint, resumable,
+                        stream, None if final else it, log_every,
+                        scaler=scaler)
+                    if new_it is not None:
+                        it = new_it
 
             with hb.trap_preemption(enable=handle_preemption) as preempt:
                 if heartbeat:
@@ -729,7 +893,7 @@ class FusedTrainStep:
                         if history["steps"] % log_every == 0:
                             scaler_window_end()
                     if len(history["loss"]) > win_start:
-                        scaler_window_end()
+                        scaler_window_end(final=True)
                     history["skipped"] = (self._guard["skipped"]
                                           - skipped_before)
                 finally:
@@ -740,6 +904,8 @@ class FusedTrainStep:
                         history["prefetch"] = made_prefetcher.stats()
                 if preempt.triggered:
                     self._preempt_exit(checkpoint, resumable, heartbeat)
+            if sentinel is not None:
+                history["sentinel"] = sentinel.stats()
             return history
 
         # guard mode is pinned for the whole drive (one executable); flag
@@ -756,6 +922,11 @@ class FusedTrainStep:
         protect = action in ("skip", "raise")
         guard = "protect" if protect else ("flag" if action != "none"
                                            else "off")
+        # grad-norm tracking is a static graph choice (like guard): only
+        # paid when the sentinel's ceiling is armed, and free when grad
+        # clipping already computes the norm
+        track_gnorm = bool(sentinel is not None
+                           and sentinel.wants_grad_norm())
         window = []
         sched = (getattr(self.optimizer, "_learning_rate", None)
                  if self._step_lr_scheduler else None)
@@ -788,7 +959,8 @@ class FusedTrainStep:
                     args, kw = self._call_form(batch)
                     self._step_count += 1
                     self._guard["total"] += 1
-                    loss, finite = self._dispatch(args, kw, guard, 1.0)
+                    loss, finite = self._dispatch(args, kw, guard, 1.0,
+                                                  track_gnorm)
                     if resumable is not None:
                         resumable.advance(1)
                     window.append((loss, finite))
@@ -800,22 +972,39 @@ class FusedTrainStep:
                         # (action='raise'), the trailing flush below must
                         # not replay the same window's bookkeeping
                         full, window = window, []
-                        self._flush_window(full, action, protect,
-                                           history, on_window,
-                                           stall_timeout=step_timeout)
+                        win = self._flush_window(full, action, protect,
+                                                 history, on_window,
+                                                 stall_timeout=step_timeout,
+                                                 track_gnorm=track_gnorm)
                         if heartbeat:
                             hb.write(step=self._step_count)
+                        if sentinel is not None:
+                            new_it = self._sentinel_check(
+                                sentinel, win, history, checkpoint,
+                                resumable, stream, it, log_every)
+                            if new_it is not None:
+                                it = new_it
                 # trailing partial window: flushed only on clean exit — an
                 # exception escaping the loop must propagate, not be
                 # replaced by a boundary FloatingPointError (the device
                 # state is already correct either way; in-graph semantics
                 # never needed the host)
                 if window:
-                    self._flush_window(window, action, protect, history,
-                                       on_window,
-                                       stall_timeout=step_timeout)
+                    win = self._flush_window(window, action, protect,
+                                             history, on_window,
+                                             stall_timeout=step_timeout,
+                                             track_gnorm=track_gnorm)
                     if heartbeat:
                         hb.write(step=self._step_count)
+                    if sentinel is not None:
+                        # the loop is over, so a skip/rollback response
+                        # has no iterator to rewind — but the restore /
+                        # warn / raise / health bookkeeping still applies
+                        # (the NEXT drive continues from the rolled-back
+                        # state and cursor)
+                        self._sentinel_check(
+                            sentinel, win, history, checkpoint,
+                            resumable, stream, None, log_every)
             except BaseException:
                 # the unfetched window's finite flags are lost with the
                 # exception — resync the host mirrors from the
@@ -823,9 +1012,7 @@ class FusedTrainStep:
                 # numbering stay exact for the rest of the process
                 if protect:
                     try:
-                        dm = self.device_metrics()
-                        self._step_count = dm["step_count"]
-                        self._guard["skipped"] = dm["skipped"]
+                        self.guard_stats(sync=True)
                     except Exception:
                         pass
                 raise
@@ -835,6 +1022,8 @@ class FusedTrainStep:
                     history["prefetch"] = made_prefetcher.stats()
             if preempt.triggered:
                 self._preempt_exit(checkpoint, resumable, heartbeat)
+        if sentinel is not None:
+            history["sentinel"] = sentinel.stats()
         return history
 
     def _preempt_exit(self, checkpoint, resumable, heartbeat):
@@ -869,13 +1058,142 @@ class FusedTrainStep:
             hb.write(step=self._step_count)
         raise SystemExit(hb.PREEMPT_EXIT_CODE)
 
+    def _sentinel_check(self, sentinel, win, history, checkpoint,
+                        resumable, stream, it, log_every, scaler=None):
+        """Judge one fetched window and run the divergence-response
+        ladder. Returns a replacement batch iterator when the response
+        rewound or skipped the stream (rollback restarts it from the
+        restored-and-advanced cursor), else ``None``.
+
+        The verdict is deterministic from replicated device values, so
+        every rank computes it identically; multi-process runs still
+        cross-check through the jax.distributed coordination service (the
+        PR-4 checkpoint-barrier transport) — a rank whose replicated
+        arithmetic diverged is exactly the failure under supervision and
+        must not roll back alone."""
+        import warnings
+
+        verdict = sentinel.observe(win)
+        spiked = sentinel.agree_verdict(verdict["verdict"] == "spike")
+        # health bookkeeping: every clean window credits the committed
+        # checkpoints; a bad window resets their pending counts — a step
+        # becomes a rollback target only FLAGS_sentinel_healthy_windows
+        # clean windows after it was written
+        if checkpoint is not None and hasattr(checkpoint, "note_window"):
+            checkpoint.note_window(clean=not spiked,
+                                   k=sentinel.healthy_windows)
+        if not spiked:
+            return None
+        why, where = sentinel.describe(verdict)
+        if sentinel.action == "raise":
+            sentinel.raise_divergence(
+                f"divergence detected ({why}) at {where}")
+        warnings.warn(
+            f"divergence sentinel: spike verdict ({why}) at {where} — "
+            f"responding with FLAGS_sentinel_action={sentinel.action}",
+            RuntimeWarning, stacklevel=3)
+        if sentinel.action == "warn":
+            return None
+        if sentinel.action == "skip":
+            # bad-window skip: assume the poisoned input region continues
+            # and drop the NEXT window of batches untrained (the cursor
+            # advances over them — they are consumed, never replayed).
+            # The offending window's updates stay applied: without a
+            # checkpoint there is nothing to rewind to
+            if it is None:
+                return None  # trailing window: no stream left to skip
+            from ..core.flags import flag_value
+            from ..core.exceptions import stall_guard
+
+            dropped = 0
+            # the drain pulls from the same loader/collective path as a
+            # normal fetch — keep it under the stall guard, or a wedge
+            # while draining would block forever (FLAGS_step_timeout_s)
+            with stall_guard(float(flag_value("step_timeout_s", 0) or 0),
+                             "sentinel skip-window drain"):
+                try:
+                    for _ in range(log_every):
+                        next(it)
+                        dropped += 1
+                        if resumable is not None:
+                            resumable.advance(1)
+                except StopIteration:
+                    pass
+            if dropped:
+                history["skipped_windows"] += 1
+            return it if dropped else None
+        # rollback: restore the last HEALTHY checkpoint and skip every
+        # batch consumed since it, so the poisoned window is not replayed
+        if checkpoint is None or resumable is None:
+            sentinel.raise_divergence(
+                "FLAGS_sentinel_action=rollback needs drive(checkpoint=a "
+                "CheckpointManager, sampler=/data=a resumable stream); "
+                f"got checkpoint={type(checkpoint).__name__}, "
+                f"resumable={type(resumable).__name__}")
+        healthy = checkpoint.latest_healthy_step()
+        admit = sentinel.agree_rollback(healthy)
+        if healthy is None:
+            sentinel.raise_divergence(
+                "no HEALTHY checkpoint to roll back to (a step is tagged "
+                "healthy only after FLAGS_sentinel_healthy_windows clean "
+                "windows pass beyond it — the spike hit before any "
+                "checkpoint earned the tag)")
+        sentinel.acquire_rollback(admit=admit)  # raises on exhaustion
+        # restore model + this step's optimizer state — but NOT the
+        # sampler: its cursor already sits just past the poisoned window
+        # (one advance() per trained batch), which IS the skip — every
+        # batch consumed since the healthy checkpoint is never replayed,
+        # and the in-flight epoch keeps its recorded shuffle seed (a
+        # restore-then-re-advance round trip would re-draw an unseeded
+        # epoch seed and resume a DIFFERENT permutation than the one the
+        # consumed batches came from)
+        pre_scale = self._lr_scale
+        checkpoint.auto_resume(model=self.model, optimizer=self,
+                               scaler=scaler, step=healthy)
+        # checkpoints written past the divergence point hold poisoned
+        # states — they must never win a latest_valid_step race against
+        # the healthy restore point on a later crash-restart
+        checkpoint.drop_steps_after(healthy)
+        if sentinel.lr_cooldown < 1.0:
+            # compound on top of the PRE-restore scale: repeated spikes
+            # in the same region restore the same (pre-cooldown)
+            # checkpoint, and cooling down after EACH rollback must keep
+            # escalating — 0.5, 0.25, ... — not reset to 0.5 every time
+            self._lr_scale = pre_scale * sentinel.lr_cooldown
+        # the rewind puts the trajectory at an earlier, higher-loss point;
+        # re-baseline the detector or the rollback itself reads as the
+        # next spike (budget-draining rollback loop)
+        sentinel.notify_rollback()
+        history["rollbacks"] += 1
+        if it is None:
+            # trailing window: the loop is already over — params, moments
+            # and cursor are rolled back, and the NEXT drive()/epoch
+            # continues from the restored position
+            return None
+        # restart the stream: drop the prefetcher's read-ahead (staged
+        # past the rollback point) and begin a fresh pass that honors the
+        # untouched cursor (already just past the poisoned window)
+        if hasattr(stream, "reset"):
+            stream.reset()
+        new_it = iter(stream)
+        if new_it is it:
+            sentinel.raise_divergence(
+                "rollback needs a re-iterable batch stream (a DataLoader "
+                "or DevicePrefetcher), got a bare one-shot iterator")
+        return new_it
+
     def _flush_window(self, window, action, protect, history, on_window,
-                      stall_timeout=0):
+                      stall_timeout=0, track_gnorm=False):
         """Fetch one deferred window (O(1) host round-trips) and replay the
         per-step guard bookkeeping that per-step fetch would have done.
-        ``stall_timeout`` arms the stall guard over the device fetches ONLY
-        — ``on_window`` (user code: checkpointing, logging) runs outside
-        it, so a slow checkpoint save is never mistaken for a wedge."""
+        Returns the window dict handed to ``on_window`` (the divergence
+        sentinel judges it). With ``track_gnorm`` the accumulator's
+        grad-norm peak rides in the SAME stacked fetch as the losses —
+        same host-sync count armed or not — and the device-side peak is
+        re-zeroed for the next window. ``stall_timeout`` arms the stall
+        guard over the device fetches ONLY — ``on_window`` (user code:
+        checkpointing, logging) runs outside it, so a slow checkpoint save
+        is never mistaken for a wedge."""
         import warnings
 
         import numpy as np
@@ -883,9 +1201,20 @@ class FusedTrainStep:
         from ..core.exceptions import stall_guard
 
         with stall_guard(stall_timeout, "window metric fetch"):
-            losses = np.asarray(
-                jnp.stack([jnp.asarray(l, jnp.float32) for l, _ in window]))
+            vals = [jnp.asarray(l, jnp.float32) for l, _ in window]
+            if track_gnorm:
+                vals.append(jnp.asarray(self._acc[3], jnp.float32))
+            stacked = np.asarray(jnp.stack(vals))
             history["host_syncs"] += 1
+            gnorm_peak = None
+            if track_gnorm:
+                gnorm_peak = float(stacked[-1])
+                losses = stacked[:-1]
+                # fresh zero for the next window's peak (host-side tuple
+                # rebuild — no device round-trip)
+                self._acc = self._acc[:3] + (jnp.float32(0.0),)
+            else:
+                losses = stacked
             finite = None
             if action != "none":
                 finite = np.asarray(jnp.stack([f for _, f in window]))
@@ -913,15 +1242,19 @@ class FusedTrainStep:
         if protect:
             history["skipped"] += n_bad
         history["windows"] += 1
+        from .sentinel import make_window
+
+        win = make_window(losses, non_finite=n_bad,
+                          step=history["steps"], gnorm_peak=gnorm_peak)
         if on_window is not None:
-            on_window({"losses": losses, "mean_loss": float(losses.mean()),
-                       "non_finite": n_bad, "step": history["steps"]})
+            on_window(win)
         if n_bad and action == "raise":
             raise FloatingPointError(
                 f"non-finite loss/grads on {n_bad} step(s) detected at the "
                 "metric-fetch boundary; the updates were already discarded "
                 "in-graph (FLAGS_check_nan_inf_action=raise, deferred "
                 "fetch)")
+        return win
 
 
 def fused_train_step(model, optimizer, loss_fn=None, step_lr_scheduler=True,

@@ -152,10 +152,30 @@ class BucketedBatchSampler(BatchSampler):
 
     # -- resumable stream (crash recovery) -------------------------------
     def advance(self, n=1):
-        """Report that ``n`` more batches of the current epoch were
-        *consumed* (trained on). Called by the training driver — not the
-        loader — so prefetch read-ahead never skews the resume cursor."""
+        """Report that ``n`` more batches of the stream were *consumed*
+        (trained on, or — on the divergence-rollback path — deliberately
+        skipped). Called by the training driver, not the loader, so
+        prefetch read-ahead never skews the resume cursor.
+
+        Rolling past the end of an epoch carries the remainder into the
+        next epoch deterministically: the epoch increments, the cursor
+        keeps the overshoot, and the epoch seed is re-drawn exactly as a
+        real epoch transition would draw it (``seed + epoch`` when
+        seeded) — so a rollback skip that lands near an epoch edge
+        resumes the same batch sequence a step-by-step consumer would
+        have seen."""
         self._cursor += int(n)
+        n_batches = len(self)
+        while n_batches and self._cursor >= n_batches:
+            self._cursor -= n_batches
+            self._epoch += 1
+            self._epoch_seed = self._draw_epoch_seed()
+            # the drawn seed is BINDING for the new epoch's first pass: a
+            # checkpoint written at this boundary records it, so the live
+            # process's next __iter__ must use it too (an unseeded
+            # redraw there would make interrupted and uninterrupted runs
+            # train different permutations)
+            self._seed_restored = True
 
     def state_dict(self):
         """Resume point of the batch stream: ``(epoch, cursor, seed)``
@@ -236,10 +256,14 @@ class BucketedBatchSampler(BatchSampler):
         # NEXT epoch instead of yielding an empty pass).
         batches = self._epoch_batches()
         if batches and self._cursor >= len(batches):
-            self._epoch += 1
-            self._cursor = 0
-            self._epoch_seed = self._draw_epoch_seed()
-            self._seed_restored = False
+            # carry the overshoot, don't truncate it: a restored cursor
+            # past the epoch end (e.g. a rollback skip persisted at an
+            # epoch edge) must land mid-next-epoch, not at its start
+            while self._cursor >= len(batches):
+                self._cursor -= len(batches)
+                self._epoch += 1
+                self._epoch_seed = self._draw_epoch_seed()
+                self._seed_restored = False
             batches = self._epoch_batches()
         elif (self._cursor == 0 and self.seed is None
               and not self._seed_restored):

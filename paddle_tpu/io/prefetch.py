@@ -169,6 +169,30 @@ class DevicePrefetcher:
                 pass
         self._active = []
 
+    def reset(self, sampler_state=None):
+        """Discard every staged (read-ahead) batch and restart from the
+        source: tears down live staging threads via :meth:`close`, so the
+        next ``iter()`` begins a fresh pass of the source. With
+        ``sampler_state`` (a ``BucketedBatchSampler.state_dict()``), the
+        source's resumable sampler is first restored to that position —
+        this is the divergence-rollback hook: after
+        ``CheckpointManager.auto_resume`` rewinds the sampler cursor,
+        ``reset`` guarantees no batch staged past the rollback point is
+        ever consumed (staged batches were never ``advance()``-d, so the
+        cursor and the restarted stream agree exactly)."""
+        self.close()
+        if sampler_state is not None:
+            from . import resolve_resumable
+
+            r = resolve_resumable(self.source)
+            if r is None:
+                raise TypeError(
+                    f"reset(sampler_state=...) needs a resumable source; "
+                    f"{type(self.source).__name__} does not expose (or "
+                    "wrap something exposing) state_dict/set_state_dict/"
+                    "advance")
+            r.set_state_dict(sampler_state)
+
     def __enter__(self):
         return self
 

@@ -247,7 +247,8 @@ class FunctionCacheStats:
 
     __slots__ = ("name", "compiles", "hits", "eager_fallbacks",
                  "bucket_pads", "per_shape_misses", "_warned",
-                 "host_blocked_ms", "queue_depth_sum", "queue_depth_n")
+                 "host_blocked_ms", "queue_depth_sum", "queue_depth_n",
+                 "scaler_fallbacks")
 
     def __init__(self, name):
         self.name = name
@@ -257,6 +258,10 @@ class FunctionCacheStats:
         self.bucket_pads = 0
         self.per_shape_misses = {}
         self._warned = False
+        # drive() calls that fell back from deferred-window metric fetch
+        # to per-step fetch because an enabled GradScaler was attached
+        # (the scale for step N+1 consumes step N's finite flag on host)
+        self.scaler_fallbacks = 0
         # host-device overlap telemetry (DevicePrefetcher / drive): how
         # long the consumer blocked waiting on the transfer thread, and the
         # staged-batch queue depth sampled at each get (depth ~0 means the
@@ -272,6 +277,7 @@ class FunctionCacheStats:
             "eager_fallbacks": self.eager_fallbacks,
             "bucket_pads": self.bucket_pads,
             "per_shape_misses": dict(self.per_shape_misses),
+            "scaler_fallbacks": self.scaler_fallbacks,
             "host_blocked_ms": round(self.host_blocked_ms, 3),
             "avg_queue_depth": (
                 round(self.queue_depth_sum / self.queue_depth_n, 3)
@@ -336,6 +342,15 @@ def record_eager_fallback(name):
     with _LOCK:
         _stats_for(name).eager_fallbacks += 1
     return RecordEvent(f"jit::eager_fallback::{name}").begin()
+
+
+def record_scaler_fallback(name):
+    """Count one ``FusedTrainStep.drive`` call that degraded from
+    deferred-window metric fetch to per-step fetch because an enabled
+    GradScaler was attached (dynamic loss scaling consumes the finite
+    flag every step)."""
+    with _LOCK:
+        _stats_for(name).scaler_fallbacks += 1
 
 
 def record_bucket_pads(name, n):

@@ -31,6 +31,11 @@ Sites wired into the framework:
   the step blocks as if a collective wedged; FLAGS_step_timeout_s surfaces
   it as TrainStallError (or, with the in-process guard off, the launcher's
   heartbeat watchdog kills + restarts the group).
+- ``train.spike``       — FusedTrainStep input poisoning (boolean site):
+  the step's first floating-point input is scaled by 1e3, so loss/grads go
+  finite-but-huge — the NaN guard stays silent and the divergence sentinel
+  (FLAGS_sentinel_action) must detect the spike at the next metric-fetch
+  window boundary and warn/skip/rollback/raise.
 
 Arming a site is scoped and seeded::
 
@@ -56,7 +61,8 @@ import random
 __all__ = ["SITES", "InjectedFault", "inject", "fire", "should_fire"]
 
 SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
-         "io.prefetch", "proc.kill", "hb.write", "train.stall")
+         "io.prefetch", "proc.kill", "hb.write", "train.stall",
+         "train.spike")
 
 
 class InjectedFault(OSError):

@@ -1,10 +1,23 @@
 #!/usr/bin/env python
 """Chaos drill for the elastic supervision layer: kill, preempt, and hang
-a REAL 2-worker launcher job and prove bit-exact end-to-end recovery.
+a REAL 2-worker launcher job and prove bit-exact end-to-end recovery —
+plus the divergence drill (``--drill spike``): poison a batch window
+mid-run and prove the sentinel detects, rolls back, skips, and recovers.
 
 Orchestrator mode (default — run it directly)::
 
     python scripts/chaos_train.py [--out DIR] [--scenarios kill,preempt,hang]
+    python scripts/chaos_train.py --drill spike
+
+``--drill spike`` runs three single-process jobs: an uninterrupted clean
+**baseline**; a **control** with fault site ``train.spike`` poisoning one
+metric-fetch window (inputs scaled 1e3 — finite-but-huge loss, invisible
+to the NaN guard) and ``FLAGS_sentinel_action=none``; and a **sentinel**
+job with the same poison and ``FLAGS_sentinel_action=rollback``. The
+drill asserts the control visibly diverges, while the sentinel job
+detects the spike at the window boundary, rolls back to
+``latest_healthy_step()``, skips the poisoned window's batches, and
+finishes with a final loss within tolerance of the clean baseline.
 
 runs an uninterrupted 2-worker baseline job, then one chaos job per
 scenario, each under ``python -m paddle_tpu.distributed.launch``:
@@ -167,6 +180,200 @@ def worker_main():
 
 
 # ---------------------------------------------------------------------------
+# spike drill (single-process divergence sentinel)
+# ---------------------------------------------------------------------------
+
+SPIKE_WINDOW = 3        # log_every for the spike drill
+SPIKE_EPOCHS = 3
+# poison the window AFTER this many boundaries have passed: late enough
+# that the sentinel's EMA warmup is over and at least one checkpoint has
+# earned its HEALTHY tag, early enough to leave recovery room
+SPIKE_POISON_AT = 5
+
+
+def spike_worker_main():
+    """One spike-drill job: mode ``baseline`` (clean), ``control``
+    (poisoned window, sentinel off) or ``sentinel`` (poisoned window,
+    rollback response). Deterministic data/model; writes per-step losses
+    and the sentinel stats for the orchestrator's assertions."""
+    import json
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.io as io
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.fused_train_step import FusedTrainStep
+    from paddle_tpu.utils import fault_injection as fi
+
+    out = os.environ["CHAOS_OUT"]
+    mode = os.environ["CHAOS_SPIKE_MODE"]
+
+    paddle.seed(0)
+    np.random.seed(0)
+    rng = np.random.RandomState(5)
+    lengths = rng.randint(3, 25, size=N_SAMPLES)
+    xs = [rng.randn(int(n), FEATS).astype("float32") for n in lengths]
+    # learnable target so the clean loss actually descends (the drill
+    # compares final losses, not just survival)
+    w_true = rng.randn(FEATS).astype("float32")
+    ys = np.array([x.mean(axis=0) @ w_true for x in xs], dtype="float32")
+
+    class VarLen(io.Dataset):
+        def __len__(self):
+            return N_SAMPLES
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(FEATS, 1)
+
+        def forward(self, x, y, mask):
+            tok = self.proj(x)[:, :, 0] * mask          # [B, L]
+            pred = tok.sum(axis=1) / mask.sum(axis=1)   # masked mean
+            d = pred - y
+            return (d * d).mean()
+
+    model = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    fstep = FusedTrainStep(model, opt)
+    sampler = io.BucketedBatchSampler(
+        VarLen(), batch_size=BATCH, boundaries=BOUNDARIES, shuffle=True,
+        seed=11, lengths=lengths.tolist(), drop_last=True)
+    loader = io.DataLoader(VarLen(), batch_sampler=sampler,
+                           collate_fn=io.PadToBucket(BOUNDARIES))
+    mgr = paddle.CheckpointManager(os.path.join(out, "ckpt"), keep_last_n=3)
+
+    sentinel = None
+    if mode == "sentinel":
+        from paddle_tpu.incubate.sentinel import TrainingSentinel
+
+        sentinel = TrainingSentinel(
+            action="rollback", zscore=4.0, warmup_windows=3, ema_beta=0.8,
+            healthy_windows=1)
+
+    poison = {"cm": None, "windows": 0}
+
+    def on_window(win):
+        for loss in win["losses"]:
+            log.write(f"{float(loss)!r}\n")
+        log.flush()
+        mgr.save(int(fstep.device_metrics()["step_count"]), model=model,
+                 optimizer=fstep, sampler=loader)
+        # arm the poison for exactly one window of dispatches
+        # (boundary-to-boundary), in control and sentinel modes alike
+        poison["windows"] += 1
+        if mode != "baseline":
+            if poison["windows"] == SPIKE_POISON_AT:
+                poison["cm"] = fi.inject("train.spike")
+                poison["cm"].__enter__()
+            elif poison["cm"] is not None:
+                poison["cm"].__exit__(None, None, None)
+                poison["cm"] = None
+
+    import warnings
+
+    losses = []
+    with open(os.path.join(out, "loss.log"), "a") as log:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for epoch in range(SPIKE_EPOCHS):
+                loader.set_epoch(epoch)
+                hist = fstep.drive(loader, log_every=SPIKE_WINDOW,
+                                   on_window=on_window, checkpoint=mgr,
+                                   sampler=loader, sentinel=sentinel)
+                losses.extend(hist["loss"])
+    if poison["cm"] is not None:
+        poison["cm"].__exit__(None, None, None)
+    summary = {
+        "mode": mode, "steps": len(losses),
+        # applied updates in the FINAL trajectory: a rollback rewinds this
+        # to the healthy step, so skipped windows never count
+        "device_steps": int(fstep.device_metrics()["step_count"]),
+        "final_loss": float(np.mean(losses[-SPIKE_WINDOW:])),
+        "sentinel": hist["sentinel"],
+        "healthy_step": mgr.latest_healthy_step(),
+    }
+    with open(os.path.join(out, "summary.json"), "w") as f:
+        json.dump(summary, f)
+    return 0
+
+
+def run_spike_job(out, mode, timeout=600):
+    os.makedirs(out, exist_ok=True)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "CHAOS_OUT": out,
+        "CHAOS_SPIKE_MODE": mode,
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if mode == "sentinel":
+        env["FLAGS_sentinel_action"] = "rollback"
+    else:
+        env["FLAGS_sentinel_action"] = "none"
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, cwd=REPO, capture_output=True, text=True,
+                       timeout=timeout)
+    return r
+
+
+def spike_drill(out_root):
+    """baseline vs control vs sentinel; see the module docstring."""
+    import json
+
+    print(f"[chaos] spike drill, scratch: {out_root}")
+    summaries = {}
+    for mode in ("baseline", "control", "sentinel"):
+        out = os.path.join(out_root, f"spike_{mode}")
+        print(f"[chaos] spike job {mode!r}...")
+        t0 = time.time()
+        r = run_spike_job(out, mode)
+        check(r.returncode == 0,
+              f"{mode}: job exits 0 (got {r.returncode}): "
+              f"{r.stderr[-800:]}")
+        with open(os.path.join(out, "summary.json")) as f:
+            summaries[mode] = json.load(f)
+        print(f"  done in {time.time() - t0:.1f}s "
+              f"(final loss {summaries[mode]['final_loss']:.6g})")
+
+    base = summaries["baseline"]["final_loss"]
+    ctrl = summaries["control"]["final_loss"]
+    sent = summaries["sentinel"]["final_loss"]
+    st = summaries["sentinel"]["sentinel"]
+    check(st and st["spikes"] >= 1,
+          f"sentinel detected the poisoned window ({st and st['spikes']} "
+          "spike verdicts)")
+    check(st["rollbacks"] >= 1,
+          f"sentinel rolled back ({st['rollbacks']}x) to the last "
+          f"healthy step")
+    check(summaries["sentinel"]["healthy_step"] is not None,
+          "healthy-step tagging produced a rollback target")
+    check(not (ctrl <= 10 * max(base, 1e-6)) or ctrl != ctrl,
+          f"control visibly diverges: {ctrl:.6g} vs baseline {base:.6g}")
+    # the sentinel run trains fewer steps (the poisoned window's batches
+    # are skipped, not replayed), so "recovered" means the same loss
+    # regime as the clean baseline — not bit-equality
+    tol = 0.5 * max(base, 1e-3) + 0.05
+    check(abs(sent - base) <= tol,
+          f"sentinel run recovers: final {sent:.6g} within ±{tol:.3g} of "
+          f"baseline {base:.6g} (control: {ctrl:.6g})")
+    check(summaries["sentinel"]["device_steps"]
+          < summaries["baseline"]["device_steps"],
+          "poisoned window was skipped, not replayed: fewer applied "
+          f"updates ({summaries['sentinel']['device_steps']} vs "
+          f"{summaries['baseline']['device_steps']}) in the final "
+          "trajectory")
+    print("[chaos] SPIKE DRILL PASSED")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
 
@@ -230,8 +437,14 @@ def main(argv=None):
     ap.add_argument("--out", default=None,
                     help="scratch dir (default: a fresh tempdir)")
     ap.add_argument("--scenarios", default="kill,preempt,hang")
+    ap.add_argument("--drill", default=None, choices=["spike"],
+                    help="run one named drill instead of the launcher "
+                         "scenarios (spike: divergence-sentinel "
+                         "detect/rollback/skip/recover)")
     args = ap.parse_args(argv)
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_train.")
+    if args.drill == "spike":
+        return spike_drill(out_root)
     scenarios = [s for s in args.scenarios.split(",") if s]
 
     print(f"[chaos] scratch: {out_root}")
@@ -290,6 +503,8 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
+    if os.environ.get("CHAOS_OUT") and os.environ.get("CHAOS_SPIKE_MODE"):
+        sys.exit(spike_worker_main())
     if os.environ.get("CHAOS_OUT") and os.environ.get("PADDLE_TRAINER_ID"):
         sys.exit(worker_main())
     sys.exit(main())

@@ -1,15 +1,20 @@
 #!/usr/bin/env python
-"""Lint: every fault-injection site must be exercised by at least one test.
+"""Lint: every fault-injection site AND every robustness flag must be
+exercised by at least one test.
 
 ``paddle_tpu.utils.fault_injection.SITES`` is the registry of named failure
 points the durability/supervision layers defend against. A site nobody
 injects is a recovery path nobody runs — this lint greps ``tests/`` (and
 ``scripts/chaos_train.py``, the launcher-level chaos drill) for each site
-string and fails listing any that appear in no test. Wired as a tier-1
-test (tests/test_supervision.py), so a new site cannot ship untested.
+string and fails listing any that appear in no test. The same rule applies
+to the robustness flag families (``FLAGS_sentinel_*`` divergence-sentinel
+knobs, ``FLAGS_ckpt_*`` checkpoint-lifecycle knobs, parsed from
+``core/flags.py``): a registered flag no test sets or references is a
+configuration surface nobody verified. Wired as a tier-1 test
+(tests/test_supervision.py), so a new site or flag cannot ship untested.
 
-Deliberately import-free: SITES is parsed from the module source, so the
-lint runs in milliseconds without pulling in jax.
+Deliberately import-free: SITES and the flag registry are parsed from the
+module sources, so the lint runs in milliseconds without pulling in jax.
 """
 
 from __future__ import annotations
@@ -22,6 +27,9 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SITES_SOURCE = os.path.join(REPO, "paddle_tpu", "utils",
                             "fault_injection.py")
+FLAGS_SOURCE = os.path.join(REPO, "paddle_tpu", "core", "flags.py")
+# flag families under the exercised-by-a-test contract
+FLAG_PREFIXES = ("sentinel_", "ckpt_")
 # non-test files that legitimately exercise sites end to end
 EXTRA_EXERCISERS = (os.path.join(REPO, "scripts", "chaos_train.py"),)
 
@@ -39,27 +47,59 @@ def registered_sites(source_path=SITES_SOURCE):
     return sites
 
 
-def find_missing(sites=None, tests_dir=None, extra=EXTRA_EXERCISERS):
-    """Sites not mentioned (as a string literal) by any test file."""
-    if sites is None:
-        sites = registered_sites()
+def registered_flags(source_path=FLAGS_SOURCE, prefixes=FLAG_PREFIXES):
+    """Names of flags in the lint-covered families, parsed (not imported)
+    from core/flags.py's ``register_flag("name", ...)`` calls."""
+    with open(source_path) as f:
+        src = f.read()
+    names = re.findall(r"register_flag\(\s*\n?\s*[\"']([a-z0-9_]+)[\"']",
+                       src)
+    if not names:
+        raise RuntimeError(f"no register_flag calls found in {source_path}")
+    out = [n for n in names if n.startswith(tuple(prefixes))]
+    if not out:
+        raise RuntimeError(
+            f"no {prefixes} flags found in {source_path} — lint would be "
+            "vacuous")
+    return out
+
+
+def _test_corpus(tests_dir=None, extra=EXTRA_EXERCISERS):
     tests_dir = tests_dir or os.path.join(REPO, "tests")
     haystack = []
-    for d in [tests_dir]:
-        for root, _dirs, files in os.walk(d):
-            for fn in files:
-                if fn.endswith(".py"):
-                    haystack.append(os.path.join(root, fn))
+    for root, _dirs, files in os.walk(tests_dir):
+        for fn in files:
+            if fn.endswith(".py"):
+                haystack.append(os.path.join(root, fn))
     haystack += [p for p in extra if os.path.exists(p)]
     corpus = ""
     for path in haystack:
         with open(path, errors="replace") as f:
             corpus += f.read()
+    return corpus
+
+
+def find_missing(sites=None, tests_dir=None, extra=EXTRA_EXERCISERS):
+    """Sites not mentioned (as a string literal) by any test file."""
+    if sites is None:
+        sites = registered_sites()
+    corpus = _test_corpus(tests_dir, extra)
     return [s for s in sites if f'"{s}"' not in corpus
             and f"'{s}'" not in corpus]
 
 
+def find_missing_flags(flags=None, tests_dir=None, extra=EXTRA_EXERCISERS):
+    """Lint-covered flags (FLAGS_sentinel_*/FLAGS_ckpt_*) that NO test
+    sets or references — matched by bare name, so ``set_flags({"FLAGS_x":
+    ...})``, env vars, and keyword references all count."""
+    if flags is None:
+        flags = registered_flags()
+    corpus = _test_corpus(tests_dir, extra)
+    return [f for f in flags if f not in corpus]
+
+
 def main(argv=None):
+    rc = 0
     missing = find_missing()
     if missing:
         print("fault sites with NO exercising test (add one per site, "
@@ -67,10 +107,19 @@ def main(argv=None):
               file=sys.stderr)
         for s in missing:
             print(f"  - {s}", file=sys.stderr)
-        return 1
-    print(f"ok: all {len(registered_sites())} fault sites are exercised "
-          "by tests")
-    return 0
+        rc = 1
+    missing_flags = find_missing_flags()
+    if missing_flags:
+        print("robustness flags with NO exercising test (set or reference "
+              "FLAGS_<name> in a test):", file=sys.stderr)
+        for f in missing_flags:
+            print(f"  - FLAGS_{f}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"ok: all {len(registered_sites())} fault sites and "
+              f"{len(registered_flags())} robustness flags are exercised "
+              "by tests")
+    return rc
 
 
 if __name__ == "__main__":

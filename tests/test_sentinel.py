@@ -1,0 +1,877 @@
+"""Divergence sentinel suite (ISSUE 5): loss-spike detection over deferred
+metric windows, the warn/skip/rollback/raise response ladder, rollback
+budget, checkpoint health tagging, epoch-edge cursor skips, and the
+satellite fixes (guard_stats sync, GradScaler fallback telemetry,
+prefetcher reset, quarantine sweep, flag lint).
+
+Everything here is fast-tier and in-process: poisoned windows are crafted
+batch lists or the seeded ``train.spike`` fault site; the end-to-end
+subprocess version is ``scripts/chaos_train.py --drill spike``.
+"""
+
+import os
+import shutil
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.io as io
+import paddle_tpu.nn as nn
+from paddle_tpu import TrainDivergenceError, jit
+from paddle_tpu.hapi.callbacks import DivergenceSentinel
+from paddle_tpu.incubate.fused_train_step import FusedTrainStep
+from paddle_tpu.incubate.sentinel import RollbackBudget, TrainingSentinel
+from paddle_tpu.utils import fault_injection as fi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_sentinel_flags():
+    yield
+    paddle.set_flags({
+        "FLAGS_sentinel_action": "none",
+        "FLAGS_sentinel_zscore": 6.0,
+        "FLAGS_sentinel_ema_beta": 0.9,
+        "FLAGS_sentinel_warmup_windows": 3,
+        "FLAGS_sentinel_grad_norm_ceiling": 0.0,
+        "FLAGS_sentinel_patience": 0,
+        "FLAGS_sentinel_rollback_budget": 3,
+        "FLAGS_sentinel_budget_window_s": 3600.0,
+        "FLAGS_sentinel_lr_cooldown": 1.0,
+        "FLAGS_sentinel_healthy_windows": 2,
+        "FLAGS_ckpt_quarantine_keep": -1,
+        "FLAGS_check_nan_inf_action": "none",
+    })
+    jit.reset_cache_stats()
+
+
+def _win(mean, gnorm=None, step=0):
+    return {"mean_loss": mean, "gnorm_peak": gnorm, "step": step,
+            "losses": np.float32([mean]), "non_finite": 0}
+
+
+class Net(nn.Layer):
+    def __init__(self, feats=4):
+        super().__init__()
+        self.l = nn.Linear(feats, 1)
+
+    def forward(self, x, y):
+        d = self.l(x)[:, 0] - y
+        return (d * d).mean()
+
+
+def _step(lr=0.05, grad_scaler=None):
+    paddle.seed(7)
+    m = Net()
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=m.parameters())
+    return m, FusedTrainStep(m, opt, grad_scaler=grad_scaler)
+
+
+def _batches(n, poison=(), scale=1e3, seed=3):
+    """n (x, y) regression batches; indices in ``poison`` get inputs
+    scaled — finite-but-huge loss, invisible to the NaN guard."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        x = rng.randn(8, 4).astype("float32")
+        y = (x.sum(axis=1) * 0.3).astype("float32")
+        if i in poison:
+            x = x * scale
+        out.append((paddle.to_tensor(x), paddle.to_tensor(y)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# detector units
+# ---------------------------------------------------------------------------
+
+class TestDetector:
+    def _sent(self, **kw):
+        kw.setdefault("action", "warn")
+        kw.setdefault("zscore", 4.0)
+        kw.setdefault("ema_beta", 0.8)
+        kw.setdefault("warmup_windows", 2)
+        return TrainingSentinel(**kw)
+
+    def test_no_spike_during_warmup(self):
+        s = self._sent(warmup_windows=3)
+        # even a 100x jump inside the warmup region is not judged
+        for m in (1.0, 100.0, 1.0):
+            assert s.observe(_win(m))["verdict"] == "ok"
+
+    def test_zscore_spike_fires_and_is_one_sided(self):
+        s = self._sent()
+        for m in (1.0, 1.1, 0.9, 1.0):
+            assert s.observe(_win(m))["verdict"] == "ok"
+        assert s.observe(_win(0.01))["verdict"] == "ok"  # a DROP is fine
+        v = s.observe(_win(50.0))
+        assert v["verdict"] == "spike"
+        assert "loss_zscore" in v["reasons"]
+        assert v["zscore"] > 4.0
+
+    def test_spike_does_not_pollute_ema(self):
+        # two consecutive poisoned windows must BOTH be flagged — the
+        # first spike's mean never enters the baseline
+        s = self._sent()
+        for m in (1.0, 1.05, 0.95):
+            s.observe(_win(m))
+        v1 = s.observe(_win(80.0))
+        v2 = s.observe(_win(85.0))
+        assert v1["verdict"] == "spike" and v2["verdict"] == "spike"
+        assert s.stats()["ema_mean"] < 2.0
+
+    def test_sigma_floor_blocks_cold_start_false_positive(self):
+        # after one clean window the EMA variance is 0; without the
+        # relative sigma floor ANY uptick would read as an infinite z
+        s = self._sent(warmup_windows=1, zscore=6.0)
+        s.observe(_win(1.0))
+        assert s.observe(_win(1.2))["verdict"] == "ok"  # 20% up: noise
+        assert s.observe(_win(5.0))["verdict"] == "spike"  # 4x up: spike
+
+    def test_grad_norm_ceiling(self):
+        s = self._sent(grad_norm_ceiling=10.0, zscore=0.0)
+        assert s.wants_grad_norm()
+        assert s.observe(_win(1.0, gnorm=5.0))["verdict"] == "ok"
+        v = s.observe(_win(1.0, gnorm=11.0))
+        assert v["verdict"] == "spike"
+        assert v["reasons"] == ["grad_norm_ceiling"]
+        # None gnorm (untracked path) never trips the ceiling
+        assert s.observe(_win(1.0, gnorm=None))["verdict"] == "ok"
+
+    def test_patience_divergence_trend(self):
+        s = self._sent(patience=3, zscore=0.0, warmup_windows=99)
+        means = [1.0, 1.01, 1.02]  # 2 consecutive rises: under patience
+        assert all(s.observe(_win(m))["verdict"] == "ok" for m in means)
+        v = s.observe(_win(1.03))  # 3rd consecutive rise
+        assert v["verdict"] == "spike"
+        assert v["reasons"] == ["divergence_trend"]
+        # the trend counter restarts after the verdict
+        assert s.observe(_win(1.04))["verdict"] == "ok"
+
+    def test_non_finite_mean_is_a_spike(self):
+        s = self._sent()
+        assert s.observe(_win(float("nan")))["verdict"] == "spike"
+
+    def test_deterministic_across_instances(self):
+        series = [1.0, 1.2, 0.9, 1.1, 30.0, 1.0, 1.05, 40.0]
+        a, b = self._sent(), self._sent()
+        va = [a.observe(_win(m))["verdict"] for m in series]
+        vb = [b.observe(_win(m))["verdict"] for m in series]
+        assert va == vb
+        assert [r["mean_loss"] for r in a.spikes] == \
+            [r["mean_loss"] for r in b.spikes]
+
+    def test_flags_configure_the_default_instance(self):
+        paddle.set_flags({
+            "FLAGS_sentinel_action": "skip",
+            "FLAGS_sentinel_zscore": 2.5,
+            "FLAGS_sentinel_ema_beta": 0.7,
+            "FLAGS_sentinel_warmup_windows": 1,
+            "FLAGS_sentinel_grad_norm_ceiling": 42.0,
+            "FLAGS_sentinel_patience": 5,
+            "FLAGS_sentinel_lr_cooldown": 0.25,
+            "FLAGS_sentinel_healthy_windows": 4,
+        })
+        s = TrainingSentinel()
+        assert (s.action, s.zscore, s.ema_beta) == ("skip", 2.5, 0.7)
+        assert (s.warmup_windows, s.grad_norm_ceiling) == (1, 42.0)
+        assert (s.patience, s.lr_cooldown, s.healthy_windows) == \
+            (5, 0.25, 4)
+
+    def test_flag_validators_reject_nonsense(self):
+        with pytest.raises(ValueError, match="sentinel_action"):
+            paddle.set_flags({"FLAGS_sentinel_action": "explode"})
+        with pytest.raises(ValueError, match="sentinel_ema_beta"):
+            paddle.set_flags({"FLAGS_sentinel_ema_beta": 1.5})
+        with pytest.raises(ValueError, match="sentinel_lr_cooldown"):
+            paddle.set_flags({"FLAGS_sentinel_lr_cooldown": 0.0})
+
+
+class TestRollbackBudget:
+    def test_leaky_bucket_ages_out(self):
+        clk = [0.0]
+        b = RollbackBudget(max_rollbacks=2, window_s=100.0,
+                           clock=lambda: clk[0])
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()
+        clk[0] = 150.0  # both events age out of the window
+        assert b.try_acquire()
+        assert b.used == 1 and b.total == 3
+
+    def test_zero_window_is_lifetime_scoped(self):
+        clk = [0.0]
+        b = RollbackBudget(max_rollbacks=1, window_s=0.0,
+                           clock=lambda: clk[0])
+        assert b.try_acquire()
+        clk[0] = 1e9
+        assert not b.try_acquire()
+
+    def test_flags_configure_budget(self):
+        paddle.set_flags({"FLAGS_sentinel_rollback_budget": 7,
+                          "FLAGS_sentinel_budget_window_s": 5.0})
+        b = RollbackBudget()
+        assert b.max_rollbacks == 7 and b.window_s == 5.0
+
+    def test_exhaustion_raises_typed_error_with_history(self):
+        s = TrainingSentinel(action="rollback",
+                             budget=RollbackBudget(max_rollbacks=1,
+                                                   window_s=0.0))
+        s.spikes.append({"mean_loss": 9.9, "reasons": ["loss_zscore"]})
+        s.acquire_rollback()
+        with pytest.raises(TrainDivergenceError) as ei:
+            s.acquire_rollback()
+        assert ei.value.rollbacks == 1
+        assert ei.value.history[0]["mean_loss"] == 9.9
+
+
+# ---------------------------------------------------------------------------
+# drive() response ladder
+# ---------------------------------------------------------------------------
+
+class TestDriveRungs:
+    def test_warn_rung_warns_and_continues(self):
+        _m, step = _step()
+        s = TrainingSentinel(action="warn", zscore=4.0, warmup_windows=2,
+                             ema_beta=0.8)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            hist = step.drive(_batches(20, poison=set(range(12, 16))),
+                              log_every=4, sentinel=s)
+        assert hist["steps"] == 20  # nothing skipped
+        assert hist["sentinel"]["spikes"] >= 1
+        assert any("sentinel" in str(x.message) for x in w)
+
+    def test_skip_rung_drops_the_next_window(self):
+        _m, step = _step()
+        s = TrainingSentinel(action="skip", zscore=4.0, warmup_windows=2,
+                             ema_beta=0.8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            hist = step.drive(_batches(24, poison=set(range(12, 20))),
+                              log_every=4, sentinel=s)
+        # each skip response drops one window's worth of batches (the
+        # poisoned window's updates stay applied — spikes can re-fire on
+        # the damaged trajectory, each dropping another window)
+        assert hist["skipped_windows"] >= 1
+        assert hist["steps"] <= 24 - 4
+        assert hist["steps"] + 4 * hist["skipped_windows"] == 24
+
+    def test_raise_rung_raises_typed_error(self):
+        _m, step = _step()
+        s = TrainingSentinel(action="raise", zscore=4.0, warmup_windows=2,
+                             ema_beta=0.8)
+        with pytest.raises(TrainDivergenceError) as ei:
+            step.drive(_batches(16, poison={9, 10, 11}), log_every=4,
+                       sentinel=s)
+        assert ei.value.history  # carries the spike records
+        assert "loss_zscore" in ei.value.history[0]["reasons"]
+        assert isinstance(ei.value, paddle.TrainDivergenceError)
+
+    def test_gnorm_tracking_rides_the_window_fetch(self):
+        # ceiling armed, z-score off: the spike is caught by the
+        # device-tracked grad-norm peak, with the SAME host-sync count as
+        # an unarmed run (the peak rides the loss stack)
+        _m, step = _step()
+        plain = step.drive(_batches(8), log_every=4)
+        _m2, step2 = _step()
+        s = TrainingSentinel(action="warn", zscore=0.0, warmup_windows=1,
+                             grad_norm_ceiling=50.0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            hist = step2.drive(_batches(8, poison={5, 6}), log_every=4,
+                               sentinel=s)
+        assert hist["host_syncs"] == plain["host_syncs"]
+        assert s.spikes and \
+            "grad_norm_ceiling" in s.spikes[0]["reasons"]
+        assert s.spikes[0]["gnorm_peak"] > 50.0
+        assert any("grad_norm_ceiling" in str(x.message) for x in w)
+
+    def test_sentinel_off_is_free_and_ab_identical(self):
+        # A/B acceptance: armed-but-quiet sentinel changes NO telemetry —
+        # same host syncs, same windows, same losses (detection is pure
+        # host math over already-fetched values)
+        _m, a = _step()
+        ha = a.drive(_batches(12), log_every=4)
+        _m2, b = _step()
+        s = TrainingSentinel(action="warn", zscore=6.0, warmup_windows=2)
+        hb_ = b.drive(_batches(12), log_every=4, sentinel=s)
+        assert hb_["host_syncs"] == ha["host_syncs"]
+        assert hb_["windows"] == ha["windows"]
+        assert hb_["loss"] == ha["loss"]
+        assert hb_["sentinel"]["spikes"] == 0
+
+    def test_flag_armed_sentinel_auto_creates(self):
+        paddle.set_flags({"FLAGS_sentinel_action": "warn"})
+        _m, step = _step()
+        hist = step.drive(_batches(6), log_every=3)
+        assert hist["sentinel"] is not None
+        assert hist["sentinel"]["action"] == "warn"
+
+    def test_flag_armed_sentinel_persists_across_drives(self):
+        # the epoch-loop pattern (one drive per epoch) must accumulate
+        # budget/history/EMA in ONE sentinel, or the leaky-bucket loop
+        # breaker could never fire
+        paddle.set_flags({"FLAGS_sentinel_action": "warn"})
+        _m, step = _step()
+        h1 = step.drive(_batches(6), log_every=3)
+        h2 = step.drive(_batches(6), log_every=3)
+        assert h2["sentinel"]["windows"] == h1["sentinel"]["windows"] + 2
+        assert step._flag_sentinel is not None
+
+    def test_train_spike_fault_site_trips_the_sentinel(self):
+        _m, step = _step()
+        s = TrainingSentinel(action="warn", zscore=4.0, warmup_windows=2,
+                             ema_beta=0.8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # arm the site for calls 13..16 (one window of a 20-step run)
+            with fi.inject("train.spike", every_n=1, max_fires=4) as inj:
+                # burn the injector's first 12 calls as misses
+                inj.every_n = None
+                inj.max_fires = 4
+                hist = step.drive(_batches(12), log_every=4, sentinel=s)
+                hist2 = step.drive(_batches(8), log_every=4, sentinel=s)
+        assert hist["sentinel"]["spikes"] == 0 or hist2  # site fired later
+        assert s.spikes, "poisoned window was not detected"
+
+
+class TestDriveRollback:
+    """Full rollback loop over a resumable varlen pipeline."""
+
+    N, FEATS, BATCH = 32, 4, 4
+    BOUNDS = [8, 16, 32]
+
+    def _pipeline(self, seed=11):
+        rng = np.random.RandomState(5)
+        lengths = rng.randint(3, 25, size=self.N)
+        xs = [rng.randn(int(n), self.FEATS).astype("float32")
+              for n in lengths]
+        w = rng.randn(self.FEATS).astype("float32")
+        ys = np.array([x.mean(axis=0) @ w for x in xs], dtype="float32")
+
+        outer = self
+
+        class VarLen(io.Dataset):
+            def __len__(self):
+                return outer.N
+
+            def __getitem__(self, i):
+                return xs[i], ys[i]
+
+        sampler = io.BucketedBatchSampler(
+            VarLen(), batch_size=self.BATCH, boundaries=self.BOUNDS,
+            shuffle=True, seed=seed, lengths=lengths.tolist(),
+            drop_last=True)
+        loader = io.DataLoader(VarLen(), batch_sampler=sampler,
+                               collate_fn=io.PadToBucket(self.BOUNDS))
+        return sampler, loader
+
+    class MaskNet(nn.Layer):
+        def __init__(self, feats):
+            super().__init__()
+            self.proj = nn.Linear(feats, 1)
+
+        def forward(self, x, y, mask):
+            tok = self.proj(x)[:, :, 0] * mask
+            pred = tok.sum(axis=1) / mask.sum(axis=1)
+            d = pred - y
+            return (d * d).mean()
+
+    def _fused(self, lr=0.1):
+        paddle.seed(0)
+        m = self.MaskNet(self.FEATS)
+        opt = paddle.optimizer.SGD(learning_rate=lr,
+                                   parameters=m.parameters())
+        return m, FusedTrainStep(m, opt)
+
+    def _run(self, tmp_path, action, poison_window, epochs=2, window=3,
+             sentinel_kw=None, lr_cooldown=1.0, name=None):
+        m, fstep = self._fused()
+        sampler, loader = self._pipeline()
+        root = str(tmp_path / f"ck_{name or action}")
+        shutil.rmtree(root, ignore_errors=True)
+        mgr = paddle.CheckpointManager(root, keep_last_n=4)
+        sentinel = None
+        if action != "none":
+            kw = dict(action=action, zscore=4.0, warmup_windows=2,
+                      ema_beta=0.8, healthy_windows=1,
+                      lr_cooldown=lr_cooldown)
+            kw.update(sentinel_kw or {})
+            sentinel = TrainingSentinel(**kw)
+        state = {"w": 0, "cm": None}
+
+        def on_window(win):
+            mgr.save(fstep.device_metrics()["step_count"], model=m,
+                     optimizer=fstep, sampler=loader)
+            state["w"] += 1
+            if poison_window and state["w"] == poison_window:
+                state["cm"] = fi.inject("train.spike")
+                state["cm"].__enter__()
+            elif state["cm"] is not None:
+                state["cm"].__exit__(None, None, None)
+                state["cm"] = None
+
+        losses, hists = [], []
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for epoch in range(epochs):
+                loader.set_epoch(epoch)
+                h = fstep.drive(loader, log_every=window,
+                                on_window=on_window, checkpoint=mgr,
+                                sampler=loader, sentinel=sentinel)
+                losses.extend(h["loss"])
+                hists.append(h)
+        if state["cm"] is not None:
+            state["cm"].__exit__(None, None, None)
+        return losses, hists, mgr, fstep, sentinel
+
+    def test_rollback_recovers_within_tolerance(self, tmp_path):
+        base, _h, _mg, bstep, _s = self._run(tmp_path, "none", None,
+                                             name="base")
+        # control: poisoned, sentinel off
+        ctrl, _h, _mg, cstep, _s = self._run(tmp_path, "none",
+                                             poison_window=3,
+                                             name="ctrl")
+        rb, hists, mgr, rstep, sent = self._run(tmp_path, "rollback",
+                                                poison_window=3)
+        assert sent.rollbacks == 1 and len(sent.spikes) == 1
+        assert sum(h["rollbacks"] for h in hists) == 1
+        base_final = float(np.mean(base[-3:]))
+        ctrl_final = float(np.mean(ctrl[-3:]))
+        rb_final = float(np.mean(rb[-3:]))
+        assert not (ctrl_final <= 10 * base_final)  # visibly diverged
+        assert abs(rb_final - base_final) <= 0.5 * base_final + 0.05
+        # the poisoned window never re-entered the applied trajectory
+        assert rstep.device_metrics()["step_count"] \
+            < bstep.device_metrics()["step_count"]
+        # poisoned newer checkpoints were dropped at rollback time and the
+        # healthy chain resumed on top
+        assert mgr.latest_healthy_step() is not None
+
+    def test_rollback_applies_lr_cooldown(self, tmp_path):
+        _l, _h, _m, fstep, sent = self._run(
+            tmp_path, "rollback", poison_window=3, lr_cooldown=0.5)
+        assert sent.rollbacks == 1
+        assert fstep._lr_scale == pytest.approx(0.5)
+        # persisted for bit-exact restart
+        assert fstep.state_dict()["lr_scale"] == pytest.approx(0.5)
+
+    def test_rollback_budget_exhaustion_raises(self, tmp_path):
+        # poison EVERY window after warmup with budget 1: the first spike
+        # rolls back, the (replayed clean, then re-poisoned... ) second
+        # verdict exhausts the bucket
+        with pytest.raises(TrainDivergenceError) as ei:
+            self._run(tmp_path, "rollback", poison_window=None,
+                      epochs=3,
+                      sentinel_kw={
+                          "budget": RollbackBudget(max_rollbacks=1,
+                                                   window_s=0.0),
+                          "grad_norm_ceiling": 1e-6, "zscore": 0.0,
+                          "warmup_windows": 99})
+        assert ei.value.rollbacks <= 1
+        assert len(ei.value.history) >= 1
+
+    def test_rollback_without_healthy_checkpoint_raises(self, tmp_path):
+        # spike before any step earned its HEALTHY tag -> typed error,
+        # not a rollback into a possibly-poisoned newest save
+        with pytest.raises(TrainDivergenceError, match="HEALTHY"):
+            self._run(tmp_path, "rollback", poison_window=1,
+                      sentinel_kw={"warmup_windows": 0, "zscore": 3.0})
+
+    def test_rollback_across_epoch_edge(self, tmp_path):
+        # healthy_windows=2 pushes the restore point ~2 windows back —
+        # into the PREVIOUS epoch: the rollback leaves the stream cursor
+        # untouched (mid-epoch-1) while model/optimizer rewind across
+        # the epoch edge, and the run completes sanely
+        losses, hists, mgr, fstep, sent = self._run(
+            tmp_path, "rollback", poison_window=4, epochs=3,
+            # wide thresholds: this tiny varlen problem's window means
+            # genuinely vary ~5x (the poison is ~1e20x) — the test
+            # targets the epoch-edge skip, not detector tuning
+            sentinel_kw={"healthy_windows": 2, "min_sigma_frac": 1.0,
+                         "zscore": 8.0})
+        assert sent.rollbacks == 1
+        final = float(np.mean(losses[-3:]))
+        assert np.isfinite(final) and final < 5.0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint health metadata
+# ---------------------------------------------------------------------------
+
+class TestHealthTagging:
+    def _mgr(self, tmp_path, **kw):
+        paddle.seed(1)
+        m = nn.Linear(3, 1)
+        return m, paddle.CheckpointManager(str(tmp_path / "ck"), **kw)
+
+    def test_k_clean_windows_promote(self, tmp_path):
+        m, mgr = self._mgr(tmp_path)
+        mgr.save(10, model=m)
+        assert mgr.latest_healthy_step() is None
+        assert mgr.note_window(clean=True, k=2) == []   # registers 10@0
+        assert mgr.note_window(clean=True, k=2) == []   # 10@1
+        assert mgr.note_window(clean=True, k=2) == [10]
+        assert mgr.latest_healthy_step() == 10
+        assert mgr.is_healthy(10)
+
+    def test_bad_window_resets_pending(self, tmp_path):
+        m, mgr = self._mgr(tmp_path)
+        mgr.save(10, model=m)
+        mgr.note_window(clean=True, k=2)
+        mgr.note_window(clean=True, k=2)   # 10@1
+        mgr.note_window(clean=False, k=2)  # reset to 0
+        assert mgr.note_window(clean=True, k=2) == []  # back to 1
+        assert mgr.note_window(clean=True, k=2) == [10]
+
+    def test_step_saved_at_this_boundary_needs_k_more(self, tmp_path):
+        m, mgr = self._mgr(tmp_path)
+        mgr.save(5, model=m)
+        mgr.note_window(clean=True, k=1)   # registers 5@0
+        mgr.save(9, model=m)
+        promoted = mgr.note_window(clean=True, k=1)
+        assert promoted == [5]             # 9 only registered now
+        assert mgr.note_window(clean=True, k=1) == [9]
+
+    def test_retention_never_deletes_newest_healthy(self, tmp_path):
+        m, mgr = self._mgr(tmp_path, keep_last_n=1)
+        mgr.save(10, model=m)
+        mgr.note_window(clean=True, k=1)
+        mgr.note_window(clean=True, k=1)  # 10 healthy
+        assert mgr.is_healthy(10)
+        mgr.save(20, model=m)
+        mgr.save(30, model=m)
+        # keep_last_n=1 would normally leave only 30; healthy 10 survives
+        assert 10 in mgr.committed_steps()
+        assert mgr.latest_healthy_step() == 10
+
+    def test_auto_resume_pinned_step(self, tmp_path):
+        m, mgr = self._mgr(tmp_path)
+        w0 = np.asarray(m.weight._data).copy()
+        mgr.save(10, model=m)
+        m.weight._rebind(m.weight._data * 3.0)
+        mgr.save(20, model=m)
+        assert mgr.auto_resume(model=m, step=10) == 10
+        np.testing.assert_allclose(np.asarray(m.weight._data), w0,
+                                   rtol=1e-6)
+        with pytest.raises(ValueError, match="no committed checkpoint"):
+            mgr.auto_resume(model=m, step=15)
+
+    def test_drop_steps_after(self, tmp_path):
+        m, mgr = self._mgr(tmp_path)
+        for s in (10, 20, 30):
+            mgr.save(s, model=m)
+        assert mgr.drop_steps_after(10) == [20, 30]
+        assert mgr.committed_steps() == [10]
+
+    def test_quarantine_sweep_flag(self, tmp_path):
+        m, mgr = self._mgr(tmp_path)
+        mgr.save(10, model=m)
+        d = mgr.step_dir(10)
+        # three non-redundant quarantines: each holds the only committed
+        # copy (the base itself is torn, nothing newer is committed)
+        for i, age in ((1, 100), (2, 50), (3, 10)):
+            q = os.path.join(mgr.root, f"step_10.replaced.{i}")
+            shutil.copytree(d, q)
+            t = 1_700_000_000 - age
+            os.utime(q, (t, t))
+        os.remove(os.path.join(d, "COMMIT"))
+        mgr._retain()  # default FLAGS_ckpt_quarantine_keep=-1: keep all
+        quars = sorted(e for e in os.listdir(mgr.root) if ".replaced." in e)
+        assert len(quars) == 3
+        paddle.set_flags({"FLAGS_ckpt_quarantine_keep": 1})
+        mgr._retain()
+        quars = sorted(e for e in os.listdir(mgr.root) if ".replaced." in e)
+        assert quars == ["step_10.replaced.3"]  # the newest survives
+        # and it is still recoverable as the step's committed copy
+        assert mgr.latest_valid_step() == 10
+
+
+# ---------------------------------------------------------------------------
+# epoch-edge cursor semantics (satellite regression)
+# ---------------------------------------------------------------------------
+
+class TestEpochEdgeAdvance:
+    def _sampler(self, seed=4, n=23, bs=4):
+        lengths = list(np.random.RandomState(0).randint(3, 30, size=n))
+        return io.BucketedBatchSampler(
+            dataset=None, batch_size=bs, boundaries=[8, 16, 32],
+            lengths=lengths, shuffle=True, seed=seed, drop_last=False)
+
+    def test_advance_carries_remainder_across_epoch(self):
+        s = self._sampler(seed=4)
+        n = len(s)
+        s.advance(n + 2)
+        sd = s.state_dict()
+        assert sd["epoch"] == 1 and sd["cursor"] == 2
+        # seeded: the rolled epoch's seed is exactly seed + epoch
+        assert sd["epoch_seed"] == 4 + 1
+
+    def test_advance_multi_epoch_roll(self):
+        s = self._sampler(seed=4)
+        n = len(s)
+        s.advance(3 * n + 1)
+        sd = s.state_dict()
+        assert sd["epoch"] == 3 and sd["cursor"] == 1
+
+    def test_rolled_stream_matches_stepwise_consumer(self):
+        # skipping across the edge in one advance() must land on the SAME
+        # remaining batch sequence a batch-at-a-time consumer reaches
+        a, b = self._sampler(seed=9), self._sampler(seed=9)
+        n = len(a)
+        a.advance(n + 3)
+        for _ in range(n):
+            b.advance(1)
+        for _ in range(3):
+            b.advance(1)
+        assert a.state_dict() == b.state_dict()
+        assert [tuple(x) for x in a] == [tuple(x) for x in b]
+
+    def test_iter_carries_restored_overshoot(self):
+        # an old checkpoint may hold cursor >= epoch length; __iter__ must
+        # carry the remainder, not truncate it to the epoch start
+        s = self._sampler(seed=6)
+        n = len(s)
+        sd = s.state_dict()
+        sd["cursor"] = n + 2
+        s2 = self._sampler(seed=6)
+        s2.set_state_dict(sd)
+        remaining = list(s2)
+        ref = self._sampler(seed=6)
+        ref.advance(n + 2)
+        assert [tuple(x) for x in remaining] == [tuple(x) for x in ref]
+
+    def test_state_dict_round_trip_after_roll(self):
+        s = self._sampler(seed=3)
+        s.advance(len(s) + 5)
+        sd = s.state_dict()
+        t = self._sampler(seed=3)
+        t.set_state_dict(sd)
+        assert [tuple(x) for x in s] == [tuple(x) for x in t]
+
+
+# ---------------------------------------------------------------------------
+# satellites: guard_stats sync, scaler fallback, prefetcher reset
+# ---------------------------------------------------------------------------
+
+class TestGuardStatsSync:
+    def test_sync_flushes_lagging_host_mirrors(self):
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+        _m, step = _step()
+        nan_x = np.full((8, 4), np.nan, np.float32)
+        y = np.zeros(8, np.float32)
+        # dispatch WITHOUT fetching (what drive does inside a window):
+        # the device discards the NaN step in-graph, the host mirror lags
+        for i in range(3):
+            step._step_count += 1
+            step._guard["total"] += 1
+            x = nan_x if i == 1 else np.ones((8, 4), np.float32)
+            step._dispatch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                           {}, "protect", 1.0)
+        lagging = step.guard_stats()
+        assert lagging["skipped"] == 0          # stale mirror
+        assert step._step_count == 3            # stale (device says 2)
+        synced = step.guard_stats(sync=True)
+        dm = step.device_metrics()
+        assert synced["skipped"] == dm["skipped"] == 1
+        assert step._step_count == dm["step_count"] == 2
+
+    def test_state_dict_is_authoritative_mid_window(self):
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+        _m, step = _step()
+        y = np.zeros(8, np.float32)
+        step._step_count += 1
+        step._guard["total"] += 1
+        step._dispatch((paddle.to_tensor(np.full((8, 4), np.nan,
+                                                 np.float32)),
+                        paddle.to_tensor(y)), {}, "protect", 1.0)
+        sd = step.state_dict()
+        assert sd["step_count"] == 0            # the skip never counted
+        assert step.guard_stats()["skipped"] == 1  # mirrors now synced
+
+
+class TestScalerFallback:
+    def _scaler_step(self):
+        from paddle_tpu.amp import GradScaler
+
+        return _step(grad_scaler=GradScaler())
+
+    def test_warns_once_and_counts_every_drive(self):
+        _m, step = self._scaler_step()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step.drive(_batches(4), log_every=2)
+            step.drive(_batches(4), log_every=2)
+        msgs = [x for x in w
+                if "per-step metric fetch" in str(x.message)]
+        assert len(msgs) == 1  # degrade-once, like io.prefetch
+        assert "FLAGS_metric_fetch_interval" in str(msgs[0].message)
+        row = jit.cache_stats(step._stats_name)
+        assert row["scaler_fallbacks"] == 2
+
+    def test_deferred_drive_does_not_count(self):
+        _m, step = _step()
+        step.drive(_batches(4), log_every=2)
+        row = jit.cache_stats(step._stats_name)
+        assert row["scaler_fallbacks"] == 0
+
+    def test_window_mean_excludes_scaler_overflow_steps(self):
+        # a routine overflow step (non-finite loss, update skipped,
+        # scale backed off) must not poison the window mean the sentinel
+        # judges — the scaler path filters to finite losses like the
+        # deferred path does
+        _m, step = self._scaler_step()
+        wins = []
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fi.inject("train.grad_nan", every_n=3, max_fires=1):
+                step.drive(_batches(4), log_every=4,
+                           on_window=wins.append)
+        assert wins
+        raw = np.float32(wins[0]["losses"])
+        assert not np.isfinite(raw).all()          # the overflow happened
+        assert np.isfinite(wins[0]["mean_loss"])   # but the mean is clean
+
+
+class TestPrefetcherReset:
+    def test_reset_discards_read_ahead_and_restarts(self):
+        batches = [(np.full((2, 2), i, np.float32),) for i in range(8)]
+        pf = io.DevicePrefetcher(batches, depth=2)
+        it = iter(pf)
+        first = [int(np.asarray(next(it)[0]._data)[0, 0]) for _ in range(3)]
+        assert first == [0, 1, 2]
+        pf.reset()
+        replay = [int(np.asarray(t[0]._data)[0, 0]) for t in pf]
+        assert replay == list(range(8))  # fresh full pass
+
+    def test_reset_restores_sampler_state(self):
+        lengths = [5] * 12
+        ds = list(range(12))
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.full((5, 2), i, np.float32)
+
+        sampler = io.BucketedBatchSampler(
+            DS(), batch_size=2, boundaries=[8], lengths=lengths, seed=0)
+        loader = io.DataLoader(DS(), batch_sampler=sampler,
+                               collate_fn=io.PadToBucket([8]))
+        pf = io.DevicePrefetcher(loader, depth=2)
+        snap = sampler.state_dict()
+        for _i, _b in zip(range(3), pf):
+            sampler.advance(1)
+        assert sampler.state_dict()["cursor"] == 3
+        pf.reset(sampler_state=snap)
+        assert sampler.state_dict() == snap
+
+    def test_reset_rejects_non_resumable_source(self):
+        pf = io.DevicePrefetcher([(np.zeros((2, 2), np.float32),)])
+        with pytest.raises(TypeError, match="resumable"):
+            pf.reset(sampler_state={"epoch": 0, "cursor": 0})
+
+
+# ---------------------------------------------------------------------------
+# hapi callback
+# ---------------------------------------------------------------------------
+
+class TestHapiSentinel:
+    def _model(self, poison=True):
+        paddle.seed(0)
+
+        class DS(io.Dataset):
+            def __init__(self):
+                rng = np.random.RandomState(1)
+                self.x = rng.randn(48, 4).astype("float32")
+                self.y = (self.x.sum(axis=1, keepdims=True)
+                          * 0.3).astype("float32")
+                if poison:
+                    self.x[28:36] *= 1e3
+
+            def __len__(self):
+                return 48
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net.parameters()),
+            loss=nn.MSELoss())
+        return model, DS()
+
+    def test_fit_auto_wires_and_warns(self):
+        paddle.set_flags({"FLAGS_sentinel_action": "warn",
+                          "FLAGS_sentinel_zscore": 3.0,
+                          "FLAGS_sentinel_warmup_windows": 2,
+                          "FLAGS_sentinel_ema_beta": 0.8})
+        model, ds = self._model()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            model.fit(ds, batch_size=4, epochs=1, log_freq=3, verbose=0,
+                      shuffle=False)
+        assert any("divergence sentinel" in str(x.message) for x in w)
+
+    def test_callback_raise_rung(self):
+        model, ds = self._model()
+        cb = DivergenceSentinel(
+            sentinel=TrainingSentinel(action="raise", zscore=3.0,
+                                      warmup_windows=2, ema_beta=0.8),
+            window=3)
+        with pytest.raises(TrainDivergenceError):
+            model.fit(ds, batch_size=4, epochs=1, log_freq=3, verbose=0,
+                      shuffle=False, callbacks=[cb])
+
+    def test_quiet_run_no_warnings(self):
+        model, ds = self._model(poison=False)
+        cb = DivergenceSentinel(
+            sentinel=TrainingSentinel(action="warn", zscore=6.0,
+                                      warmup_windows=2), window=3)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            model.fit(ds, batch_size=4, epochs=1, log_freq=3, verbose=0,
+                      shuffle=False, callbacks=[cb])
+        assert not any("divergence sentinel" in str(x.message) for x in w)
+        assert cb.sentinel.windows > 0
+
+
+# ---------------------------------------------------------------------------
+# lint extension (flags must be exercised by tests)
+# ---------------------------------------------------------------------------
+
+class TestFlagLint:
+    def _mod(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "check_fault_sites",
+            os.path.join(REPO, "scripts", "check_fault_sites.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_all_robustness_flags_are_exercised(self):
+        mod = self._mod()
+        flags = mod.registered_flags()
+        # the sentinel family and the checkpoint family are both present
+        assert any(f.startswith("sentinel_") for f in flags)
+        assert any(f.startswith("ckpt_") for f in flags)
+        assert mod.find_missing_flags() == []
+
+    def test_lint_catches_an_untested_flag(self):
+        mod = self._mod()
+        fake = "sentinel_" + "never_tested_knob"
+        assert mod.find_missing_flags(flags=[fake]) == [fake]
+
+    def test_train_spike_site_is_registered(self):
+        assert "train.spike" in fi.SITES
+        mod = self._mod()
+        assert "train.spike" in mod.registered_sites()

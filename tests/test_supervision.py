@@ -271,15 +271,20 @@ class TestSamplerState:
 
     def test_fully_consumed_epoch_rolls_over(self):
         # a resume-armed loop that never calls set_epoch must keep making
-        # progress: exhausting the epoch rolls to the next one
+        # progress: exhausting the epoch rolls to the next one. Since the
+        # divergence-rollback work, advance() itself carries the cursor
+        # across the epoch edge (re-seeding exactly as a real epoch
+        # transition would), so the roll happens eagerly at consumption
+        # time rather than lazily at the next __iter__
         s = _sampler(shuffle=True, seed=4)
         n = len(list(s))
-        s.advance(n)
         epoch0 = s.state_dict()["epoch"]
-        nxt = list(s)  # rollover, not an empty pass
-        assert len(nxt) == n
+        s.advance(n)
         assert s.state_dict()["epoch"] == epoch0 + 1
         assert s.state_dict()["cursor"] == 0
+        nxt = list(s)  # a full fresh pass, not an empty one
+        assert len(nxt) == n
+        assert s.state_dict()["epoch"] == epoch0 + 1
 
     def test_fingerprint_mismatch_raises(self):
         s = _sampler()
