@@ -30,6 +30,19 @@ import numpy as np
 
 ROUND1_TOKENS_PER_SEC = 78701.7
 
+# per-workload MFU floors (ROADMAP item 2 tripwire, PERF.md round-6
+# promise): 0.95x the BENCH_r05 measurement. Every bench line carries its
+# floor so scripts/check_bench_regression.py can fail a round that
+# regresses a workload — wins must stick. Raise a floor when a campaign
+# lands a durable improvement.
+MFU_FLOORS = {
+    "llama125m_train_tokens_per_sec": round(0.5829 * 0.95, 4),
+    "resnet50_train_images_per_sec": round(0.2509 * 0.95, 4),
+    "deepfm_train_examples_per_sec": round(0.0036 * 0.95, 4),
+    "bert_base_finetune_tokens_per_sec": round(0.3932 * 0.95, 4),
+    "ppyoloe_s_train_images_per_sec": round(0.0763 * 0.95, 4),
+}
+
 # peak dense bf16 TFLOP/s per chip by generation
 _PEAK_BF16 = {
     "v2": 45e12,
@@ -105,6 +118,8 @@ def _emit(rec, step=None, batch=None, items_per_batch=None):
       own BENCH_r*.json history (the reference publishes no numbers, so the
       trend is self-referential and says so).
     """
+    if rec.get("mfu_floor") is None:
+        rec["mfu_floor"] = MFU_FLOORS.get(rec.get("metric"))
     if step is not None and rec.get("mfu") is None:
         try:
             flops = step.lowered_flops(*batch)
@@ -166,14 +181,13 @@ def _bench_loop(step, make_batch, batch_sizes, steps, warmup, rebuild):
     return measure(best_bs, steps, 1), best_bs
 
 
-def bench_resnet50(on_tpu):
-    """BASELINE config 1: ResNet-50 training images/sec, bf16, fused step."""
+def make_resnet(on_tpu):
+    """ResNet workload builder (BASELINE config 1), shared by the bench
+    loop and scripts/audit_hlo.py: returns (build, make_batch, sizing)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.vision import models
 
-    paddle.seed(0)
-    np.random.seed(0)
     if on_tpu:
         depth, img, steps, warmup, batch_sizes = 50, 224, 12, 2, [64, 128, 256]
     else:
@@ -196,8 +210,6 @@ def bench_resnet50(on_tpu):
                                         parameters=m.parameters())
         return paddle.incubate.fused_train_step(WithLoss(m), opt)
 
-    step = build()
-
     def make_batch(bs):
         x = paddle.to_tensor(
             np.random.randn(bs, 3, img, img).astype(np.float32)
@@ -205,7 +217,21 @@ def bench_resnet50(on_tpu):
         y = paddle.to_tensor(np.random.randint(0, 1000, (bs,)))
         return x, y
 
-    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
+    return build, make_batch, dict(steps=steps, warmup=warmup,
+                                   batch_sizes=batch_sizes, img=img)
+
+
+def bench_resnet50(on_tpu):
+    """BASELINE config 1: ResNet-50 training images/sec, bf16, fused step."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+    build, make_batch, sz = make_resnet(on_tpu)
+    step = build()
+    img = sz["img"]
+    ips, bs = _bench_loop(step, make_batch, sz["batch_sizes"], sz["steps"],
+                          sz["warmup"], build)
     _emit({
         "metric": "resnet50_train_images_per_sec" if on_tpu
                   else "resnet18_cpu_train_images_per_sec",
@@ -215,14 +241,16 @@ def bench_resnet50(on_tpu):
     }, step=step, batch=make_batch(bs), items_per_batch=bs)
 
 
-def bench_deepfm(on_tpu):
-    """BASELINE config 4: DeepFM (criteo config) training examples/sec."""
+def make_deepfm(on_tpu, sparse_path="lazy"):
+    """DeepFM workload builder (BASELINE config 4), shared by the bench
+    loop, scripts/audit_hlo.py and scripts/bench_sparse_embedding.py.
+    ``sparse_path``: "lazy" (Adam lazy_mode=True — row-sparse embedding
+    grads + gather/update/scatter moments, the ISSUE 6 fast path) or
+    "dense" (the pre-round-7 full-table path, kept for A/B)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.models import DeepFM
 
-    paddle.seed(0)
-    np.random.seed(0)
     vocab, nfield, dense_dim = (1000001, 26, 13)
     if on_tpu:
         steps, warmup, batch_sizes = 20, 3, [4096, 8192, 16384]
@@ -241,10 +269,9 @@ def bench_deepfm(on_tpu):
         m = DeepFM(vocab, 9, dense_dim, nfield, layer_sizes=(512, 256, 128))
         m.train()
         opt = paddle.optimizer.Adam(learning_rate=1e-3,
-                                    parameters=m.parameters())
+                                    parameters=m.parameters(),
+                                    lazy_mode=(sparse_path == "lazy"))
         return paddle.incubate.fused_train_step(WithLoss(m), opt)
-
-    step = build()
 
     def make_batch(bs):
         ids = paddle.to_tensor(
@@ -255,24 +282,45 @@ def bench_deepfm(on_tpu):
             np.random.randint(0, 2, (bs, 1)).astype(np.float32))
         return ids, dense, label
 
-    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
+    return build, make_batch, dict(steps=steps, warmup=warmup,
+                                   batch_sizes=batch_sizes, vocab=vocab,
+                                   nfield=nfield)
+
+
+def bench_deepfm(on_tpu):
+    """BASELINE config 4: DeepFM (criteo config) training examples/sec.
+    Default path is the round-7 lazy (row-sparse) one; set
+    BENCH_DEEPFM_SPARSE=dense for the old full-table arm (the full A/B
+    lives in scripts/bench_sparse_embedding.py)."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+    sparse_path = os.environ.get("BENCH_DEEPFM_SPARSE", "lazy")
+    if sparse_path not in ("lazy", "dense"):
+        raise SystemExit(
+            f"BENCH_DEEPFM_SPARSE={sparse_path!r}: expected 'lazy' or "
+            "'dense'")
+    build, make_batch, sz = make_deepfm(on_tpu, sparse_path=sparse_path)
+    step = build()
+    ips, bs = _bench_loop(step, make_batch, sz["batch_sizes"], sz["steps"],
+                          sz["warmup"], build)
     _emit({
         "metric": "deepfm_train_examples_per_sec",
         "value": round(ips, 1), "unit": "examples/s", "vs_baseline": None,
-        "batch_size": bs, "vocab": vocab,
+        "batch_size": bs, "vocab": sz["vocab"],
+        "sparse_path": sparse_path,
         "baseline_note": "reference publishes no in-tree numbers; MFU is "
                          "expected tiny (embedding-bound workload)",
     }, step=step, batch=make_batch(bs), items_per_batch=bs)
 
 
-def bench_ppyoloe(on_tpu):
-    """BASELINE config 3: PP-YOLOE-s training images/sec (conv-heavy,
-    640x640, full TAL/VFL/GIoU/DFL loss)."""
+def make_ppyoloe(on_tpu):
+    """PP-YOLOE workload builder (BASELINE config 3), shared by the bench
+    loop and scripts/audit_hlo.py."""
     import paddle_tpu as paddle
     from paddle_tpu.vision.models import PPYOLOE, PPYOLOEConfig
 
-    paddle.seed(0)
-    np.random.seed(0)
     if on_tpu:
         cfg = PPYOLOEConfig(depth_mult=0.33, width_mult=0.50, max_boxes=16)
         img, steps, warmup, batch_sizes = 640, 10, 2, [16, 32]
@@ -290,8 +338,6 @@ def bench_ppyoloe(on_tpu):
         return paddle.incubate.fused_train_step(m, opt,
                                                 loss_fn=lambda o: o[0])
 
-    step = build()
-
     def make_batch(bs):
         x = paddle.to_tensor(
             np.random.randn(bs, 3, img, img).astype(np.float32)
@@ -305,7 +351,22 @@ def bench_ppyoloe(on_tpu):
             np.random.randint(0, cfg.num_classes, (bs, g)).astype(np.int64))
         return x, gt_b, gt_l
 
-    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup, build)
+    return build, make_batch, dict(steps=steps, warmup=warmup,
+                                   batch_sizes=batch_sizes, img=img)
+
+
+def bench_ppyoloe(on_tpu):
+    """BASELINE config 3: PP-YOLOE-s training images/sec (conv-heavy,
+    640x640, full TAL/VFL/GIoU/DFL loss)."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+    build, make_batch, sz = make_ppyoloe(on_tpu)
+    step = build()
+    img = sz["img"]
+    ips, bs = _bench_loop(step, make_batch, sz["batch_sizes"], sz["steps"],
+                          sz["warmup"], build)
     _emit({
         "metric": "ppyoloe_s_train_images_per_sec" if on_tpu
                   else "ppyoloe_tiny_cpu_train_images_per_sec",
@@ -315,15 +376,13 @@ def bench_ppyoloe(on_tpu):
     }, step=step, batch=make_batch(bs), items_per_batch=bs)
 
 
-def bench_bert(on_tpu):
-    """BASELINE config 2: BERT-base fine-tune (seq classification),
-    tokens/sec — the ERNIE-3.0 / BERT fine-tune workload."""
+def make_bert(on_tpu):
+    """BERT fine-tune workload builder (BASELINE config 2), shared by the
+    bench loop and scripts/audit_hlo.py."""
     import paddle_tpu as paddle
     from paddle_tpu.models import BertForSequenceClassification, bert_base, \
         bert_tiny
 
-    paddle.seed(0)
-    np.random.seed(0)
     if on_tpu:
         cfg = bert_base()
         seq, steps, warmup, batch_sizes = 128, 15, 3, [64, 128]
@@ -348,9 +407,10 @@ def bench_bert(on_tpu):
 
         wrapped.lowered_flops = (
             lambda ids, labels: raw.lowered_flops(ids, labels=labels))
+        wrapped.hlo_cost_report = (
+            lambda ids, labels, **kw: raw.hlo_cost_report(
+                ids, labels=labels, **kw))
         return wrapped
-
-    step = build()
 
     def make_batch(bs):
         ids = paddle.to_tensor(
@@ -359,8 +419,22 @@ def bench_bert(on_tpu):
             np.random.randint(0, cfg.num_labels, (bs,)).astype(np.int64))
         return ids, labels
 
-    ips, bs = _bench_loop(step, make_batch, batch_sizes, steps, warmup,
-                          build)
+    return build, make_batch, dict(steps=steps, warmup=warmup,
+                                   batch_sizes=batch_sizes, seq=seq)
+
+
+def bench_bert(on_tpu):
+    """BASELINE config 2: BERT-base fine-tune (seq classification),
+    tokens/sec — the ERNIE-3.0 / BERT fine-tune workload."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+    build, make_batch, sz = make_bert(on_tpu)
+    step = build()
+    seq = sz["seq"]
+    ips, bs = _bench_loop(step, make_batch, sz["batch_sizes"], sz["steps"],
+                          sz["warmup"], build)
     _emit({
         "metric": "bert_base_finetune_tokens_per_sec" if on_tpu
                   else "bert_tiny_cpu_finetune_tokens_per_sec",
@@ -473,20 +547,11 @@ def bench_overlap(on_tpu):
     })
 
 
-def main():
+def make_llama(on_tpu):
+    """Flagship llama workload builder, shared by main() and
+    scripts/audit_hlo.py: ``build()`` returns ``(step, n_params)``."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_125m
-
-    paddle.seed(0)
-    np.random.seed(0)
-
-    on_tpu = True
-    try:
-        import jax
-
-        on_tpu = jax.default_backend() not in ("cpu",)
-    except Exception:
-        pass
 
     if on_tpu:
         cfg = llama_125m()
@@ -502,7 +567,7 @@ def main():
     def loss_of(out):
         return out[0] if isinstance(out, (tuple, list)) else out
 
-    def build_step():
+    def build():
         model = LlamaForCausalLM(cfg)
         model.bfloat16()
         model.train()
@@ -512,20 +577,43 @@ def main():
         return paddle.incubate.fused_train_step(model, opt,
                                                 loss_fn=loss_of), n
 
-    step, n_params = build_step()
-
-    def rebuild():
-        # OOM invalidates the donated param buffers — rebuild fresh
-        nonlocal n_params
-        s, n_params = build_step()
-        return s
-
     def make_batch(bs):
         ids = paddle.to_tensor(
             np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
         labels = paddle.to_tensor(
             np.random.randint(0, cfg.vocab_size, (bs, seq)).astype(np.int32))
         return ids, labels
+
+    return build, make_batch, dict(steps=steps, warmup=warmup,
+                                   batch_sizes=batch_sizes, seq=seq,
+                                   cfg=cfg)
+
+
+def main():
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+
+    on_tpu = True
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        pass
+
+    build, make_batch, sz = make_llama(on_tpu)
+    cfg, seq = sz["cfg"], sz["seq"]
+    steps, warmup, batch_sizes = sz["steps"], sz["warmup"], sz["batch_sizes"]
+    step, n_params = build()
+    build_step = build
+
+    def rebuild():
+        # OOM invalidates the donated param buffers — rebuild fresh
+        nonlocal n_params
+        s, n_params = build_step()
+        return s
 
     seqs_per_sec, best_bs = _bench_loop(step, make_batch, batch_sizes, steps,
                                         warmup, rebuild)

@@ -155,9 +155,50 @@ class SparseEmbedding(Layer):
                     stacklevel=2)
             else:  # counting is an eager host-side gate
                 self._observe(x)
+        self._note_lookup(x)
         # plain gather; GSPMD turns it into masked local gather + all-reduce
         # when the table is sharded (the PS pull)
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def _note_lookup(self, x):
+        """Record the batch's row ids for the eager lazy-Adam path
+        (``Adam(lazy_mode=True)`` gathers only these rows of the dense
+        autograd gradient — see ops/sparse_grad.py). Inside a trace the
+        capture mechanism owns id tracking instead."""
+        from ...core import state
+        from ...ops import sparse_grad
+
+        if self.training and not state.in_trace() \
+                and not self.weight.stop_gradient:
+            sparse_grad.note_eager_lookup(self.weight, x)
+
+    def pooled(self, x, mode="sum"):
+        """Fused lookup+pool over the trailing field axis
+        (``F.embedding_bag``): returns ``[..., dim]`` without ever
+        materializing the ``[..., F, dim]`` per-field intermediate —
+        DeepFM's first-order term uses this so its ``[B, F, 1]`` tensor
+        never exists."""
+        if mode not in ("sum", "mean"):
+            raise ValueError(
+                f"pooled mode must be 'sum' or 'mean', got {mode!r}")
+        if self._entry is not None and self.training:
+            # admission filtering needs the eager forward (count gate +
+            # grad hook); pool its output with the SAME padding semantics
+            # as F.embedding_bag — padding rows are zero in the sum and
+            # excluded from the mean's denominator
+            rows = self.forward(x)
+            out = rows.sum(-2)
+            if mode == "sum":
+                return out
+            if self._padding_idx is None:
+                return out / float(x.shape[-1])
+            keep = (x != self._padding_idx).astype(rows.dtype)
+            n = keep.sum(-1, keepdim=True)
+            n = n + (n == 0).astype(rows.dtype)  # live-count floor of 1
+            return out / n
+        self._note_lookup(x)
+        return F.embedding_bag(x, self.weight, mode=mode,
+                               padding_idx=self._padding_idx)
 
 
 _FUNCTIONAL_TABLES: dict = {}

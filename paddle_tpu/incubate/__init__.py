@@ -6,6 +6,7 @@ train step and (distributed) the sparse all-to-all MoE layer.
 """
 
 from .fused_train_step import FusedTrainStep, fused_train_step  # noqa: F401
+from .fold_conv_bn import fold_conv_bn  # noqa: F401
 from .sentinel import RollbackBudget, TrainingSentinel  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
@@ -18,7 +19,8 @@ from .extras import (  # noqa: F401
     softmax_mask_fuse_upper_triangle,
 )
 
-__all__ = ["FusedTrainStep", "fused_train_step", "RollbackBudget",
+__all__ = ["FusedTrainStep", "fused_train_step", "fold_conv_bn",
+           "RollbackBudget",
            "TrainingSentinel", "asp", "autotune", "nn",
            "optimizer", "LookAhead", "ModelAverage", "graph_khop_sampler",
            "graph_reindex", "graph_sample_neighbors", "graph_send_recv",

@@ -1,0 +1,92 @@
+"""Conv–BatchNorm folding for inference/eval steps (ISSUE 6 A/B probe).
+
+Reference analog: ``paddle.incubate`` / Paddle-Inference's conv_bn_fuse
+pass. In eval mode BatchNorm is an affine transform with frozen statistics,
+so it folds into the preceding convolution exactly:
+
+    W' = W * gamma / sqrt(var + eps)        (per output channel)
+    b' = (b - mean) * gamma / sqrt(var + eps) + beta
+
+The fold removes one full feature-map read+write per conv (the BN op), the
+classic inference-graph fusion. Whether it *pays* under XLA — which already
+fuses the BN affine into the conv's output elementwise epilogue — is an
+empirical question; ``scripts/bench_conv_bn_fold.py`` measures it per the
+PERF.md A/B discipline and the verdict (kept or reverted) is recorded in
+PERF.md's round-7 table either way.
+
+Only eval-mode models fold (training BN updates running stats and
+normalizes by batch statistics — folding would change the math);
+``fold_conv_bn`` walks every sublayer and folds each BatchNorm2D that
+DIRECTLY follows a Conv2D in its parent's sublayer order — the
+conv→bn idiom ResNet/PPYOLOE-style blocks register."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["fold_conv_bn"]
+
+
+def _foldable(conv, bn):
+    from ..nn.layer.conv import Conv2D
+    from ..nn.layer.norm import BatchNorm2D
+
+    return (isinstance(conv, Conv2D) and isinstance(bn, BatchNorm2D)
+            and not conv._transpose
+            and bn._mean.shape[0] == conv.weight.shape[0])
+
+
+def fold_conv_bn(model, verify_eval=True):
+    """Fold every (Conv2D -> BatchNorm2D) adjacent pair in ``model``'s
+    sublayer trees into the conv; the BN is replaced with ``Identity``.
+    Returns the number of folded pairs. The model must be in eval mode
+    (``verify_eval=False`` skips the check for frozen-BN training
+    setups).
+
+    Adjacency is REGISTRATION order, not dataflow: the fold assumes a BN
+    registered right after a conv normalizes that conv's output (the
+    conv→bn idiom of ResNet/PPYOLOE-style blocks). A model whose forward
+    wires them differently (e.g. the BN applied to a skip branch) would
+    be silently mis-folded — this utility cannot see the forward graph,
+    so ALWAYS verify folded-vs-unfolded outputs on a sample batch before
+    trusting a folded model (``scripts/bench_conv_bn_fold.py`` does
+    exactly this and refuses to report a speedup on mismatch)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..nn.layer.common import Identity
+
+    if verify_eval and model.training:
+        raise RuntimeError(
+            "fold_conv_bn requires an eval-mode model (model.eval()): "
+            "training-mode BatchNorm normalizes by batch statistics and "
+            "cannot be folded")
+    folded = 0
+    for _, parent in model.named_sublayers(include_self=True):
+        subs = list(parent._sub_layers.items())
+        for (_, conv), (bn_name, bn) in zip(subs, subs[1:]):
+            if not _foldable(conv, bn):
+                continue
+            gamma = np.asarray(bn.weight._data, np.float32)
+            beta = np.asarray(bn.bias._data, np.float32)
+            mean = np.asarray(bn._mean._data, np.float32)
+            var = np.asarray(bn._variance._data, np.float32)
+            scale = gamma / np.sqrt(var + bn._epsilon)
+            w = np.asarray(conv.weight._data, np.float32)
+            w_dtype = conv.weight._data.dtype
+            new_w = w * scale.reshape(-1, 1, 1, 1)
+            b = (np.asarray(conv.bias._data, np.float32)
+                 if conv.bias is not None else 0.0)
+            new_b = (b - mean) * scale + beta
+            conv.weight._rebind(jnp.asarray(new_w).astype(w_dtype))
+            if conv.bias is not None:
+                conv.bias._rebind(
+                    jnp.asarray(new_b).astype(conv.bias._data.dtype))
+            else:
+                conv.bias = conv.create_parameter(
+                    [conv._out_channels], is_bias=True)
+                conv.bias._rebind(jnp.asarray(new_b).astype(w_dtype))
+                conv.bias.stop_gradient = True
+            parent._sub_layers[bn_name] = Identity()
+            folded += 1
+    return folded

@@ -143,6 +143,19 @@ class FusedTrainStep:
             raise TypeError(
                 f"fused_train_step supports SGD/Momentum/Adam/AdamW, got "
                 f"{type(opt).__name__}")
+        # row-sparse lazy route (Adam/AdamW lazy_mode=True): embedding-table
+        # params skip the dense vocab-sized gradient entirely — the lookup
+        # is captured (ops/sparse_grad.py), its backward yields
+        # (row_ids, row_grads) at batchxfields size, and the update is a
+        # gather→update→scatter over touched rows only. Zero model-code
+        # change: any SparseEmbedding / sparse nn.Embedding parameter
+        # qualifies automatically.
+        self._sparse_names = ()
+        if self._kind in ("adam", "adamw") and \
+                bool(getattr(opt, "_lazy_mode", False)):
+            self._sparse_names = tuple(sorted(
+                self._find_sparse_param_names(model)))
+
         if self._kind in ("adam", "adamw"):
             z = {n: jnp.zeros(self._params[n].shape, jnp.float32)
                  for n in self._names}
@@ -200,6 +213,29 @@ class FusedTrainStep:
                                donate_argnums=(0, 1, 2, 3),
                                static_argnums=(8, 9))
 
+    def _find_sparse_param_names(self, model):
+        """Trainable params that are embedding tables: the weights of
+        ``distributed.ps.SparseEmbedding`` layers and of ``nn.Embedding``
+        layers constructed with ``sparse=True`` (the reference's
+        SelectedRows-gradient markers)."""
+        from ..distributed.ps import SparseEmbedding
+        from ..nn.layer.common import Embedding
+
+        by_id = {id(self._tensors[n]): n for n in self._names}
+        names = set()
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, SparseEmbedding):
+                w = sub.weight
+            elif isinstance(sub, Embedding) and getattr(sub, "_sparse",
+                                                        False):
+                w = sub.weight
+            else:
+                continue
+            n = by_id.get(id(w))
+            if n is not None:
+                names.add(n)
+        return names
+
     # -- pure step ------------------------------------------------------
     def _loss(self, params, data, kwdata, scale):
         all_params = dict(params)
@@ -214,12 +250,95 @@ class FusedTrainStep:
             out = out[0]
         return out * scale  # loss scaling fused in-graph (scale==1 => no-op)
 
+    def _sparse_value_and_grad(self, params, data, kwdata, scale, sparse):
+        """Differentiate the loss with embedding tables on the row-sparse
+        path: the tables enter through ``stop_gradient`` and each captured
+        lookup's rows ride a zeros ``[n_ids, dim]`` delta, so the backward
+        emits per-occurrence row grads instead of a vocab-sized
+        scatter-add. Returns ``(loss, dense_grads, sparse_grads)`` where
+        ``sparse_grads[name] = (uniq_ids, row_grads, valid)`` — duplicate
+        ids already segment-summed into unique slots at the static
+        batchxfields bound (shapes stay bucket-stable for the jit cache)."""
+        from ..ops import sparse_grad
+
+        registry = {id(params[n]): n for n in sparse}
+        # discovery: one abstract forward (jax.make_jaxpr — no FLOPs, no
+        # executable, runs at trace time only) records each lookup's
+        # flattened id count so the deltas exist before differentiation,
+        # and yields the jaxpr for the lookup-only safety analysis
+        with sparse_grad.capture(registry, "discover") as cap:
+            closed = jax.make_jaxpr(
+                lambda: self._loss(params, data, kwdata, scale))()
+        # safety gate: a table consumed by anything other than the
+        # capture's stop_gradient route (tied weights, direct matmul, a
+        # cast that broke identity matching) would silently LOSE that
+        # gradient on the row-sparse path — fall it back to dense
+        safe = sparse_grad.lookup_only_tables(
+            closed, {n: params[n] for n in sparse})
+        unsafe = [n for n in sparse if n not in safe]
+        if unsafe:
+            import warnings
+
+            warnings.warn(
+                f"{self._stats_name}: sparse table(s) {sorted(unsafe)} are "
+                "used outside embedding lookups in this loss (tied "
+                "weights / direct reads) — taking the DENSE gradient path "
+                "for them; lazy_mode row-sparse updates apply only to "
+                "lookup-only tables", stacklevel=2)
+            sparse = [n for n in sparse if n in safe]
+            if not sparse:
+                loss, grads = jax.value_and_grad(self._loss)(
+                    params, data, kwdata, scale)
+                return loss, grads, {}
+            registry = {id(params[n]): n for n in sparse}
+        sparse_set = set(sparse)
+        deltas = {n: [jnp.zeros((k, params[n].shape[-1]), jnp.float32)
+                      for k in cap.counts.get(n, [])] for n in sparse}
+        dense_params = {n: v for n, v in params.items()
+                        if n not in sparse_set}
+
+        def loss_fn(dp, deltas_):
+            full = dict(dp)
+            for n in sparse:
+                full[n] = params[n]
+            with sparse_grad.capture(registry, "apply", deltas_) as c:
+                out = self._loss(full, data, kwdata, scale)
+                ids = {n: list(c.ids.get(n, [])) for n in sparse}
+            return out, ids
+
+        (loss, ids_rec), (dgrads, delta_grads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(dense_params, deltas)
+        sgrads = {}
+        for n in sparse:
+            chunks = ids_rec.get(n, [])
+            if not chunks:
+                # registered table the forward never looked up: no rows
+                # touched, no update this step
+                dim = params[n].shape[-1]
+                sgrads[n] = (jnp.zeros((0,), jnp.int32),
+                             jnp.zeros((0, dim), jnp.float32),
+                             jnp.zeros((0,), jnp.bool_))
+                continue
+            ids_all = (chunks[0] if len(chunks) == 1
+                       else jnp.concatenate(chunks))
+            g_all = (delta_grads[n][0] if len(delta_grads[n]) == 1
+                     else jnp.concatenate(delta_grads[n]))
+            sgrads[n] = sparse_grad.segment_rows(ids_all, g_all,
+                                                 combine="add")
+        return loss, dgrads, sgrads
+
     def _step_impl(self, params, m1, m2, acc, lr, scale, data, kwdata,
                    guard, track_gnorm):
         step_prev, loss_sum, skips, gpeak = acc
         step = step_prev + 1.0  # bias-correction count for THIS step
-        loss, grads = jax.value_and_grad(self._loss)(params, data, kwdata,
-                                                     scale)
+        sparse = [n for n in self._sparse_names if n in params]
+        if sparse:
+            loss, grads, sgrads = self._sparse_value_and_grad(
+                params, data, kwdata, scale, sparse)
+        else:
+            loss, grads = jax.value_and_grad(self._loss)(params, data,
+                                                         kwdata, scale)
+            sgrads = {}
         # unscale: grads of the scaled loss divided by scale are the true
         # grads (reference check_finite_and_unscale), and the finite check
         # runs post-unscale exactly like AmpScaler.unscale_
@@ -227,21 +346,29 @@ class FusedTrainStep:
         loss = loss * inv
         grads = jax.tree.map(lambda g: (_f32(g) * inv).astype(g.dtype),
                              grads)
+        sgrads = {n: (ids, g * inv, valid)
+                  for n, (ids, g, valid) in sgrads.items()}
+        sgrad_leaves = [g for _, g, _ in sgrads.values()]
         if guard == "off":
             all_finite = jnp.bool_(True)  # constant: no reduction in-graph
         else:
             all_finite = jnp.all(jnp.isfinite(loss))
-            for g in jax.tree.leaves(grads):
+            for g in jax.tree.leaves(grads) + sgrad_leaves:
                 all_finite = jnp.logical_and(all_finite,
                                              jnp.all(jnp.isfinite(g)))
         gnorm = None  # pre-clip global grad norm (the explosion signal)
         if self._clip_norm is not None or track_gnorm:
+            # dead dedup slots hold zero rows, so the row-grad squares sum
+            # to exactly the dense table-grad norm contribution
             gnorm = jnp.sqrt(sum(
-                jnp.sum(_f32(g) ** 2) for g in jax.tree.leaves(grads)))
+                jnp.sum(_f32(g) ** 2)
+                for g in jax.tree.leaves(grads) + sgrad_leaves))
         if self._clip_norm is not None:
             factor = jnp.minimum(1.0, self._clip_norm / (gnorm + 1e-12))
             grads = jax.tree.map(lambda g: (_f32(g) * factor).astype(g.dtype),
                                  grads)
+            sgrads = {n: (ids, g * factor, valid)
+                      for n, (ids, g, valid) in sgrads.items()}
         opt = self.optimizer
         kind = self._kind
         if kind in ("adam", "adamw"):
@@ -267,10 +394,28 @@ class FusedTrainStep:
 
             out = {n: upd(params[n], grads[n], m1[n], m2[n],
                           self._wds[n], self._lr_ratios[n])
-                   for n in params}
+                   for n in params if n not in sgrads}
             new_p = {n: v[0] for n, v in out.items()}
             new_m1 = {n: v[1] for n, v in out.items()}
             new_m2 = {n: v[2] for n, v in out.items()}
+            if sgrads:
+                from ..optimizer.optimizers import lazy_adam_rows
+
+                for n, (ids, row_g, valid) in sgrads.items():
+                    # protect mode gates the scatter itself: a non-finite
+                    # step masks every slot, and masked slots write back
+                    # current values — the dense path's vocab-sized
+                    # jnp.where select is never needed here
+                    upd_mask = (jnp.logical_and(valid, all_finite)
+                                if guard == "protect" else valid)
+                    np_, nm1, nm2 = lazy_adam_rows(
+                        params[n], m1[n], m2[n], ids, row_g, upd_mask,
+                        lr, b1, b2, eps, b1p, b2p, kind,
+                        jnp.float32(self._wds[n]),
+                        jnp.float32(self._lr_ratios[n]))
+                    new_p[n] = np_
+                    new_m1[n] = nm1
+                    new_m2[n] = nm2
         elif kind == "momentum":
             mu = jnp.float32(opt._momentum)
 
@@ -298,7 +443,11 @@ class FusedTrainStep:
             # bias-correction count does not advance — all in-graph, so no
             # host fetch is needed for the discard to be correct
             def keep(new, old):
-                return {n: jnp.where(all_finite, new[n], old[n])
+                # sparse-route entries were already gated at scatter time
+                # (upd_mask) — a vocab-sized select here would reintroduce
+                # the full-table traffic the lazy path removes
+                return {n: (new[n] if n in sgrads
+                            else jnp.where(all_finite, new[n], old[n]))
                         for n in new}
 
             new_p = keep(new_p, params)
@@ -324,29 +473,45 @@ class FusedTrainStep:
         return loss, all_finite, new_acc, new_p, new_m1, new_m2
 
     # -- public ---------------------------------------------------------
+    def _lower(self, *data, **kwdata):
+        """Lower (but do not run) the fused executable for these inputs —
+        guard off, gnorm tracking off: the plain steady-state program.
+        When the step already compiled for these shapes, ``.compile()`` on
+        the result is a cache hit, not a second compile."""
+        darrs, karrs = self._prepare_arrays(data, kwdata, record=False)
+        return self._jitted.lower(
+            self._params, self._m1, self._m2,
+            (jnp.float32(0), jnp.float32(0), jnp.float32(0),
+             jnp.float32(0)),
+            jnp.float32(1e-3), jnp.float32(1), darrs, karrs, "off",
+            False)
+
     def lowered_flops(self, *data, **kwdata):
         """FLOPs of one full fused step (forward + backward + update) from
         XLA's HLO cost analysis on the lowered program — self-measured, no
         hand-derived formula. Returns None when the backend provides no
         estimate. Used by bench.py for MFU accounting."""
-        darrs, karrs = self._prepare_arrays(data, kwdata, record=False)
         try:
-            lowered = self._jitted.lower(
-                self._params, self._m1, self._m2,
-                (jnp.float32(0), jnp.float32(0), jnp.float32(0),
-                 jnp.float32(0)),
-                jnp.float32(1e-3), jnp.float32(1), darrs, karrs, "off",
-                False)
+            lowered = self._lower(*data, **kwdata)
             cost = lowered.cost_analysis()
             if not (hasattr(cost, "get") and cost.get("flops")):
-                # some backends only report cost post-compile; with the
-                # step already compiled for these shapes this is a cache
-                # hit, not a second compile
+                # some backends only report cost post-compile
                 cost = lowered.compile().cost_analysis()
             flops = cost.get("flops") if hasattr(cost, "get") else None
             return float(flops) if flops and flops > 0 else None
         except Exception:
             return None
+
+    def hlo_cost_report(self, *data, top_n=None, **kwdata):
+        """Per-op cost ledger of this step's OPTIMIZED HLO for the given
+        inputs: each entry-computation op with its bytes accessed (result
+        + operands — a fusion's external traffic) and estimated FLOPs,
+        ranked by bytes. See ``paddle.jit.hlo_audit`` for the method and
+        ``scripts/audit_hlo.py`` for the per-workload reports."""
+        from ..jit import hlo_audit
+
+        compiled = self._lower(*data, **kwdata).compile()
+        return hlo_audit.audit(compiled, top_n=top_n)
 
     def _prepare_arrays(self, data, kwdata, record=True):
         """Unwrap call inputs to jax arrays, padding each up to its shape

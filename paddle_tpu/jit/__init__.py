@@ -39,11 +39,12 @@ from ..static.input_spec import InputSpec
 from . import cache as cache_mod
 from .cache import (BucketSpec, cache_stats, get_shape_buckets,  # noqa: F401
                     reset_cache_stats, set_shape_buckets)
+from . import hlo_audit  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "enable_to_static", "ignore_module", "cache_stats",
            "reset_cache_stats", "set_shape_buckets", "get_shape_buckets",
-           "BucketSpec"]
+           "BucketSpec", "hlo_audit"]
 
 _TO_STATIC_ENABLED = True
 

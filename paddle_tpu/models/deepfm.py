@@ -63,9 +63,10 @@ class DeepFM(nn.Layer):
         demb = self.dense_emb(dense_x).unsqueeze(1)  # [B, 1, D]
         fields = paddle.concat([emb, demb], axis=1)  # [B, F+1, D]
 
-        # first order
-        first = (self.first_order_weight(sparse_ids).squeeze(-1).sum(-1,
-                                                                     keepdim=True)
+        # first order: fused lookup+pool (F.embedding_bag) — the gather and
+        # the field-sum run as one reduction, so the [B, F, 1] per-field
+        # intermediate never materializes
+        first = (self.first_order_weight.pooled(sparse_ids, mode="sum")
                  + self.dense_linear(dense_x))  # [B, 1]
 
         # second order (FM identity)

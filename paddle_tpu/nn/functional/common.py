@@ -16,6 +16,7 @@ from ...ops.manipulation import pad as _pad_nd  # noqa: F401  (re-export as F.pa
 
 __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "embedding_bag",
     "one_hot", "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
     "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
     "label_smooth", "zeropad2d",
@@ -103,7 +104,15 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 @op("embedding_op")
 def _embedding(x, weight, padding_idx=None, sparse=False):
-    out = jnp.take(weight, x, axis=0)
+    from ...ops import sparse_grad
+
+    # row-sparse capture (FusedTrainStep lazy-Adam route): when this table
+    # is registered in an active capture, the gather routes through a
+    # [n_ids, dim] delta so the backward yields row grads, never a
+    # vocab-sized scatter-add. Forward value is bit-identical.
+    out = sparse_grad.captured_lookup(x, weight)
+    if out is None:
+        out = jnp.take(weight, x, axis=0)
     if padding_idx is not None:
         mask = (x == padding_idx)[..., None]
         out = jnp.where(mask, 0.0, out)
@@ -114,6 +123,46 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     return _embedding(x, weight,
                       padding_idx=None if padding_idx is None else int(padding_idx),
                       sparse=bool(sparse))
+
+
+@op("embedding_bag_op")
+def _embedding_bag(x, weight, mode="sum", padding_idx=None):
+    from ...ops import sparse_grad
+
+    if padding_idx is None:
+        out = sparse_grad.captured_pooled_lookup(x, weight, mode)
+        if out is not None:
+            return out
+        # gather+reduce in one expression: the [B, F, dim] intermediate is
+        # never handed to another op, so XLA fuses the lookup and the pool
+        # into one loop (verified by the HLO audit on deepfm's first-order
+        # term, where the pooled dim is 1)
+        rows = jnp.take(weight, x, axis=0)
+        return rows.mean(axis=-2) if mode == "mean" else rows.sum(axis=-2)
+    # padding rows contribute zero to the sum and do not count toward the
+    # mean's denominator (torch.nn.EmbeddingBag semantics)
+    out = sparse_grad.captured_lookup(x, weight)
+    if out is None:
+        out = jnp.take(weight, x, axis=0)
+    keep = (x != padding_idx)[..., None]
+    out = jnp.where(keep, out, 0.0)
+    if mode == "mean":
+        n = jnp.maximum(jnp.sum(keep, axis=-2), 1)
+        return out.sum(axis=-2) / n.astype(out.dtype)
+    return out.sum(axis=-2)
+
+
+def embedding_bag(x, weight, mode="sum", padding_idx=None, name=None):
+    """Fused lookup+pool: ``embedding(x, weight)`` reduced over the field
+    axis (``sum`` or ``mean``) without materializing the ``[B, F, dim]``
+    intermediate as a separate tensor — the ``F.embedding_bag`` analog.
+    ``x`` is int ``[..., F]``; returns ``[..., dim]``."""
+    if mode not in ("sum", "mean"):
+        raise ValueError(f"embedding_bag mode must be 'sum' or 'mean', "
+                         f"got {mode!r}")
+    return _embedding_bag(
+        x, weight, mode=str(mode),
+        padding_idx=None if padding_idx is None else int(padding_idx))
 
 
 def one_hot(x, num_classes, name=None):

@@ -22,6 +22,7 @@ from .linalg import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from . import (  # noqa: F401
     creation, extras, indexing, linalg, logic, manipulation, math,
+    sparse_grad,
 )
 from .manipulation import row_stack, t  # noqa: F401
 

@@ -152,6 +152,78 @@ class Adagrad(Optimizer):
             self._set_acc("moment", p, m)
 
 
+def lazy_adam_rows(param, m1, m2, ids, grads, upd_mask, lr, beta1, beta2,
+                   eps, b1p, b2p, mode, wd, lr_ratio):
+    """Lazy-mode Adam/AdamW over touched rows only (the reference's
+    ``Adam(lazy_mode=True)`` / SelectedRows adam kernel, SparseCore-style):
+    gather the touched rows of the table and both moments, run the exact
+    dense update formula on them, scatter the results back. Untouched rows
+    — table AND moments — are never read or written; bias correction uses
+    the GLOBAL step (``b1p``/``b2p`` passed in), matching Paddle's lazy
+    semantics.
+
+    ``ids [K]`` are deduplicated row ids (``sparse_grad.segment_rows``)
+    with ``grads [K, dim]`` their summed row gradients; ``upd_mask [K]``
+    disables dead dedup slots (and, in the fused step's protect mode, a
+    whole non-finite step). Masked slots alias row ``ids[0]`` and carry
+    slot 0's OWN payload (its updated value, or its current value when
+    slot 0 is itself masked), so every scatter write targeting one row is
+    identical — deterministic regardless of scatter order.
+
+    Pure function: shared verbatim by the in-graph FusedTrainStep route and
+    the donated eager kernel below, so the two paths cannot drift."""
+    if int(ids.shape[0]) == 0:
+        return param, m1, m2
+    safe = jnp.where(upd_mask, ids, ids[0])
+    pf = _f32(param[safe])
+    m1r = m1[safe]
+    m2r = m2[safe]
+    gf = _f32(grads)
+    if mode == "adam":
+        gf = gf + wd * pf
+    m1n = beta1 * m1r + (1 - beta1) * gf
+    m2n = beta2 * m2r + (1 - beta2) * gf * gf
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    step_lr = lr * lr_ratio
+    new = pf - step_lr * m1h / (jnp.sqrt(m2h) + eps)
+    if mode == "adamw":
+        new = new - step_lr * wd * pf
+    mask = upd_mask[:, None]
+
+    def settle(updated, current):
+        # masked slot → keep current values; then masked slots (which all
+        # alias row ids[0]) take slot 0's payload so duplicate writes agree
+        base = jnp.where(mask, updated, current)
+        return jnp.where(mask, base, base[0][None])
+
+    return (param.at[safe].set(settle(new, pf).astype(param.dtype)),
+            m1.at[safe].set(settle(m1n, m1r)),
+            m2.at[safe].set(settle(m2n, m2r)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(10,))
+def _adam_lazy_update(param, m1, m2, dense_grad, raw_ids, lr, beta1,
+                      beta2, eps, step, mode, wd, lr_ratio):
+    """Eager lazy-mode kernel: the autograd gradient is dense (the gather's
+    backward scatter-adds into a vocab-sized buffer), but its live rows are
+    known from the forward's recorded lookups — so only those rows are
+    gathered here, and the table + moments see row traffic instead of three
+    full-table streams. The id dedup runs IN here (one fused executable
+    per step, not a string of eager dispatches); duplicate occurrences
+    were already summed by the scatter-add, hence the plain row gather of
+    each unique id (no re-summing)."""
+    from ..ops.sparse_grad import unique_ids
+
+    ids, valid = unique_ids(raw_ids)
+    b1p = jnp.power(beta1, step)
+    b2p = jnp.power(beta2, step)
+    row_grads = jnp.take(dense_grad, jnp.where(valid, ids, ids[0]),
+                         axis=0)
+    return lazy_adam_rows(param, m1, m2, ids, row_grads, valid, lr,
+                          beta1, beta2, eps, b1p, b2p, mode, wd, lr_ratio)
+
+
 class _AdamBase(Optimizer):
     _mode = "adam"
 
@@ -166,6 +238,33 @@ class _AdamBase(Optimizer):
         self._epsilon = epsilon
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
+        self._lazy_mode = bool(lazy_mode)
+        self._multi_precision = bool(multi_precision)
+        self._fallback_warned = set()
+        if self._multi_precision:
+            self._warn_fallback(
+                "multi_precision",
+                "multi_precision=True is not implemented on this backend; "
+                "updates run the standard fp32-compute path (parameters "
+                "cast up per step, no persistent master weights)")
+
+    @property
+    def lazy_mode(self):
+        return self._lazy_mode
+
+    @property
+    def multi_precision(self):
+        return self._multi_precision
+
+    def _warn_fallback(self, key, msg):
+        """Requested-but-unimplemented combination: say so ONCE per
+        instance, then take the dense/standard path silently."""
+        if key in self._fallback_warned:
+            return
+        self._fallback_warned.add(key)
+        import warnings
+
+        warnings.warn(f"{type(self).__name__}: {msg}", stacklevel=3)
 
     def _wd_coeff(self):
         wd = self.regularization
@@ -175,7 +274,73 @@ class _AdamBase(Optimizer):
             return float(wd)
         return float(getattr(wd, "_coeff", getattr(wd, "coeff", 0.0)))
 
+    def _param_wd(self, p):
+        wd = self._wd_coeff()
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return wd
+
+    def _apply_lazy(self, params_grads):
+        """Route params with recorded sparse lookups through the lazy row
+        kernel; returns the (param, grad) pairs left for the dense path.
+        A lazy table's update touches only the rows the forward looked up
+        — untouched rows' moments stay untouched (no beta decay), exactly
+        Paddle's lazy_mode semantics.
+
+        Contract: the recorded lookup ids must cover the gradient's
+        support — true when the table is used ONLY through
+        ``SparseEmbedding`` lookups (the sole recorder). A table whose
+        weight additionally feeds other ops (tied projections) must train
+        with ``lazy_mode=False``; the fused-step route detects that case
+        structurally and falls back per table, the eager path cannot see
+        the rest of the graph and relies on this contract."""
+        from ..ops import sparse_grad
+
+        fp32_init = lambda p: jnp.zeros(p._data.shape, jnp.float32)  # noqa: E731
+        rest = []
+        step = jnp.float32(self._global_step + 1)
+        for p, g in params_grads:
+            ids = sparse_grad.consume_eager_lookups(p)
+            if ids is None or p._data.ndim != 2 \
+                    or g._data.shape != p._data.shape:
+                rest.append((p, g))
+                continue
+            m1 = self._acc("moment1", p, fp32_init)
+            m2 = self._acc("moment2", p, fp32_init)
+            lr_ratio = (float(self._lr_ratio(p))
+                        if self._lr_ratio is not None else 1.0)
+            new_p, new_m1, new_m2 = _adam_lazy_update(
+                p._data, m1, m2, g._data, ids,
+                jnp.float32(self.get_lr()), jnp.float32(self._beta1),
+                jnp.float32(self._beta2), jnp.float32(self._epsilon),
+                step, self._mode, jnp.float32(self._param_wd(p)),
+                jnp.float32(lr_ratio))
+            p._rebind(new_p)
+            self._set_acc("moment1", p, new_m1)
+            self._set_acc("moment2", p, new_m2)
+        return rest
+
+    def state_dict(self):
+        sd = super().state_dict()
+        sd["lazy_mode"] = self._lazy_mode
+        sd["multi_precision"] = self._multi_precision
+        return sd
+
+    def set_state_dict(self, state_dict):
+        super().set_state_dict(state_dict)
+        if "lazy_mode" in state_dict:
+            self._lazy_mode = bool(state_dict["lazy_mode"])
+        if "multi_precision" in state_dict:
+            self._multi_precision = bool(state_dict["multi_precision"])
+
+    load_state_dict = set_state_dict
+
     def _apply(self, params_grads):
+        if self._lazy_mode:
+            params_grads = self._apply_lazy(params_grads)
+            if not params_grads:
+                return
         fp32_init = lambda p: jnp.zeros(p._data.shape, jnp.float32)  # noqa: E731
         params = [p._data for p, _ in params_grads]
         grads = [g._data for _, g in params_grads]

@@ -1,0 +1,119 @@
+"""Per-op HLO cost audit over the bench workloads (ISSUE 6 tentpole 4).
+
+For each workload this builds the SAME fused train step bench.py measures
+(bench.make_* builders — single source, the audit can never drift from
+the bench), lowers + compiles it for the bench batch shape, and prints the
+per-op cost table from ``paddle.jit.hlo_audit``: every entry-computation
+op of the optimized HLO ranked by estimated bytes accessed, with
+first-order FLOPs alongside and XLA's aggregate ``cost_analysis`` total as
+the sanity anchor. This is where MFU-campaign targets come from — measured
+HLO, not guesses.
+
+``deepfm`` audits BOTH sparse paths (dense full-table Adam vs the lazy
+row-sparse route) and reports the vocab-sized-op probe: on the lazy path
+no op in the top entries may stream a vocab-sized buffer (the dense
+scatter/moment/param streams are exactly what lazy_mode removes).
+
+Usage:
+  python scripts/audit_hlo.py [llama|resnet50|deepfm|bert|ppyoloe|all]
+      [--top 12] [--sparse-path lazy|dense|both]
+
+CPU runs use each workload's smoke sizing (tiny models); on a TPU the
+full bench configs compile, so expect real compile time per workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+WORKLOADS = ("resnet50", "deepfm", "bert", "ppyoloe", "llama")
+
+
+def build_step(workload, on_tpu, sparse_path="lazy"):
+    """(fused step, bench-shaped batch, sizing dict) via bench.make_*."""
+    import bench
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+    if workload == "llama":
+        build, make_batch, sz = bench.make_llama(on_tpu)
+        step, _ = build()
+    elif workload == "resnet50":
+        build, make_batch, sz = bench.make_resnet(on_tpu)
+        step = build()
+    elif workload == "deepfm":
+        build, make_batch, sz = bench.make_deepfm(on_tpu,
+                                                  sparse_path=sparse_path)
+        step = build()
+    elif workload == "bert":
+        build, make_batch, sz = bench.make_bert(on_tpu)
+        step = build()
+    elif workload == "ppyoloe":
+        build, make_batch, sz = bench.make_ppyoloe(on_tpu)
+        step = build()
+    else:
+        raise SystemExit(f"unknown workload {workload!r}; expected one of "
+                         f"{WORKLOADS} | all")
+    return step, make_batch(sz["batch_sizes"][0]), sz
+
+
+def audit_workload(workload, on_tpu, top_n, sparse_path="lazy"):
+    """Audit one workload; returns the report dict (the deepfm variant
+    returns the report of the requested sparse path)."""
+    from paddle_tpu.jit import hlo_audit
+
+    step, batch, sz = build_step(workload, on_tpu, sparse_path)
+    rep = step.hlo_cost_report(*batch)
+    label = workload + (f" [{sparse_path}]" if workload == "deepfm" else "")
+    print(hlo_audit.format_table(
+        rep, top_n=top_n,
+        title=f"== {label}: per-op cost of one fused train step "
+              f"(bs={sz['batch_sizes'][0]}) =="))
+    if workload == "deepfm":
+        hits = hlo_audit.vocab_sized_ops(rep, sz["vocab"], top_n=top_n)
+        print(f"   vocab-sized (>= {sz['vocab']} rows) ops streamed in "
+              f"top-{top_n}: {len(hits)}"
+              + "".join(f"\n     - {h['opcode']} {h['shape']}"
+                        for h in hits))
+    print()
+    return rep
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("workload", nargs="?", default="all",
+                   choices=WORKLOADS + ("all",))
+    p.add_argument("--top", type=int, default=12)
+    p.add_argument("--sparse-path", default="both",
+                   choices=("lazy", "dense", "both"),
+                   help="deepfm only: which embedding-gradient path(s)")
+    args = p.parse_args(argv)
+
+    on_tpu = True
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        pass
+
+    names = WORKLOADS if args.workload == "all" else (args.workload,)
+    for name in names:
+        if name == "deepfm" and args.sparse_path == "both":
+            audit_workload(name, on_tpu, args.top, "dense")
+            audit_workload(name, on_tpu, args.top, "lazy")
+        else:
+            audit_workload(name, on_tpu, args.top,
+                           args.sparse_path if name == "deepfm" else "lazy")
+
+
+if __name__ == "__main__":
+    main()

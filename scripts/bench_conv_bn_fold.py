@@ -1,0 +1,130 @@
+"""Conv-BN fold A/B probe for inference-style eval steps (ISSUE 6,
+measured-first discipline).
+
+Question: does folding BatchNorm into the preceding conv
+(``paddle.incubate.fold_conv_bn``) speed up a jit-compiled eval forward
+for the conv-heavy workloads (resnet / ppyoloe backbone), or does XLA
+already fuse the BN affine into the conv epilogue, making the fold a
+no-op? PERF.md's round-4 lesson says don't guess — measure both arms and
+record the verdict (kept OR reverted) in the round table.
+
+Both arms run the SAME eval model (identical seeds/weights, eval mode,
+one compiled forward via the fused functional path), differing only in
+whether ``fold_conv_bn`` ran before compilation. Outputs must agree to
+float tolerance (the fold is an exact algebraic rewrite up to rounding);
+wall time over >= 20 compiled forwards, compile excluded.
+
+Usage:
+  python scripts/bench_conv_bn_fold.py [--model resnet|ppyoloe]
+      [--steps 30] [--batch-size 4] [--img 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(name, on_tpu):
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    np.random.seed(0)
+    if name == "resnet":
+        from paddle_tpu.vision import models
+
+        if on_tpu:
+            m = models.ResNet(models.BottleneckBlock, 50, num_classes=1000)
+        else:
+            m = models.ResNet(models.BasicBlock, 18, num_classes=1000)
+    else:
+        from paddle_tpu.vision.models import PPYOLOE, PPYOLOEConfig
+
+        cfg = (PPYOLOEConfig(depth_mult=0.33, width_mult=0.50) if on_tpu
+               else PPYOLOEConfig(num_classes=4, depth_mult=0.33,
+                                  width_mult=0.25, max_boxes=4))
+        m = PPYOLOE(cfg)
+    m.eval()
+    return m
+
+
+def run_arm(name, fold, on_tpu, bs, img, steps):
+    """One probe arm: fresh identically-seeded eval model, optionally
+    folded, one jitted forward executable, timed over ``steps`` runs."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import functional_call, params_dict
+
+    m = build_model(name, on_tpu)
+    folded = 0
+    if fold:
+        folded = paddle.incubate.fold_conv_bn(m)
+    params = params_dict(m, include_buffers=True)
+
+    @jax.jit
+    def fwd(params, x):
+        out = functional_call(m, params, x)
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(bs, 3, img, img).astype(np.float32))._data
+    out = jax.block_until_ready(fwd(params, x))  # compile, excluded
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fwd(params, x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(bs * steps / dt, 1),
+            "folded_pairs": folded, "wall_s": round(dt, 4),
+            "out": np.asarray(out)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="resnet",
+                   choices=("resnet", "ppyoloe"))
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--img", type=int, default=64)
+    args = p.parse_args(argv)
+
+    on_tpu = True
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        pass
+
+    base = run_arm(args.model, False, on_tpu, args.batch_size, args.img,
+                   args.steps)
+    fold = run_arm(args.model, True, on_tpu, args.batch_size, args.img,
+                   args.steps)
+    close = bool(np.allclose(base.pop("out"), fold.pop("out"),
+                             rtol=1e-3, atol=1e-4))
+    out = {
+        "workload": f"{args.model}_eval_conv_bn_fold_ab",
+        "batch_size": args.batch_size, "img": args.img,
+        "steps": args.steps,
+        "images_per_sec_unfolded": base["images_per_sec"],
+        "images_per_sec_folded": fold["images_per_sec"],
+        "fold_speedup": round(fold["images_per_sec"]
+                              / base["images_per_sec"], 3),
+        "folded_pairs": fold["folded_pairs"],
+        "outputs_close": close,
+    }
+    print(json.dumps(out))
+    if not close:
+        sys.exit("FAIL: folded outputs diverge from unfolded")
+
+
+if __name__ == "__main__":
+    main()

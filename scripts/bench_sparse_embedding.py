@@ -1,0 +1,151 @@
+"""Dense-vs-lazy sparse-embedding A/B (ISSUE 6, PERF.md discipline).
+
+Drives the SAME identically-seeded DeepFM training stream through the
+fused train step twice, differing ONLY in ``Adam(lazy_mode=...)``:
+
+  dense  every step materializes the vocab-sized embedding gradient
+         (scatter-add) and streams the full table + both Adam moments
+         through memory to update ~batchxfields rows
+  lazy   the lookup's backward yields (row_ids, row_grads) at the static
+         batchxfields bound (ops/sparse_grad.py) and the optimizer runs
+         gather→update→scatter over touched rows only
+
+Methodology (PERF.md A/B rules):
+- identical seeds: both arms build the same init and batch sequence;
+- wall time over >= 20 steps, compile/warmup excluded (identical effect
+  in both arms — the steady-state update path is the effect under test);
+- bit-compared losses where applicable: the FIRST step's loss must be
+  bit-equal (same params, and the capture's zero-delta forward is
+  bit-identical to the dense gather). Later losses legitimately diverge:
+  lazy-mode Adam is a different optimizer by design — untouched rows'
+  moments do not decay (the reference's documented lazy semantics). The
+  per-row update parity (touched rows exact, untouched bit-identical) is
+  asserted in tests/test_sparse_embedding.py.
+
+The harness (``default_sizing`` / ``build_step`` / ``run_arm``) is also
+imported by the slow-tier acceptance test so the probe and the test
+cannot drift. The default CPU sizing keeps the REAL deepfm vocab
+(1,000,001 rows): the dense arm's pain is the full-table stream, so
+shrinking the table would benchmark a different problem.
+
+Usage:
+  python scripts/bench_sparse_embedding.py [--steps 20] [--batch-size 256]
+      [--vocab 1000001] [--tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_sizing(tiny=False):
+    """(vocab, nfield, dense_dim, layer_sizes, bs, steps) shared by the
+    probe and the slow-tier acceptance test. ``tiny`` shrinks the DNN and
+    step count but keeps the criteo vocab — the dense-arm table stream IS
+    the measured effect."""
+    if tiny:
+        return 1000001, 26, 13, (64, 32), 128, 20
+    return 1000001, 26, 13, (512, 256, 128), 256, 24
+
+
+def build_step(vocab, nfield, dense_dim, layer_sizes, lazy):
+    """Identically-seeded DeepFM fused step; only lazy_mode differs."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models import DeepFM
+
+    paddle.seed(0)
+    np.random.seed(0)
+
+    class WithLoss(paddle.nn.Layer):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, ids, dense, label):
+            return F.binary_cross_entropy(self.inner(ids, dense), label)
+
+    m = DeepFM(vocab, 9, dense_dim, nfield, layer_sizes=layer_sizes)
+    m.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters(), lazy_mode=lazy)
+    return paddle.incubate.fused_train_step(WithLoss(m), opt)
+
+
+def make_batches(vocab, nfield, dense_dim, bs, steps, seed=1):
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(seed)
+    return [(paddle.to_tensor(
+                 rng.randint(0, vocab, (bs, nfield)).astype(np.int32)),
+             paddle.to_tensor(rng.randn(bs, dense_dim).astype(np.float32)),
+             paddle.to_tensor(
+                 rng.randint(0, 2, (bs, 1)).astype(np.float32)))
+            for _ in range(steps + 1)]  # +1 warmup batch
+
+
+def run_arm(lazy, vocab, nfield, dense_dim, layer_sizes, bs, steps,
+            seed=1):
+    """One A/B arm: fresh identically-seeded step + identical stream.
+    Returns examples/s over ``steps`` timed steps (warmup excluded) and
+    the per-step losses."""
+    step = build_step(vocab, nfield, dense_dim, layer_sizes, lazy)
+    batches = make_batches(vocab, nfield, dense_dim, bs, steps, seed)
+    losses = [float(step(*batches[0]).numpy())]  # compile + warmup
+    t0 = time.perf_counter()
+    for b in batches[1:]:
+        losses.append(float(step(*b).numpy()))
+    dt = time.perf_counter() - t0
+    return {"examples_per_sec": round(steps * bs / dt, 1),
+            "loss": losses, "wall_s": round(dt, 3)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--vocab", type=int, default=None)
+    p.add_argument("--tiny", action="store_true",
+                   help="smaller DNN / fewer steps (test sizing)")
+    args = p.parse_args(argv)
+
+    vocab, nfield, dense_dim, layers, bs, steps = default_sizing(args.tiny)
+    vocab = args.vocab or vocab
+    bs = args.batch_size or bs
+    steps = args.steps or steps
+    if steps < 20:
+        print(f"WARNING: --steps {steps} < 20 breaks the PERF.md wall-time "
+              "discipline", file=sys.stderr)
+
+    dense = run_arm(False, vocab, nfield, dense_dim, layers, bs, steps)
+    lazy = run_arm(True, vocab, nfield, dense_dim, layers, bs, steps)
+    speedup = lazy["examples_per_sec"] / dense["examples_per_sec"]
+    out = {
+        "workload": "deepfm_sparse_embedding_ab",
+        "vocab": vocab, "batch_size": bs, "steps": steps,
+        "examples_per_sec_dense": dense["examples_per_sec"],
+        "examples_per_sec_lazy": lazy["examples_per_sec"],
+        "lazy_speedup": round(speedup, 3),
+        # first step: same init, and the capture's zero-delta forward must
+        # be bit-identical to the dense gather
+        "first_loss_bit_equal": dense["loss"][0] == lazy["loss"][0],
+        "note": "later losses diverge by design: lazy Adam leaves "
+                "untouched rows' moments undecayed (reference lazy_mode "
+                "semantics); row-update parity is asserted in "
+                "tests/test_sparse_embedding.py",
+    }
+    print(json.dumps(out))
+    if not out["first_loss_bit_equal"]:
+        sys.exit("FAIL: first-step losses differ between arms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Bench regression tripwire (ISSUE 6 satellite; PERF.md round-6 promise).
+
+PERF.md's round-6 note bounded the r5 deepfm/bert drift as noise and
+promised "a tripwire for r6" — this closes it in code instead of prose.
+For every metric in the LATEST ``BENCH_r*.json`` artifact:
+
+1. **round-over-round floor**: ``value >= ratio x previous round's value``
+   (default 0.95 — the same noise bound PERF.md's round-6 note used);
+2. **MFU floor**: ``mfu >= mfu_floor`` when the line carries both (bench
+   lines emit ``mfu_floor`` per workload since round 7; for older
+   artifacts the floor falls back to ``bench.MFU_FLOORS``).
+
+A metric that first appears in the latest round has no previous value and
+only gets the MFU check. Exits 1 with one ``FAIL`` line per violation —
+wire it after the bench run so a regressing round cannot land silently.
+The fast test in tests/test_perf_tools.py runs these checks on the
+repo's committed artifacts (tier-1), so the tripwire itself cannot rot.
+
+Usage:
+  python scripts/check_bench_regression.py [--dir REPO_ROOT]
+      [--ratio 0.95] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+
+def load_rounds(dirpath):
+    """{round number: {metric: record}} from every BENCH_r*.json (each
+    artifact stores the bench run's stdout tail: one JSON line per
+    workload)."""
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(dirpath, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            data = json.load(open(path))
+        except Exception:
+            continue
+        recs = {}
+        for line in str(data.get("tail", "")).splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            if rec.get("metric") and rec.get("value"):
+                recs[rec["metric"]] = rec
+        if recs:
+            rounds[int(m.group(1))] = recs
+    return rounds
+
+
+def default_floors():
+    """Per-metric MFU floors for artifacts predating the in-line
+    ``mfu_floor`` field — bench.py owns the numbers."""
+    try:
+        import bench
+
+        return dict(bench.MFU_FLOORS)
+    except Exception:
+        return {}
+
+
+def check(rounds, ratio=0.95, floors=None):
+    """Failure strings for the latest round (empty == all clear)."""
+    if not rounds:
+        return ["FAIL: no BENCH_r*.json artifacts found"]
+    floors = dict(default_floors() if floors is None else floors)
+    latest = max(rounds)
+    prev_rounds = sorted((r for r in rounds if r < latest), reverse=True)
+    failures = []
+    # a workload that crashed (or emitted value 0, filtered at load) has
+    # no line in the latest round — the tripwire must treat a VANISHED
+    # metric as a regression, not silently shrink its coverage. The
+    # lookback spans the last 3 prior rounds, so a metric that stays
+    # broken keeps failing instead of dropping out after one round
+    # (absent 4+ rounds = deliberately retired).
+    expected = {}
+    for r in prev_rounds[:3]:
+        for metric in rounds[r]:
+            expected.setdefault(metric, r)
+    for metric, r in sorted(expected.items()):
+        if metric not in rounds[latest]:
+            failures.append(
+                f"FAIL {metric}: present in r{r} but missing from "
+                f"r{latest} (workload crashed or reported no value)")
+    for metric, rec in sorted(rounds[latest].items()):
+        value = rec["value"]
+        # round-over-round: compare against the most recent earlier round
+        # that measured this metric
+        for r in prev_rounds:
+            prev = rounds[r].get(metric)
+            if prev and prev.get("value"):
+                floor = ratio * prev["value"]
+                if value < floor:
+                    failures.append(
+                        f"FAIL {metric}: r{latest} value {value} < "
+                        f"{ratio} x r{r} value {prev['value']} "
+                        f"(= {floor:.1f})")
+                break
+        mfu = rec.get("mfu")
+        mfu_floor = rec.get("mfu_floor")
+        if mfu_floor is None:
+            mfu_floor = floors.get(metric)
+        if mfu_floor is None:
+            continue  # workload with no floor: nothing to hold
+        if mfu is None:
+            # a floored workload that stopped reporting MFU is LOST
+            # telemetry, not a pass — cost_analysis breaking must not
+            # silently disarm the floor
+            failures.append(
+                f"FAIL {metric}: r{latest} has mfu_floor {mfu_floor} but "
+                "no mfu value (MFU telemetry lost)")
+        elif mfu < mfu_floor:
+            failures.append(
+                f"FAIL {metric}: r{latest} mfu {mfu} < floor {mfu_floor}")
+    return failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=_REPO,
+                   help="directory holding BENCH_r*.json artifacts")
+    p.add_argument("--ratio", type=float, default=0.95)
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable summary line")
+    args = p.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    failures = check(rounds, ratio=args.ratio)
+    latest = max(rounds) if rounds else None
+    if args.json:
+        print(json.dumps({"latest_round": latest,
+                          "checked_metrics":
+                              len(rounds.get(latest, {})) if rounds else 0,
+                          "failures": failures}))
+    else:
+        for f in failures:
+            print(f)
+        if not failures:
+            n = len(rounds.get(latest, {})) if rounds else 0
+            print(f"OK: round {latest}, {n} metrics within "
+                  f"{args.ratio}x of prior round and above MFU floors")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

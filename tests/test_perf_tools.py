@@ -1,0 +1,277 @@
+"""Perf measurement tooling (ISSUE 6): the per-op HLO cost audit, the
+bench regression tripwire, and the conv-BN fold probe utility."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scripts():
+    p = os.path.join(_REPO, "scripts")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# HLO cost audit
+# ---------------------------------------------------------------------------
+class TestHloAudit:
+    def test_audit_simple_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.jit import hlo_audit
+
+        def f(a, b):
+            return jnp.tanh(a @ b).sum()
+
+        compiled = jax.jit(f).lower(jnp.zeros((64, 32)),
+                                    jnp.zeros((32, 16))).compile()
+        rep = hlo_audit.audit(compiled)
+        assert rep["n_ops"] >= 1
+        assert rep["total_bytes"] > 0
+        # the dot dominates flops: 2*64*32*16
+        assert rep["total_flops"] >= 2 * 64 * 32 * 16
+        table = hlo_audit.format_table(rep, top_n=5)
+        assert "MBytes" in table and "MFLOPs" in table
+
+    def test_parsed_flops_track_backend(self):
+        """The per-op estimate is for ranking, but its total must stay
+        within a small factor of XLA's own aggregate on a matmul model."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.jit import hlo_audit
+
+        def f(w1, w2, x):
+            h = jnp.maximum(x @ w1, 0.0)
+            return (h @ w2).sum()
+
+        g = jax.jit(jax.grad(f, argnums=(0, 1)))
+        compiled = g.lower(jnp.zeros((64, 64)), jnp.zeros((64, 8)),
+                           jnp.zeros((32, 64))).compile()
+        rep = hlo_audit.audit(compiled)
+        bf = rep["backend_flops"]
+        if bf:  # some backends report nothing — then there is no anchor
+            assert rep["total_flops"] < 3 * bf
+            assert rep["total_flops"] > bf / 3
+
+    def test_fused_step_report_and_vocab_probe(self):
+        """ISSUE 6 acceptance on the deepfm shape: the dense path streams
+        vocab-sized scatter/update ops in its top entries; the lazy path's
+        top entries contain none."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.jit import hlo_audit
+        from paddle_tpu.models import DeepFM
+
+        vocab, nf, dd = 10001, 26, 13
+
+        class WithLoss(paddle.nn.Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+
+            def forward(self, ids, dense, label):
+                return F.binary_cross_entropy(self.inner(ids, dense),
+                                              label)
+
+        def build(lazy):
+            paddle.seed(7)
+            np.random.seed(7)
+            m = DeepFM(vocab, 9, dd, nf, layer_sizes=(64, 32))
+            m.train()
+            opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=m.parameters(),
+                                        lazy_mode=lazy)
+            return paddle.incubate.fused_train_step(WithLoss(m), opt)
+
+        rng = np.random.RandomState(0)
+        batch = (paddle.to_tensor(
+                     rng.randint(0, vocab, (64, nf)).astype(np.int32)),
+                 paddle.to_tensor(rng.randn(64, dd).astype(np.float32)),
+                 paddle.to_tensor(
+                     rng.randint(0, 2, (64, 1)).astype(np.float32)))
+        rep_dense = build(False).hlo_cost_report(*batch)
+        rep_lazy = build(True).hlo_cost_report(*batch)
+        assert hlo_audit.vocab_sized_ops(rep_dense, vocab, top_n=10)
+        assert not hlo_audit.vocab_sized_ops(rep_lazy, vocab, top_n=10)
+
+
+# ---------------------------------------------------------------------------
+# bench regression tripwire
+# ---------------------------------------------------------------------------
+def _rounds(**by_round):
+    """{round: {metric: rec}} from {metric: value or (value, mfu)}."""
+    out = {}
+    for r, metrics in by_round.items():
+        rnd = {}
+        for m, v in metrics.items():
+            rec = {"metric": m, "value": v[0] if isinstance(v, tuple)
+                   else v}
+            if isinstance(v, tuple):
+                rec["mfu"] = v[1]
+            rnd[m] = rec
+        out[int(r.lstrip("r"))] = rnd
+    return out
+
+
+class TestBenchRegression:
+    def test_repo_artifacts_pass(self):
+        """The tier-1 wiring: the committed BENCH_r*.json history must be
+        within the tripwire (r5's worst vs_prev_round is 0.969)."""
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = cbr.load_rounds(_REPO)
+        assert len(rounds) >= 2
+        failures = cbr.check(rounds)
+        assert failures == [], failures
+
+    def test_value_regression_detected(self):
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": 100.0}, r2={"m": 90.0})
+        fails = cbr.check(rounds, ratio=0.95, floors={})
+        assert len(fails) == 1 and "m" in fails[0]
+        assert cbr.check(_rounds(r1={"m": 100.0}, r2={"m": 96.0}),
+                         floors={}) == []
+
+    def test_mfu_floor_detected(self):
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": (100.0, 0.5)}, r2={"m": (100.0, 0.3)})
+        fails = cbr.check(rounds, floors={"m": 0.4})
+        assert len(fails) == 1 and "mfu" in fails[0]
+        # in-line mfu_floor wins over the fallback table
+        rounds[2]["m"]["mfu_floor"] = 0.2
+        assert cbr.check(rounds, floors={"m": 0.4}) == []
+
+    def test_vanished_metric_fails(self):
+        """A workload that crashes before emitting its line must trip the
+        wire, not silently shrink coverage."""
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": 100.0, "k": 10.0}, r2={"m": 100.0})
+        fails = cbr.check(rounds, floors={})
+        assert len(fails) == 1 and "k" in fails[0] and "missing" in fails[0]
+
+    def test_vanished_metric_keeps_failing_across_rounds(self):
+        """A metric missing for two consecutive rounds must still fail
+        (3-round lookback), not drop out of coverage after one flag."""
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": 100.0, "k": 10.0}, r2={"m": 100.0},
+                         r3={"m": 100.0})
+        fails = cbr.check(rounds, floors={})
+        assert len(fails) == 1 and "k" in fails[0] and "missing" in fails[0]
+        # absent 4+ rounds = retired: no longer expected
+        rounds = _rounds(r1={"k": 10.0}, r2={"m": 1.0}, r3={"m": 1.0},
+                         r4={"m": 1.0}, r5={"m": 1.0})
+        assert cbr.check(rounds, floors={}) == []
+
+    def test_lost_mfu_telemetry_fails_floored_metric(self):
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": (100.0, 0.5)}, r2={"m": 100.0})
+        fails = cbr.check(rounds, floors={"m": 0.4})
+        assert len(fails) == 1 and "telemetry" in fails[0]
+        # no floor -> no mfu obligation
+        assert cbr.check(rounds, floors={}) == []
+
+    def test_new_metric_without_history_only_mfu_checked(self):
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": 100.0}, r2={"m": 100.0, "new": 5.0})
+        assert cbr.check(rounds, floors={}) == []
+
+    def test_metric_skipping_a_round_compares_last_seen(self):
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r1={"m": 100.0, "k": 10.0}, r2={"m": 100.0},
+                         r3={"m": 100.0, "k": 5.0})
+        fails = cbr.check(rounds, floors={})
+        assert len(fails) == 1 and "k" in fails[0]
+
+    def test_cli_json(self):
+        """The script's CLI contract the driver/CI calls."""
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "check_bench_regression.py"),
+             "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["failures"] == [] and rec["latest_round"] >= 5
+
+
+# ---------------------------------------------------------------------------
+# conv-BN fold
+# ---------------------------------------------------------------------------
+class TestConvBnFold:
+    def _model(self):
+        paddle.seed(0)
+        np.random.seed(0)
+        m = paddle.nn.Sequential(
+            paddle.nn.Conv2D(3, 8, 3, padding=1, bias_attr=False),
+            paddle.nn.BatchNorm2D(8),
+            paddle.nn.ReLU(),
+            paddle.nn.Conv2D(8, 4, 3, padding=1),
+            paddle.nn.BatchNorm2D(4),
+        )
+        # non-trivial BN stats (fresh BN is an identity transform)
+        m.train()
+        x = paddle.to_tensor(np.random.randn(4, 3, 8, 8).astype(np.float32))
+        for _ in range(3):
+            m(x)
+        m.eval()
+        return m
+
+    def test_fold_is_numerically_equivalent(self):
+        m = self._model()
+        x = paddle.to_tensor(np.random.randn(2, 3, 8, 8).astype(np.float32))
+        ref = np.asarray(m(x)._data)
+        n = paddle.incubate.fold_conv_bn(m)
+        assert n == 2
+        got = np.asarray(m(x)._data)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        # the BNs are gone from the module tree
+        from paddle_tpu.nn.layer.norm import BatchNorm2D
+
+        assert not any(isinstance(s, BatchNorm2D) for s in m.sublayers())
+
+    def test_fold_refuses_training_mode(self):
+        m = self._model()
+        m.train()
+        with pytest.raises(RuntimeError, match="eval"):
+            paddle.incubate.fold_conv_bn(m)
+
+    def test_fold_resnet_block(self):
+        from paddle_tpu.vision import models
+
+        paddle.seed(1)
+        m = models.ResNet(models.BasicBlock, 18, num_classes=10)
+        m.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+        ref = np.asarray(m(x)._data)
+        n = paddle.incubate.fold_conv_bn(m)
+        assert n >= 17  # 20 convs; stem + blocks fold
+        got = np.asarray(m(x)._data)
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

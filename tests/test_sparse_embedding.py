@@ -1,0 +1,590 @@
+"""Row-sparse embedding gradients + lazy Adam (ISSUE 6).
+
+Parity contract (the reference's ``Adam(lazy_mode=True)`` / SelectedRows
+semantics): vs one dense-Adam step from identical state, the lazy update
+is EXACT on touched rows and bit-identical (never written) on untouched
+rows — including repeated ids (segment-sum dedup), ``padding_idx`` rows
+and weight decay (applied to touched rows only). The fused
+(``FusedTrainStep``) and eager paths are both covered, plus the
+``state_dict`` round-trip through ``CheckpointManager.auto_resume`` (the
+PR-2/4 bit-exact resume contract must hold for row-sparse moments)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.ps import SparseEmbedding
+from paddle_tpu.ops import sparse_grad
+
+VOCAB, DIM, NF = 97, 5, 6
+
+
+# ---------------------------------------------------------------------------
+# segment_rows: static-size dedup
+# ---------------------------------------------------------------------------
+class TestSegmentRows:
+    def test_sum_dedup(self):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray([7, 3, 7, 1, 3, 7], jnp.int32)
+        vals = jnp.asarray(np.arange(12, dtype=np.float32).reshape(6, 2))
+        uq, uv, valid = sparse_grad.segment_rows(ids, vals, combine="add")
+        assert int(valid.sum()) == 3
+        got = {int(uq[i]): np.asarray(uv[i]) for i in range(3)}
+        ref = {}
+        for i, r in enumerate(np.asarray(ids)):
+            ref.setdefault(int(r), np.zeros(2, np.float32))
+            ref[int(r)] += np.asarray(vals)[i]
+        for r, v in ref.items():
+            np.testing.assert_array_equal(got[r], v)
+        # dead slots hold exact zeros (they feed norm sums unmasked)
+        np.testing.assert_array_equal(np.asarray(uv[3:]),
+                                      np.zeros((3, 2), np.float32))
+
+    def test_set_dedup_keeps_one_representative(self):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray([4, 4, 4], jnp.int32)
+        vals = jnp.full((3, 2), 5.0, jnp.float32)
+        uq, uv, valid = sparse_grad.segment_rows(ids, vals, combine="set")
+        assert int(valid.sum()) == 1
+        np.testing.assert_array_equal(np.asarray(uv[0]), [5.0, 5.0])
+
+    def test_empty(self):
+        import jax.numpy as jnp
+
+        ids = jnp.zeros((0,), jnp.int32)
+        vals = jnp.zeros((0, 3), jnp.float32)
+        uq, uv, valid = sparse_grad.segment_rows(ids, vals)
+        assert uq.shape == (0,) and valid.shape == (0,)
+
+    def test_all_unique(self):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray([9, 2, 5], jnp.int32)
+        vals = jnp.asarray(np.eye(3, dtype=np.float32))
+        uq, uv, valid = sparse_grad.segment_rows(ids, vals)
+        assert int(valid.sum()) == 3
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def build_eager(lazy, mode="adam", wd=None, padding_idx=None, lr=0.05,
+                seed=11):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    emb = SparseEmbedding(VOCAB, DIM, padding_idx=padding_idx)
+    lin = paddle.nn.Linear(DIM, 1)
+    params = list(emb.parameters()) + list(lin.parameters())
+    cls = paddle.optimizer.Adam if mode == "adam" else paddle.optimizer.AdamW
+    kw = dict(learning_rate=lr, parameters=params, lazy_mode=lazy)
+    if wd is not None:
+        kw["weight_decay"] = wd
+    opt = cls(**kw)
+    return emb, lin, opt
+
+
+def eager_step(emb, lin, opt, ids_np):
+    ids = paddle.to_tensor(ids_np)
+    loss = (lin(emb(ids)) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def init_table(padding_idx=None, seed=11):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    return np.asarray(
+        SparseEmbedding(VOCAB, DIM, padding_idx=padding_idx).weight._data)
+
+
+IDS = np.array([[3, 9, 3, 41, 9, 3], [9, 41, 0, 0, 7, 88]], np.int32)
+
+
+# ---------------------------------------------------------------------------
+# eager lazy parity
+# ---------------------------------------------------------------------------
+class TestEagerLazyParity:
+    @pytest.mark.parametrize("mode,wd", [
+        ("adam", None),          # no decay
+        ("adam", 0.1),           # coupled L2 — touched rows only in lazy
+        ("adamw", 0.05),         # decoupled decay — touched rows only
+    ])
+    def test_single_step_parity(self, mode, wd):
+        ed, ld, od = build_eager(False, mode, wd)
+        el, ll, ol = build_eager(True, mode, wd)
+        l_d = eager_step(ed, ld, od, IDS)
+        l_l = eager_step(el, ll, ol, IDS)
+        assert l_d == l_l  # identical forward
+        a = np.asarray(ed.weight._data)
+        b = np.asarray(el.weight._data)
+        touched = np.unique(IDS)
+        untouched = np.setdiff1d(np.arange(VOCAB), touched)
+        # exact on touched rows (same per-element arithmetic as dense)
+        np.testing.assert_array_equal(a[touched], b[touched])
+        # untouched rows NEVER written: bit-identical to init — under
+        # coupled L2 the dense path moves them (g=wd*p), lazy must not
+        np.testing.assert_array_equal(init_table()[untouched],
+                                      b[untouched])
+        # dense (non-table) params take the identical dense path
+        np.testing.assert_array_equal(np.asarray(ld.weight._data),
+                                      np.asarray(ll.weight._data))
+
+    def test_weight_decay_touched_rows_only(self):
+        # with pure decay pressure, an untouched row must stay at init on
+        # the lazy arm even though dense Adam decays it every step
+        ed, ld, od = build_eager(False, "adam", 0.5)
+        el, ll, ol = build_eager(True, "adam", 0.5)
+        for _ in range(3):
+            eager_step(ed, ld, od, IDS)
+            eager_step(el, ll, ol, IDS)
+        untouched = np.setdiff1d(np.arange(VOCAB), np.unique(IDS))
+        a = np.asarray(ed.weight._data)[untouched]
+        b = np.asarray(el.weight._data)[untouched]
+        init = init_table()[untouched]
+        assert not np.array_equal(a, init)  # dense DID move them
+        np.testing.assert_array_equal(b, init)  # lazy did not
+
+    def test_multistep_matches_numpy_lazy_reference(self):
+        """3 steps of eager lazy Adam vs a from-scratch numpy
+        implementation of Paddle's lazy semantics (global-step bias
+        correction, touched-rows-only moments)."""
+        el, ll, ol = build_eager(True, "adam", None, lr=0.05)
+        w_hist = [np.asarray(el.weight._data).copy()]
+        batches = [IDS, IDS[:, ::-1].copy(), (IDS + 1) % VOCAB]
+        for b in batches:
+            eager_step(el, ll, ol, b)
+            w_hist.append(np.asarray(el.weight._data).copy())
+
+        # replay with numpy on the embedding table only
+        e2, l2, o2 = build_eager(True, "adam", None, lr=0.05)
+        w = np.asarray(e2.weight._data).copy()
+        m1 = np.zeros_like(w)
+        m2 = np.zeros_like(w)
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.05
+        for t, ids_np in enumerate(batches, 1):
+            # capture the true dense grad of this step from autograd
+            ids = paddle.to_tensor(ids_np)
+            loss = (l2(e2(ids)) ** 2).sum()
+            loss.backward()
+            g = np.asarray(e2.weight.grad._data)
+            rows = np.unique(ids_np)
+            gf = g[rows]
+            m1[rows] = b1 * m1[rows] + (1 - b1) * gf
+            m2[rows] = b2 * m2[rows] + (1 - b2) * gf * gf
+            m1h = m1[rows] / (1 - b1 ** t)
+            m2h = m2[rows] / (1 - b2 ** t)
+            w[rows] = w[rows] - lr * m1h / (np.sqrt(m2h) + eps)
+            o2.step()  # advance the real optimizer in lockstep
+            o2.clear_grad()
+            # numpy vs XLA differ by ~1 ULP per step (operation ordering)
+            np.testing.assert_allclose(np.asarray(e2.weight._data), w,
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_multi_precision_warns_once_and_falls_back(self):
+        p = paddle.Parameter(np.zeros((4, 2), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            opt = paddle.optimizer.Adam(parameters=[p],
+                                        multi_precision=True)
+        assert sum("multi_precision" in str(x.message) for x in w) == 1
+        # the fallback still trains (dense fp32-compute path)
+        from paddle_tpu.core.tensor import Tensor
+
+        p.grad = Tensor(np.ones((4, 2), np.float32))
+        opt.step()
+        assert not np.array_equal(np.asarray(p._data),
+                                  np.zeros((4, 2), np.float32))
+
+    def test_flags_roundtrip_state_dict(self):
+        p = paddle.Parameter(np.zeros((4, 2), np.float32))
+        opt = paddle.optimizer.Adam(parameters=[p], lazy_mode=True)
+        sd = opt.state_dict()
+        assert sd["lazy_mode"] is True and sd["multi_precision"] is False
+        opt2 = paddle.optimizer.Adam(parameters=[p])
+        assert not opt2.lazy_mode
+        opt2.set_state_dict(sd)
+        assert opt2.lazy_mode and not opt2.multi_precision
+
+
+# ---------------------------------------------------------------------------
+# fused (in-graph) lazy parity
+# ---------------------------------------------------------------------------
+class MiniSparse(paddle.nn.Layer):
+    """Two tables (one via fused lookup+pool) + a dense head."""
+
+    def __init__(self, padding_idx=None):
+        super().__init__()
+        self.emb = SparseEmbedding(VOCAB, DIM, padding_idx=padding_idx)
+        self.first = SparseEmbedding(VOCAB, 1, padding_idx=padding_idx)
+        self.lin = paddle.nn.Linear(DIM, 1)
+
+    def forward(self, ids, label):
+        out = (self.lin(self.emb(ids)).squeeze(-1).sum(-1, keepdim=True)
+               + self.first.pooled(ids, mode="sum"))
+        return ((out - label) ** 2).mean()
+
+
+def build_fused(lazy, padding_idx=None, seed=5, clip=None,
+                mode="adam", wd=None):
+    paddle.seed(seed)
+    np.random.seed(seed)
+    m = MiniSparse(padding_idx=padding_idx)
+    m.train()
+    cls = paddle.optimizer.Adam if mode == "adam" else paddle.optimizer.AdamW
+    kw = dict(learning_rate=0.05, parameters=m.parameters(),
+              lazy_mode=lazy, grad_clip=clip)
+    if wd is not None:
+        kw["weight_decay"] = wd
+    opt = cls(**kw)
+    return m, paddle.incubate.fused_train_step(m, opt)
+
+
+def batch_of(ids_np, seed=0):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(ids_np),
+            paddle.to_tensor(
+                rng.randn(ids_np.shape[0], 1).astype(np.float32)))
+
+
+class TestFusedLazyParity:
+    def test_detects_sparse_params_only_with_lazy(self):
+        _, step_lazy = build_fused(True)
+        _, step_dense = build_fused(False)
+        assert set(step_lazy._sparse_names) == {"emb.weight",
+                                               "first.weight"}
+        assert step_dense._sparse_names == ()
+
+    @pytest.mark.parametrize("mode,wd", [("adam", None), ("adamw", 0.05)])
+    def test_single_step_parity_with_repeated_ids(self, mode, wd):
+        md, sd = build_fused(False, mode=mode, wd=wd)
+        ml, sl = build_fused(True, mode=mode, wd=wd)
+        ids, label = batch_of(IDS)
+        l_d = float(sd(ids, label).numpy())
+        l_l = float(sl(ids, label).numpy())
+        assert l_d == l_l  # zero-delta capture forward is bit-identical
+        touched = np.unique(IDS)
+        untouched = np.setdiff1d(np.arange(VOCAB), touched)
+        for name in ("emb.weight", "first.weight"):
+            a = np.asarray(dict(md.named_parameters())[name]._data)
+            b = np.asarray(dict(ml.named_parameters())[name]._data)
+            np.testing.assert_array_equal(a[touched], b[touched],
+                                          err_msg=name)
+        # untouched rows bit-identical to init on the lazy arm
+        paddle.seed(5)
+        np.random.seed(5)
+        m0 = MiniSparse()
+        for name in ("emb.weight", "first.weight"):
+            init = np.asarray(dict(m0.named_parameters())[name]._data)
+            b = np.asarray(dict(ml.named_parameters())[name]._data)
+            np.testing.assert_array_equal(init[untouched], b[untouched],
+                                          err_msg=name)
+        # dense params bit-equal across arms
+        np.testing.assert_array_equal(
+            np.asarray(dict(md.named_parameters())["lin.weight"]._data),
+            np.asarray(dict(ml.named_parameters())["lin.weight"]._data))
+
+    def test_fused_matches_eager_lazy(self):
+        """Same lazy semantics through both engines (whole-graph grad vs
+        op-level autograd): trajectories must agree to float tolerance."""
+        ml, sl = build_fused(True)
+        me = MiniSparse()
+        paddle.seed(5)
+        np.random.seed(5)
+        me = MiniSparse()  # identical init
+        me.train()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=me.parameters(),
+                                    lazy_mode=True)
+        for t in range(3):
+            ids, label = batch_of((IDS + t) % VOCAB, seed=t)
+            lf = float(sl(ids, label).numpy())
+            loss = me(ids, label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            assert abs(lf - float(loss.numpy())) < 1e-5
+        for (n, pe), (_, pf) in zip(me.named_parameters(),
+                                    ml.named_parameters()):
+            np.testing.assert_allclose(np.asarray(pe._data),
+                                       np.asarray(pf._data),
+                                       rtol=1e-5, atol=1e-6, err_msg=n)
+
+    def test_padding_idx_row_never_updated(self):
+        pad = 3  # appears repeatedly in IDS
+        ml, sl = build_fused(True, padding_idx=pad, seed=9)
+        init = {n: np.asarray(p._data).copy()
+                for n, p in ml.named_parameters()}
+        for t in range(3):
+            ids, label = batch_of(IDS, seed=t)
+            sl(ids, label)
+        for name in ("emb.weight", "first.weight"):
+            got = np.asarray(dict(ml.named_parameters())[name]._data)
+            np.testing.assert_array_equal(got[pad], init[name][pad],
+                                          err_msg=name)
+            # non-pad touched rows DID move
+            assert not np.array_equal(got[9], init[name][9])
+
+    def test_global_norm_clip_on_sparse_path(self):
+        clip = paddle.nn.ClipGradByGlobalNorm(0.01)
+        md, sd = build_fused(False, clip=clip)
+        ml, sl = build_fused(True, clip=clip)
+        ids, label = batch_of(IDS)
+        assert float(sd(ids, label).numpy()) == float(sl(ids, label).numpy())
+        touched = np.unique(IDS)
+        for name in ("emb.weight", "first.weight"):
+            a = np.asarray(dict(md.named_parameters())[name]._data)
+            b = np.asarray(dict(ml.named_parameters())[name]._data)
+            # clip factor computed from the SAME global norm (dedup'd row
+            # grads sum to the dense table grad) — tolerance only for the
+            # reduction-order difference in the norm itself
+            np.testing.assert_allclose(a[touched], b[touched],
+                                       rtol=1e-5, atol=1e-7, err_msg=name)
+
+    def test_protect_mode_discards_sparse_update_in_graph(self):
+        from paddle_tpu.core import flags
+
+        ml, sl = build_fused(True)
+        ids, label = batch_of(IDS)
+        before = {n: np.asarray(p._data).copy()
+                  for n, p in ml.named_parameters()}
+        old = flags.flag_value("check_nan_inf_action", "none")
+        try:
+            paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+            bad = paddle.to_tensor(
+                np.full((IDS.shape[0], 1), np.nan, np.float32))
+            sl(ids, bad)
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf_action": old})
+        for n, p in ml.named_parameters():
+            np.testing.assert_array_equal(np.asarray(p._data), before[n],
+                                          err_msg=n)
+        assert sl.guard_stats()["skipped"] == 1
+
+    def test_checkpoint_roundtrip_auto_resume(self, tmp_path):
+        """PR-2/4 contract: save mid-training, keep training, then restore
+        into a FRESH model/step and replay — losses and row-sparse moments
+        must be bit-exact."""
+        from paddle_tpu.distributed.checkpoint.manager import \
+            CheckpointManager
+
+        ml, sl = build_fused(True, seed=21)
+        for t in range(2):
+            ids, label = batch_of((IDS + t) % VOCAB, seed=t)
+            sl(ids, label)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(2, model=ml, optimizer=sl)
+        cont = []
+        for t in range(2, 4):
+            ids, label = batch_of((IDS + t) % VOCAB, seed=t)
+            cont.append(float(sl(ids, label).numpy()))
+
+        m2, s2 = build_fused(True, seed=999)  # different init, on purpose
+        step = mgr.auto_resume(model=m2, optimizer=s2)
+        assert step == 2
+        replay = []
+        for t in range(2, 4):
+            ids, label = batch_of((IDS + t) % VOCAB, seed=t)
+            replay.append(float(s2(ids, label).numpy()))
+        assert cont == replay  # bit-exact resume
+        for (n, pa), (_, pb) in zip(ml.named_parameters(),
+                                    m2.named_parameters()):
+            np.testing.assert_array_equal(np.asarray(pa._data),
+                                          np.asarray(pb._data), err_msg=n)
+
+
+class TiedUse(paddle.nn.Layer):
+    """A sparse table ALSO consumed outside its lookup (tied read)."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = SparseEmbedding(VOCAB, DIM)
+        self.lin = paddle.nn.Linear(DIM, 1)
+
+    def forward(self, ids, label):
+        out = self.lin(self.emb(ids)).sum()
+        # direct (non-lookup) use of the table: its gradient is dense
+        return out + (self.emb.weight ** 2).sum() * 1e-3
+
+
+class TestLookupOnlySafetyGate:
+    def test_tied_use_falls_back_dense_with_warning(self):
+        paddle.seed(13)
+        np.random.seed(13)
+        m = TiedUse()
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=m.parameters(),
+                                    lazy_mode=True)
+        step = paddle.incubate.fused_train_step(m, opt)
+        w0 = np.asarray(m.emb.weight._data).copy()
+        ids, label = batch_of(IDS)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step(ids, label)
+        assert any("outside embedding lookups" in str(x.message)
+                   for x in w)
+        # the dense fallback keeps the direct-use gradient: EVERY row
+        # moves (the weight-norm term touches the whole table)
+        w1 = np.asarray(m.emb.weight._data)
+        untouched = np.setdiff1d(np.arange(VOCAB), np.unique(IDS))
+        assert not np.array_equal(w0[untouched], w1[untouched])
+
+    def test_lookup_only_tables_analysis(self):
+        import jax
+        import jax.numpy as jnp
+
+        w = jnp.ones((8, 3))
+        v = jnp.ones((8, 3))
+
+        def f():
+            safe_rows = jnp.take(jax.lax.stop_gradient(w),
+                                 jnp.array([1, 2]), axis=0)
+            return safe_rows.sum() + (v * 2).sum()  # v used directly
+
+        closed = jax.make_jaxpr(f)()
+        safe = sparse_grad.lookup_only_tables(closed, {"w": w, "v": v})
+        assert safe == {"w"}
+
+
+# ---------------------------------------------------------------------------
+# fused lookup+pool (embedding_bag)
+# ---------------------------------------------------------------------------
+class TestEmbeddingBag:
+    @pytest.mark.parametrize("mode", ["sum", "mean"])
+    def test_matches_unfused(self, mode):
+        paddle.seed(1)
+        w = paddle.Parameter(np.random.randn(VOCAB, DIM).astype(np.float32))
+        ids = paddle.to_tensor(IDS)
+        got = F.embedding_bag(ids, w, mode=mode)
+        rows = F.embedding(ids, w)
+        ref = rows.sum(-2) if mode == "sum" else rows.mean(-2)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(ref._data),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_pooled_mode_validated_on_both_paths(self):
+        class CF:
+            _name = "count_filter_entry"
+            _count = 1
+
+        plain = SparseEmbedding(10, 2)
+        filt = SparseEmbedding(10, 2, entry=CF())
+        plain.train()
+        filt.train()
+        x = paddle.to_tensor(np.array([[1, 2]], np.int32))
+        for layer in (plain, filt):
+            with pytest.raises(ValueError, match="mode"):
+                layer.pooled(x, mode="max")
+
+    def test_pooled_mean_entry_path_matches_embedding_bag(self):
+        """The entry-filtered eager path must use the same padding-aware
+        mean denominator as F.embedding_bag."""
+
+        class CF:
+            _name = "count_filter_entry"
+            _count = 1
+
+        paddle.seed(4)
+        a = SparseEmbedding(20, 3, padding_idx=0, entry=CF())
+        paddle.seed(4)
+        b = SparseEmbedding(20, 3, padding_idx=0)
+        a.train()
+        b.train()
+        x = paddle.to_tensor(np.array([[1, 0, 2], [0, 0, 5]], np.int32))
+        np.testing.assert_allclose(
+            np.asarray(a.pooled(x, mode="mean")._data),
+            np.asarray(b.pooled(x, mode="mean")._data),
+            rtol=1e-6, atol=1e-7)
+
+    def test_padding_idx_excluded_from_mean(self):
+        w = paddle.Parameter(np.ones((10, 2), np.float32))
+        ids = paddle.to_tensor(np.array([[1, 0, 2]], np.int32))
+        out = F.embedding_bag(ids, w, mode="mean", padding_idx=0)
+        # two live rows of ones → mean 1.0 (a padding-naive mean gives 2/3)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.ones((1, 2), np.float32))
+
+    def test_gradients_match_unfused(self):
+        paddle.seed(2)
+        wa = paddle.Parameter(np.random.randn(VOCAB, DIM).astype(np.float32))
+        wb = paddle.Parameter(np.asarray(wa._data).copy())
+        ids = paddle.to_tensor(IDS)
+        F.embedding_bag(ids, wa, mode="sum").sum().backward()
+        F.embedding(ids, wb).sum(-2).sum().backward()
+        np.testing.assert_allclose(np.asarray(wa.grad._data),
+                                   np.asarray(wb.grad._data),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_deepfm_first_order_unchanged(self):
+        """DeepFM's pooled first-order term computes the same model
+        function as the pre-fusion squeeze/sum formulation."""
+        from paddle_tpu.models import DeepFM
+
+        paddle.seed(3)
+        np.random.seed(3)
+        m = DeepFM(VOCAB, DIM, 4, NF, layer_sizes=(8,))
+        m.eval()
+        ids = paddle.to_tensor(IDS)
+        dense = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        out = m(ids, dense)
+        # reference recomputation with the unfused formulation
+        first = (m.first_order_weight(ids).squeeze(-1)
+                 .sum(-1, keepdim=True) + m.dense_linear(dense))
+        fields = paddle.concat(
+            [m.embedding(ids), m.dense_emb(dense).unsqueeze(1)], axis=1)
+        sum_sq = fields.sum(1) ** 2
+        sq_sum = (fields ** 2).sum(1)
+        second = 0.5 * (sum_sq - sq_sum).sum(-1, keepdim=True)
+        deep = m.dnn(fields.reshape([2, -1]))
+        ref = paddle.nn.functional.sigmoid(first + second + deep)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data),
+                                   rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# A/B harness (scripts/bench_sparse_embedding.py)
+# ---------------------------------------------------------------------------
+def _load_harness():
+    import importlib
+    import os
+    import sys
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    return importlib.import_module("bench_sparse_embedding")
+
+
+class TestSparseBenchHarness:
+    def test_arms_share_first_loss(self):
+        bse = _load_harness()
+        kw = dict(vocab=501, nfield=4, dense_dim=3, layer_sizes=(8,),
+                  bs=16, steps=3)
+        dense = bse.run_arm(False, **kw)
+        lazy = bse.run_arm(True, **kw)
+        assert dense["loss"][0] == lazy["loss"][0]
+        assert len(dense["loss"]) == len(lazy["loss"]) == 4
+
+    @pytest.mark.slow
+    def test_lazy_speedup_at_deepfm_config(self):
+        """ISSUE 6 acceptance: >= 2x examples/s on the dense-vs-lazy A/B
+        at CPU smoke scale with the REAL deepfm vocab."""
+        bse = _load_harness()
+        vocab, nfield, dense_dim, layers, bs, steps = \
+            bse.default_sizing(tiny=True)
+        dense = bse.run_arm(False, vocab, nfield, dense_dim, layers, bs,
+                            steps)
+        lazy = bse.run_arm(True, vocab, nfield, dense_dim, layers, bs,
+                           steps)
+        assert dense["loss"][0] == lazy["loss"][0]
+        speedup = lazy["examples_per_sec"] / dense["examples_per_sec"]
+        assert speedup >= 2.0, f"lazy speedup {speedup:.2f}x < 2x"
