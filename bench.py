@@ -547,6 +547,48 @@ def bench_overlap(on_tpu):
     })
 
 
+def bench_serving(on_tpu):
+    """LLM serving A/B (ISSUE 7 tentpole): one seeded Poisson multi-tenant
+    request stream replayed through a naive batch-of-one ``model.generate``
+    loop vs the paged-KV continuous-batching ``LLMEngine``. Greedy outputs
+    must be bit-exact across arms and the engine's decode graph must not
+    recompile inside the timed window (both asserted here — a serving win
+    that breaks either is a broken win). The harness lives in
+    scripts/bench_serving.py (single source, also the standalone probe and
+    the acceptance test)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import bench_serving as bsv
+
+    res = bsv.run_ab(tiny=not on_tpu)
+    assert res["bit_exact"], "engine diverged from batch-of-one greedy"
+    assert res["engine"]["decode_compiles_in_window"] == 0, \
+        "decode graph recompiled inside the timed window"
+    _emit({
+        "metric": "serving_engine_tokens_per_sec" if on_tpu
+                  else "serving_cpu_engine_tokens_per_sec",
+        "value": res["engine"]["tokens_per_sec"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "tokens_per_sec_naive": res["naive"]["tokens_per_sec"],
+        "serving_speedup": res["speedup"],
+        "p50_ms": res["engine"]["p50_ms"],
+        "p99_ms": res["engine"]["p99_ms"],
+        "p50_ms_naive": res["naive"]["p50_ms"],
+        "p99_ms_naive": res["naive"]["p99_ms"],
+        "bit_exact": res["bit_exact"],
+        "decode_compiles_in_window": res["engine"]["decode_compiles_in_window"],
+        "evictions": res["engine"]["evictions"],
+        "num_requests": res["num_requests"],
+        "max_batch_size": res["max_batch_size"],
+        "baseline_note": "A/B over one seeded Poisson request stream; "
+                         "compiles warmed in both arms (steady-state "
+                         "batching is the effect); greedy outputs "
+                         "bit-exact across arms",
+    })
+
+
 def make_llama(on_tpu):
     """Flagship llama workload builder, shared by main() and
     scripts/audit_hlo.py: ``build()`` returns ``(step, n_params)``."""
@@ -679,6 +721,8 @@ if __name__ == "__main__":
         bench_ppyoloe(_on_tpu)
     elif workload == "overlap":
         bench_overlap(_on_tpu)
+    elif workload == "serving":
+        bench_serving(_on_tpu)
     elif workload == "llama":
         main()
     elif workload == "all":
@@ -689,6 +733,7 @@ if __name__ == "__main__":
                    lambda: bench_bert(_on_tpu),
                    lambda: bench_bert_varlen(_on_tpu),
                    lambda: bench_overlap(_on_tpu),
+                   lambda: bench_serving(_on_tpu),
                    lambda: bench_ppyoloe(_on_tpu)):
             try:
                 fn()
@@ -697,4 +742,5 @@ if __name__ == "__main__":
         main()
     else:
         sys.exit(f"unknown workload {workload!r}; expected llama | resnet50 "
-                 "| deepfm | bert | bert_varlen | ppyoloe | overlap | all")
+                 "| deepfm | bert | bert_varlen | ppyoloe | overlap | "
+                 "serving | all")

@@ -19,7 +19,19 @@ import threading
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "PrecisionType", "PlaceType", "get_version",
+           "LLMEnginePredictor", "serving"]
+
+
+def __getattr__(name):
+    # lazy: the serving engine pulls jax at import; the facade should not
+    if name == "serving":
+        import importlib
+
+        mod = importlib.import_module(".serving", __name__)
+        globals()["serving"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class PrecisionType:
@@ -58,6 +70,8 @@ class Config:
         self._memory_optim = True
         self._cpu_math_threads = 1
         self._enable_profile = False
+        self._llm_engine = False
+        self._llm_engine_kwargs = {}
 
     # ---- model paths ----------------------------------------------------
     def set_model(self, prog_file, params_file=None):
@@ -110,11 +124,29 @@ class Config:
     def enable_profile(self):
         self._enable_profile = True
 
+    # ---- LLM serving engine (ISSUE 7 satellite) -------------------------
+    def enable_llm_engine(self, x=True, **engine_kwargs):
+        """Route llama serving artifacts (``serving.save_llama_artifact``
+        output, detected by the ``.llamacfg.json`` sidecar) through the
+        paged-KV continuous-batching ``serving.LLMEngine`` instead of the
+        StableHLO replay path. ``engine_kwargs`` forward to ``LLMEngine``
+        (``num_blocks``, ``block_size``, ``max_batch_size``, …). For any
+        other artifact the knob is accepted-and-recorded like the other
+        graph knobs: ``create_predictor`` still returns the plain
+        StableHLO :class:`Predictor`."""
+        self._llm_engine = bool(x)
+        if engine_kwargs:
+            self._llm_engine_kwargs.update(engine_kwargs)
+
+    def llm_engine_enabled(self):
+        return self._llm_engine
+
     def summary(self):
         return (f"prog_file: {self._prog_file}\n"
                 f"device: {self._device or 'default'}\n"
                 f"precision: {self._precision}\n"
-                f"ir_optim: {self._ir_optim} (performed by XLA)")
+                f"ir_optim: {self._ir_optim} (performed by XLA)\n"
+                f"llm_engine: {self._llm_engine}")
 
 
 class Tensor:
@@ -227,7 +259,102 @@ class Predictor:
         pass
 
 
-def create_predictor(config: Config) -> Predictor:
+class LLMEnginePredictor:
+    """Predictor-shaped front over ``serving.LLMEngine`` — what
+    ``create_predictor`` returns for a llama serving artifact when
+    ``Config.enable_llm_engine()`` is set.
+
+    The handle API maps onto generation: bind int32 token ids of shape
+    ``[B, S]`` (zero-padded rows allowed via the optional ``seq_lens``
+    handle) to ``input_ids``, ``run()`` submits every row as a request,
+    drives the engine to completion, and fills one output handle per row
+    with that row's prompt+generated tokens. The engine itself is exposed
+    as ``.engine`` for streaming/continuous use — the handle API is the
+    batch convenience."""
+
+    def __init__(self, config):
+        import dataclasses
+
+        from .serving import LLMEngine, load_llama_artifact
+        from .serving.scheduler import SamplingParams
+
+        self._config = config
+        path = config.prog_file()
+        if path is None:
+            raise ValueError("Config has no model path; use "
+                             "Config(prog_file) or set_model()")
+        kwargs = dict(config._llm_engine_kwargs)
+        # sampling knobs (max_new_tokens, eos_token_id, …) split off from
+        # the engine-construction knobs by SamplingParams' field names
+        fields = {f.name for f in dataclasses.fields(SamplingParams)}
+        samp = {k: kwargs.pop(k) for k in list(kwargs) if k in fields}
+        self._sampling = SamplingParams(**samp) if samp else None
+        self.engine = LLMEngine(load_llama_artifact(path), **kwargs)
+        self._inputs = {"input_ids": Tensor("input_ids", ([-1, -1], "int32")),
+                        "seq_lens": Tensor("seq_lens", ([-1], "int32"))}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs) or ["out0"]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        import dataclasses as _dc
+
+        if inputs is not None:
+            self._inputs["input_ids"].copy_from_cpu(np.asarray(inputs[0]))
+            if len(inputs) > 1:
+                self._inputs["seq_lens"].copy_from_cpu(np.asarray(inputs[1]))
+        ids = np.asarray(self._inputs["input_ids"]._value)
+        if ids.ndim == 1:
+            ids = ids[None]
+        lens_h = self._inputs["seq_lens"]._value
+        if lens_h is not None:
+            lens = np.asarray(lens_h).reshape(-1)
+            if lens.shape[0] != ids.shape[0]:
+                raise ValueError(
+                    f"seq_lens has {lens.shape[0]} entries for "
+                    f"{ids.shape[0]} input rows")
+        else:
+            lens = np.full(ids.shape[0], ids.shape[1])
+        prompts = [ids[i, :int(lens[i])] for i in range(ids.shape[0])]
+        outs = self.engine.generate(
+            prompts, _dc.replace(self._sampling) if self._sampling else None)
+        # seq_lens describes THIS batch only — clear it so the next run's
+        # (possibly unpadded, differently-sized) batch is not silently
+        # truncated by stale lengths
+        self._inputs["seq_lens"]._value = None
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            t = Tensor(f"out{i}")
+            t._value = np.asarray(o)
+            self._outputs[f"out{i}"] = t
+        return outs
+
+    def try_shrink_memory(self):
+        pass
+
+    def close(self):
+        self.engine.close()
+
+
+def create_predictor(config: Config):
+    if config._llm_engine:
+        from . import serving
+
+        path = config.prog_file()
+        if path is not None and serving.is_llama_artifact(path):
+            return LLMEnginePredictor(config)
+        # non-llama artifact: the knob is recorded, the StableHLO
+        # replay path serves it (same contract as the other graph knobs)
     return Predictor(config)
 
 
@@ -320,6 +447,7 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     import shutil
 
     import jax
+    import jax.export  # noqa: F401  (submodule not auto-imported)
     import jax.numpy as jnp
     import ml_dtypes  # noqa: F401  (np.dtype("bfloat16") resolution)
 

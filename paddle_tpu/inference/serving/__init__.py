@@ -1,0 +1,23 @@
+"""paddle.inference.serving — TPU-native LLM serving engine (ISSUE 7).
+
+A real serving path for the flagship llama models: block-allocated paged
+KV cache (``kv_cache``), a ragged paged-attention decode kernel with a
+pure-lax CPU fallback (``paged_attention`` + ``ops/pallas``), a
+continuous-batching scheduler with prefill/decode split (``scheduler``),
+and the ``LLMEngine`` front-end (``engine``). See DESIGN_DECISIONS.md
+"Paged KV cache & continuous batching" and the README serving recipe.
+"""
+
+from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
+from .paged_attention import paged_decode_attention  # noqa: F401
+from .engine import (  # noqa: F401
+    LLMEngine, StepOutput, is_llama_artifact, load_llama_artifact,
+    save_llama_artifact,
+)
+
+__all__ = [
+    "BlockAllocator", "PagedKVCache", "Request", "SamplingParams",
+    "Scheduler", "paged_decode_attention", "LLMEngine", "StepOutput",
+    "save_llama_artifact", "load_llama_artifact", "is_llama_artifact",
+]

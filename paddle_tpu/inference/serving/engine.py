@@ -1,0 +1,639 @@
+"""LLM serving engine front-end (ISSUE 7 tentpole, part d).
+
+``LLMEngine`` turns a ``LlamaForCausalLM`` into a continuously-batched
+server:
+
+* ``add_request`` enqueues a prompt; a ``DevicePrefetcher``-style ingest
+  thread pads it to its prefill bucket (PR-1 ``BucketSpec`` semantics, via
+  ``io.prefetch.np_pad_to_bucket``) and starts the host→device transfer
+  off the decode thread's critical path;
+* ``step`` runs one scheduler tick: admit + prefill queued prompts
+  (one compiled prefill graph per length bucket), then ONE fixed-shape
+  decode step for every running slot against the paged KV pool — the
+  decode graph compiles once and is reused for the life of the engine
+  (``paddle.jit.cache_stats()`` row ``llm_engine_decode#n`` proves it);
+* ``stream`` iterates steps and yields tokens as they are produced;
+* ``reload_weights`` hot-swaps weights from a ``CheckpointManager``
+  (``latest_healthy_step()`` — the divergence-sentinel-approved step)
+  WITHOUT recompiling: weights are jit arguments, not baked constants.
+
+Pool writes happen in-graph (``lax.dynamic_update_slice``); attention
+reads route through ``serving.paged_attention`` (Pallas on TPU, pure-lax
+gather on CPU). Sampling is host-side per request via
+``models.llama.sample_next_tokens`` — the same function the eager
+``generate`` path uses, so engine outputs are bit-exact against it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import queue
+import threading
+import warnings
+
+import numpy as np
+
+from .kv_cache import PagedKVCache
+from .scheduler import Request, SamplingParams, Scheduler
+
+__all__ = ["LLMEngine", "StepOutput", "save_llama_artifact",
+           "load_llama_artifact"]
+
+
+@dataclasses.dataclass
+class StepOutput:
+    rid: int
+    token: int
+    finished: bool
+    finish_reason: str | None = None
+
+
+def _default_buckets(block_size, max_model_len):
+    """Doubling ladder of prefill lengths, block-aligned: one compiled
+    prefill graph per rung."""
+    buckets, b = [], block_size
+    while b < max_model_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_model_len)
+    return buckets
+
+
+class _IngestThread:
+    """Push-based analog of ``io.DevicePrefetcher``'s transfer thread:
+    pads each queued prompt to its prefill bucket on the host and starts
+    the device transfer, so admission never blocks decode on H2D. Dies
+    once, warns once, and the engine degrades to synchronous staging."""
+
+    def __init__(self, stage_fn, name):
+        self._stage = stage_fn
+        self._q: queue.Queue = queue.Queue()
+        self._ready: list = []
+        self._cond = threading.Condition()
+        self._pending = 0  # submitted but not yet drained
+        self._dead = False
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name=f"{name}-ingest")
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            req = self._q.get()
+            if req is None:
+                return
+            try:
+                self._stage(req)
+            except BaseException as e:
+                self._dead = True
+                warnings.warn(
+                    f"LLMEngine ingest thread died ({e!r}); degrading to "
+                    "synchronous request staging", RuntimeWarning)
+                with self._cond:
+                    # flush EVERYTHING un-staged (the failing request AND
+                    # anything still queued behind it) back to the engine —
+                    # step() re-stages synchronously; stranding them would
+                    # leave has_work() true forever with nothing to drain
+                    self._ready.append(req)
+                    while True:
+                        try:
+                            nxt = self._q.get_nowait()
+                        except queue.Empty:
+                            break
+                        if nxt is not None:
+                            self._ready.append(nxt)
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self._ready.append(req)
+                self._cond.notify_all()
+
+    @property
+    def pending(self):
+        with self._cond:
+            return self._pending
+
+    def submit(self, req):
+        with self._cond:
+            self._pending += 1
+        if self._dead:
+            with self._cond:
+                self._ready.append(req)
+                self._cond.notify_all()
+            return
+        self._q.put(req)
+
+    def drain(self, wait=False, timeout=1.0):
+        """Staged requests since the last drain. ``wait=True`` blocks (up
+        to ``timeout``) until at least one lands — the engine uses it when
+        it would otherwise spin on an empty scheduler while requests are
+        in flight on the ingest thread."""
+        with self._cond:
+            if wait and not self._ready and self._pending:
+                self._cond.wait_for(lambda: self._ready, timeout=timeout)
+            out, self._ready = self._ready, []
+            self._pending -= len(out)
+        return out
+
+    def close(self):
+        if not self._dead:
+            self._q.put(None)
+            self._thread.join(timeout=2.0)
+
+
+class LLMEngine:
+    """Continuous-batching paged-KV serving engine over a llama model."""
+
+    _instance_ids = itertools.count(1)
+
+    def __init__(self, model, *, num_blocks=64, block_size=16,
+                 max_batch_size=4, max_model_len=None, prefill_buckets=None,
+                 max_prefills_per_step=1, ingest_async=True):
+        from ...models.llama import LlamaForCausalLM
+
+        if not isinstance(model, LlamaForCausalLM):
+            raise TypeError("LLMEngine serves LlamaForCausalLM models; got "
+                            f"{type(model).__name__}")
+        self.model = model
+        self.config = model.config
+        was_training = model.training
+        model.eval()
+        self._was_training = was_training
+        limit = self.config.max_position_embeddings
+        self.max_model_len = min(int(max_model_len or limit), limit)
+        self.block_size = int(block_size)
+        self.max_pages = -(-self.max_model_len // self.block_size)
+        dtype = model.llama.layers[0].self_attn.k_proj.weight.dtype
+        self.cache = PagedKVCache(self.config, num_blocks, block_size,
+                                  dtype=dtype)
+        self.scheduler = Scheduler(self.cache.allocator, block_size,
+                                   max_batch_size, max_prefills_per_step)
+        self.max_batch_size = int(max_batch_size)
+        buckets = prefill_buckets or _default_buckets(self.block_size,
+                                                      self.max_model_len)
+        # block-align every rung so prefill writes whole pages
+        self.prefill_buckets = sorted({
+            min(-(-int(b) // self.block_size) * self.block_size,
+                self.max_model_len)
+            for b in buckets})
+        n = next(LLMEngine._instance_ids)
+        self._name = f"llm_engine#{n}"
+        self._prefill_name = f"llm_engine_prefill#{n}"
+        self._decode_name = f"llm_engine_decode#{n}"
+        self._params = model._unique_params()
+        self._prefill_jit = None
+        self._decode_jit = None
+        self._requests: dict[int, Request] = {}
+        self._ingest = (_IngestThread(self._stage_request, self._name)
+                        if ingest_async else None)
+        self.stats_extra = {"steps": 0, "prefills": 0, "tokens_out": 0}
+
+    # ------------------------------------------------------------------
+    # request lifecycle
+    # ------------------------------------------------------------------
+    def _bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds the largest "
+                         f"prefill bucket {self.prefill_buckets[-1]}")
+
+    def _stage_request(self, req):
+        """Pad the request's current prefix to its prefill bucket and start
+        the H2D transfer (ingest thread / re-prefill staging)."""
+        import jax
+
+        from ...io.prefetch import np_pad_to_bucket
+        from ...jit.cache import BucketSpec
+
+        toks = req.tokens
+        bucket = self._bucket_for(len(toks))
+        spec = BucketSpec({1: (bucket,)})
+        ids, _ = np_pad_to_bucket(toks[None].astype(np.int32), spec,
+                                  lengths={1: len(toks)})
+        req._staged = (jax.device_put(ids), bucket, len(toks))
+
+    def add_request(self, prompt_ids, sampling: SamplingParams | None = None,
+                    arrival_t=None):
+        """Enqueue a prompt; returns the request id. Never blocks on pool
+        exhaustion — the request queues until blocks free up."""
+        req = Request(prompt_ids, sampling, arrival_t=arrival_t)
+        total = len(req.prompt) + req.sampling.max_new_tokens
+        cap = min(self.max_model_len,
+                  (self.cache.num_blocks - 1) * self.block_size)
+        if total > cap:
+            raise ValueError(
+                f"request needs {total} tokens but the engine caps at "
+                f"{cap} (max_model_len={self.max_model_len}, pool="
+                f"{self.cache.num_blocks - 1} usable blocks x "
+                f"{self.block_size})")
+        # an evicted request re-prefills from its full prefix (up to
+        # total-1 tokens): with custom prefill_buckets the largest rung
+        # must cover that, or staging would fail mid-stream
+        if total - 1 > self.prefill_buckets[-1]:
+            raise ValueError(
+                f"request may need a {total - 1}-token prefill (prompt + "
+                f"re-prefill after eviction) but the largest prefill "
+                f"bucket is {self.prefill_buckets[-1]}")
+        if req.sampling.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self._requests[req.rid] = req
+        if self._ingest is not None:
+            self._ingest.submit(req)
+        else:
+            self._stage_request(req)
+            self.scheduler.waiting.append(req)
+        return req.rid
+
+    def request(self, rid):
+        return self._requests[rid]
+
+    def output_tokens(self, rid):
+        """np prompt+generated tokens for a request."""
+        r = self._requests[rid]
+        return np.concatenate(
+            [r.prompt, np.asarray(r.output_tokens, np.int32)])
+
+    def release(self, rid):
+        """Drop a FINISHED request's bookkeeping (prompt + output token
+        arrays). A long-lived server must release requests once their
+        outputs are delivered or host memory grows without bound —
+        ``generate`` releases automatically; ``stream`` consumers that
+        read tokens incrementally can release on the finished
+        ``StepOutput``."""
+        req = self._requests.get(rid)
+        if req is None:
+            return
+        if not req.finished:
+            raise ValueError(f"request {rid} is {req.state}; only "
+                             "finished requests can be released")
+        del self._requests[rid]
+
+    def has_work(self):
+        if self._ingest is not None and self._ingest.pending:
+            return True
+        return self.scheduler.has_work()
+
+    # ------------------------------------------------------------------
+    # compiled graphs
+    # ------------------------------------------------------------------
+    def _build_jits(self):
+        from ...core import state as _state
+        from ...core.tensor import Tensor
+        from ...jit.cache import CountingJit
+        from .paged_attention import paged_decode_attention
+
+        model = self.model
+        params = self._params
+        block_size = self.block_size
+
+        def _head(h):
+            from ...nn import functional as F
+
+            if model.lm_head is not None:
+                return model.lm_head(h)
+            return F.linear(h, model.llama.embed_tokens.weight.t())
+
+        def _arr(x):
+            return x._data if isinstance(x, Tensor) else x
+
+        def prefill_pure(param_arrays, ids, true_len, tables_row,
+                         k_pools, v_pools):
+            """ids [1, Sb]; tables_row [Sb // block]; returns (last real
+            position's logits [1, V], pools)."""
+            import jax
+            import jax.numpy as jnp
+
+            from ...nn.functional.flash_attention import _sdpa_ref
+            from ...ops import manipulation as M
+
+            old = [p._data for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                with _state.trace_guard():
+                    sb = ids.shape[1]
+                    pages = sb // block_size
+                    x = model.llama.embed_tokens(Tensor._wrap(ids))
+                    cos = model.llama.rope_cos[:sb]
+                    sin = model.llama.rope_sin[:sb]
+                    new_k, new_v = [], []
+                    for layer, kp, vp in zip(model.llama.layers,
+                                             k_pools, v_pools):
+                        attn = layer.self_attn
+                        h = layer.input_layernorm(x)
+                        b, s = 1, sb
+                        from ...models.llama import apply_rope
+
+                        q = M.reshape(attn.q_proj(h),
+                                      [b, s, attn.num_heads, attn.head_dim])
+                        k = M.reshape(attn.k_proj(h),
+                                      [b, s, attn.num_kv_heads,
+                                       attn.head_dim])
+                        v = M.reshape(attn.v_proj(h),
+                                      [b, s, attn.num_kv_heads,
+                                       attn.head_dim])
+                        q = apply_rope(q, cos, sin)
+                        k = apply_rope(k, cos, sin)
+                        ka, va = _arr(k), _arr(v)
+                        for j in range(pages):
+                            sl = slice(j * block_size, (j + 1) * block_size)
+                            kp = jax.lax.dynamic_update_slice(
+                                kp, ka[0:1, sl].astype(kp.dtype),
+                                (tables_row[j], 0, 0, 0))
+                            vp = jax.lax.dynamic_update_slice(
+                                vp, va[0:1, sl].astype(vp.dtype),
+                                (tables_row[j], 0, 0, 0))
+                        out = _sdpa_ref.raw_fn(_arr(q), ka, va, causal=True)
+                        attn_out = attn.o_proj(
+                            M.reshape(Tensor._wrap(out), [b, s, -1]))
+                        x = x + attn_out
+                        x = x + layer.mlp(layer.post_attention_layernorm(x))
+                        new_k.append(kp)
+                        new_v.append(vp)
+                    h = model.llama.norm(x)
+                    h_arr = _arr(h)
+                    last = jax.lax.dynamic_slice(
+                        h_arr, (0, jnp.asarray(true_len, jnp.int32) - 1, 0),
+                        (1, 1, h_arr.shape[-1]))
+                    logits = _head(Tensor._wrap(last))
+            finally:
+                for p, a in zip(params, old):
+                    p._data = a
+            return _arr(logits)[:, 0], new_k, new_v
+
+        def decode_pure(param_arrays, ids, positions, tables,
+                        k_pools, v_pools):
+            """ids [B, 1] (last sampled token per slot); positions [B]
+            (tokens already cached); tables [B, P]. Writes each token at
+            ``positions``, attends over ``positions+1`` ragged lengths.
+            Returns (logits [B, V], pools)."""
+            import jax
+            import jax.numpy as jnp
+
+            from ...ops import manipulation as M
+
+            old = [p._data for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                with _state.trace_guard():
+                    bsz = ids.shape[0]
+                    x = model.llama.embed_tokens(Tensor._wrap(ids))
+                    cos_t = _arr(model.llama.rope_cos)
+                    sin_t = _arr(model.llama.rope_sin)
+                    # batched rope at per-request positions
+                    c = cos_t[positions][:, None, None, :]
+                    sn = sin_t[positions][:, None, None, :]
+                    new_k, new_v = [], []
+                    for layer, kp, vp in zip(model.llama.layers,
+                                             k_pools, v_pools):
+                        attn = layer.self_attn
+                        h = layer.input_layernorm(x)
+                        q = M.reshape(attn.q_proj(h),
+                                      [bsz, 1, attn.num_heads, attn.head_dim])
+                        k = M.reshape(attn.k_proj(h),
+                                      [bsz, 1, attn.num_kv_heads,
+                                       attn.head_dim])
+                        v = M.reshape(attn.v_proj(h),
+                                      [bsz, 1, attn.num_kv_heads,
+                                       attn.head_dim])
+
+                        def rope(t):
+                            a = _arr(t)
+                            d2 = a.shape[-1] // 2
+                            a1, a2 = a[..., :d2], a[..., d2:]
+                            cc = c.astype(a.dtype)
+                            ss = sn.astype(a.dtype)
+                            return jnp.concatenate(
+                                [a1 * cc - a2 * ss, a2 * cc + a1 * ss], -1)
+
+                        qa, ka, va = rope(q), rope(k), _arr(v)
+                        blk = tables[jnp.arange(bsz),
+                                     positions // block_size]
+                        off = positions % block_size
+                        for i in range(bsz):
+                            kp = jax.lax.dynamic_update_slice(
+                                kp, ka[i:i + 1].astype(kp.dtype),
+                                (blk[i], off[i], 0, 0))
+                            vp = jax.lax.dynamic_update_slice(
+                                vp, va[i:i + 1].astype(vp.dtype),
+                                (blk[i], off[i], 0, 0))
+                        out = paged_decode_attention(
+                            qa, kp, vp, tables, positions + 1,
+                            scale=1.0 / math.sqrt(attn.head_dim))
+                        attn_out = attn.o_proj(
+                            M.reshape(Tensor._wrap(out), [bsz, 1, -1]))
+                        x = x + attn_out
+                        x = x + layer.mlp(layer.post_attention_layernorm(x))
+                        new_k.append(kp)
+                        new_v.append(vp)
+                    h = model.llama.norm(x)
+                    logits = _head(h[:, -1:])
+            finally:
+                for p, a in zip(params, old):
+                    p._data = a
+            return _arr(logits)[:, 0], new_k, new_v
+
+        self._prefill_jit = CountingJit(prefill_pure, self._prefill_name,
+                                        donate_argnums=(4, 5))
+        self._decode_jit = CountingJit(decode_pure, self._decode_name,
+                                       donate_argnums=(4, 5))
+
+    # ------------------------------------------------------------------
+    # the scheduler tick
+    # ------------------------------------------------------------------
+    def step(self):
+        """One engine tick: drain ingest, admit + prefill, one decode for
+        all running slots. Returns the ``StepOutput`` tokens produced."""
+        import jax.numpy as jnp
+
+        if self._decode_jit is None:
+            self._build_jits()
+        sched = self.scheduler
+        if self._ingest is not None:
+            # block (briefly) only when the scheduler would otherwise spin
+            # empty while requests are in flight on the ingest thread
+            for req in self._ingest.drain(wait=not sched.has_work()):
+                if not hasattr(req, "_staged"):  # ingest thread died
+                    self._stage_request(req)
+                sched.waiting.append(req)
+        outputs = []
+        if not sched.has_work():
+            return outputs
+        self.stats_extra["steps"] += 1
+
+        # -- prefill (admission) ---------------------------------------
+        for slot, req in sched.pick_prefills():
+            staged = getattr(req, "_staged", None)
+            if staged is None or staged[2] != len(req.tokens):
+                self._stage_request(req)  # re-prefill after eviction
+                staged = req._staged
+            ids_dev, bucket, true_len = staged
+            pages = bucket // self.block_size
+            tables_row = np.zeros(pages, np.int32)
+            n = min(len(req.blocks), pages)
+            tables_row[:n] = req.blocks[:n]
+            logits, self.cache.k, self.cache.v = self._prefill_jit(
+                [p._data for p in self._params], ids_dev,
+                np.int32(true_len), jnp.asarray(tables_row),
+                self.cache.k, self.cache.v)
+            req.num_cached = true_len
+            self.stats_extra["prefills"] += 1
+            outputs.extend(self._emit(req, np.asarray(logits)[0]))
+
+        # -- decode ------------------------------------------------------
+        sched.ensure_decode_room()
+        running = [(i, r) for i, r in enumerate(sched.slots) if r is not None]
+        if running:
+            B = self.max_batch_size
+            ids = np.zeros((B, 1), np.int32)
+            positions = np.zeros(B, np.int32)
+            table_lists = [[] for _ in range(B)]
+            for i, req in running:
+                ids[i, 0] = req.last_token
+                positions[i] = req.num_cached
+                table_lists[i] = req.blocks
+            tables = self.cache.table_array(table_lists, self.max_pages)
+            logits, self.cache.k, self.cache.v = self._decode_jit(
+                [p._data for p in self._params], jnp.asarray(ids),
+                jnp.asarray(positions), tables, self.cache.k, self.cache.v)
+            logits = np.asarray(logits)
+            for i, req in running:
+                req.num_cached += 1
+                outputs.extend(self._emit(req, logits[i]))
+        return outputs
+
+    def _emit(self, req, row):
+        """Sample the next token for ``req`` from logits ``row`` [V],
+        append it, finish bookkeeping. Returns [StepOutput]."""
+        from ...models.llama import sample_next_tokens
+
+        s = req.sampling
+        tok = int(sample_next_tokens(
+            row[None], do_sample=s.do_sample, temperature=s.temperature,
+            top_k=s.top_k, top_p=s.top_p, rng=req._rng)[0])
+        req.output_tokens.append(tok)
+        self.stats_extra["tokens_out"] += 1
+        done = req.should_finish()
+        if done:
+            self.scheduler.finish(req)
+        return [StepOutput(req.rid, tok, done,
+                           req.finish_reason() if done else None)]
+
+    def stream(self):
+        """Yield ``StepOutput`` s until the engine drains."""
+        while self.has_work():
+            yield from self.step()
+
+    def generate(self, prompts, sampling: SamplingParams | None = None):
+        """Convenience batch API: submit every prompt, run to completion,
+        return the full token arrays (prompt + generated) in order."""
+        rids = [self.add_request(p, dataclasses.replace(sampling)
+                                 if sampling else None) for p in prompts]
+        for _ in self.stream():
+            pass
+        outs = [self.output_tokens(r) for r in rids]
+        for r in rids:
+            self.release(r)
+        return outs
+
+    # ------------------------------------------------------------------
+    # weights + teardown
+    # ------------------------------------------------------------------
+    def reload_weights(self, source):
+        """Hot-reload weights without recompiling: from a
+        ``CheckpointManager`` (prefers ``latest_healthy_step()``, falls
+        back to ``latest_valid_step()``), a checkpoint step directory, or
+        a state-dict file path. Returns the restored step (or None)."""
+        from ...distributed.checkpoint import load_state_dict
+        from ...distributed.checkpoint.manager import CheckpointManager
+
+        if isinstance(source, CheckpointManager):
+            step = source.latest_healthy_step()
+            if step is None:
+                step = source.latest_valid_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "reload_weights: no committed checkpoint in "
+                    f"{source.root}")
+            load_state_dict(self.model.state_dict(), source.step_dir(step))
+            return step
+        import os
+
+        from ...framework import io as _fio
+
+        path = str(source)
+        if os.path.isdir(path):
+            load_state_dict(self.model.state_dict(), path)
+            return None
+        self.model.set_state_dict(_fio.load(path))
+        return None
+
+    def stats(self):
+        d = dict(self.stats_extra)
+        d.update(self.scheduler.stats)
+        d["blocks_free"] = self.cache.allocator.num_free
+        d["blocks_high_water"] = self.cache.allocator.high_water
+        d["waiting"] = len(self.scheduler.waiting)
+        d["running"] = len(self.scheduler.running)
+        d["prefill_stats_row"] = self._prefill_name
+        d["decode_stats_row"] = self._decode_name
+        return d
+
+    def close(self):
+        if self._ingest is not None:
+            self._ingest.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ----------------------------------------------------------------------
+# llama serving artifacts (consumed by inference.create_predictor)
+# ----------------------------------------------------------------------
+
+def save_llama_artifact(model, path):
+    """Persist a llama model as a serving artifact: ``<path>.llamacfg.json``
+    (the LlamaConfig) + ``<path>.pdiparams`` (weights). The engine-backed
+    predictor (``Config.enable_llm_engine``) detects the sidecar config and
+    rebuilds the model around it."""
+    import json
+    import os
+
+    from ...framework.io import save as fsave
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".llamacfg.json", "w") as f:
+        json.dump(dataclasses.asdict(model.config), f)
+    fsave(model.state_dict(), path + ".pdiparams")
+
+
+def is_llama_artifact(path):
+    import os
+
+    if path.endswith(".pdmodel"):
+        path = path[: -len(".pdmodel")]
+    return os.path.exists(path + ".llamacfg.json")
+
+
+def load_llama_artifact(path):
+    """Rebuild the model from :func:`save_llama_artifact` output."""
+    import json
+
+    from ...framework.io import load as fload
+    from ...models.llama import LlamaConfig, LlamaForCausalLM
+
+    if path.endswith(".pdmodel"):
+        path = path[: -len(".pdmodel")]
+    with open(path + ".llamacfg.json") as f:
+        cfg = LlamaConfig(**json.load(f))
+    model = LlamaForCausalLM(cfg)
+    model.set_state_dict(fload(path + ".pdiparams"))
+    model.eval()
+    return model

@@ -1,0 +1,108 @@
+"""Block-allocated paged KV cache (ISSUE 7 tentpole, part a).
+
+The flagship decode loop used to grow its cache by ``concat`` — a fresh
+XLA compile and a full cache copy per generated token, and worse, memory
+sized for every request's MAXIMUM length up front. The serving fix
+(vLLM-style, per PAPERS.md "Ragged Paged Attention … for TPU") is a
+static block pool:
+
+* one ``[num_blocks, block_size, num_kv_heads, head_dim]`` K and V array
+  per layer, allocated ONCE — shapes never change, so one compiled decode
+  graph serves any mix of request lengths;
+* a host-side free-list ``BlockAllocator`` hands blocks to requests as
+  they grow, token by token — memory is proportional to tokens actually
+  alive, not to worst-case lengths;
+* per-request **block tables** (host lists, shipped to the device as a
+  small int32 array each step) map logical token positions to pool
+  blocks; all pool writes happen in-graph via ``lax.dynamic_update_slice``
+  so the decode executable is reused forever.
+
+Block 0 is reserved as the **null block**: padded table entries point at
+it, so in-graph writes for padding land somewhere harmless instead of
+clobbering a live request's block. It is never handed out.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BlockAllocator", "PagedKVCache"]
+
+
+class BlockAllocator:
+    """LIFO free-list over ``num_blocks`` pool blocks.
+
+    Block 0 is the reserved null block (see module docstring) and is never
+    allocated. ``allocate`` is all-or-nothing: asking for more blocks than
+    are free returns ``None`` and takes nothing — the scheduler's signal
+    to queue (or evict), never a partial grab to unwind.
+    """
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (one is the reserved null "
+                             f"block), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # LIFO: recently-freed (cache-warm) blocks are reused first
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._allocated = set()
+        self.high_water = 0
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    def allocate(self, n=1):
+        """``n`` block ids, or ``None`` (and no state change) if fewer
+        than ``n`` are free."""
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._allocated.update(ids)
+        self.high_water = max(self.high_water, len(self._allocated))
+        return ids
+
+    def free(self, ids):
+        for b in ids:
+            if b not in self._allocated:
+                raise ValueError(f"double-free or foreign block {b}")
+            self._allocated.discard(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """Static per-layer K/V block pools + the allocator that carves them.
+
+    ``k``/``v`` are lists (one per layer) of
+    ``[num_blocks, block_size, num_kv_heads, head_dim]`` arrays. They are
+    plain jax arrays deliberately: the engine threads them through its
+    compiled step functions (donated on TPU) and rebinds the returned
+    buffers, exactly like ``FusedTrainStep`` handles optimizer state.
+    """
+
+    def __init__(self, config, num_blocks, block_size, dtype=None):
+        if dtype is None:
+            dtype = jnp.float32
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        shape = (self.num_blocks, self.block_size,
+                 config.num_key_value_heads, config.head_dim)
+        L = config.num_hidden_layers
+        self.k = [jnp.zeros(shape, dtype) for _ in range(L)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(L)]
+        self.allocator = BlockAllocator(num_blocks)
+
+    def blocks_for_tokens(self, n_tokens):
+        """Blocks needed to hold ``n_tokens``."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def table_array(self, block_lists, max_blocks):
+        """Host block tables -> device int32 [len(block_lists), max_blocks],
+        padded with the null block."""
+        import numpy as np
+
+        out = np.zeros((len(block_lists), max_blocks), np.int32)
+        for i, blocks in enumerate(block_lists):
+            out[i, :len(blocks)] = blocks
+        return jax.device_put(out)

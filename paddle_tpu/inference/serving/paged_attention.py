@@ -1,0 +1,58 @@
+"""Paged decode attention — kernel routing + pure-``lax`` fallback.
+
+The serving engine's decode step calls :func:`paged_decode_attention` once
+per layer inside its compiled graph. On TPU (or in Pallas interpret mode)
+it routes to the Pallas kernel in ``ops/pallas/paged_attention.py``; on
+CPU it runs the pure-``lax`` fallback below — a gather of each request's
+pages out of the pool followed by a masked dense attention — which is the
+numerical reference the kernel (and the tests) are matched against.
+
+CPU-fallback contract (see DESIGN_DECISIONS.md): same signature, same
+ragged-length semantics, outputs matched to the dense llama attention —
+only the memory-traffic shape differs (the fallback materializes the
+gathered [B, P*block, Hkv, D] view; the kernel never does).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...nn.functional.flash_attention import _sdpa_ref
+
+__all__ = ["paged_decode_attention"]
+
+
+def _lax_fallback(q, k_pool, v_pool, block_tables, context_lens, scale):
+    """q [B, 1, H, D] -> [B, 1, H, D] via gather + masked dense sdpa."""
+    b, p = block_tables.shape
+    n, block_size, hkv, d = k_pool.shape
+    k = k_pool[block_tables].reshape(b, p * block_size, hkv, d)
+    v = v_pool[block_tables].reshape(b, p * block_size, hkv, d)
+    pos = jnp.arange(p * block_size, dtype=jnp.int32)[None, :]
+    mask = (pos < context_lens[:, None])[:, None, None, :]  # [B,1,1,S]
+    return _sdpa_ref.raw_fn(q, k, v, attn_mask=mask, scale=scale)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
+                           scale=None):
+    """One decode token per request against the paged pool.
+
+    q: [B, 1, H, D] (the just-written token's query); pools
+    [N, block, Hkv, D]; block_tables [B, P] int32; context_lens [B] int32
+    counting tokens INCLUDING the one just written. Returns [B, 1, H, D].
+    """
+    d = q.shape[-1]
+    block_size = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    from ...ops.pallas.paged_attention import (
+        paged_decode_attention_pallas, use_pallas_paged)
+
+    if use_pallas_paged(d, block_size):
+        out = paged_decode_attention_pallas(
+            q[:, 0], k_pool, v_pool, block_tables, context_lens, scale)
+        return out[:, None]
+    return _lax_fallback(q, k_pool, v_pool, block_tables, context_lens,
+                         float(scale))

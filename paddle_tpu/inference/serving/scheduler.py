@@ -1,0 +1,199 @@
+"""Continuous batching scheduler (ISSUE 7 tentpole, part c).
+
+Token-granularity admission into a fixed set of decode slots:
+
+* a fixed ``max_batch_size`` of decode slots so the decode graph compiles
+  ONCE — a finished request's slot is refilled by the next waiting request
+  at the very next step (continuous batching), never by re-batching into a
+  new shape;
+* **prefill/decode split**: prompts run through their own compiled
+  prefill graphs (one per registered length bucket — the PR-1 shape-bucket
+  discipline), decode runs the shared fixed-shape step; a step admits at
+  most ``max_prefills_per_step`` prompts so decode latency for running
+  requests stays bounded;
+* **graceful degradation**: a request that cannot get blocks stays queued
+  (FIFO) — the engine never crashes on pool exhaustion. If a RUNNING
+  request cannot grow by one block, the scheduler evicts the
+  most-recently-admitted running request (its blocks free immediately, it
+  re-queues at the FRONT and will re-prefill from its full
+  prompt+generated prefix later — greedy decode makes the re-derived
+  tokens identical), mirroring vLLM's recompute preemption;
+* blocks free the moment a request finishes (EOS or max_new_tokens).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+
+import numpy as np
+
+__all__ = ["SamplingParams", "Request", "Scheduler"]
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_new_tokens: int = 32
+    eos_token_id: int | None = None
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int | None = None
+
+
+class Request:
+    """One in-flight generation request."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, prompt_ids, sampling: SamplingParams | None = None,
+                 rid=None, arrival_t=None):
+        self.rid = rid if rid is not None else next(Request._ids)
+        self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.sampling = sampling or SamplingParams()
+        self.arrival_t = arrival_t
+        self.state = WAITING
+        self.output_tokens: list[int] = []
+        self.blocks: list[int] = []       # pool block ids, in order
+        self.num_cached = 0               # tokens materialized in the pool
+        self.admit_seq = -1               # admission order (eviction policy)
+        self.evictions = 0
+        self._rng = (np.random.RandomState(self.sampling.seed)
+                     if self.sampling.do_sample else None)
+
+    @property
+    def tokens(self):
+        """Prompt + generated so far (the re-prefill prefix on eviction)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.output_tokens, np.int32)])
+
+    @property
+    def last_token(self):
+        return (self.output_tokens[-1] if self.output_tokens
+                else int(self.prompt[-1]))
+
+    @property
+    def finished(self):
+        return self.state == FINISHED
+
+    def finish_reason(self):
+        if self.state != FINISHED:
+            return None
+        s = self.sampling
+        if (s.eos_token_id is not None and self.output_tokens
+                and self.output_tokens[-1] == s.eos_token_id):
+            return "eos"
+        return "length"
+
+    def should_finish(self):
+        s = self.sampling
+        if len(self.output_tokens) >= s.max_new_tokens:
+            return True
+        return (s.eos_token_id is not None and self.output_tokens
+                and self.output_tokens[-1] == s.eos_token_id)
+
+
+class Scheduler:
+    """Slots + FIFO wait queue over a :class:`BlockAllocator`."""
+
+    def __init__(self, allocator, block_size, max_batch_size,
+                 max_prefills_per_step=1):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self.slots: list[Request | None] = [None] * int(max_batch_size)
+        self.waiting: deque[Request] = deque()
+        self.max_prefills_per_step = int(max_prefills_per_step)
+        self._admit_seq = itertools.count()
+        self.stats = {"admitted": 0, "evictions": 0, "finished": 0,
+                      "queued_on_exhaustion": 0}
+
+    # -- queries ---------------------------------------------------------
+    @property
+    def running(self):
+        return [r for r in self.slots if r is not None]
+
+    def has_work(self):
+        return bool(self.waiting) or any(self.slots)
+
+    def _free_slot(self):
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    # -- admission (prefill picks) --------------------------------------
+    def pick_prefills(self):
+        """Waiting requests to prefill THIS step: pops up to
+        ``max_prefills_per_step`` requests that fit (a free slot + blocks
+        for prompt-and-first-token). A head-of-queue request that does not
+        fit stays queued — FIFO, no overtaking — and the engine simply
+        decodes with what is running."""
+        picked = []
+        while (len(picked) < self.max_prefills_per_step and self.waiting
+               and self._free_slot() is not None):
+            req = self.waiting[0]
+            need = -(-(len(req.tokens) + 1) // self.block_size)
+            blocks = self.allocator.allocate(need)
+            if blocks is None:
+                self.stats["queued_on_exhaustion"] += 1
+                break
+            self.waiting.popleft()
+            slot = self._free_slot()
+            req.blocks = blocks
+            req.state = RUNNING
+            req.admit_seq = next(self._admit_seq)
+            self.slots[slot] = req
+            self.stats["admitted"] += 1
+            picked.append((slot, req))
+        return picked
+
+    # -- decode-time growth / eviction ----------------------------------
+    def ensure_decode_room(self):
+        """Grow every running request that is about to write past its last
+        block. On exhaustion, evict the most-recently-admitted running
+        request (free its blocks, re-queue at the FRONT) and retry —
+        token-granularity eviction. Returns the evicted requests."""
+        evicted = []
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            while len(req.tokens) + 1 > len(req.blocks) * self.block_size:
+                got = self.allocator.allocate(1)
+                if got is not None:
+                    req.blocks.extend(got)
+                    continue
+                victim = max((r for r in self.running if r is not req),
+                             key=lambda r: r.admit_seq, default=None)
+                if victim is None:
+                    victim = req  # alone and out of memory: preempt self
+                self._evict(victim)
+                evicted.append(victim)
+                if victim is req:
+                    break
+        return evicted
+
+    def _evict(self, req):
+        slot = self.slots.index(req)
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.num_cached = 0
+        req.state = WAITING
+        req.evictions += 1
+        self.slots[slot] = None
+        self.waiting.appendleft(req)
+        self.stats["evictions"] += 1
+
+    # -- completion ------------------------------------------------------
+    def finish(self, req):
+        slot = self.slots.index(req)
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.state = FINISHED
+        self.slots[slot] = None
+        self.stats["finished"] += 1

@@ -39,7 +39,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["DevicePrefetcher"]
+__all__ = ["DevicePrefetcher", "np_pad_to_bucket"]
 
 # worker -> consumer token kinds
 _ITEM = "item"
@@ -63,8 +63,13 @@ def _array_leaves(tree, out=None):
     return out
 
 
-def _np_pad_to_bucket(arr, spec, lengths):
-    """Host-side (numpy) mirror of jit.cache.pad_array_to_bucket."""
+def np_pad_to_bucket(arr, spec, lengths=None):
+    """Host-side (numpy) mirror of ``jit.cache.pad_array_to_bucket``:
+    zero-pad ``arr`` up to its bucket under ``spec`` on the CALLING thread
+    (no device work). Shared by the transfer thread below and the serving
+    engine's request-ingest staging (``inference.serving``), so prompt
+    padding and batch padding land on identical bucket shapes. Returns
+    ``(array, was_padded)``."""
     from ..jit import cache as jit_cache
 
     if lengths is None:
@@ -74,6 +79,9 @@ def _np_pad_to_bucket(arr, spec, lengths):
         return arr, False
     widths = [(0, t - s) for s, t in zip(arr.shape, target)]
     return np.pad(arr, widths), True
+
+
+_np_pad_to_bucket = np_pad_to_bucket  # backward-compatible alias
 
 
 class DevicePrefetcher:

@@ -26,6 +26,7 @@ import warnings
 
 import numpy as np
 import jax
+import jax.export  # noqa: F401  (submodule not auto-imported on jax 0.4.3x)
 import jax.numpy as jnp
 
 from ..core import rng as rng_mod
@@ -37,14 +38,14 @@ from ..nn.layer.layers import Layer
 from ..profiler.utils import RecordEvent
 from ..static.input_spec import InputSpec
 from . import cache as cache_mod
-from .cache import (BucketSpec, cache_stats, get_shape_buckets,  # noqa: F401
-                    reset_cache_stats, set_shape_buckets)
+from .cache import (BucketSpec, CountingJit, cache_stats,  # noqa: F401
+                    get_shape_buckets, reset_cache_stats, set_shape_buckets)
 from . import hlo_audit  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "enable_to_static", "ignore_module", "cache_stats",
            "reset_cache_stats", "set_shape_buckets", "get_shape_buckets",
-           "BucketSpec", "hlo_audit"]
+           "BucketSpec", "CountingJit", "hlo_audit"]
 
 _TO_STATIC_ENABLED = True
 

@@ -46,7 +46,7 @@ register_flag(
 
 __all__ = [
     "BucketSpec", "set_shape_buckets", "get_shape_buckets", "cache_stats",
-    "reset_cache_stats",
+    "reset_cache_stats", "CountingJit",
 ]
 
 
@@ -399,3 +399,61 @@ def reset_cache_stats():
     """Drop all compile-cache counters (does NOT drop compiled executables)."""
     with _LOCK:
         _STATS.clear()
+
+
+# --------------------------------------------------------------------------
+# CountingJit — jax.jit with compile-cache telemetry
+# --------------------------------------------------------------------------
+
+class CountingJit:
+    """``jax.jit`` wrapper whose compile/hit behavior is visible in
+    ``paddle.jit.cache_stats()`` under ``name``.
+
+    The serving engine and the llama decode loop dispatch hand-built pure
+    functions (donated KV buffers, no autograd) that bypass
+    ``StaticFunction`` — without this wrapper their compiles would be
+    invisible and the "zero decode recompiles after warmup" acceptance
+    unverifiable. A shape signature (array shapes/dtypes + static-arg
+    values) not seen before means jax traces + XLA-compiles a fresh
+    executable this dispatch; anything else is a cache hit — the same
+    counting contract as ``FusedTrainStep._count_dispatch``.
+
+    ``donate_argnums`` is honored only on TPU-class backends: XLA:CPU
+    rejects donation with a warning per call, and the smoke tests run CPU.
+    """
+
+    __slots__ = ("name", "_jit", "_seen", "_static")
+
+    def __init__(self, fn, name, static_argnums=(), donate_argnums=()):
+        import jax
+
+        self.name = name
+        self._static = tuple(static_argnums)
+        if jax.default_backend() not in ("tpu", "axon"):
+            donate_argnums = ()
+        self._jit = jax.jit(fn, static_argnums=self._static,
+                            donate_argnums=donate_argnums)
+        self._seen = set()
+
+    def _signature(self, args):
+        import jax
+
+        arrays = []
+        statics = []
+        for i, a in enumerate(args):
+            if i in self._static:
+                statics.append(repr(a))
+                continue
+            leaves = jax.tree_util.tree_leaves(a)
+            arrays.extend(l for l in leaves if hasattr(l, "shape"))
+        sig = shape_signature(arrays)
+        return sig + ("||" + "|".join(statics) if statics else "")
+
+    def __call__(self, *args):
+        sig = self._signature(args)
+        if sig in self._seen:
+            record_hit(self.name)
+        else:
+            self._seen.add(sig)
+            record_compile(self.name, sig)
+        return self._jit(*args)

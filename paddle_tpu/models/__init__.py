@@ -8,6 +8,7 @@ from .bert import (  # noqa: F401
     bert_base, bert_tiny,
 )
 from .llama import (  # noqa: F401
-    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_1b, llama_7b, llama_13b,
-    llama_125m, llama_small, llama_tiny,
+    LlamaConfig, LlamaForCausalLM, LlamaModel, StaticKVCache,
+    sample_next_tokens, llama_1b, llama_7b, llama_13b, llama_125m,
+    llama_small, llama_tiny,
 )

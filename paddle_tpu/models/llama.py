@@ -30,8 +30,9 @@ from ..nn.layer.layers import Layer
 from ..nn.layer.norm import RMSNorm
 from ..ops import creation, manipulation as M, math as ops_math
 
-__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
-           "llama_small", "llama_125m", "llama_1b", "llama_7b", "llama_13b"]
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "StaticKVCache",
+           "sample_next_tokens", "llama_tiny", "llama_small", "llama_125m",
+           "llama_1b", "llama_7b", "llama_13b"]
 
 
 @dataclasses.dataclass
@@ -93,6 +94,120 @@ def apply_rope(x, cos, sin):
     return _rope_apply(x, cos, sin)
 
 
+@_op("rope_apply_at")
+def _rope_apply_at(x, cos_t, sin_t, pos):
+    """Rope at a traced offset: x [B, s, H, D] holds absolute positions
+    ``pos..pos+s-1``; cos_t/sin_t are the FULL [max_pos, D/2] tables and the
+    slice happens in-graph (lax.dynamic_slice), so one compiled decode step
+    serves every position — the static-cache decode contract."""
+    import jax
+    import jax.numpy as jnp
+
+    s, d2 = x.shape[1], x.shape[-1] // 2
+    pos = jnp.asarray(pos, jnp.int32)
+    cos = jax.lax.dynamic_slice(cos_t, (pos, jnp.int32(0)), (s, d2))
+    sin = jax.lax.dynamic_slice(sin_t, (pos, jnp.int32(0)), (s, d2))
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :].astype(x.dtype)
+    sn = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * sn, x2 * c + x1 * sn], axis=-1)
+
+
+@_op("llama_cached_attn_step")
+def _cached_attn_step(q, k, v, k_buf, v_buf, pos):
+    """Static-capacity KV cache step: write this call's K/V (already
+    rope'd) at ``pos`` via ``lax.dynamic_update_slice`` — the cache shape
+    NEVER changes, so decode never recompiles — then attend over the cache
+    prefix. q/k/v: [B, s, H(kv), D]; k_buf/v_buf: [B, C, Hkv, D];
+    pos: scalar tokens-already-written. Masked columns contribute exactly
+    zero (fp32 softmax underflow of the -1e30 logits against zero-filled
+    buffers), so prefill through this path matches the dense causal
+    forward. Returns (out [B, s, H, D], k_buf, v_buf)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..nn.functional.flash_attention import _sdpa_ref
+
+    s, cap = q.shape[1], k_buf.shape[1]
+    pos = jnp.asarray(pos, jnp.int32)
+    zero = jnp.int32(0)
+    k_buf = jax.lax.dynamic_update_slice(
+        k_buf, k.astype(k_buf.dtype), (zero, pos, zero, zero))
+    v_buf = jax.lax.dynamic_update_slice(
+        v_buf, v.astype(v_buf.dtype), (zero, pos, zero, zero))
+    col = jnp.arange(cap, dtype=jnp.int32)[None, None, None, :]
+    row = jnp.arange(s, dtype=jnp.int32)[None, None, :, None]
+    mask = col <= (pos + row)  # causal over the written prefix
+    out = _sdpa_ref.raw_fn(q, k_buf, v_buf, attn_mask=mask)
+    return out, k_buf, v_buf
+
+
+class StaticKVCache:
+    """Preallocated static-capacity KV cache for autoregressive decode.
+
+    Per-layer K/V buffers of shape ``[batch, capacity, num_kv_heads,
+    head_dim]`` plus a host-side write offset ``pos``. Every decode step
+    writes one token in-graph (``lax.dynamic_update_slice``) and attends
+    over the first ``pos+1`` entries — shapes never change, so the whole
+    32-token decode reuses ONE compiled executable instead of the
+    concat-per-step path's compile-per-token cliff (ISSUE 7 satellite;
+    ``paddle.jit.cache_stats()`` shows the counts)."""
+
+    __slots__ = ("k", "v", "pos")
+
+    def __init__(self, config: LlamaConfig, batch_size, capacity,
+                 dtype=None):
+        import jax.numpy as jnp
+
+        if dtype is None:
+            dtype = jnp.float32
+        shape = (batch_size, capacity, config.num_key_value_heads,
+                 config.head_dim)
+        self.k = [jnp.zeros(shape, dtype)
+                  for _ in range(config.num_hidden_layers)]
+        self.v = [jnp.zeros(shape, dtype)
+                  for _ in range(config.num_hidden_layers)]
+        self.pos = 0
+
+    @property
+    def capacity(self):
+        return self.k[0].shape[1]
+
+    @property
+    def batch_size(self):
+        return self.k[0].shape[0]
+
+
+def sample_next_tokens(last, *, do_sample=False, temperature=1.0, top_k=None,
+                       top_p=None, rng=None):
+    """Host-side next-token selection over logits ``last`` (np [B, V]):
+    greedy argmax, or seeded temperature/top-k/top-p sampling via ``rng``
+    (a ``np.random.RandomState``). Shared by ``LlamaForCausalLM.generate``
+    and the serving engine so both paths sample identically."""
+    last = np.asarray(last).astype(np.float64)
+    if not do_sample:
+        return last.argmax(-1)
+    if rng is None:
+        rng = np.random.RandomState()
+    last = last / max(temperature, 1e-6)
+    if top_k is not None:
+        k_eff = min(int(top_k), last.shape[1])
+        kth = np.sort(last, -1)[:, -k_eff][:, None]
+        last = np.where(last < kth, -np.inf, last)
+    probs = np.exp(last - last.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    if top_p is not None:
+        srt = np.argsort(-probs, -1)
+        cum = np.cumsum(np.take_along_axis(probs, srt, -1), -1)
+        cut = cum - np.take_along_axis(probs, srt, -1) > top_p
+        kill = np.zeros_like(probs, bool)
+        np.put_along_axis(kill, srt, cut, -1)
+        probs = np.where(kill, 0, probs)
+        probs /= probs.sum(-1, keepdims=True)
+    return np.array([rng.choice(probs.shape[1], p=probs[i])
+                     for i in range(last.shape[0])])
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -143,6 +258,19 @@ class LlamaAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=attn_mask is None)
         return self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
+
+    def forward_cached(self, x, k_buf, v_buf, pos, cos_t, sin_t):
+        """Static-cache step (prefill when ``pos==0`` with s>1, decode when
+        s==1): project, rope at offset ``pos``, write into the preallocated
+        buffers, attend over the prefix. Returns (out, k_buf, v_buf)."""
+        b, s = x.shape[0], x.shape[1]
+        q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        q = _rope_apply_at(q, cos_t, sin_t, pos)
+        k = _rope_apply_at(k, cos_t, sin_t, pos)
+        out, k_buf, v_buf = _cached_attn_step(q, k, v, k_buf, v_buf, pos)
+        return (self.o_proj(M.reshape(out, [b, s, -1])), k_buf, v_buf)
 
     def forward_einsum_block(self, x, cos, sin, attn_mask=None):
         """Head-major single-op attention block (PT_ATTN_EINSUM=1): the
@@ -294,6 +422,13 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMoE(config) if use_moe else LlamaMLP(config)
         self._fusable_norm = config.hidden_size % 128 == 0
 
+    def forward_cached(self, x, k_buf, v_buf, pos, cos_t, sin_t):
+        attn_out, k_buf, v_buf = self.self_attn.forward_cached(
+            self.input_layernorm(x), k_buf, v_buf, pos, cos_t, sin_t)
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, k_buf, v_buf
+
     def forward(self, x, cos, sin, attn_mask=None, cache=None):
         if cache is not None:
             attn_out, new_cache = self.self_attn(
@@ -340,6 +475,19 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
+    def forward_cached(self, input_ids, k_bufs, v_bufs, pos):
+        """Static-cache forward: ``k_bufs``/``v_bufs`` are per-layer
+        [B, C, Hkv, D] buffers (arrays or Tensors), ``pos`` the write
+        offset. Returns (normed hidden, new k_bufs, new v_bufs)."""
+        x = self.embed_tokens(input_ids)
+        new_k, new_v = [], []
+        for layer, kb, vb in zip(self.layers, k_bufs, v_bufs):
+            x, kb, vb = layer.forward_cached(x, kb, vb, pos,
+                                             self.rope_cos, self.rope_sin)
+            new_k.append(kb)
+            new_v.append(vb)
+        return self.norm(x), new_k, new_v
+
     def forward(self, input_ids, attn_mask=None, caches=None):
         x = self.embed_tokens(input_ids)
         s = input_ids.shape[1]
@@ -359,7 +507,12 @@ class LlamaModel(Layer):
         return self.norm(x)
 
 
+import itertools as _itertools
+
+
 class LlamaForCausalLM(Layer):
+    _decode_instance_ids = _itertools.count(1)
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -392,68 +545,118 @@ class LlamaForCausalLM(Layer):
             return loss, logits
         return logits
 
-    # ---- generation (KV-cache decode) --------------------------------
+    # ---- generation (static-capacity KV-cache decode) ----------------
+    #: decode caches round their capacity up to this multiple so compile
+    #: count is O(capacity buckets), not O(distinct prompt+max_new sums)
+    DECODE_CAPACITY_BUCKET = 64
+
+    def _unique_params(self):
+        seen, params = set(), []
+        for _, p in self.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+        return params
+
+    def _cached_step_jit(self):
+        """Lazily-built compiled (prefill+decode) step over the static KV
+        cache: ``(param_arrays, ids, pos, k_bufs, v_bufs) -> (last-position
+        logits [B, V], k_bufs, v_bufs)``. One executable per (batch,
+        seq-len, capacity) shape — decode steps all share one — counted in
+        ``paddle.jit.cache_stats()`` under this model's ``llama_decode#n``
+        row. Cache buffers are donated on TPU backends."""
+        jit = self.__dict__.get("_gen_jit")
+        if jit is not None:
+            return jit
+        from ..core import state as _state
+        from ..jit.cache import CountingJit
+
+        params = self._unique_params()
+        model = self
+
+        def pure(param_arrays, ids, pos, k_bufs, v_bufs):
+            old = [p._data for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                with _state.trace_guard():
+                    h, k_bufs, v_bufs = model.llama.forward_cached(
+                        Tensor._wrap(ids), k_bufs, v_bufs, pos)
+                    h = h[:, -1:]
+                    logits = (model.lm_head(h) if model.lm_head is not None
+                              else F.linear(
+                                  h, model.llama.embed_tokens.weight.t()))
+            finally:
+                for p, a in zip(params, old):
+                    p._data = a
+
+            def arr(x):
+                return x._data if isinstance(x, Tensor) else x
+
+            return (arr(logits)[:, 0], [arr(b) for b in k_bufs],
+                    [arr(b) for b in v_bufs])
+
+        name = f"llama_decode#{next(LlamaForCausalLM._decode_instance_ids)}"
+        jit = CountingJit(pure, name, donate_argnums=(3, 4))
+        self.__dict__["_gen_jit"] = jit
+        self.__dict__["_gen_params"] = params
+        return jit
+
+    def cached_step(self, ids, cache: StaticKVCache):
+        """Run one compiled static-cache step over ``ids`` (np/jnp
+        [B, s] int32) at the cache's current offset; advances the cache
+        and returns last-position logits as a jax array [B, V]."""
+        import jax.numpy as jnp
+
+        jit = self._cached_step_jit()
+        params = self.__dict__["_gen_params"]
+        logits, cache.k, cache.v = jit(
+            [p._data for p in params], jnp.asarray(ids, jnp.int32),
+            np.int32(cache.pos), cache.k, cache.v)
+        cache.pos += int(ids.shape[1])
+        return logits
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None, eos_token_id=None, seed=None,
                  do_sample=False):
-        """Autoregressive decode with per-layer KV caches: one causal
-        prefill over the prompt, then seq-1 steps against the cache
-        (capability analog of PaddleNLP's model.generate greedy/sampling
-        path). Returns [B, prompt + new] token ids."""
+        """Autoregressive decode against a preallocated static-capacity KV
+        cache (capability analog of PaddleNLP's model.generate
+        greedy/sampling path): one compiled prefill over the prompt writes
+        K/V at offset 0, then each new token runs the SAME compiled decode
+        step at an advancing offset — O(1) XLA compiles per capacity
+        bucket across the whole decode instead of the old concat-grown
+        cache's compile-and-copy per token. Returns [B, prompt + new]."""
         rng = np.random.RandomState(seed)
         b, s = input_ids.shape[0], input_ids.shape[1]
-        L = self.config.num_hidden_layers
         limit = self.config.max_position_embeddings
         if s + max_new_tokens > limit:
             raise ValueError(
                 f"generate: prompt ({s}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_position_embeddings "
                 f"({limit})")
+        bucket = self.DECODE_CAPACITY_BUCKET
+        capacity = min(-(-(s + max_new_tokens) // bucket) * bucket, limit)
+        dtype = self.llama.layers[0].self_attn.k_proj.weight.dtype
+        cache = StaticKVCache(self.config, b, capacity, dtype=dtype)
 
-        # causal prefill THROUGH the cache path (explicit tril mask: the
-        # cache branch runs non-causal sdpa so the mask must say causal)
-        mask = Tensor(np.tril(np.ones((1, 1, s, s), bool)))
-        empty = Tensor(np.zeros(
-            (b, 0, self.config.num_key_value_heads, self.config.head_dim),
-            np.float32))
-        caches = [(empty, empty) for _ in range(L)]
-        h, caches = self.llama(input_ids, mask, caches)
+        logits = self.cached_step(input_ids._data
+                                  if isinstance(input_ids, Tensor)
+                                  else input_ids, cache)
         out_ids = [input_ids]
         finished = np.zeros(b, bool)
         for step in range(max_new_tokens):
-            h = h[:, -1:]  # only the last position feeds the head
-            logits = (self.lm_head(h) if self.lm_head is not None
-                      else F.linear(h, self.llama.embed_tokens.weight.t()))
-            last = logits[:, -1].numpy().astype(np.float64)  # [B, V]
-            if do_sample:
-                last = last / max(temperature, 1e-6)
-                if top_k is not None:
-                    k_eff = min(int(top_k), last.shape[1])
-                    kth = np.sort(last, -1)[:, -k_eff][:, None]
-                    last = np.where(last < kth, -np.inf, last)
-                probs = np.exp(last - last.max(-1, keepdims=True))
-                probs /= probs.sum(-1, keepdims=True)
-                if top_p is not None:
-                    srt = np.argsort(-probs, -1)
-                    cum = np.cumsum(np.take_along_axis(probs, srt, -1), -1)
-                    cut = cum - np.take_along_axis(probs, srt, -1) > top_p
-                    kill = np.zeros_like(probs, bool)
-                    np.put_along_axis(kill, srt, cut, -1)
-                    probs = np.where(kill, 0, probs)
-                    probs /= probs.sum(-1, keepdims=True)
-                nxt = np.array([rng.choice(probs.shape[1], p=probs[i])
-                                for i in range(b)])
-            else:
-                nxt = last.argmax(-1)
+            nxt = sample_next_tokens(logits, do_sample=do_sample,
+                                     temperature=temperature, top_k=top_k,
+                                     top_p=top_p, rng=rng)
             if eos_token_id is not None:
                 nxt = np.where(finished, eos_token_id, nxt)
                 finished |= nxt == eos_token_id
-            cur = Tensor(nxt.astype(np.int32)[:, None])
-            out_ids.append(cur)
+            cur = nxt.astype(np.int32)[:, None]
+            out_ids.append(Tensor(cur))
             if eos_token_id is not None and finished.all():
                 break
             if step + 1 < max_new_tokens:  # no wasted trailing forward
-                h, caches = self.llama(cur, None, caches)
+                logits = self.cached_step(cur, cache)
         return M.concat(out_ids, axis=1)
 
     # ---- sharding plan (consumed by auto_parallel / graft dryrun) ----

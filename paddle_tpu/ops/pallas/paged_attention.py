@@ -1,0 +1,120 @@
+"""Paged-attention decode kernel — Pallas TPU (ISSUE 7 tentpole, part b).
+
+Single-token decode over a block-paged KV cache (PAPERS.md: "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for TPU").
+Each grid step (request b, page p) DMAs ONE pool block — chosen by the
+scalar-prefetched block table, so the gather never materializes the
+per-request KV in HBM — and folds it into an online-softmax accumulator
+held in VMEM scratch across the page loop. Ragged per-request lengths come
+from the scalar-prefetched ``context_lens``: pages past a request's length
+are skipped (``pl.when``), and the tail page masks positions beyond the
+length, so ONE compiled kernel serves any mix of request lengths — the
+whole point of the paged layout.
+
+Layouts:
+  q            [B, H, D]         (one decode token per request)
+  k/v pool     [N, block, Hkv, D]
+  block_tables [B * P] int32     (flattened; P = max pages per request)
+  context_lens [B]     int32     (tokens INCLUDING the one just written)
+
+GQA: kv heads are broadcast to q heads inside the kernel (VMEM-local
+repeat, the pool stays at Hkv).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _interpret
+
+__all__ = ["paged_decode_attention_pallas", "use_pallas_paged"]
+
+
+def use_pallas_paged(head_dim, block_size):
+    """The real-TPU gate: MXU-friendly head_dim and a lane-aligned block.
+    Interpret mode (PT_PALLAS_INTERPRET=1) runs anywhere for parity tests."""
+    if _interpret():
+        return True
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    return head_dim % 128 == 0 and block_size % 8 == 0
+
+
+def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, block_size, groups, scale):
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = lens_ref[pl.program_id(0)]
+    n_pages = (ctx + block_size - 1) // block_size
+
+    @pl.when(p < n_pages)
+    def _page():
+        q = q_ref[0].astype(jnp.float32) * scale          # [H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [block, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        kt = jnp.repeat(jnp.swapaxes(k, 0, 1), groups, axis=0)  # [H, blk, D]
+        vt = jnp.repeat(jnp.swapaxes(v, 0, 1), groups, axis=0)
+        s = jax.lax.dot_general(q, kt, (((1,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32)  # [H,blk]
+        tok = p * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(tok < ctx, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pexp = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(pexp, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            pexp, vt, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+        # revisited output block: the LAST active page's write survives
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
+                                  context_lens, scale):
+    """q [B, H, D]; pools [N, block, Hkv, D]; block_tables [B, P] int32;
+    context_lens [B] int32. Returns [B, H, D]."""
+    b, h, d = q.shape
+    n, block_size, hkv, _ = k_pool.shape
+    p = block_tables.shape[1]
+    groups = h // hkv
+    tables_flat = block_tables.reshape(-1).astype(jnp.int32)
+    lens = context_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, p),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j, T, L: (i, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda i, j, T, L: (T[i * p + j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda i, j, T, L: (T[i * p + j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, T, L: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, block_size=block_size, groups=groups,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=_interpret(),
+    )(tables_flat, lens, q, k_pool, v_pool)

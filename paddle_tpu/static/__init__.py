@@ -242,6 +242,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     import pickle
 
     import jax
+    import jax.export  # noqa: F401  (submodule not auto-imported)
 
     feed_vars = (feed_vars if isinstance(feed_vars, (list, tuple))
                  else [feed_vars])

@@ -1,0 +1,269 @@
+"""Serving engine A/B harness (ISSUE 7 tentpole, PERF.md discipline).
+
+Replays ONE seeded Poisson multi-tenant request stream (exponential
+inter-arrival times, varied prompt lengths and generation budgets) through
+two arms over the SAME model weights:
+
+  naive    batch-of-one FIFO loop: each request waits for its arrival
+           time, then runs ``model.generate`` alone — the pre-engine
+           serving story (one request on the chip at a time)
+  engine   ``inference.serving.LLMEngine``: continuous batching over the
+           paged KV pool — arrivals are admitted mid-decode at token
+           granularity, up to ``max_batch_size`` requests share every
+           fixed-shape decode step
+
+Both arms decode greedily, so outputs must be BIT-EXACT across arms
+(asserted in the summary) — batching changes WHO shares a step, never the
+math. Compiles are warmed before the timed window in both arms by
+replaying the stream's shape set once (the engine acceptance is ZERO
+decode-graph compiles inside the timed window, proven from
+``paddle.jit.cache_stats()``), so the measured effect is steady-state
+batching, not compile amortization.
+
+Metrics per arm: generated tokens/s over the makespan, and per-request
+latency (finish − arrival) p50/p99.
+
+The harness (``default_sizing`` / ``request_stream`` / ``run_naive`` /
+``run_engine``) is also imported by bench.py's ``serving`` workload and
+tests/test_serving.py's acceptance test so the bench line, the probe and
+the test can never drift apart.
+
+Usage:
+  python scripts/bench_serving.py [--requests 16] [--rate 40]
+      [--max-batch 4] [--seed 0] [--tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_sizing(tiny):
+    """(cfg, stream kwargs, engine kwargs) shared by this probe, bench.py's
+    ``serving`` workload and the acceptance test."""
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:  # CI / CPU smoke
+        cfg = llama_tiny()
+        stream = dict(n=16, rate=150.0, min_prompt=4, max_prompt=24,
+                      min_new=12, max_new=24)
+        engine = dict(num_blocks=160, block_size=8, max_batch_size=8,
+                      max_prefills_per_step=2)
+    else:
+        cfg = llama_small()
+        stream = dict(n=64, rate=100.0, min_prompt=16, max_prompt=256,
+                      min_new=32, max_new=128)
+        engine = dict(num_blocks=512, block_size=16, max_batch_size=8)
+    return cfg, stream, engine
+
+
+@dataclasses.dataclass
+class _Req:
+    arrival: float
+    prompt: np.ndarray
+    max_new: int
+
+
+def request_stream(cfg, *, n, rate, min_prompt, max_prompt, min_new,
+                   max_new, seed=0):
+    """Seeded Poisson request stream: arrival offsets are cumulative
+    exponential inter-arrival gaps at ``rate`` req/s; prompt lengths and
+    generation budgets are uniform over their ranges."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate, size=n)
+    arrivals = np.cumsum(gaps)
+    out = []
+    for t in arrivals:
+        plen = int(rng.randint(min_prompt, max_prompt + 1))
+        prompt = rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+        out.append(_Req(float(t), prompt, int(rng.randint(min_new,
+                                                          max_new + 1))))
+    return out
+
+
+def _latency_stats(latencies):
+    arr = np.asarray(sorted(latencies))
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+    }
+
+
+def run_naive(model, stream):
+    """Batch-of-one FIFO: each request runs ``model.generate`` alone (the
+    static-cache path — already O(1) compiles per capacity bucket — so the
+    A/B isolates BATCHING, not the old concat-per-token cliff)."""
+    import paddle_tpu as paddle
+
+    outs, lat = [], []
+    t0 = time.perf_counter()
+    for req in stream:
+        now = time.perf_counter() - t0
+        if now < req.arrival:
+            time.sleep(req.arrival - now)
+        ids = paddle.to_tensor(req.prompt[None])
+        out = model.generate(ids, max_new_tokens=req.max_new)
+        outs.append(np.asarray(out.numpy()[0]))
+        lat.append((time.perf_counter() - t0) - req.arrival)
+    wall = time.perf_counter() - t0
+    gen_tokens = sum(r.max_new for r in stream)
+    return dict(outputs=outs, wall_s=round(wall, 4),
+                tokens_per_sec=round(gen_tokens / wall, 1),
+                gen_tokens=gen_tokens, **_latency_stats(lat))
+
+
+def run_engine(model, stream, engine=None, **engine_kwargs):
+    """Continuous batching through ``LLMEngine``; admission respects the
+    same arrival clock the naive arm slept on. Pass a warmed ``engine``
+    (see :func:`warm_arms`) so the timed window starts with its prefill
+    and decode executables already built."""
+    from paddle_tpu.inference.serving import LLMEngine, SamplingParams
+    from paddle_tpu.jit import cache_stats
+
+    eng = engine if engine is not None else LLMEngine(model, **engine_kwargs)
+    steps0 = eng.stats_extra["steps"]
+    evictions0 = eng.scheduler.stats["evictions"]
+    eng.cache.allocator.high_water = 0  # window-local peak (pool is empty)
+    try:
+        row = cache_stats().get(eng._decode_name) or {}
+        compiles0 = row.get("compiles", 0)
+        lat, rids = [], []
+        finish_t = {}
+        i = 0
+        t0 = time.perf_counter()
+        while i < len(stream) or eng.has_work():
+            now = time.perf_counter() - t0
+            while i < len(stream) and stream[i].arrival <= now:
+                rids.append(eng.add_request(
+                    stream[i].prompt,
+                    SamplingParams(max_new_tokens=stream[i].max_new)))
+                i += 1
+            if not eng.has_work():
+                time.sleep(max(0.0, stream[i].arrival - now))
+                continue
+            for out in eng.step():
+                if out.finished:
+                    finish_t[out.rid] = time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        for req, rid in zip(stream, rids):
+            lat.append(finish_t[rid] - req.arrival)
+        outs = [eng.output_tokens(rid) for rid in rids]
+        row = cache_stats().get(eng._decode_name) or {}
+        stats = eng.stats()
+    finally:
+        if engine is None:
+            eng.close()
+    gen_tokens = sum(r.max_new for r in stream)
+    return dict(outputs=outs, wall_s=round(wall, 4),
+                tokens_per_sec=round(gen_tokens / wall, 1),
+                gen_tokens=gen_tokens,
+                decode_compiles_in_window=row.get("compiles", 0) - compiles0,
+                engine_steps=stats["steps"] - steps0,
+                evictions=stats["evictions"] - evictions0,
+                blocks_high_water=stats["blocks_high_water"],
+                **_latency_stats(lat))
+
+
+def warm_arms(model, stream, **engine_kwargs):
+    """Compile every shape both arms will hit — the engine's prefill
+    buckets + its decode graph, and the naive arm's per-capacity-bucket
+    generate executables — untimed. Returns the warmed engine; the timed
+    window must run on THE SAME instance (executables live on the
+    instance's jit wrappers)."""
+    from paddle_tpu.inference.serving import LLMEngine, SamplingParams
+    import paddle_tpu as paddle
+
+    eng = LLMEngine(model, **engine_kwargs)
+    for req in stream:
+        eng.add_request(req.prompt,
+                        SamplingParams(max_new_tokens=req.max_new))
+    for _ in eng.stream():
+        pass
+    caps = set()
+    for req in stream:
+        b = model.DECODE_CAPACITY_BUCKET
+        cap = -(-(len(req.prompt) + req.max_new) // b) * b
+        if (len(req.prompt), cap) not in caps:
+            caps.add((len(req.prompt), cap))
+            model.generate(paddle.to_tensor(req.prompt[None]),
+                           max_new_tokens=req.max_new)
+    return eng
+
+
+def run_ab(cfg=None, stream_kwargs=None, engine_kwargs=None, *, tiny=True,
+           seed=0):
+    """Full A/B: build model, warm, run both arms, cross-check outputs."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    if cfg is None:
+        cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = request_stream(cfg, seed=seed, **stream_kwargs)
+    eng = warm_arms(model, stream, **engine_kwargs)
+    try:
+        naive = run_naive(model, stream)
+        engine = run_engine(model, stream, engine=eng)
+    finally:
+        eng.close()
+    bit_exact = (len(naive["outputs"]) == len(engine["outputs"]) and all(
+        a.shape == b.shape and (a == b).all()
+        for a, b in zip(naive["outputs"], engine["outputs"])))
+    return dict(
+        naive={k: v for k, v in naive.items() if k != "outputs"},
+        engine={k: v for k, v in engine.items() if k != "outputs"},
+        speedup=round(engine["tokens_per_sec"] / naive["tokens_per_sec"], 3),
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+        max_batch_size=engine_kwargs["max_batch_size"],
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--rate", type=float, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU smoke sizing (llama_tiny)")
+    args = ap.parse_args()
+
+    tiny = args.tiny
+    if not tiny:
+        try:
+            import jax
+
+            tiny = jax.default_backend() in ("cpu",)
+        except Exception:
+            tiny = True
+    cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)
+    if args.requests is not None:
+        stream_kwargs["n"] = args.requests
+    if args.rate is not None:
+        stream_kwargs["rate"] = args.rate
+    if args.max_batch is not None:
+        engine_kwargs["max_batch_size"] = args.max_batch
+
+    res = run_ab(cfg, stream_kwargs, engine_kwargs, seed=args.seed)
+    print(json.dumps(res, indent=2))
+    if not res["bit_exact"]:
+        sys.exit("FAIL: engine outputs diverge from batch-of-one greedy")
+    if res["engine"]["decode_compiles_in_window"]:
+        sys.exit("FAIL: decode graph recompiled inside the timed window")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,638 @@
+"""LLM serving engine tests (ISSUE 7): block allocator, paged-vs-dense
+attention parity, continuous-batching bit-exactness, scheduler
+admission/eviction, O(1)-compile decode, create_predictor wiring."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (
+    BlockAllocator, LLMEngine, PagedKVCache, Request, SamplingParams,
+    Scheduler, load_llama_artifact, paged_decode_attention,
+    save_llama_artifact,
+)
+
+
+def tiny_cfg():
+    from paddle_tpu.models import llama_tiny
+
+    return llama_tiny()
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(7)
+    m = LlamaForCausalLM(tiny_cfg())
+    m.eval()
+    return m
+
+
+def prompts_fixed(cfg, lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, n).astype(np.int32)
+            for n in lengths]
+
+
+# ---------------------------------------------------------------------------
+# block allocator
+# ---------------------------------------------------------------------------
+
+class TestBlockAllocator:
+    def test_block_zero_reserved(self):
+        a = BlockAllocator(4)
+        got = a.allocate(3)
+        assert sorted(got) == [1, 2, 3]  # block 0 never handed out
+        assert a.num_free == 0
+
+    def test_exhaustion_all_or_nothing(self):
+        a = BlockAllocator(4)
+        assert a.allocate(2) is not None
+        free_before = a.num_free
+        assert a.allocate(2) is None  # only 1 free
+        assert a.num_free == free_before  # no partial grab
+
+    def test_free_and_lifo_reuse(self):
+        a = BlockAllocator(8)
+        first = a.allocate(3)
+        a.free(first)
+        again = a.allocate(3)
+        assert again == list(reversed(first))  # LIFO: warm blocks first
+        assert a.num_free == 8 - 1 - 3
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(4)
+        ids = a.allocate(1)
+        a.free(ids)
+        with pytest.raises(ValueError):
+            a.free(ids)
+
+    def test_high_water(self):
+        a = BlockAllocator(8)
+        x = a.allocate(4)
+        a.free(x)
+        a.allocate(2)
+        assert a.high_water == 4
+
+    def test_too_small_pool_rejected(self):
+        with pytest.raises(ValueError):
+            BlockAllocator(1)
+
+
+# ---------------------------------------------------------------------------
+# scheduler (host-only: no jax)
+# ---------------------------------------------------------------------------
+
+def _mk_req(n_prompt, **samp):
+    return Request(np.arange(1, n_prompt + 1, dtype=np.int32),
+                   SamplingParams(**samp) if samp else None)
+
+
+class TestScheduler:
+    def _sched(self, num_blocks=16, block_size=4, slots=2, prefills=1):
+        return Scheduler(BlockAllocator(num_blocks), block_size, slots,
+                         prefills)
+
+    def test_fifo_admission_respects_slots_and_quota(self):
+        s = self._sched(slots=2, prefills=4)
+        reqs = [_mk_req(3) for _ in range(3)]
+        s.waiting.extend(reqs)
+        picked = s.pick_prefills()
+        # 3 waiting, 4 allowed per step, but only 2 slots
+        assert [r for _, r in picked] == reqs[:2]
+        assert list(s.waiting) == reqs[2:]
+
+    def test_max_prefills_per_step(self):
+        s = self._sched(slots=4, prefills=1)
+        s.waiting.extend(_mk_req(3) for _ in range(3))
+        assert len(s.pick_prefills()) == 1
+        assert len(s.pick_prefills()) == 1
+
+    def test_queue_on_exhaustion_no_overtake(self):
+        # pool: 3 usable blocks of 4 => a 12-token prompt needs 4 (12+1
+        # tokens) and cannot be admitted; a later short request must NOT
+        # overtake it (FIFO)
+        s = self._sched(num_blocks=4, block_size=4, slots=2)
+        big, small = _mk_req(12), _mk_req(3)
+        s.waiting.extend([big, small])
+        assert s.pick_prefills() == []
+        assert s.stats["queued_on_exhaustion"] == 1
+        assert list(s.waiting) == [big, small]
+
+    def test_finish_frees_blocks(self):
+        s = self._sched()
+        s.waiting.append(_mk_req(6))
+        ((slot, req),) = s.pick_prefills()
+        held = list(req.blocks)
+        assert held
+        s.finish(req)
+        assert req.blocks == [] and s.slots[slot] is None
+        assert s.allocator.num_free == s.allocator.num_blocks - 1
+        assert s.stats["finished"] == 1
+        assert held[0] not in s.allocator._allocated
+
+    def test_eviction_picks_most_recent_and_requeues_front(self):
+        # 7 usable blocks of 2: two 5-token requests (3 blocks each for
+        # tokens+1) admit; growth then exhausts the pool
+        s = self._sched(num_blocks=8, block_size=2, slots=2, prefills=2)
+        a, b = _mk_req(5), _mk_req(5)
+        s.waiting.extend([a, b])
+        assert len(s.pick_prefills()) == 2
+        a.num_cached = b.num_cached = 5
+        a.output_tokens.extend([1])   # tokens=6; writing token 7 needs a
+        b.output_tokens.extend([1])   # 4th block per request, 1 free left
+        s.ensure_decode_room()        # second grower must evict
+        assert s.stats["evictions"] == 1
+        evicted = s.waiting[0]
+        assert evicted in (a, b)
+        assert evicted.blocks == [] and evicted.num_cached == 0
+        assert evicted.state == "waiting" and evicted.evictions == 1
+
+    def test_lone_request_out_of_memory_preempts_self(self):
+        s = self._sched(num_blocks=3, block_size=2, slots=1)
+        r = _mk_req(3)
+        s.waiting.append(r)
+        assert len(s.pick_prefills()) == 1
+        r.num_cached = 3
+        r.output_tokens.extend([1])  # tokens=4; +1 needs 3rd block: none
+        evicted = s.ensure_decode_room()
+        assert evicted == [r] and s.waiting[0] is r
+
+    def test_seeded_stream_never_leaks_blocks(self):
+        rng = np.random.RandomState(0)
+        s = self._sched(num_blocks=12, block_size=2, slots=3, prefills=2)
+        backlog = [_mk_req(int(rng.randint(1, 8))) for _ in range(20)]
+        done = 0
+        for _ in range(300):
+            while backlog and len(s.waiting) < 4:
+                s.waiting.append(backlog.pop())
+            for _, r in s.pick_prefills():
+                r.num_cached = len(r.prompt)
+            s.ensure_decode_room()
+            for r in list(s.running):
+                r.output_tokens.append(1)
+                r.num_cached += 1
+                if len(r.output_tokens) >= 3 and rng.rand() < 0.5:
+                    s.finish(r)
+                    done += 1
+            # invariant: allocated blocks == exactly the running requests'
+            held = sorted(b for r in s.running for b in r.blocks)
+            assert sorted(s.allocator._allocated) == held
+            if done == 20 and not s.has_work():
+                break
+        assert done == 20
+        assert s.allocator.num_free == s.allocator.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# paged attention parity
+# ---------------------------------------------------------------------------
+
+def _paged_case(seed=0, B=3, H=4, Hkv=2, D=16, block=4, P=5, N=32):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(B, 1, H, D).astype(np.float32)
+    k_pool = rng.randn(N, block, Hkv, D).astype(np.float32)
+    v_pool = rng.randn(N, block, Hkv, D).astype(np.float32)
+    # distinct non-null blocks per request
+    perm = rng.permutation(np.arange(1, N))[:B * P].reshape(B, P)
+    lens = rng.randint(1, P * block + 1, size=B).astype(np.int32)
+    return q, k_pool, v_pool, perm.astype(np.int32), lens
+
+
+def _dense_reference(q, k_pool, v_pool, tables, lens):
+    """Independent numpy reference: gather + masked softmax, GQA repeat."""
+    B, _, H, D = q.shape
+    _, block, Hkv, _ = k_pool.shape
+    P = tables.shape[1]
+    out = np.zeros_like(q)
+    for i in range(B):
+        k = k_pool[tables[i]].reshape(P * block, Hkv, D)[:lens[i]]
+        v = v_pool[tables[i]].reshape(P * block, Hkv, D)[:lens[i]]
+        k = np.repeat(k, H // Hkv, axis=1)  # [S, H, D]
+        v = np.repeat(v, H // Hkv, axis=1)
+        for h in range(H):
+            s = (q[i, 0, h] @ k[:, h].T) / np.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[i, 0, h] = p @ v[:, h]
+    return out
+
+
+class TestPagedAttentionParity:
+    def test_lax_fallback_matches_dense(self):
+        import jax.numpy as jnp
+
+        q, kp, vp, tables, lens = _paged_case()
+        got = np.asarray(paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens)))
+        np.testing.assert_allclose(got, _dense_reference(q, kp, vp, tables,
+                                                         lens), atol=1e-5)
+
+    def test_single_token_context(self):
+        import jax.numpy as jnp
+
+        q, kp, vp, tables, lens = _paged_case(seed=3)
+        lens[:] = 1  # only the just-written token is visible
+        got = np.asarray(paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens)))
+        np.testing.assert_allclose(got, _dense_reference(q, kp, vp, tables,
+                                                         lens), atol=1e-5)
+
+    def test_pallas_interpret_matches_dense(self, monkeypatch):
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("PT_PALLAS_INTERPRET", "1")
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas, use_pallas_paged)
+
+        assert use_pallas_paged(16, 4)
+        q, kp, vp, tables, lens = _paged_case(seed=5)
+        got = np.asarray(paged_decode_attention_pallas(
+            jnp.asarray(q[:, 0]), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens),
+            1.0 / np.sqrt(q.shape[-1])))[:, None]
+        np.testing.assert_allclose(got, _dense_reference(q, kp, vp, tables,
+                                                         lens), atol=1e-5)
+
+    def test_pallas_routing_gate(self):
+        from paddle_tpu.ops.pallas.paged_attention import use_pallas_paged
+
+        # CPU backend, no interpret: must route to the lax fallback
+        assert not use_pallas_paged(128, 16)
+
+
+# ---------------------------------------------------------------------------
+# static-cache eager generate (satellite: O(1) compiles per bucket)
+# ---------------------------------------------------------------------------
+
+class TestStaticCacheGenerate:
+    def test_greedy_matches_full_forward(self, model):
+        cfg = model.config
+        ids = paddle.to_tensor(prompts_fixed(cfg, [6, 6], seed=1)[0][None])
+        out = model.generate(ids, max_new_tokens=2).numpy()
+        logits = model(ids).numpy()
+        assert out[0, 6] == logits[0, -1].argmax()
+        ext = paddle.to_tensor(out[:, :7].astype(np.int32))
+        assert out[0, 7] == model(ext).numpy()[0, -1].argmax()
+
+    def test_decode_compiles_o1_across_32_tokens(self):
+        from paddle_tpu.models import LlamaForCausalLM
+
+        paddle.seed(3)
+        m = LlamaForCausalLM(tiny_cfg())
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 512, (1, 8)).astype("int32"))
+        m.generate(ids, max_new_tokens=32)
+        row = paddle.jit.cache_stats()[m.__dict__["_gen_jit"].name]
+        # prefill shape + decode shape = 2 compiles; every other decode
+        # step hits (the pre-ISSUE-7 concat path compiled O(tokens))
+        assert row["compiles"] == 2
+        assert row["hits"] == 30
+        # same capacity bucket again: zero new compiles
+        m.generate(ids, max_new_tokens=32)
+        row = paddle.jit.cache_stats()[m.__dict__["_gen_jit"].name]
+        assert row["compiles"] == 2
+
+    def test_capacity_bucketing_bounds_compiles(self):
+        from paddle_tpu.models import LlamaForCausalLM
+
+        paddle.seed(3)
+        m = LlamaForCausalLM(tiny_cfg())
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 512, (1, 8)).astype("int32"))
+        # 8+24 and 8+40 both round up to the same 64-capacity bucket:
+        # the decode executable is shared, only hit counts grow
+        m.generate(ids, max_new_tokens=24)
+        c1 = paddle.jit.cache_stats()[m.__dict__["_gen_jit"].name]["compiles"]
+        m.generate(ids, max_new_tokens=40)
+        c2 = paddle.jit.cache_stats()[m.__dict__["_gen_jit"].name]["compiles"]
+        assert c1 == c2 == 2
+
+    def test_sampling_seeded_reproducible(self, model):
+        ids = paddle.to_tensor(np.zeros((1, 4), "int32"))
+        a = model.generate(ids, max_new_tokens=4, do_sample=True,
+                           temperature=1.3, top_k=16, top_p=0.9, seed=11)
+        b = model.generate(ids, max_new_tokens=4, do_sample=True,
+                           temperature=1.3, top_k=16, top_p=0.9, seed=11)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+# ---------------------------------------------------------------------------
+# engine: continuous batching bit-exactness + lifecycle
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_continuous_batching_bit_exact_vs_batch_of_one(self, model):
+        cfg = model.config
+        prompts = prompts_fixed(cfg, [5, 9, 3, 12], seed=2)
+        refs = [model.generate(paddle.to_tensor(p[None]),
+                               max_new_tokens=8).numpy()[0]
+                for p in prompts]
+        with LLMEngine(model, num_blocks=64, block_size=8,
+                       max_batch_size=4) as eng:
+            outs = eng.generate(prompts,
+                                SamplingParams(max_new_tokens=8))
+            stats = eng.stats()
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+        assert stats["finished"] == 4
+        assert stats["blocks_free"] == 63  # everything freed on finish
+
+    def test_bit_exact_under_eviction(self, model):
+        cfg = model.config
+        prompts = prompts_fixed(cfg, [10, 11, 9], seed=4)
+        refs = [model.generate(paddle.to_tensor(p[None]),
+                               max_new_tokens=10).numpy()[0]
+                for p in prompts]
+        # pool deliberately too small for three full requests: forces
+        # token-granularity eviction + re-prefill mid-stream
+        with LLMEngine(model, num_blocks=9, block_size=4,
+                       max_batch_size=3) as eng:
+            outs = eng.generate(prompts,
+                                SamplingParams(max_new_tokens=10))
+            stats = eng.stats()
+        assert stats["evictions"] >= 1  # the stress actually happened
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+
+    def test_pool_exhaustion_queues_not_crashes(self, model):
+        cfg = model.config
+        prompts = prompts_fixed(cfg, [8, 8, 8], seed=5)
+        # 4 usable blocks of 4 = room for ~one request at a time
+        with LLMEngine(model, num_blocks=5, block_size=4,
+                       max_batch_size=2) as eng:
+            outs = eng.generate(prompts, SamplingParams(max_new_tokens=6))
+            stats = eng.stats()
+        assert len(outs) == 3 and all(len(o) == 14 for o in outs)
+        assert stats["queued_on_exhaustion"] >= 1
+        assert stats["finished"] == 3
+
+    def test_eos_finishes_and_frees_blocks(self, model):
+        cfg = model.config
+        p = prompts_fixed(cfg, [6], seed=6)[0]
+        first = int(model.generate(paddle.to_tensor(p[None]),
+                                   max_new_tokens=1).numpy()[0, -1])
+        with LLMEngine(model, num_blocks=16, block_size=8,
+                       max_batch_size=2) as eng:
+            rid = eng.add_request(p, SamplingParams(max_new_tokens=32,
+                                                    eos_token_id=first))
+            finals = [o for o in eng.stream() if o.finished]
+            assert eng.request(rid).finish_reason() == "eos"
+            assert len(eng.output_tokens(rid)) == 7  # stopped at eos
+            assert eng.stats()["blocks_free"] == 15
+        assert finals[0].rid == rid
+
+    def test_one_decode_compile_across_request_mix(self, model):
+        cfg = model.config
+        with LLMEngine(model, num_blocks=64, block_size=8,
+                       max_batch_size=4) as eng:
+            eng.generate(prompts_fixed(cfg, [4, 7], seed=7),
+                         SamplingParams(max_new_tokens=5))
+            eng.generate(prompts_fixed(cfg, [3, 9, 5, 6], seed=8),
+                         SamplingParams(max_new_tokens=7))
+            row = paddle.jit.cache_stats()[eng._decode_name]
+        # every decode step of every mix hits ONE executable
+        assert row["compiles"] == 1
+        assert row["hits"] >= 10
+
+    def test_request_longer_than_capacity_rejected(self, model):
+        with LLMEngine(model, num_blocks=4, block_size=4,
+                       max_batch_size=2) as eng:
+            with pytest.raises(ValueError):
+                eng.add_request(np.arange(1, 30, dtype=np.int32),
+                                SamplingParams(max_new_tokens=8))
+
+    def test_request_exceeding_largest_prefill_bucket_rejected(self, model):
+        # custom rungs smaller than max_model_len: a request whose
+        # re-prefill prefix could outgrow the top rung must fail at
+        # add_request, not on the ingest thread mid-stream
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2, prefill_buckets=[32]) as eng:
+            with pytest.raises(ValueError, match="prefill bucket"):
+                eng.add_request(np.arange(1, 21, dtype=np.int32),
+                                SamplingParams(max_new_tokens=20))
+
+    def test_ingest_death_flushes_queued_requests(self, model):
+        cfg = model.config
+        prompts = prompts_fixed(cfg, [5, 7], seed=14)
+        refs = [model.generate(paddle.to_tensor(p[None]),
+                               max_new_tokens=4).numpy()[0]
+                for p in prompts]
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2) as eng:
+            real_stage = eng._ingest._stage
+            calls = {"n": 0}
+
+            def dying_stage(req):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("boom")
+                real_stage(req)
+
+            eng._ingest._stage = dying_stage
+            with pytest.warns(RuntimeWarning, match="ingest thread died"):
+                r1 = eng.add_request(prompts[0],
+                                     SamplingParams(max_new_tokens=4))
+                r2 = eng.add_request(prompts[1],
+                                     SamplingParams(max_new_tokens=4))
+                # both requests (the failing one AND the one queued
+                # behind it) must still complete via sync re-staging
+                for _ in eng.stream():
+                    pass
+            np.testing.assert_array_equal(eng.output_tokens(r1), refs[0])
+            np.testing.assert_array_equal(eng.output_tokens(r2), refs[1])
+
+    def test_release_bounds_request_bookkeeping(self, model):
+        cfg = model.config
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2) as eng:
+            # generate() auto-releases: nothing retained afterwards
+            eng.generate(prompts_fixed(cfg, [4, 6], seed=15),
+                         SamplingParams(max_new_tokens=3))
+            assert eng._requests == {}
+            # a running request cannot be released
+            rid = eng.add_request(prompts_fixed(cfg, [4], seed=16)[0],
+                                  SamplingParams(max_new_tokens=3))
+            eng.step()
+            with pytest.raises(ValueError, match="finished"):
+                eng.release(rid)
+            for _ in eng.stream():
+                pass
+            eng.release(rid)
+            assert rid not in eng._requests
+            eng.release(rid)  # idempotent
+
+    def test_sync_ingest_path(self, model):
+        cfg = model.config
+        prompts = prompts_fixed(cfg, [5, 7], seed=9)
+        refs = [model.generate(paddle.to_tensor(p[None]),
+                               max_new_tokens=4).numpy()[0]
+                for p in prompts]
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2, ingest_async=False) as eng:
+            outs = eng.generate(prompts, SamplingParams(max_new_tokens=4))
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+
+    def test_reload_weights_from_checkpoint_manager(self, model, tmp_path):
+        from paddle_tpu.distributed.checkpoint.manager import (
+            CheckpointManager)
+
+        cfg = model.config
+        p = prompts_fixed(cfg, [6], seed=10)[0]
+        mgr = CheckpointManager(str(tmp_path / "ckpts"))
+        mgr.save(3, model=model)
+        mgr.note_window(True)  # promote to healthy
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2) as eng:
+            ref = eng.generate([p], SamplingParams(max_new_tokens=5))[0]
+            # poison the weights in place — decode now diverges
+            w = model.llama.embed_tokens.weight
+            orig = np.asarray(w.numpy()).copy()
+            w.set_value(paddle.to_tensor(orig + 1.0))
+            bad = eng.generate([p], SamplingParams(max_new_tokens=5))[0]
+            assert not np.array_equal(ref, bad)
+            step = eng.reload_weights(mgr)
+            assert step == 3
+            # NO recompile: same executable, restored outputs
+            compiles = paddle.jit.cache_stats()[eng._decode_name]["compiles"]
+            good = eng.generate([p], SamplingParams(max_new_tokens=5))[0]
+            np.testing.assert_array_equal(ref, good)
+            assert (paddle.jit.cache_stats()[eng._decode_name]["compiles"]
+                    == compiles)
+
+
+# ---------------------------------------------------------------------------
+# create_predictor wiring
+# ---------------------------------------------------------------------------
+
+class TestPredictorWiring:
+    @pytest.fixture(scope="class")
+    def artifact(self, model):
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "model")
+        save_llama_artifact(model, path)
+        return path
+
+    def test_engine_predictor_bit_exact(self, model, artifact):
+        from paddle_tpu import inference
+
+        cfg = model.config
+        c = inference.Config(artifact)
+        c.enable_llm_engine(num_blocks=32, block_size=8, max_batch_size=2,
+                            max_new_tokens=5)
+        pred = inference.create_predictor(c)
+        assert isinstance(pred, inference.LLMEnginePredictor)
+        try:
+            ids = np.stack(prompts_fixed(cfg, [6, 6], seed=11))
+            outs = pred.run([ids])
+            ref = model.generate(paddle.to_tensor(ids.astype(np.int32)),
+                                 max_new_tokens=5).numpy()
+            for i in range(2):
+                np.testing.assert_array_equal(outs[i], ref[i])
+            assert pred.get_output_names() == ["out0", "out1"]
+        finally:
+            pred.close()
+
+    def test_seq_lens_handle_trims_padding(self, model, artifact):
+        from paddle_tpu import inference
+
+        cfg = model.config
+        c = inference.Config(artifact)
+        c.enable_llm_engine(num_blocks=32, block_size=8, max_batch_size=2,
+                            max_new_tokens=4)
+        pred = inference.create_predictor(c)
+        try:
+            row = prompts_fixed(cfg, [5], seed=12)[0]
+            padded = np.zeros((1, 9), np.int32)
+            padded[0, :5] = row
+            (out,) = pred.run([padded, np.array([5])])
+            ref = model.generate(paddle.to_tensor(row[None]),
+                                 max_new_tokens=4).numpy()[0]
+            np.testing.assert_array_equal(out, ref)
+            # seq_lens is per-batch: the next run's unpadded 2-row batch
+            # must NOT be truncated by the stale [5]
+            rows2 = np.stack(prompts_fixed(cfg, [7, 7], seed=14))
+            outs2 = pred.run([rows2])
+            ref2 = model.generate(paddle.to_tensor(rows2.astype(np.int32)),
+                                  max_new_tokens=4).numpy()
+            for i in range(2):
+                np.testing.assert_array_equal(outs2[i], ref2[i])
+            # mismatched seq_lens count is a typed error, not silent
+            with pytest.raises(ValueError, match="seq_lens"):
+                pred.run([rows2, np.array([7])])
+        finally:
+            pred.close()
+
+    def test_artifact_roundtrip(self, model, artifact):
+        m2 = load_llama_artifact(artifact)
+        ids = paddle.to_tensor(
+            prompts_fixed(model.config, [6], seed=13)[0][None])
+        np.testing.assert_array_equal(
+            model.generate(ids, max_new_tokens=3).numpy(),
+            m2.generate(ids, max_new_tokens=3).numpy())
+
+    def test_knob_recorded_for_non_llama_artifacts(self, tmp_path):
+        from paddle_tpu import inference, nn
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(1)
+        m = nn.Linear(4, 2)
+        m.eval()
+        path = str(tmp_path / "dense")
+        paddle.jit.save(m, path,
+                        input_spec=[InputSpec([-1, 4], "float32", "x")])
+        c = inference.Config(path)
+        c.enable_llm_engine()  # knob on, but not a llama artifact
+        assert c.llm_engine_enabled()
+        pred = inference.create_predictor(c)
+        assert isinstance(pred, inference.Predictor)  # record-only
+        x = np.random.randn(3, 4).astype(np.float32)
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(),
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bench harness acceptance
+# ---------------------------------------------------------------------------
+
+def _bench_mod():
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    return importlib.import_module("bench_serving")
+
+
+class TestBenchServing:
+    def test_ab_smoke_bit_exact_zero_recompiles(self):
+        bsv = _bench_mod()
+        cfg, _, _ = bsv.default_sizing(tiny=True)
+        res = bsv.run_ab(cfg,
+                         dict(n=5, rate=200.0, min_prompt=4, max_prompt=10,
+                              min_new=4, max_new=8),
+                         dict(num_blocks=32, block_size=8, max_batch_size=4),
+                         seed=0)
+        assert res["bit_exact"]
+        assert res["engine"]["decode_compiles_in_window"] == 0
+
+    @pytest.mark.slow
+    def test_acceptance_2x_tokens_per_sec(self):
+        # ISSUE 7 acceptance: >=2x tokens/s vs the naive batch-of-one
+        # loop on the llama CPU smoke, bit-exact, zero decode recompiles
+        bsv = _bench_mod()
+        res = bsv.run_ab(tiny=True)
+        assert res["bit_exact"]
+        assert res["engine"]["decode_compiles_in_window"] == 0
+        assert res["speedup"] >= 2.0, res
