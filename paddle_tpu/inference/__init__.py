@@ -207,7 +207,9 @@ class Predictor:
         for i, (shape, dtype, name) in enumerate(specs):
             name = name or f"x{i}"
             self._inputs[name] = Tensor(name, (shape, dtype))
-        self._outputs = {}
+        # placeholder handle so every advertised output name is fetchable
+        # even before the first run() (its value stays None until then)
+        self._outputs = {"out0": Tensor("out0")}
         self._lock = threading.Lock()
 
     # ---- handles --------------------------------------------------------
@@ -218,7 +220,7 @@ class Predictor:
         return self._inputs[name]
 
     def get_output_names(self):
-        return list(self._outputs) or ["out0"]
+        return list(self._outputs)
 
     def get_output_handle(self, name):
         return self._outputs[name]
@@ -237,11 +239,12 @@ class Predictor:
         with self._lock:
             out = self._layer._exported.call(self._layer._consts, *args)
         outs = [np.asarray(o) for o in out]
-        self._outputs = {}
+        fresh = {}
         for i, o in enumerate(outs):
             t = Tensor(f"out{i}")
             t._value = o
-            self._outputs[f"out{i}"] = t
+            fresh[f"out{i}"] = t
+        self._outputs = fresh or {"out0": Tensor("out0")}
         return outs
 
     def clone(self):
@@ -251,7 +254,7 @@ class Predictor:
 
         c = copy.copy(self)
         c._inputs = {n: Tensor(n, h._spec) for n, h in self._inputs.items()}
-        c._outputs = {}
+        c._outputs = {"out0": Tensor("out0")}
         c._lock = threading.Lock()
         return c
 
@@ -292,7 +295,9 @@ class LLMEnginePredictor:
         self.engine = LLMEngine(load_llama_artifact(path), **kwargs)
         self._inputs = {"input_ids": Tensor("input_ids", ([-1, -1], "int32")),
                         "seq_lens": Tensor("seq_lens", ([-1], "int32"))}
-        self._outputs = {}
+        # placeholder handle so every advertised output name is fetchable
+        # even before the first run() (one handle per row appears after)
+        self._outputs = {"out0": Tensor("out0")}
 
     def get_input_names(self):
         return list(self._inputs)
@@ -301,7 +306,7 @@ class LLMEnginePredictor:
         return self._inputs[name]
 
     def get_output_names(self):
-        return list(self._outputs) or ["out0"]
+        return list(self._outputs)
 
     def get_output_handle(self, name):
         return self._outputs[name]
@@ -332,11 +337,12 @@ class LLMEnginePredictor:
         # (possibly unpadded, differently-sized) batch is not silently
         # truncated by stale lengths
         self._inputs["seq_lens"]._value = None
-        self._outputs = {}
+        fresh = {}
         for i, o in enumerate(outs):
             t = Tensor(f"out{i}")
             t._value = np.asarray(o)
-            self._outputs[f"out{i}"] = t
+            fresh[f"out{i}"] = t
+        self._outputs = fresh or {"out0": Tensor("out0")}
         return outs
 
     def try_shrink_memory(self):

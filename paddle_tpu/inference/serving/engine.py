@@ -86,11 +86,15 @@ class _IngestThread:
             try:
                 self._stage(req)
             except BaseException as e:
-                self._dead = True
                 warnings.warn(
                     f"LLMEngine ingest thread died ({e!r}); degrading to "
                     "synchronous request staging", RuntimeWarning)
                 with self._cond:
+                    # _dead flips and the queue flushes under ONE lock
+                    # acquisition: submit() holds the same lock across its
+                    # dead-check and enqueue, so a request can never land in
+                    # _q after this flush and be stranded there forever
+                    self._dead = True
                     # flush EVERYTHING un-staged (the failing request AND
                     # anything still queued behind it) back to the engine —
                     # step() re-stages synchronously; stranding them would
@@ -115,14 +119,17 @@ class _IngestThread:
             return self._pending
 
     def submit(self, req):
+        # dead-check and enqueue under one lock hold: the worker's death
+        # path flips _dead and flushes _q while holding the same lock, so
+        # either this put lands before the flush (and gets flushed) or we
+        # observe _dead and hand the request straight to _ready
         with self._cond:
             self._pending += 1
-        if self._dead:
-            with self._cond:
+            if self._dead:
                 self._ready.append(req)
                 self._cond.notify_all()
-            return
-        self._q.put(req)
+                return
+            self._q.put(req)
 
     def drain(self, wait=False, timeout=1.0):
         """Staged requests since the last drain. ``wait=True`` blocks (up
@@ -161,9 +168,25 @@ class LLMEngine:
         model.eval()
         self._was_training = was_training
         limit = self.config.max_position_embeddings
-        self.max_model_len = min(int(max_model_len or limit), limit)
         self.block_size = int(block_size)
-        self.max_pages = -(-self.max_model_len // self.block_size)
+        requested_len = min(int(max_model_len or limit), limit)
+        # block-alignment invariant: prefill writes whole pages only, so a
+        # max_model_len that is not a block multiple would leave the prompt
+        # tail out of the pool at the top bucket — silently wrong decodes.
+        # Round DOWN to whole pages; the truncated tail was unservable anyway.
+        self.max_model_len = (requested_len // self.block_size
+                              ) * self.block_size
+        if self.max_model_len == 0:
+            raise ValueError(
+                f"max_model_len={requested_len} is smaller than "
+                f"block_size={self.block_size}; nothing fits in one page")
+        if self.max_model_len != requested_len:
+            warnings.warn(
+                f"max_model_len={requested_len} is not a multiple of "
+                f"block_size={self.block_size}; rounding down to "
+                f"{self.max_model_len} so prefill stays page-aligned",
+                RuntimeWarning)
+        self.max_pages = self.max_model_len // self.block_size
         dtype = model.llama.layers[0].self_attn.k_proj.weight.dtype
         self.cache = PagedKVCache(self.config, num_blocks, block_size,
                                   dtype=dtype)
@@ -467,7 +490,7 @@ class LLMEngine:
         # -- prefill (admission) ---------------------------------------
         for slot, req in sched.pick_prefills():
             staged = getattr(req, "_staged", None)
-            if staged is None or staged[2] != len(req.tokens):
+            if staged is None or staged[2] != req.num_tokens:
                 self._stage_request(req)  # re-prefill after eviction
                 staged = req._staged
             ids_dev, bucket, true_len = staged
@@ -585,6 +608,8 @@ class LLMEngine:
     def close(self):
         if self._ingest is not None:
             self._ingest.close()
+        if self._was_training:
+            self.model.train()
 
     def __enter__(self):
         return self

@@ -74,6 +74,12 @@ class Request:
             [self.prompt, np.asarray(self.output_tokens, np.int32)])
 
     @property
+    def num_tokens(self):
+        """O(1) token count — ``.tokens`` concatenates, so hot scheduler
+        loops must not call it just to measure."""
+        return len(self.prompt) + len(self.output_tokens)
+
+    @property
     def last_token(self):
         return (self.output_tokens[-1] if self.output_tokens
                 else int(self.prompt[-1]))
@@ -138,7 +144,7 @@ class Scheduler:
         while (len(picked) < self.max_prefills_per_step and self.waiting
                and self._free_slot() is not None):
             req = self.waiting[0]
-            need = -(-(len(req.tokens) + 1) // self.block_size)
+            need = -(-(req.num_tokens + 1) // self.block_size)
             blocks = self.allocator.allocate(need)
             if blocks is None:
                 self.stats["queued_on_exhaustion"] += 1
@@ -163,7 +169,11 @@ class Scheduler:
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
-            while len(req.tokens) + 1 > len(req.blocks) * self.block_size:
+            # the decode step writes ONE token at position len(tokens)-1,
+            # so capacity len(tokens) is exactly enough — demanding a
+            # lookahead block here would evict needlessly when the pool is
+            # full at a block boundary
+            while req.num_tokens > len(req.blocks) * self.block_size:
                 got = self.allocator.allocate(1)
                 if got is not None:
                     req.blocks.extend(got)

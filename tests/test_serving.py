@@ -142,10 +142,10 @@ class TestScheduler:
         a, b = _mk_req(5), _mk_req(5)
         s.waiting.extend([a, b])
         assert len(s.pick_prefills()) == 2
-        a.num_cached = b.num_cached = 5
-        a.output_tokens.extend([1])   # tokens=6; writing token 7 needs a
-        b.output_tokens.extend([1])   # 4th block per request, 1 free left
-        s.ensure_decode_room()        # second grower must evict
+        a.num_cached = b.num_cached = 6
+        a.output_tokens.extend([1, 1])  # tokens=7 > capacity 6: each needs
+        b.output_tokens.extend([1, 1])  # a 4th block, but only 1 is free
+        s.ensure_decode_room()          # second grower must evict
         assert s.stats["evictions"] == 1
         evicted = s.waiting[0]
         assert evicted in (a, b)
@@ -157,10 +157,23 @@ class TestScheduler:
         r = _mk_req(3)
         s.waiting.append(r)
         assert len(s.pick_prefills()) == 1
-        r.num_cached = 3
-        r.output_tokens.extend([1])  # tokens=4; +1 needs 3rd block: none
-        evicted = s.ensure_decode_room()
+        r.num_cached = 4
+        r.output_tokens.extend([1, 1])  # tokens=5 > capacity 4: needs a
+        evicted = s.ensure_decode_room()  # 3rd block and none exist
         assert evicted == [r] and s.waiting[0] is r
+
+    def test_no_eviction_when_exactly_at_block_boundary(self):
+        # decode writes at position len(tokens)-1, so a request whose
+        # tokens EXACTLY fill its blocks needs no growth — demanding a
+        # lookahead block here used to evict when the pool was full
+        s = self._sched(num_blocks=3, block_size=2, slots=1)
+        r = _mk_req(3)
+        s.waiting.append(r)
+        assert len(s.pick_prefills()) == 1  # 2 blocks = capacity 4, 0 free
+        r.num_cached = 3
+        r.output_tokens.append(1)  # tokens=4 == capacity: write pos 3 fits
+        assert s.ensure_decode_room() == []
+        assert s.stats["evictions"] == 0 and r.state == "running"
 
     def test_seeded_stream_never_leaks_blocks(self):
         rng = np.random.RandomState(0)
@@ -420,6 +433,60 @@ class TestEngine:
                 eng.add_request(np.arange(1, 21, dtype=np.int32),
                                 SamplingParams(max_new_tokens=20))
 
+    def test_unaligned_max_model_len_rounds_down(self, model):
+        # an unaligned cap used to leave the top prefill bucket unaligned:
+        # prefill writes whole pages only, so a 34-token prompt's tail
+        # never reached the pool and decode was silently wrong. The cap
+        # now rounds DOWN to whole pages (with a warning) and a prompt
+        # that needed the truncated tail is rejected up front.
+        cfg = model.config
+        with pytest.warns(RuntimeWarning, match="not a multiple"):
+            eng = LLMEngine(model, num_blocks=8, block_size=16,
+                            max_batch_size=2, max_model_len=40)
+        with eng:
+            assert eng.max_model_len == 32
+            assert eng.prefill_buckets[-1] == 32
+            assert all(b % 16 == 0 for b in eng.prefill_buckets)
+            with pytest.raises(ValueError, match="caps at"):
+                eng.add_request(prompts_fixed(cfg, [34], seed=20)[0],
+                                SamplingParams(max_new_tokens=1))
+            p = prompts_fixed(cfg, [20], seed=21)[0]
+            (out,) = eng.generate([p], SamplingParams(max_new_tokens=4))
+            ref = model.generate(paddle.to_tensor(p[None]),
+                                 max_new_tokens=4).numpy()[0]
+            np.testing.assert_array_equal(out, ref)
+
+    def test_max_model_len_below_block_size_rejected(self, model):
+        with pytest.raises(ValueError, match="block_size"):
+            LLMEngine(model, num_blocks=8, block_size=16, max_model_len=8)
+
+    def test_submit_after_ingest_death_not_stranded(self, model):
+        # a request submitted AFTER the worker died and flushed its queue
+        # must land in _ready (drained by step), never sit in _q forever
+        cfg = model.config
+        prompts = prompts_fixed(cfg, [5, 6], seed=22)
+        refs = [model.generate(paddle.to_tensor(p[None]),
+                               max_new_tokens=3).numpy()[0]
+                for p in prompts]
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2) as eng:
+            def boom(req):
+                raise RuntimeError("boom")
+
+            eng._ingest._stage = boom
+            with pytest.warns(RuntimeWarning, match="ingest thread died"):
+                r1 = eng.add_request(prompts[0],
+                                     SamplingParams(max_new_tokens=3))
+                eng._ingest._thread.join(timeout=5.0)
+                assert not eng._ingest._thread.is_alive()
+                r2 = eng.add_request(prompts[1],
+                                     SamplingParams(max_new_tokens=3))
+                assert eng._ingest._q.empty()  # nothing stranded in _q
+                for _ in eng.stream():
+                    pass
+            np.testing.assert_array_equal(eng.output_tokens(r1), refs[0])
+            np.testing.assert_array_equal(eng.output_tokens(r2), refs[1])
+
     def test_ingest_death_flushes_queued_requests(self, model):
         cfg = model.config
         prompts = prompts_fixed(cfg, [5, 7], seed=14)
@@ -542,6 +609,21 @@ class TestPredictorWiring:
         finally:
             pred.close()
 
+    def test_output_names_fetchable_before_run(self, model, artifact):
+        # every advertised output name must resolve to a handle even
+        # before the first run() (it used to KeyError on "out0")
+        from paddle_tpu import inference
+
+        c = inference.Config(artifact)
+        c.enable_llm_engine(num_blocks=16, block_size=8, max_batch_size=2)
+        pred = inference.create_predictor(c)
+        try:
+            assert pred.get_output_names() == ["out0"]
+            h = pred.get_output_handle("out0")
+            assert h.name() == "out0"
+        finally:
+            pred.close()
+
     def test_seq_lens_handle_trims_padding(self, model, artifact):
         from paddle_tpu import inference
 
@@ -595,6 +677,9 @@ class TestPredictorWiring:
         assert c.llm_engine_enabled()
         pred = inference.create_predictor(c)
         assert isinstance(pred, inference.Predictor)  # record-only
+        # advertised output names are fetchable before the first run
+        for n in pred.get_output_names():
+            assert pred.get_output_handle(n).name() == n
         x = np.random.randn(3, 4).astype(np.float32)
         (out,) = pred.run([x])
         np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(),
