@@ -11,6 +11,12 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# forward-compat: newer-jax names (jax.shard_map, sharding.AxisType, ...)
+# installed on older jax runtimes before anything dereferences them
+from .core import jax_compat as _jax_compat
+
+_jax_compat.install()
+
 from .core import dtype as _dtype_mod
 from .core.dtype import (  # noqa: F401
     bfloat16, bool, complex64, complex128, float16, float32, float64,
@@ -99,7 +105,8 @@ from .core.rng import (  # noqa: F401,E402
     set_rng_state as set_cuda_rng_state,
 )
 from .distributed.parallel import DataParallel  # noqa: F401,E402
-from .distributed.checkpoint.manager import CheckpointManager  # noqa: F401,E402
+from .distributed.checkpoint.manager import (  # noqa: F401,E402
+    CheckpointManager, PlanMismatchError)
 
 #: paddle.dtype — callable canonicalizer (the reference exposes the VarType
 #: class; under JAX a dtype IS its canonical string/np form)

@@ -1,0 +1,153 @@
+"""Forward-compat shims for new-jax APIs on older jax runtimes.
+
+The codebase is written against the current jax surface — ``jax.shard_map``
+with ``axis_names``/``check_vma``, ``jax.sharding.AxisType``,
+``jax.make_mesh(..., axis_types=)`` and ``jax.lax.axis_size`` — but some
+images pin jax 0.4.x, where shard_map still lives in
+``jax.experimental.shard_map`` with the ``check_rep``/``auto`` spelling and
+the other names do not exist at all. :func:`install` bridges the gap by
+installing the missing attributes AT IMPORT (``paddle_tpu/__init__``), only
+when absent: on a current jax it is a no-op, so there is no behavior fork
+on the supported path.
+
+Semantics notes for the 0.4.x bridge:
+
+- ``axis_names`` (partial-manual shard_map) maps to FULL-manual
+  (``auto=frozenset()``), not to ``auto=<other axes>``: the 0.4.x SPMD
+  partitioner hard-crashes (``IsManualSubgroup`` check) on partial-manual
+  regions with NamedSharding-committed inputs on CPU. Full-manual is
+  value-identical whenever ``in_specs`` fully describe the intended layout
+  and the body only issues collectives over the named axes — true for
+  every shard_map in this repo (attention collectives, pp stage scan, MoE
+  dispatch). What degrades is only GSPMD auto-partitioning *inside* the
+  body over the unnamed axes (e.g. tp within a pp stage): those dims
+  compute replicated on 0.4.x. Documented perf cliff, not a correctness
+  one.
+- ``check_vma``/``check_rep`` map to ``check_rep=False``: with the
+  partial→full manual conversion the replication claims in ``out_specs``
+  are not what 0.4.x's checker would verify, and every call site in this
+  repo opts out anyway.
+- ``jax.lax.axis_size(name)`` maps to ``lax.psum(1, name)`` — a Python
+  int 1 reduced over the axis is folded statically, so the result is a
+  plain int usable for trip counts and permutation tables.
+"""
+
+from __future__ import annotations
+
+__all__ = ["install"]
+
+
+def _shim_shard_map(jax):
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None,
+                  auto=None):
+        del axis_names, check_vma, check_rep, auto  # see module docstring
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+
+    shard_map.__doc__ = ("paddle_tpu jax_compat bridge to "
+                         "jax.experimental.shard_map (full-manual, "
+                         "check_rep=False); see core/jax_compat.py")
+    return shard_map
+
+
+class _AxisType:
+    """Stand-in for ``jax.sharding.AxisType`` (sharding-in-types axis
+    kinds). Old jax has no Explicit mode — every mesh axis already behaves
+    like ``Auto`` — so the members only need identity."""
+
+    class _Member:
+        def __init__(self, name):
+            self._name = name
+
+        def __repr__(self):
+            return f"AxisType.{self._name}"
+
+    Auto = _Member("Auto")
+    Explicit = _Member("Explicit")
+    Manual = _Member("Manual")
+
+
+def install():
+    """Install the missing attributes on ``jax``. Idempotent; no-op on a
+    jax that already provides them."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shim_shard_map(jax)
+
+    if not hasattr(jax.sharding, "AxisType"):
+        jax.sharding.AxisType = _AxisType
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax.lax, "pcast"):
+        # VMA (varying-manual-axes) casts only exist alongside check_vma;
+        # with the bridge's check_rep=False there is no varying-ness
+        # tracking to satisfy — identity is the correct lowering
+        def pcast(x, axis_names=None, *, to=None):
+            del axis_names, to
+            return x
+
+        jax.lax.pcast = pcast
+
+    import inspect
+
+    try:
+        accepts_axis_types = "axis_types" in inspect.signature(
+            jax.make_mesh).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        accepts_axis_types = True
+    if not accepts_axis_types:
+        _orig_make_mesh = jax.make_mesh
+
+        def make_mesh(axis_shapes, axis_names, *, devices=None,
+                      axis_types=None):
+            del axis_types  # pre-AxisType jax: every axis is Auto already
+            return _orig_make_mesh(axis_shapes, axis_names, devices=devices)
+
+        make_mesh.__wrapped__ = _orig_make_mesh
+        jax.make_mesh = make_mesh
+
+    # transitional 0.4.x Mesh takes axis_types as a {AxisTypes: names} dict
+    # (or not at all); the codebase passes the current per-axis tuple form.
+    # Normalize tuple/list axis_types away — on these versions None already
+    # means classic auto/GSPMD for every axis, which is what AxisType.Auto
+    # requests. Patched on the class so jax.sharding.Mesh and
+    # jax._src.mesh.Mesh callers both see it.
+    if not hasattr(jax.sharding, "_pt_axis_types_normalized"):
+        mesh_cls = jax.sharding.Mesh
+        try:
+            new_params = inspect.signature(mesh_cls.__new__).parameters
+        except (TypeError, ValueError):  # pragma: no cover
+            new_params = {}
+        needs_normalize = \
+            isinstance(getattr(jax.sharding, "AxisType", None), type) and \
+            jax.sharding.AxisType is _AxisType
+        if needs_normalize and "axis_types" in new_params:
+            _orig_new = mesh_cls.__new__
+
+            def _mesh_new(cls, devices, axis_names=None, axis_types=None,
+                          *args, **kwargs):
+                if isinstance(axis_types, (tuple, list)):
+                    axis_types = None
+                return _orig_new(cls, devices, axis_names, axis_types,
+                                 *args, **kwargs)
+
+            mesh_cls.__new__ = _mesh_new
+            jax.sharding._pt_axis_types_normalized = True
+        elif needs_normalize:  # Mesh without axis_types support at all
+            _orig_new2 = mesh_cls.__new__
+
+            def _mesh_new2(cls, devices, axis_names=None, *args, **kwargs):
+                kwargs.pop("axis_types", None)
+                return _orig_new2(cls, devices, axis_names, *args, **kwargs)
+
+            mesh_cls.__new__ = _mesh_new2
+            jax.sharding._pt_axis_types_normalized = True

@@ -24,6 +24,7 @@ __all__ = [
     "ProcessMesh", "Shard", "Replicate", "Partial", "get_mesh", "set_mesh",
     "spawn", "launch", "save_state_dict", "load_state_dict",
     "CheckpointManager",
+    "PlanMismatchError",
 ]
 
 _initialized = False
@@ -104,10 +105,12 @@ from .auto_parallel import (  # noqa: E402,F401
 from .auto_parallel.placement import Placement  # noqa: E402,F401
 from .parallel import DataParallel  # noqa: E402,F401
 from . import fleet  # noqa: E402,F401
+from . import plan  # noqa: E402,F401
+from .plan import Plan, compile_step_with_plan  # noqa: E402,F401
 from . import ps  # noqa: E402,F401
 from . import sharding  # noqa: E402,F401
 from .checkpoint import (  # noqa: E402,F401
-    CheckpointManager, load_state_dict, save_state_dict)
+    CheckpointManager, PlanMismatchError, load_state_dict, save_state_dict)
 from .collective import destroy_process_group, is_available  # noqa: E402,F401
 from .compat import (  # noqa: E402,F401
     CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,

@@ -46,7 +46,8 @@ from ...core.tensor import Tensor
 from ...framework.io import CheckpointCorruptionError
 
 __all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
-           "CheckpointManager", "CheckpointCorruptionError", "is_committed",
+           "CheckpointManager", "PlanMismatchError",
+           "CheckpointCorruptionError", "is_committed",
            "verify_checkpoint", "sync_processes", "allgather_success",
            "allgather_ints"]
 
@@ -582,4 +583,5 @@ def load_state_dict(state_dict, path, process_group=None,
     return state_dict
 
 
-from .manager import CheckpointManager  # noqa: E402  (needs the fns above)
+from .manager import (  # noqa: E402  (needs the fns above)
+    CheckpointManager, PlanMismatchError)

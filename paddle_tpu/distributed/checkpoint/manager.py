@@ -47,13 +47,24 @@ from . import (_write_commit, is_committed, load_state_dict, save_state_dict,
                verify_checkpoint)
 from ...framework.io import CheckpointCorruptionError
 
-__all__ = ["CheckpointManager"]
+__all__ = ["CheckpointManager", "PlanMismatchError"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _OPT_FILE = "optimizer.pdopt"
 _SCALER_FILE = "scaler.pdscaler"
 _SAMPLER_FILE = "sampler.pdsampler"
 _HEALTH_FILE = "HEALTHY"
+_PLAN_FILE = "plan.json"
+
+
+class PlanMismatchError(RuntimeError):
+    """A checkpoint written under one sharding plan is being restored
+    under an incompatible one (different mesh shape, or different
+    param-spec/strategy tables over the same mesh). Restoring anyway
+    would mis-shard silently — weights land on a layout the compiled
+    step was not built for. Re-create the Plan the checkpoint records
+    (``plan.json`` in the step directory holds its mesh + digest), or
+    restore with ``plan=None`` to skip the check deliberately."""
 
 
 def _resolve_sampler(obj):
@@ -240,8 +251,46 @@ class CheckpointManager:
         return dropped
 
     # ---- save -----------------------------------------------------------
+    # ---- plan fingerprint ----------------------------------------------
+    def plan_fingerprint(self, step):
+        """The ``{"mesh": {...}, "digest": ...}`` fingerprint recorded at
+        save time, or ``None`` for plan-less / pre-plan checkpoints."""
+        import json
+
+        p = os.path.join(self.step_dir(step), _PLAN_FILE)
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    @staticmethod
+    def _check_plan(recorded, plan, step):
+        """Raise :class:`PlanMismatchError` when ``plan``'s fingerprint
+        disagrees with the recorded one. A plan-less checkpoint restored
+        under a plan (or vice versa) passes — there is nothing recorded
+        to contradict; the layout commit in ``auto_resume``'s callers
+        (``FusedTrainStep._adopt_external_rebinds``) re-places arrays."""
+        if recorded is None or plan is None:
+            return
+        fp = plan.fingerprint()
+        if dict(recorded.get("mesh", {})) != dict(fp["mesh"]):
+            raise PlanMismatchError(
+                f"checkpoint step_{step} was written under mesh "
+                f"{recorded.get('mesh')} but is being restored under mesh "
+                f"{fp['mesh']} — restoring would mis-shard silently; "
+                "rebuild the recorded mesh (README: multichip recipe) or "
+                "pass plan=None to override")
+        if recorded.get("digest") != fp["digest"]:
+            raise PlanMismatchError(
+                f"checkpoint step_{step} was written under the same mesh "
+                f"{fp['mesh']} but a DIFFERENT plan table (digest "
+                f"{recorded.get('digest')} vs {fp['digest']}): param/"
+                "moment layouts differ — rebuild the recorded plan or "
+                "pass plan=None to override")
+
     def save(self, step, model=None, optimizer=None, scaler=None,
-             state_dict=None, writer=None, async_save=None, sampler=None):
+             state_dict=None, writer=None, async_save=None, sampler=None,
+             plan=None):
         """Write a committed checkpoint for ``step``. ``model`` /
         ``state_dict`` go through the sharded writer (COMMIT last);
         ``optimizer`` / ``scaler`` / ``sampler`` state dicts are pickled
@@ -280,6 +329,17 @@ class CheckpointManager:
             if sampler is not None:
                 _fio.save(_resolve_sampler(sampler).state_dict(),
                           os.path.join(d, _SAMPLER_FILE))
+            if plan is not None:
+                # step metadata: mesh shape + rule/strategy digest, so a
+                # restore onto an incompatible mesh fails typed instead
+                # of mis-sharding silently (auto_resume(plan=...))
+                import json
+
+                from ...utils.retry import atomic_write
+
+                payload = json.dumps(plan.fingerprint()).encode()
+                atomic_write(os.path.join(d, _PLAN_FILE),
+                             lambda f: f.write(payload))
             if writer is not None:
                 writer(d)
         if jax.process_count() > 1:
@@ -375,7 +435,7 @@ class CheckpointManager:
 
     # ---- resume ---------------------------------------------------------
     def auto_resume(self, model=None, optimizer=None, scaler=None,
-                    verify=False, sampler=None, step=None):
+                    verify=False, sampler=None, step=None, plan=None):
         """Restore ``model`` + ``optimizer`` + ``scaler`` + ``sampler``
         from the newest valid checkpoint and return its step (the
         optimizer's global step / LR schedule ride in its state dict; the
@@ -406,6 +466,9 @@ class CheckpointManager:
                 verify_checkpoint(self.step_dir(step))
         if step is None:
             return None
+        # plan fingerprint gate BEFORE any state is touched: a mismatch
+        # must leave model/optimizer exactly as they were
+        self._check_plan(self.plan_fingerprint(step), plan, step)
         d = self.step_dir(step)
         if model is not None and any(
                 fn.endswith(".npz") for fn in os.listdir(d)):

@@ -1,0 +1,67 @@
+"""Mesh declaration for sharding plans.
+
+One place that turns ``{"dp": 2, "tp": 2}`` into a ``jax.sharding.Mesh``
+over ``jax.devices()`` with the repo's canonical axis vocabulary. The mesh
+is CPU-testable anywhere: export
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (plus
+``JAX_PLATFORMS=cpu``) before jax initializes and ``jax.devices()`` serves
+N virtual host devices — the same trick the tier-1 conftest and the graft
+dryrun use, so every plan in this repo compiles and runs under pytest.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["AXES", "make_mesh", "mesh_axes"]
+
+# canonical axis order: pipeline outermost (manual stage scan), then the
+# batch-ish axes, then the within-layer axes. A plan mesh uses a subset, in
+# this order, so two plans over the same degrees fingerprint identically.
+AXES = ("pp", "dp", "fsdp", "tp", "sep", "ep")
+
+
+def make_mesh(axes, devices=None):
+    """Build a named device mesh from ``{"axis": degree}``.
+
+    ``axes`` may be a dict or a sequence of ``(name, degree)`` pairs. Axis
+    names outside :data:`AXES` are allowed (custom meshes) but dict inputs
+    are reordered to the canonical order; pair-sequences keep caller order.
+    Degree-1 axes are kept — they cost nothing and keep specs stable when a
+    degree is turned down to 1.
+    """
+    if isinstance(axes, dict):
+        known = [a for a in AXES if a in axes]
+        extra = [a for a in axes if a not in AXES]
+        names = tuple(known + extra)
+        sizes = tuple(int(axes[a]) for a in names)
+    else:
+        pairs = list(axes)
+        names = tuple(str(n) for n, _ in pairs)
+        sizes = tuple(int(s) for _, s in pairs)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate mesh axis names: {names}")
+    if any(s < 1 for s in sizes):
+        raise ValueError(f"mesh axis degrees must be >= 1: "
+                         f"{dict(zip(names, sizes))}")
+    need = 1
+    for s in sizes:
+        need *= s
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {need} devices, have "
+            f"{len(devices)}; on CPU export XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need} (before jax "
+            "initializes) to get a virtual mesh")
+    return jax.make_mesh(
+        sizes, names,
+        axis_types=(jax.sharding.AxisType.Auto,) * len(names),
+        devices=tuple(devices[:need]))
+
+
+def mesh_axes(mesh):
+    """``{axis: degree}`` of a mesh, in mesh order."""
+    return {name: int(size) for name, size in
+            zip(mesh.axis_names, mesh.devices.shape)}

@@ -133,14 +133,34 @@ class Model:
         self._loss = None
         self._metrics = []
         self._scaler = None
+        self._plan = None
+        self._planned_step = None
+        self._planned_disabled = False
+        self._planned_fallback_warned = False
         self.stop_training = False
 
     # -- setup -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
-        """ref model.py:1676."""
+                amp_configs=None, plan=None):
+        """ref model.py:1676.
+
+        ``plan``: a :class:`paddle_tpu.distributed.plan.Plan`. The
+        network's parameters are committed to the plan's layouts and
+        ``fit``/``train_batch`` route each update through a
+        ``FusedTrainStep(plan=...)`` — i.e. the hapi loop compiles through
+        the same ``compile_step_with_plan`` layer as fused training and
+        serving (ROADMAP item 3). The planned fused path needs a prepared
+        ``loss``; prepared Metrics or an AMP level fall back to the eager
+        step (with the plan's parameter placement still applied) because
+        metric update needs the forward outputs on the host."""
         self._optimizer = optimizer
         self._loss = loss
+        self._plan = plan
+        self._planned_step = None
+        self._planned_disabled = False
+        self._planned_fallback_warned = False
+        if plan is not None:
+            plan.apply_to_model(self.network)
         self._metrics = _to_list(metrics)
         for m in self._metrics:
             assert isinstance(m, Metric), (
@@ -167,6 +187,29 @@ class Model:
         inputs = _tensorize(inputs)
         labels = _tensorize(labels)
 
+        if self._plan is not None:
+            if not update:
+                # gradient accumulation mixes eager grad state with the
+                # fused step's in-graph update — incoherent. Before the
+                # fused step ever runs, the session degrades to the eager
+                # path; once it HAS run, its Adam moments and step count
+                # live inside the fused step and an eager fallback would
+                # silently discard them (bias correction restarting from
+                # zero) — that is an error, not a degrade
+                if self._planned_step is not None:
+                    raise RuntimeError(
+                        "Model.prepare(plan=...): train_batch(update="
+                        "False) after planned steps have run would "
+                        "discard the optimizer moments/step count held "
+                        "by the fused planned step. prepare() without "
+                        "plan= for gradient accumulation, or keep "
+                        "update=True under the plan")
+                self._planned_disabled = True
+            step = self._planned_train_step(len(labels))
+            if step is not None:
+                loss = step(*inputs, *labels)
+                return [DeferredScalar(loss)], []
+
         if self._amp_level in ("O1", "O2"):
             with _amp.auto_cast(level=self._amp_level):
                 outs = self.network(*inputs)
@@ -186,6 +229,96 @@ class Model:
                 self._optimizer.clear_grad()
         metrics = self._update_metrics(outs, labels)
         return [DeferredScalar(loss)], metrics
+
+    def _planned_train_step(self, n_labels):
+        """The ``FusedTrainStep(plan=...)`` the planned fit path
+        dispatches through — built once, so the whole hapi loop compiles
+        through ``compile_step_with_plan`` like fused training and the
+        serving engine. Returns ``None`` (eager fallback, parameters
+        still on the plan's layouts) when the prepared config cannot take
+        the fused route: AMP, prepared Metrics (they need the forward
+        outputs host-side), no prepared loss, or gradient accumulation."""
+        if (self._planned_disabled or self._amp_level is not None
+                or self._loss is None or self._metrics):
+            pending = getattr(self, "_pending_opt_state", None)
+            if pending is not None:
+                # a Model.load stash destined for the fused step, but the
+                # eager path owns optimizer state from here on — hand it
+                # over (or say loudly why we can't) instead of silently
+                # training with zeroed moments/step count
+                self._pending_opt_state = None
+                if self._fused_opt_format(pending):
+                    warnings.warn(
+                        "Model.load restored optimizer state in the "
+                        "fused planned-step format, but this session "
+                        "takes the eager fallback (AMP/metrics/gradient "
+                        "accumulation) — the restored moments/step "
+                        "count CANNOT be applied to the eager optimizer "
+                        "and it starts fresh",
+                        RuntimeWarning, stacklevel=3)
+                else:
+                    self._optimizer.set_state_dict(pending)
+            if not self._planned_fallback_warned:
+                self._planned_fallback_warned = True
+                warnings.warn(
+                    "Model.prepare(plan=...): the fused planned step "
+                    "needs a prepared loss and no AMP/metrics/gradient "
+                    "accumulation; falling back to the eager step "
+                    "(parameters stay on the plan's layouts)",
+                    RuntimeWarning, stacklevel=3)
+            return None
+        if self._planned_step is None:
+            from ..incubate.fused_train_step import FusedTrainStep
+            from ..nn.layer.layers import Layer
+
+            net, loss_layer, k = self.network, self._loss, int(n_labels)
+
+            class _NetLoss(Layer):
+                """network + prepared loss as ONE forward so the fused
+                step differentiates end to end (the label rides as the
+                trailing ``k`` call arguments)."""
+
+                def __init__(self):
+                    super().__init__()
+                    self.net = net
+                    self.loss = loss_layer
+
+                def forward(self, *args):
+                    outs = self.net(*(args[:len(args) - k] if k else args))
+                    labels = list(args[len(args) - k:]) if k else []
+                    return loss_layer(*(_to_list(outs) + labels))
+
+            # scoped("net."): _NetLoss prefixes every parameter name with
+            # "net.", so rule tables anchored at the network root
+            # ("llama.layers.*") would silently stop matching in the
+            # fused step's in/out sharding pins — the scoped view strips
+            # the prefix before rule matching (same mesh/fingerprint)
+            self._planned_step = FusedTrainStep(
+                _NetLoss(), self._optimizer, step_lr_scheduler=False,
+                plan=self._plan.scoped("net."))
+            self._planned_n_labels = k
+            pending = getattr(self, "_pending_opt_state", None)
+            if pending is not None:
+                # optimizer state from Model.load that arrived before
+                # this step existed (moments keyed "m1.net.<param>"
+                # match because _NetLoss prefixes the SAME "net." path)
+                if not self._fused_opt_format(pending):
+                    # a plain-optimizer .pdopt (saved without a planned
+                    # step): its "<tensor>_moment1" keys mean nothing to
+                    # the fused step — say so instead of silently
+                    # restoring nothing
+                    warnings.warn(
+                        "Model.load restored optimizer state in the "
+                        "plain-optimizer format; the fused planned step "
+                        "cannot adopt it and moments/step count start "
+                        "fresh", RuntimeWarning, stacklevel=3)
+                self._planned_step.set_state_dict(pending)
+                self._pending_opt_state = None
+        if self._planned_n_labels != n_labels:
+            raise ValueError(
+                f"planned train_batch was compiled for "
+                f"{self._planned_n_labels} label(s), got {n_labels}")
+        return self._planned_step
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -454,14 +587,64 @@ class Model:
             os.makedirs(d, exist_ok=True)
         _save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
-            _save(self._optimizer.state_dict(), path + ".pdopt")
+            # while a planned fit trains, the moments / bias-correction
+            # step live in the FusedTrainStep (in-graph, donated), not in
+            # the wrapped optimizer's accumulators — the step object is
+            # the authoritative optimizer state (same contract as
+            # CheckpointManager.save(optimizer=fused_step))
+            pending = getattr(self, "_pending_opt_state", None)
+            if self._planned_step is not None:
+                sd = self._planned_step.state_dict()
+            elif pending is not None:
+                # loaded under a plan but no planned batch has run yet:
+                # the restored state is still in the stash — round-trip
+                # it instead of writing the fresh optimizer's empty state
+                sd = pending
+            else:
+                sd = self._optimizer.state_dict()
+            _save(sd, path + ".pdopt")
+
+    @staticmethod
+    def _fused_opt_format(sd):
+        """Whether an optimizer state dict is in the FusedTrainStep
+        format ("step_count" / "m1.<param>" keys) vs the plain-optimizer
+        one ("<tensor>_moment1" / "global_step")."""
+        return "step_count" in sd or any(
+            k.startswith(("m1.", "m2.")) for k in sd)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         self.network.set_state_dict(_load(path + ".pdparams"))
         opt_path = path + ".pdopt"
         if (not reset_optimizer and self._optimizer is not None
                 and os.path.exists(opt_path)):
-            self._optimizer.set_state_dict(_load(opt_path))
+            sd = _load(opt_path)
+            if self._planned_step is not None:
+                if not self._fused_opt_format(sd):
+                    # same mismatch the pre-build stash path warns on:
+                    # the fused step silently matches none of the plain
+                    # "<tensor>_moment1" keys
+                    warnings.warn(
+                        "Model.load: optimizer state is in the plain-"
+                        "optimizer format; the fused planned step "
+                        "cannot adopt it and moments/step count start "
+                        "fresh", RuntimeWarning, stacklevel=2)
+                self._planned_step.set_state_dict(sd)
+            elif self._plan is not None:
+                # planned checkpoint restored before the first planned
+                # batch built the fused step: stash it —
+                # _planned_train_step applies it on construction
+                self._pending_opt_state = sd
+            else:
+                if self._fused_opt_format(sd):
+                    # fourth cross-format path: a planned save's
+                    # "m1.net.*"/"step_count" keys mean nothing to the
+                    # plain optimizer — warn like the mirror cases
+                    warnings.warn(
+                        "Model.load: optimizer state is in the fused "
+                        "planned-step format; the plain optimizer "
+                        "cannot adopt it and moments/step count start "
+                        "fresh", RuntimeWarning, stacklevel=2)
+                self._optimizer.set_state_dict(sd)
         return self
 
     def parameters(self, *args, **kwargs):

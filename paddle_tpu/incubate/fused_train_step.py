@@ -65,7 +65,8 @@ class FusedTrainStep:
     _instance_count = 0
 
     def __init__(self, model, optimizer, loss_fn=None, step_lr_scheduler=True,
-                 shape_buckets=None, bucket_args=None, grad_scaler=None):
+                 shape_buckets=None, bucket_args=None, grad_scaler=None,
+                 plan=None):
         from ..jit.cache import BucketSpec
 
         from ..optimizer.optimizers import SGD, Adam, AdamW, Momentum
@@ -74,6 +75,16 @@ class FusedTrainStep:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._step_lr_scheduler = step_lr_scheduler
+        # sharding plan (distributed.plan.Plan): parameters are committed
+        # to their plan shardings IN PLACE before capture below, moments
+        # take the plan's moment layout (zeroN dim-0 sharding), data
+        # inputs are placed per the activation rules at dispatch, and the
+        # step compiles through compile_step_with_plan — the ONE compile
+        # layer shared with hapi fit and LLMEngine. plan=None keeps the
+        # exact single-device program (same entry point, no fork).
+        self._plan = plan
+        if plan is not None:
+            plan.apply_to_model(model)
         # step anomaly guard (FLAGS_check_nan_inf_action) + optional fused
         # dynamic loss scaling: with a grad_scaler the loss is scaled and the
         # grads unscaled in-graph (one executable, same as the reference's
@@ -167,6 +178,16 @@ class FusedTrainStep:
             self._m2 = {}
         else:
             self._m1, self._m2 = {}, {}
+        if plan is not None:
+            # zeroN moment layout (dim-0 over the sharding axis when it
+            # divides, else the param's own spec) — committed up front so
+            # the first dispatch compiles for it
+            self._m1 = {n: jax.device_put(
+                v, plan.moment_sharding_for(n, v.shape))
+                for n, v in self._m1.items()}
+            self._m2 = {n: jax.device_put(
+                v, plan.moment_sharding_for(n, v.shape))
+                for n, v in self._m2.items()}
 
         if self._kind in ("adam", "adamw"):
             # per-param decoupled decay honoring apply_decay_param_fun
@@ -209,9 +230,39 @@ class FusedTrainStep:
         # holds for track_gnorm (the sentinel's grad-norm ceiling): off
         # compiles out both the norm reduction (unless grad clipping
         # already pays it) and the peak update
-        self._jitted = jax.jit(self._step_impl,
-                               donate_argnums=(0, 1, 2, 3),
-                               static_argnums=(8, 9))
+        from ..distributed.plan import compile_step_with_plan
+
+        # the one compile layer (ROADMAP item 3): plan=None lowers to the
+        # identical plain jax.jit; a real plan lets GSPMD partition the
+        # step from the committed param/moment/data placements (shard_map
+        # regions for the sep attention collectives ride inside the trace).
+        # out_shardings pin the updated params/moments to their DECLARED
+        # layouts: without them GSPMD propagates the dp-sharded moment
+        # layout into the new params, and after one donation round-trip a
+        # zero1 plan silently creeps into a zero3 one.
+        in_specs = out_specs = None
+        if self._plan is not None:
+            p_specs = {n: self._plan.spec_for(n, self._params[n].shape)
+                       for n in self._params}
+            m1_specs = {n: self._plan.moment_spec_for(n, self._m1[n].shape)
+                        for n in self._m1}
+            m2_specs = {n: self._plan.moment_spec_for(n, self._m2[n].shape)
+                        for n in self._m2}
+            # params/moments pinned on BOTH sides: inputs so GSPMD cannot
+            # re-layout an uncommitted buffer away from its declared spec,
+            # outputs so the donated round-trip hands back the same layout
+            # (otherwise propagation leaks the dp moment sharding into the
+            # new params and a zero1 plan creeps into zero3 — and the
+            # donation aliaser rejects the input/output layout mismatch).
+            # acc/lr/scale/data/kwdata stay None: committed data placement
+            # (activation rules) already says everything the plan knows.
+            in_specs = (p_specs, m1_specs, m2_specs,
+                        None, None, None, None, None)
+            out_specs = (None, None, None, p_specs, m1_specs, m2_specs)
+        self._jitted = compile_step_with_plan(
+            self._step_impl, self._plan, in_specs=in_specs,
+            out_specs=out_specs,
+            donate_argnums=(0, 1, 2, 3), static_argnums=(8, 9))
 
     def _find_sparse_param_names(self, model):
         """Trainable params that are embedding tables: the weights of
@@ -553,6 +604,12 @@ class FusedTrainStep:
                     karrs[k] = a
             if record:
                 jit_cache.record_bucket_pads(self._stats_name, n_pad)
+        if self._plan is not None:
+            # activation rules: commit each data input to its plan
+            # sharding (batch over dp, seq over sep, ...) so GSPMD sees
+            # the intended layout instead of inferring replication
+            darrs = tuple(self._plan.place_data(a) for a in darrs)
+            karrs = {k: self._plan.place_data(a) for k, a in karrs.items()}
         return darrs, karrs
 
     def _count_dispatch(self, darrs, karrs):
@@ -609,10 +666,21 @@ class FusedTrainStep:
                 key = f"{prefix}.{n}"
                 if key in sd:
                     v = sd[key]
-                    store[n] = jnp.asarray(
+                    arr = jnp.asarray(
                         v._data if isinstance(v, Tensor) else v)
+                    if self._plan is not None:
+                        arr = jax.device_put(
+                            arr,
+                            self._plan.moment_sharding_for(n, arr.shape))
+                    store[n] = arr
 
     load_state_dict = set_state_dict
+
+    @property
+    def plan(self):
+        """The sharding Plan this step compiles under (None on the
+        single-device path)."""
+        return self._plan
 
     def _adopt_external_rebinds(self):
         """A checkpoint resume (``CheckpointManager.auto_resume`` /
@@ -623,6 +691,13 @@ class FusedTrainStep:
         for n in self._names:
             t = self._tensors[n]._data
             if t is not self._params[n]:
+                if self._plan is not None:
+                    # a restore loads host arrays; re-commit to the plan
+                    # layout or the next dispatch would compile/reshard
+                    # for a replicated input
+                    t = jax.device_put(
+                        t, self._plan.sharding_for(n, t.shape))
+                    self._tensors[n]._rebind(t)
                 self._params[n] = t
 
     def device_metrics(self):
@@ -1203,7 +1278,8 @@ class FusedTrainStep:
         if checkpoint is not None:
             step_now = self.device_metrics()["step_count"]
             handle = checkpoint.save(step_now, model=self.model,
-                                     optimizer=self, sampler=resumable)
+                                     optimizer=self, sampler=resumable,
+                                     plan=self._plan)
             if handle is not None:  # async save: the exit must not tear it
                 checkpoint.wait()
         else:
@@ -1314,7 +1390,8 @@ class FusedTrainStep:
         # consumed batches came from)
         pre_scale = self._lr_scale
         checkpoint.auto_resume(model=self.model, optimizer=self,
-                               scaler=scaler, step=healthy)
+                               scaler=scaler, step=healthy,
+                               plan=self._plan)
         # checkpoints written past the divergence point hold poisoned
         # states — they must never win a latest_valid_step race against
         # the healthy restore point on a later crash-restart

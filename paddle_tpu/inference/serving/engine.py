@@ -156,13 +156,23 @@ class LLMEngine:
 
     def __init__(self, model, *, num_blocks=64, block_size=16,
                  max_batch_size=4, max_model_len=None, prefill_buckets=None,
-                 max_prefills_per_step=1, ingest_async=True):
+                 max_prefills_per_step=1, ingest_async=True, plan=None):
         from ...models.llama import LlamaForCausalLM
 
         if not isinstance(model, LlamaForCausalLM):
             raise TypeError("LLMEngine serves LlamaForCausalLM models; got "
                             f"{type(model).__name__}")
         self.model = model
+        # sharding plan (distributed.plan.Plan): weights are committed to
+        # the plan's layouts (e.g. Megatron tp for pod-scale serving) and
+        # both engine executables lower through compile_step_with_plan —
+        # the ONE compile layer shared with FusedTrainStep and hapi fit.
+        # GSPMD propagates the committed weight placements through the
+        # prefill/decode bodies; plan=None keeps the exact single-device
+        # program (same entry point, no fork).
+        self._plan = plan
+        if plan is not None:
+            plan.apply_to_model(model)
         self.config = model.config
         was_training = model.training
         model.eval()
@@ -304,7 +314,6 @@ class LLMEngine:
     def _build_jits(self):
         from ...core import state as _state
         from ...core.tensor import Tensor
-        from ...jit.cache import CountingJit
         from .paged_attention import paged_decode_attention
 
         model = self.model
@@ -459,10 +468,14 @@ class LLMEngine:
                     p._data = a
             return _arr(logits)[:, 0], new_k, new_v
 
-        self._prefill_jit = CountingJit(prefill_pure, self._prefill_name,
-                                        donate_argnums=(4, 5))
-        self._decode_jit = CountingJit(decode_pure, self._decode_name,
-                                       donate_argnums=(4, 5))
+        from ...distributed.plan import compile_step_with_plan
+
+        self._prefill_jit = compile_step_with_plan(
+            prefill_pure, self._plan, name=self._prefill_name,
+            donate_argnums=(4, 5))
+        self._decode_jit = compile_step_with_plan(
+            decode_pure, self._plan, name=self._decode_name,
+            donate_argnums=(4, 5))
 
     # ------------------------------------------------------------------
     # the scheduler tick
@@ -570,6 +583,15 @@ class LLMEngine:
         ``CheckpointManager`` (prefers ``latest_healthy_step()``, falls
         back to ``latest_valid_step()``), a checkpoint step directory, or
         a state-dict file path. Returns the restored step (or None)."""
+        try:
+            return self._reload_weights_impl(source)
+        finally:
+            if self._plan is not None:
+                # restored host arrays must go back to the plan's layouts
+                # or the next step would recompile for replicated inputs
+                self._plan.apply_to_model(self.model)
+
+    def _reload_weights_impl(self, source):
         from ...distributed.checkpoint import load_state_dict
         from ...distributed.checkpoint.manager import CheckpointManager
 

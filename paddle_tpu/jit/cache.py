@@ -424,7 +424,8 @@ class CountingJit:
 
     __slots__ = ("name", "_jit", "_seen", "_static")
 
-    def __init__(self, fn, name, static_argnums=(), donate_argnums=()):
+    def __init__(self, fn, name, static_argnums=(), donate_argnums=(),
+                 jit_kwargs=None):
         import jax
 
         self.name = name
@@ -432,8 +433,12 @@ class CountingJit:
         if jax.default_backend() not in ("tpu", "axon"):
             donate_argnums = ()
         self._jit = jax.jit(fn, static_argnums=self._static,
-                            donate_argnums=donate_argnums)
+                            donate_argnums=donate_argnums,
+                            **(jit_kwargs or {}))
         self._seen = set()
+
+    def lower(self, *args):
+        return self._jit.lower(*args)
 
     def _signature(self, args):
         import jax

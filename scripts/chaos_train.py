@@ -8,6 +8,16 @@ Orchestrator mode (default — run it directly)::
 
     python scripts/chaos_train.py [--out DIR] [--scenarios kill,preempt,hang]
     python scripts/chaos_train.py --drill spike
+    python scripts/chaos_train.py --drill plan
+
+``--drill plan`` reruns the kill/preempt/hang scenarios with the worker
+training under a dp=2 x tp=2 **sharded plan** (column/row tp split,
+zero1 moments over dp, a virtual 8-device CPU mesh inside a single
+worker process): every step compiles through ``compile_step_with_plan``,
+every checkpoint records the plan fingerprint, ``auto_resume(plan=...)``
+re-validates it on restart, and the recovered loss sequence must be
+bit-identical to the uninterrupted sharded baseline (ROADMAP item 3
+acceptance).
 
 ``--drill spike`` runs three single-process jobs: an uninterrupted clean
 **baseline**; a **control** with fault site ``train.spike`` poisoning one
@@ -94,6 +104,7 @@ def worker_main():
     chaos_step = int(os.environ.get("CHAOS_STEP", "0"))
     chaos_rank = int(os.environ.get("CHAOS_RANK", "-1"))
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    with_plan = bool(os.environ.get("CHAOS_PLAN"))
 
     paddle.seed(0)
     np.random.seed(0)
@@ -122,10 +133,48 @@ def worker_main():
             d = pred - y
             return (d * d).mean()
 
-    model = Net()
-    opt = paddle.optimizer.SGD(learning_rate=0.1,
-                               parameters=model.parameters())
-    fstep = FusedTrainStep(model, opt)
+    class PlanNet(nn.Layer):
+        """Two Linears so the drill's tp axis has a real column/row split
+        (the 1-wide proj of Net gives tp nothing to shard)."""
+
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(FEATS, 8)
+            self.fc2 = nn.Linear(8, 1)
+
+        def forward(self, x, y, mask):
+            tok = self.fc2(paddle.tanh(self.fc1(x)))[:, :, 0] * mask
+            pred = tok.sum(axis=1) / mask.sum(axis=1)   # masked mean
+            d = pred - y
+            return (d * d).mean()
+
+    plan = None
+    if with_plan:
+        # the --plan drill: a dp x tp sharded plan (zero1 moments over
+        # dp) on a virtual CPU mesh — kill/preempt/hang restarts must be
+        # bit-exact THROUGH the sharded layouts, and the checkpoint's
+        # plan fingerprint must admit the (identical) restore plan
+        from paddle_tpu.distributed.plan import Plan
+
+        plan = Plan.build(
+            {"dp": 2, "tp": 2},
+            ["dp",
+             ("tp", {"rules": (("*fc1*", {1: "tp"}),
+                               ("*fc2*", {0: "tp"}))}),
+             ("zero1", {"axis": "dp"})])
+
+    model = PlanNet() if with_plan else Net()
+    if with_plan:
+        # AdamW so the zero1 arm has REAL moment buffers to shard, save
+        # and restore — with momentum-less SGD the zero1 layout would be
+        # applied to nothing and the drill would never exercise sharded
+        # optimizer-state round-trips
+        opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=model.parameters())
+    else:
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+    fstep = FusedTrainStep(model, opt, plan=plan)
     sampler = io.BucketedBatchSampler(
         VarLen(), batch_size=BATCH, boundaries=BOUNDARIES, shuffle=True,
         seed=11, lengths=lengths.tolist(), drop_last=True)
@@ -133,7 +182,9 @@ def worker_main():
                            collate_fn=io.PadToBucket(BOUNDARIES))
 
     mgr = paddle.CheckpointManager(os.path.join(out, "ckpt"), keep_last_n=3)
-    resumed = mgr.auto_resume(model, fstep, sampler=loader)
+    # plan= arms the fingerprint gate: a restore under a DIFFERENT mesh /
+    # rule table raises PlanMismatchError instead of mis-sharding
+    resumed = mgr.auto_resume(model, fstep, sampler=loader, plan=plan)
     base = 0 if resumed is None else int(resumed)
     start_epoch = loader.state_dict()["epoch"]
 
@@ -147,8 +198,12 @@ def worker_main():
             log.write(f"{gs} {float(l)!r}\n")
         log.flush()
         os.fsync(log.fileno())
+        # plan= records the fingerprint on EVERY window checkpoint (not
+        # just preemption saves), so kill/hang restarts re-validate it
+        # through auto_resume(plan=) rather than passing trivially on a
+        # fingerprint-less checkpoint (plan is None on the base drill)
         mgr.save(int(fstep.device_metrics()["step_count"]), model=model,
-                 optimizer=fstep, sampler=loader)
+                 optimizer=fstep, sampler=loader, plan=plan)
         if (scenario == "preempt" and gstep_end >= chaos_step
                 and not os.path.exists(marker)):
             open(marker, "w").write("x")
@@ -374,6 +429,78 @@ def spike_drill(out_root):
 
 
 # ---------------------------------------------------------------------------
+# plan drill (sharded-plan restart bit-exactness — ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+# one worker process carrying a virtual 8-device CPU mesh; the dp=2 x tp=2
+# plan shards the drill net column/row over tp with zero1 moments over dp
+_PLAN_ENV = {
+    "CHAOS_PLAN": "dp2xtp2",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def plan_drill(out_root, scenarios=("kill", "preempt", "hang")):
+    """kill -9 / preempt / hang under a dp x tp SHARDED PLAN, restart
+    bit-exact: the launcher scenarios, single-process (the virtual mesh
+    lives inside the worker), with every step compiled through
+    ``compile_step_with_plan`` and every checkpoint carrying the plan
+    fingerprint that ``auto_resume(plan=...)`` re-validates on restart."""
+    print(f"[chaos] plan drill (dp=2 x tp=2 zero1), scratch: {out_root}")
+    print("[chaos] plan baseline (uninterrupted sharded run)...")
+    base_out = os.path.join(out_root, "plan_baseline")
+    r = run_job(base_out, "none", extra_env=_PLAN_ENV, nproc=1)
+    check(r.returncode == 0,
+          f"plan baseline exits 0 (got {r.returncode}): {r.stderr[-800:]}")
+    baseline = read_losses(base_out)
+    check(baseline and sorted(baseline) == list(range(1, len(baseline) + 1)),
+          f"plan baseline logged a contiguous {len(baseline)}-step "
+          "sequence")
+
+    results = {}
+    for sc in scenarios:
+        out = os.path.join(out_root, f"plan_{sc}")
+        print(f"[chaos] plan scenario {sc!r}...")
+        if sc == "kill":
+            r = run_job(out, "kill", chaos_step=8, chaos_rank=0,
+                        max_restart=2, extra_env=_PLAN_ENV, nproc=1)
+        elif sc == "preempt":
+            r = run_job(out, "preempt", chaos_step=2 * WINDOW,
+                        max_restart=0, extra_env=_PLAN_ENV, nproc=1)
+        elif sc == "hang":
+            # the sharded step's first compile is slower than the plain
+            # drill's — the timeout must not mistake compile for a hang
+            r = run_job(out, "hang", chaos_step=7, chaos_rank=0,
+                        max_restart=2, nproc=1,
+                        extra_env=dict(_PLAN_ENV,
+                                       FLAGS_worker_hang_timeout_s="20",
+                                       FLAGS_worker_term_grace_s="2"))
+        else:
+            raise SystemExit(f"unknown plan scenario {sc!r}")
+        check(r.returncode == 0,
+              f"plan {sc}: job completes within budget "
+              f"(rc={r.returncode}): {r.stderr[-800:]}")
+        losses = read_losses(out)
+        check(losses == baseline,
+              f"plan {sc}: loss sequence bit-identical to the sharded "
+              f"baseline ({len(losses)} steps)")
+        if sc == "preempt":
+            check("restart budget untouched" in r.stderr,
+                  "plan preempt: relaunch consumed zero restart budget")
+        if sc == "kill":
+            check("restart 1/" in r.stderr,
+                  "plan kill: consumed restart budget")
+        if sc == "hang":
+            check("heartbeats stale" in r.stderr,
+                  "plan hang: watchdog detected the stall")
+        results[sc] = r.elapsed
+        print(f"  done in {r.elapsed:.1f}s")
+    print("[chaos] PLAN DRILL PASSED:",
+          ", ".join(f"{k}={v:.1f}s" for k, v in results.items()))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
 
@@ -394,10 +521,10 @@ def _job_env(out, scenario, chaos_step=0, chaos_rank=-1, extra=None):
 
 
 def run_job(out, scenario, chaos_step=0, chaos_rank=-1, max_restart=0,
-            extra_env=None, timeout=600):
+            extra_env=None, timeout=600, nproc=2):
     os.makedirs(out, exist_ok=True)
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
-           "--nproc_per_node=2", f"--max_restart={max_restart}",
+           f"--nproc_per_node={nproc}", f"--max_restart={max_restart}",
            f"--log_dir={os.path.join(out, 'logs')}",
            os.path.abspath(__file__)]
     t0 = time.time()
@@ -437,14 +564,19 @@ def main(argv=None):
     ap.add_argument("--out", default=None,
                     help="scratch dir (default: a fresh tempdir)")
     ap.add_argument("--scenarios", default="kill,preempt,hang")
-    ap.add_argument("--drill", default=None, choices=["spike"],
+    ap.add_argument("--drill", default=None, choices=["spike", "plan"],
                     help="run one named drill instead of the launcher "
                          "scenarios (spike: divergence-sentinel "
-                         "detect/rollback/skip/recover)")
+                         "detect/rollback/skip/recover; plan: kill/"
+                         "preempt/hang under a dp x tp sharded plan, "
+                         "restart bit-exact)")
     args = ap.parse_args(argv)
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_train.")
     if args.drill == "spike":
         return spike_drill(out_root)
+    if args.drill == "plan":
+        return plan_drill(
+            out_root, tuple(s for s in args.scenarios.split(",") if s))
     scenarios = [s for s in args.scenarios.split(",") if s]
 
     print(f"[chaos] scratch: {out_root}")

@@ -16,9 +16,22 @@ wire it after the bench run so a regressing round cannot land silently.
 The fast test in tests/test_perf_tools.py runs these checks on the
 repo's committed artifacts (tier-1), so the tripwire itself cannot rot.
 
+**Multichip strategy-parity tripwire** (ISSUE 8 satellite): the LATEST
+``MULTICHIP_r*.json`` artifact's dryrun lines are checked too. Since the
+plan rewrite the dryrun prints ``PLAN <strategy> loss=<x>
+baseline=<y>`` pairs — the planned loss and the single-device loss for
+the SAME config/seed/data — and this script fails any strategy whose
+loss drifts more than ``--multichip-tol`` (relative, default 5%) from
+its baseline, plus fails when the latest artifact carries NO anchored
+lines at all (an unarmed tripwire is a fail, not a skip). This is the
+check that would have caught the r05 Ulysses line: the old hand-wired
+arm printed ``(out*out).sum()`` of random q/k/v — 1834.9071 — beside CE
+losses near 6.26; any baseline-anchored formulation flags a ~293x
+relative drift instantly.
+
 Usage:
   python scripts/check_bench_regression.py [--dir REPO_ROOT]
-      [--ratio 0.95] [--json]
+      [--ratio 0.95] [--multichip-tol 0.05] [--json]
 """
 
 from __future__ import annotations
@@ -131,22 +144,149 @@ def check(rounds, ratio=0.95, floors=None):
     return failures
 
 
+_MC_LINE = re.compile(
+    r"^dryrun_multichip:\s+(?P<name>.+?)\s+loss=(?P<loss>\S+)"
+    r"(?:\s+baseline=(?P<baseline>\S+))?")
+
+
+def load_multichip_rounds(dirpath):
+    """{round: {"ok": bool, "lines": [{name, loss, baseline}]}} from every
+    ``MULTICHIP_r*.json`` (each stores the dryrun's stdout tail). Lines
+    without a ``baseline=`` field are pre-plan-format (r01–r05) or
+    engine/pipeline rows whose reference is an in-dryrun assert — they
+    are kept (for the vanish lookback) but not drift-checked."""
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              "MULTICHIP_r*.json"))):
+        m = re.search(r"MULTICHIP_r(\d+)", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            data = json.load(open(path))
+        except Exception:
+            # unreadable artifact: keep the round (ok=False, no lines) so
+            # a corrupt LATEST artifact fails instead of silently falling
+            # back to the previous good round
+            rounds[int(m.group(1))] = {"ok": False, "lines": []}
+            continue
+        lines = []
+        for line in str(data.get("tail", "")).splitlines():
+            lm = _MC_LINE.match(line.strip())
+            if not lm:
+                continue
+            # \S+ tokens so nan AND inf parse (both must FAIL the drift
+            # check, not vanish from it); genuinely unparseable tokens
+            # drop the row, which the vanish lookback then flags
+            try:
+                loss = float(lm.group("loss"))
+                baseline = lm.group("baseline")
+                baseline = (float(baseline)
+                            if baseline is not None else None)
+            except ValueError:
+                continue
+            lines.append({"name": lm.group("name"),
+                          "loss": loss,
+                          "baseline": baseline})
+        # record the round even with zero parseable lines — a dryrun that
+        # crashed before printing anything must trip the "no anchored
+        # lines" / "not ok" checks when it is the latest round, not be
+        # dropped from the window
+        rounds[int(m.group(1))] = {
+            "ok": bool(data.get("ok", False)) and not data.get(
+                "skipped", False),
+            "lines": lines,
+        }
+    return rounds
+
+
+def check_multichip(rounds, tol=0.05):
+    """Failure strings for the latest multichip round (empty == clear)."""
+    if not rounds:
+        return ["FAIL multichip: no MULTICHIP_r*.json artifacts found"]
+    latest = max(rounds)
+    rec = rounds[latest]
+    failures = []
+    if not rec["ok"]:
+        failures.append(
+            f"FAIL multichip r{latest}: artifact not ok (dryrun crashed "
+            "or was skipped)")
+    anchored = {l["name"]: l for l in rec["lines"]
+                if l["baseline"] is not None}
+    if not anchored:
+        failures.append(
+            f"FAIL multichip r{latest}: no 'loss=... baseline=...' "
+            "strategy lines — the plan-dryrun parity tripwire is "
+            "unarmed (pre-plan artifact format, or the strategy table "
+            "stopped printing baselines)")
+    for name, l in sorted(anchored.items()):
+        rel = abs(l["loss"] - l["baseline"]) / max(abs(l["baseline"]),
+                                                   1e-9)
+        # `not (rel <= tol)`: a nan/inf loss or baseline must FAIL — a
+        # plain `rel > tol` is False for nan and would report a
+        # non-finite strategy inside the OK count
+        if not (rel <= tol):
+            failures.append(
+                f"FAIL multichip {name}: r{latest} loss {l['loss']} "
+                f"drifts {rel:.1%} from its single-device baseline "
+                f"{l['baseline']} (tolerance {tol:.0%})")
+    # a strategy row that vanishes is a regression, not shrunk coverage
+    # (same 3-round lookback rule as the bench metrics)
+    prev_rounds = sorted((r for r in rounds if r < latest), reverse=True)
+    expected = {}
+    for r in prev_rounds[:3]:
+        for l in rounds[r]["lines"]:
+            if l["baseline"] is not None:
+                expected.setdefault(l["name"], r)
+    latest_all = {l["name"] for l in rec["lines"]}
+    for name, r in sorted(expected.items()):
+        if name in anchored:
+            continue
+        if name in latest_all:
+            # the row still prints but LOST its baseline= — it silently
+            # left the drift check's coverage (the r05 failure mode:
+            # an incomparable metric wearing an OK suffix)
+            failures.append(
+                f"FAIL multichip {name}: r{latest} prints without "
+                f"baseline= (anchored in r{r}) — the drift check no "
+                "longer covers it")
+        else:
+            failures.append(
+                f"FAIL multichip {name}: present in r{r} but missing "
+                f"from r{latest} (strategy row dropped from the dryrun "
+                "table)")
+    return failures
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--dir", default=_REPO,
                    help="directory holding BENCH_r*.json artifacts")
     p.add_argument("--ratio", type=float, default=0.95)
+    p.add_argument("--multichip-tol", type=float, default=0.05,
+                   help="relative tolerance of a strategy dryrun loss vs "
+                        "its single-device baseline")
     p.add_argument("--json", action="store_true",
                    help="emit one machine-readable summary line")
     args = p.parse_args(argv)
 
     rounds = load_rounds(args.dir)
     failures = check(rounds, ratio=args.ratio)
+    mc_rounds = load_multichip_rounds(args.dir)
+    failures += check_multichip(mc_rounds, tol=args.multichip_tol)
     latest = max(rounds) if rounds else None
+    mc_latest = max(mc_rounds) if mc_rounds else None
+    # only lines carrying baseline= were actually drift-checked — report
+    # that count, not every parsed line, or the summary overstates what
+    # the tripwire verified
+    mc_anchored = (sum(1 for l in mc_rounds[mc_latest]["lines"]
+                       if l["baseline"] is not None)
+                   if mc_rounds else 0)
     if args.json:
         print(json.dumps({"latest_round": latest,
                           "checked_metrics":
                               len(rounds.get(latest, {})) if rounds else 0,
+                          "multichip_round": mc_latest,
+                          "multichip_lines": mc_anchored,
                           "failures": failures}))
     else:
         for f in failures:
@@ -154,7 +294,10 @@ def main(argv=None):
         if not failures:
             n = len(rounds.get(latest, {})) if rounds else 0
             print(f"OK: round {latest}, {n} metrics within "
-                  f"{args.ratio}x of prior round and above MFU floors")
+                  f"{args.ratio}x of prior round and above MFU floors; "
+                  f"multichip r{mc_latest}, {mc_anchored} anchored "
+                  f"strategy lines within "
+                  f"{args.multichip_tol:.0%} of baseline")
     sys.exit(1 if failures else 0)
 
 

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Lint: every registered sharding-plan strategy must have an exercising
+test (check_fault_sites.py's rule, applied to the plan table).
+
+``paddle_tpu.distributed.plan.strategies.STRATEGIES`` is the registry of
+named plan builders (``dp``/``zero1..3``/``tp``/``sep``/``ep``/``pp``).
+A strategy nobody builds a plan with is a parallelism path nobody runs —
+this lint walks ``tests/`` (plus ``__graft_entry__.py``'s dryrun matrix
+and ``scripts/chaos_train.py``'s plan drill) for ``Plan.build(...)`` /
+``strategies.apply(...)`` calls, collects the strategy-name string
+constants inside them, and fails listing any registered strategy that no
+plan construction mentions. Wired as a tier-1 test (tests/test_plan.py),
+so a new strategy row cannot ship untested.
+
+Deliberately import-free: the registry is parsed from the module source
+(``@register_strategy("name")`` decorations) and the exercisers are
+AST-walked, so the lint runs in milliseconds without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STRATEGIES_SOURCE = os.path.join(REPO, "paddle_tpu", "distributed",
+                                 "plan", "strategies.py")
+# non-test files that legitimately exercise strategies end to end
+EXTRA_EXERCISERS = (
+    os.path.join(REPO, "__graft_entry__.py"),
+    os.path.join(REPO, "scripts", "chaos_train.py"),
+)
+
+
+def registered_strategies(source_path=STRATEGIES_SOURCE):
+    """Strategy names, parsed (not imported) from the
+    ``@register_strategy("name")`` decorations in strategies.py."""
+    with open(source_path) as f:
+        src = f.read()
+    names = re.findall(r"@register_strategy\(\s*[\"']([a-z0-9_]+)[\"']",
+                       src)
+    if not names:
+        raise RuntimeError(
+            f"no @register_strategy decorations found in {source_path} — "
+            "lint would be vacuous")
+    return names
+
+
+def _strategy_names(node):
+    """Strategy NAMES inside a strategies argument: a bare string
+    (``apply``'s name / a plain entry) or the FIRST element of a
+    ``(name, kwargs)`` entry. Kwarg VALUES deliberately do not count —
+    ``('zero1', {'axis': 'dp'})`` exercises zero1, not dp."""
+    out = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+        return out
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+            elif isinstance(el, (ast.Tuple, ast.List)) and el.elts:
+                first = el.elts[0]
+                if (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    out.add(first.value)
+    return out
+
+
+def _is_plan_construction(call):
+    """``Plan.build(...)`` / ``<plan module>.apply(...)`` — the two ways a
+    strategy entry is named at a use site."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in ("build", "apply")
+    if isinstance(fn, ast.Name):
+        return fn.id in ("apply",)
+    return False
+
+
+def _strategy_args(call):
+    """Only the argument that NAMES strategies: ``Plan.build``'s second
+    positional / ``strategies=`` kwarg, ``apply``'s second positional /
+    ``name=`` kwarg. The mesh-axes argument is deliberately excluded —
+    ``Plan.build({'sep': 4}, ['dp'])`` sizes a sep axis but exercises no
+    sep strategy, and counting its dict keys would keep the lint green
+    after the last real ``('sep', ...)`` entry is deleted."""
+    out = []
+    if len(call.args) > 1:
+        out.append(call.args[1])
+    for kw in call.keywords:
+        if kw.arg in ("strategies", "name"):
+            out.append(kw.value)
+    return out
+
+
+def exercised_strategies(paths=None, tests_dir=None):
+    """Strategy-name strings mentioned inside plan constructions across
+    the test corpus."""
+    if paths is None:
+        tests_dir = tests_dir or os.path.join(REPO, "tests")
+        paths = []
+        for root, _dirs, files in os.walk(tests_dir):
+            for fn in files:
+                if fn.endswith(".py"):
+                    paths.append(os.path.join(root, fn))
+        paths += [p for p in EXTRA_EXERCISERS if os.path.exists(p)]
+    used = set()
+    for path in paths:
+        with open(path, errors="replace") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_plan_construction(node):
+                for arg in _strategy_args(node):
+                    used |= _strategy_names(arg)
+    return used
+
+
+def main(argv=None):
+    del argv
+    registered = registered_strategies()
+    used = exercised_strategies()
+    missing = [s for s in registered if s not in used]
+    if missing:
+        for s in missing:
+            print(f"FAIL strategy {s!r}: registered in "
+                  "distributed/plan/strategies.py but no test or dryrun "
+                  "builds a plan with it")
+        return 1
+    print(f"OK: {len(registered)} registered strategies all exercised "
+          f"({', '.join(sorted(registered))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

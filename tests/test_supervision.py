@@ -872,3 +872,17 @@ class TestChaosDrill:
             timeout=560)
         assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
         assert "ALL SCENARIOS PASSED" in r.stdout
+
+    def test_plan_drill_sharded_restarts_bit_exact(self, tmp_path):
+        """The ISSUE-8 acceptance drill: kill -9 / preempt / hang under a
+        dp x tp SHARDED PLAN (zero1 moments, plan-fingerprinted
+        checkpoints) restart to a loss sequence bit-identical to the
+        uninterrupted sharded baseline."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "chaos_train.py"),
+             "--drill", "plan", "--out", str(tmp_path)],
+            env=_launch_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=560)
+        assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+        assert "PLAN DRILL PASSED" in r.stdout
