@@ -147,6 +147,18 @@ def _emit(rec, step=None, batch=None, items_per_batch=None):
         rec["baseline_note"] = (
             note if not prior or prior.startswith("reference publishes")
             else f"{prior}; {note}")
+    if "metrics_snapshot" not in rec:
+        # observability registry riding on every line (ISSUE 10): the
+        # compact form (counters/gauges + histogram count/sum/p50/p99) so
+        # check_bench_regression can later floor e.g. serving p99 the way
+        # it floors MFU. Best-effort: a bench line must never fail on its
+        # own telemetry.
+        try:
+            from paddle_tpu.observability import metrics as _obs_metrics
+
+            rec["metrics_snapshot"] = _obs_metrics.compact_snapshot()
+        except Exception:
+            rec["metrics_snapshot"] = None
     print(json.dumps(rec))
 
 
@@ -577,6 +589,12 @@ def bench_serving(on_tpu):
         "p99_ms": res["engine"]["p99_ms"],
         "p50_ms_naive": res["naive"]["p50_ms"],
         "p99_ms_naive": res["naive"]["p99_ms"],
+        # engine-owned latency histograms (ISSUE 10): measured at the
+        # engine's own sampling points, not by the bench clock
+        "ttft_p50_ms": res["engine"]["ttft_p50_ms"],
+        "ttft_p99_ms": res["engine"]["ttft_p99_ms"],
+        "itl_p50_ms": res["engine"]["itl_p50_ms"],
+        "itl_p99_ms": res["engine"]["itl_p99_ms"],
         "bit_exact": res["bit_exact"],
         "decode_compiles_in_window": res["engine"]["decode_compiles_in_window"],
         "evictions": res["engine"]["evictions"],

@@ -51,6 +51,7 @@ from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
 from . import jit  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import vision  # noqa: F401
 from . import metric  # noqa: F401
 from . import hapi  # noqa: F401

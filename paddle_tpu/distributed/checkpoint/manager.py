@@ -39,10 +39,13 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import time
 
 import jax
 
 from ...framework import io as _fio
+from ...observability import metrics as _obs_metrics
+from ...observability import trace as _obs_trace
 from . import (_write_commit, is_committed, load_state_dict, save_state_dict,
                verify_checkpoint)
 from ...framework.io import CheckpointCorruptionError
@@ -50,6 +53,39 @@ from ...framework.io import CheckpointCorruptionError
 __all__ = ["CheckpointManager", "PlanMismatchError"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+# checkpoint IO observability (ISSUE 10): durations as histograms, bytes
+# as a counter — unlabeled (process-wide; a process rarely runs more than
+# one manager, and root paths are unbounded strings the label-cardinality
+# rule forbids). Checkpoint IO is already a host-blocking region, so the
+# spans/timers sit at an allowed sync point by construction. For async
+# saves the duration covers submission; the shard-write tail is the
+# AsyncSaveHandle's, and bytes are accounted when wait() lands it.
+_H_SAVE_S = _obs_metrics.histogram(
+    "ckpt_save_seconds", "wall time of CheckpointManager.save (async: "
+    "the synchronous submission portion)",
+    buckets=_obs_metrics.DEFAULT_SECONDS_BUCKETS)
+_H_RESTORE_S = _obs_metrics.histogram(
+    "ckpt_restore_seconds", "wall time of CheckpointManager.auto_resume "
+    "when a checkpoint was actually restored",
+    buckets=_obs_metrics.DEFAULT_SECONDS_BUCKETS)
+_M_SAVE_BYTES = _obs_metrics.counter(
+    "ckpt_save_bytes_total", "bytes in committed checkpoint step dirs, "
+    "accounted when the save lands")
+
+
+def _dir_bytes(path):
+    total = 0
+    try:
+        for root, _dirs, files in os.walk(path):
+            for fn in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, fn))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return total
 _OPT_FILE = "optimizer.pdopt"
 _SCALER_FILE = "scaler.pdscaler"
 _SAMPLER_FILE = "sampler.pdsampler"
@@ -301,6 +337,29 @@ class CheckpointManager:
         extra files into the directory under the same commit (hapi's
         ModelCheckpoint uses this). Returns the :class:`AsyncSaveHandle`
         for async saves, else ``None``."""
+        # land the PREVIOUS async save before starting this save's timer:
+        # its write tail (handle.wait + bytes walk + retention) belongs to
+        # that save, not to this one's ckpt_save_seconds observation
+        self.wait()
+        t0_ns = time.perf_counter_ns()
+        try:
+            handle = self._save_impl(step, model=model, optimizer=optimizer,
+                                     scaler=scaler, state_dict=state_dict,
+                                     writer=writer, async_save=async_save,
+                                     sampler=sampler, plan=plan)
+        finally:
+            t1_ns = time.perf_counter_ns()
+            _H_SAVE_S.observe((t1_ns - t0_ns) / 1e9)
+            _obs_trace.add_complete("ckpt.save", t0_ns, t1_ns, cat="ckpt",
+                                    args={"step": int(step)})
+        if handle is None:
+            # synchronous save: the directory just committed — account it
+            _M_SAVE_BYTES.inc(_dir_bytes(self.step_dir(step)))
+        return handle
+
+    def _save_impl(self, step, model=None, optimizer=None, scaler=None,
+                   state_dict=None, writer=None, async_save=None,
+                   sampler=None, plan=None):
         self.wait()  # land the previous async write + run its retention
         if async_save is None:
             async_save = self.async_save
@@ -372,6 +431,7 @@ class CheckpointManager:
         _step, handle = self._pending
         self._pending = None
         handle.wait()
+        _M_SAVE_BYTES.inc(_dir_bytes(self.step_dir(_step)))
         self._retain()
 
     def _retain(self):
@@ -469,6 +529,7 @@ class CheckpointManager:
         # plan fingerprint gate BEFORE any state is touched: a mismatch
         # must leave model/optimizer exactly as they were
         self._check_plan(self.plan_fingerprint(step), plan, step)
+        t0_ns = time.perf_counter_ns()
         d = self.step_dir(step)
         if model is not None and any(
                 fn.endswith(".npz") for fn in os.listdir(d)):
@@ -482,4 +543,8 @@ class CheckpointManager:
         sp_p = os.path.join(d, _SAMPLER_FILE)
         if sampler is not None and os.path.exists(sp_p):
             _resolve_sampler(sampler).set_state_dict(_fio.load(sp_p))
+        t1_ns = time.perf_counter_ns()
+        _H_RESTORE_S.observe((t1_ns - t0_ns) / 1e9)
+        _obs_trace.add_complete("ckpt.restore", t0_ns, t1_ns, cat="ckpt",
+                                args={"step": int(step)})
         return step

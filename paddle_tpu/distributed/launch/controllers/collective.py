@@ -41,7 +41,19 @@ import tempfile
 import time
 
 from ....core.flags import flag_value
-from ..heartbeat import PREEMPT_EXIT_CODE, stale as _hb_stale
+from ....observability import metrics as _obs_metrics
+from ..heartbeat import PREEMPT_EXIT_CODE, live_ranks as _hb_live
+
+# rank-liveness gauge (ISSUE 10): how many of this node's workers look
+# alive RIGHT NOW — process running, and (when the hang watchdog is
+# armed) heartbeat mtime fresh enough. Updated every supervision tick;
+# transitions are appended to <log_dir>/liveness.log so an external
+# drill (scripts/chaos_train.py --scenarios kill) can assert the gauge
+# dipped during a kill and recovered after the restart.
+_G_LIVE_RANKS = _obs_metrics.gauge(
+    "launch_live_ranks",
+    "workers of this node currently alive (process running + heartbeat "
+    "fresh when the hang watchdog is armed)")
 
 __all__ = ["CollectiveController", "RestartBudget", "CrashLoopError",
            "HANG_EXIT_CODE", "PREEMPT_EXIT_CODE"]
@@ -168,6 +180,7 @@ class CollectiveController:
         else:
             self._hb_dir = tempfile.mkdtemp(prefix="paddle_hb.")
         os.makedirs(self._hb_dir, exist_ok=True)
+        self._last_live = None  # last launch_live_ranks value published
 
     # -- env contract ----------------------------------------------------
     def _worker_env(self, local_rank):
@@ -252,6 +265,11 @@ class CollectiveController:
         preempt_seen = None
         while True:
             codes = [p.poll() for p in self.procs]
+            # publish liveness BEFORE the crash/hang judgments below, so
+            # the tick that detects a dead rank records the dip first;
+            # the hang check below derives from the same single
+            # heartbeat-dir read instead of re-reading it
+            running, live = self._note_liveness(codes, hang_timeout)
             crash = next((rc for rc in codes if rc is not None
                           and rc not in (0, PREEMPT_EXIT_CODE)), None)
             if crash is not None:
@@ -271,18 +289,45 @@ class CollectiveController:
                     self._kill_all()
                     return PREEMPT_EXIT_CODE
             # judge only the still-running ranks: a finished or preempted
-            # worker's aging heartbeat file must not condemn the live ones
-            live = [self.args.rank * self.nproc + lr
-                    for lr, p in enumerate(self.procs) if p.poll() is None]
-            if hang_timeout > 0 and live and _hb_stale(
-                    self._hb_dir, hang_timeout, since=self._spawn_time,
-                    ranks=live):
+            # worker's aging heartbeat file must not condemn the live ones.
+            # "some running rank missing from the fresh-heartbeat set" is
+            # exactly the old stale()-over-the-stalest-rank judgment,
+            # computed from this tick's single heartbeat-dir read
+            if hang_timeout > 0 and running and live != running:
                 print("[launch] worker heartbeats stale (no progress for "
                       f"{hang_timeout:g}s) — killing the hung group",
                       file=sys.stderr)
                 self._kill_all()
                 return HANG_EXIT_CODE
             time.sleep(0.2)
+
+    def _note_liveness(self, codes, hang_timeout):
+        """Set the ``launch_live_ranks`` gauge from this tick's evidence:
+        a rank is live when its process is running and — with the hang
+        watchdog armed — its heartbeat mtime is fresh (``hb.live_ranks``,
+        spawn time as the not-yet-written grace anchor). Value changes are
+        appended to ``<log_dir>/liveness.log`` (``<epoch-seconds> <n>``)
+        so the chaos drill can assert the gauge flipped during a kill.
+        Returns ``(running_ranks, live_ranks)`` so the caller's hang
+        judgment reuses this tick's one heartbeat-dir read."""
+        running = {str(self.args.rank * self.nproc + lr)
+                   for lr, rc in enumerate(codes) if rc is None}
+        live = set(running)
+        if hang_timeout > 0 and live:
+            live &= _hb_live(self._hb_dir, hang_timeout,
+                             since=self._spawn_time, ranks=live)
+        n = len(live)
+        _G_LIVE_RANKS.set(n)
+        if n != self._last_live:
+            self._last_live = n
+            if self.args.log_dir:
+                try:
+                    with open(os.path.join(self.args.log_dir,
+                                           "liveness.log"), "a") as f:
+                        f.write(f"{time.time():.3f} {n}\n")
+                except OSError:
+                    pass
+        return running, live
 
     def _refresh_master(self):
         """Fresh coordinator port per restart round for auto-selected

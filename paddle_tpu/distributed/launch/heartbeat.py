@@ -35,7 +35,7 @@ import threading
 import time
 
 __all__ = ["HEARTBEAT_DIR_ENV", "PREEMPT_EXIT_CODE", "heartbeat_dir",
-           "write", "read_all", "stale", "PreemptionState",
+           "write", "read_all", "stale", "live_ranks", "PreemptionState",
            "trap_preemption"]
 
 HEARTBEAT_DIR_ENV = "PADDLE_HEARTBEAT_DIR"
@@ -131,6 +131,33 @@ def stale(dir, timeout_s, since=None, now=None, expected=None, ranks=None):
             return False
         times = [float(since)]
     return (now - min(times)) > float(timeout_s)
+
+
+def live_ranks(dir, timeout_s, since=None, now=None, ranks=None):
+    """Rank ids (strings) whose heartbeat looks alive: newest ``hb.<rank>``
+    mtime within ``timeout_s`` of ``now``. A rank that has not written yet
+    is scored at ``since`` (its spawn time) when given, so a freshly
+    spawned worker counts as live until ``since + timeout_s`` — the same
+    grace :func:`stale` applies. ``ranks`` names the candidate set (the
+    supervisor passes its workers); without it only ranks that wrote are
+    considered. Feeds the supervisor's ``launch_live_ranks`` gauge
+    (``paddle.observability.metrics``)."""
+    if now is None:
+        now = time.time()
+    beats = read_all(dir)
+    candidates = ({str(r) for r in ranks} if ranks is not None
+                  else set(beats))
+    out = set()
+    for r in candidates:
+        t = beats.get(r, {}).get("time")
+        if t is None:
+            t = since
+        if t is None:
+            continue
+        if not timeout_s or float(timeout_s) <= 0 \
+                or (now - float(t)) <= float(timeout_s):
+            out.add(r)
+    return out
 
 
 class PreemptionState:

@@ -462,35 +462,50 @@ class Model:
         # the elastic launcher relaunches budget-free
         with _hb.trap_preemption() as _preempt:
             try:
+                from ..observability import trace as _obs_trace
+
                 for epoch in range(epochs):
-                    cbks.on_epoch_begin(epoch)
-                    self._reset_metrics()
-                    logs = {}
-                    for step, batch in enumerate(stream):
-                        cbks.on_train_batch_begin(step)
-                        batch = _to_list(batch)
-                        ins, labs = self._split_batch(batch)
-                        update = (step + 1) % accumulate_grad_batches == 0
-                        losses, _ = self.train_batch(ins, labs,
-                                                     update=update)
-                        logs = {"loss": losses[0], **self._metric_logs()}
-                        cbks.set_params({**cbks.callbacks[0].params,
-                                         "last_step": step})
-                        cbks.on_train_batch_end(step, logs)
-                        it += 1
-                        # feed the launcher's hang watchdog (no-op when
-                        # unsupervised: one env lookup)
-                        _hb.write(step=it)
-                        if _preempt.triggered:
-                            self.stop_training = True
-                            break
-                        if num_iters is not None and it >= num_iters:
-                            break
-                    cbks.on_epoch_end(epoch, logs)
+                    # epoch boundaries are host-side control flow — an
+                    # allowed span site (ISSUE 10: spans only where the
+                    # host already blocks); batches inside stay span-free
+                    _epoch_span = _obs_trace.span(
+                        "hapi.epoch", cat="train", args={"epoch": epoch})
+                    try:
+                        cbks.on_epoch_begin(epoch)
+                        self._reset_metrics()
+                        logs = {}
+                        for step, batch in enumerate(stream):
+                            cbks.on_train_batch_begin(step)
+                            batch = _to_list(batch)
+                            ins, labs = self._split_batch(batch)
+                            update = (step + 1) % \
+                                accumulate_grad_batches == 0
+                            losses, _ = self.train_batch(ins, labs,
+                                                         update=update)
+                            logs = {"loss": losses[0],
+                                    **self._metric_logs()}
+                            cbks.set_params({**cbks.callbacks[0].params,
+                                             "last_step": step})
+                            cbks.on_train_batch_end(step, logs)
+                            it += 1
+                            # feed the launcher's hang watchdog (no-op
+                            # when unsupervised: one env lookup)
+                            _hb.write(step=it)
+                            if _preempt.triggered:
+                                self.stop_training = True
+                                break
+                            if num_iters is not None and it >= num_iters:
+                                break
+                        cbks.on_epoch_end(epoch, logs)
+                    finally:
+                        # the failing epoch must still land in the trace
+                        _epoch_span.end()
 
                     if eval_loader is not None and not _preempt.triggered \
                             and (epoch + 1) % eval_freq == 0:
-                        self._run_eval(eval_loader, cbks)
+                        with _obs_trace.span("hapi.eval", cat="train",
+                                             args={"epoch": epoch}):
+                            self._run_eval(eval_loader, cbks)
                     if self.stop_training:
                         break
                     if num_iters is not None and it >= num_iters:

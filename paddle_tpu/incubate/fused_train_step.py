@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
 from ..utils import functional_call, params_dict
 
 __all__ = ["FusedTrainStep", "fused_train_step"]
@@ -39,6 +41,37 @@ __all__ = ["FusedTrainStep", "fused_train_step"]
 # in-process stall guard (FLAGS_step_timeout_s) or the launcher's heartbeat
 # watchdog (FLAGS_worker_hang_timeout_s) must be the thing that ends it
 _STALL_SLEEP_S = 3600.0
+
+# drive() observability (ISSUE 10): every series is labeled by this step
+# instance's stats name, recorded ONLY at window boundaries from values
+# the host already holds — zero added host syncs (the A/B in
+# tests/test_observability.py asserts host_syncs and losses bit-identical
+# with observability on vs off). The guard gauges are the registry mirror
+# behind guard_stats()' backward-compatible dict.
+_M_TRAIN_STEPS = _obs_metrics.counter(
+    "train_steps_total", "fused train steps dispatched through drive()")
+_M_TRAIN_SKIPPED = _obs_metrics.counter(
+    "train_skipped_steps_total",
+    "updates discarded in-graph for non-finite loss/grads")
+_M_TRAIN_ROLLBACKS = _obs_metrics.counter(
+    "train_rollbacks_total", "divergence-sentinel rollbacks performed")
+_H_WINDOW_S = _obs_metrics.histogram(
+    "train_window_seconds", "wall time of one metric-fetch window",
+    buckets=_obs_metrics.DEFAULT_SECONDS_BUCKETS)
+_G_ITEMS_PER_S = _obs_metrics.gauge(
+    "train_items_per_sec",
+    "tokens-or-examples/s over the last recorded window (tokens when the "
+    "leading input is 2-D integer ids, else leading-dim examples)")
+_G_GUARD = {
+    "total": _obs_metrics.gauge(
+        "train_guard_total", "steps dispatched through the anomaly guard"),
+    "skipped": _obs_metrics.gauge(
+        "train_guard_skipped", "guard-discarded steps (host mirror)"),
+    "consecutive_skips": _obs_metrics.gauge(
+        "train_guard_consecutive_skips", "current non-finite skip streak"),
+    "warned": _obs_metrics.gauge(
+        "train_guard_warned", "warn-mode non-finite events"),
+}
 
 
 def _f32(x):
@@ -736,7 +769,66 @@ class FusedTrainStep:
             dm = self.device_metrics()
             self._step_count = dm["step_count"]
             self._guard["skipped"] = dm["skipped"]
+        self._publish_guard_metrics()
         return dict(self._guard)
+
+    def _publish_guard_metrics(self):
+        """Mirror the guard's host counters into the registry
+        (``train_guard_*{instance=...}``) — guard_stats() keeps its dict
+        shape, the registry carries the same numbers for scraping."""
+        for k, g in _G_GUARD.items():
+            g.set(self._guard[k], instance=self._stats_name)
+
+    @staticmethod
+    def _batch_items(args, kw):
+        """Items one batch contributes to the throughput gauge: tokens
+        (rows x length) when the leading input is a 2-D integer array
+        (token ids), else leading-dim examples. A heuristic, stated as
+        one — the gauge is `train_items_per_sec`, not a benchmark."""
+        for x in list(args) + list(kw.values()):
+            arr = x._data if isinstance(x, Tensor) else x
+            shape = getattr(arr, "shape", None)
+            if shape is None or len(shape) == 0:
+                continue
+            if len(shape) == 2 and jnp.issubdtype(arr.dtype, jnp.integer):
+                return int(shape[0]) * int(shape[1])
+            return int(shape[0])
+        return 1
+
+    def _record_window_obs(self, obs_state, n_steps, n_bad, t_end):
+        """Accumulate one flushed window into the pending observability
+        state and publish at the ``metrics_every`` cadence. Pure host
+        arithmetic over values already fetched — never a device sync."""
+        every = obs_state["every"]
+        if every == 0:
+            return
+        obs_state["steps"] += n_steps
+        obs_state["bad"] += n_bad
+        if every is not None and obs_state["steps"] < every:
+            return
+        self._publish_window_obs(obs_state, t_end)
+
+    def _publish_window_obs(self, obs_state, t_end):
+        """Publish the pending accumulation. Also called once at drive
+        exit with whatever remains: a `*_total` counter that silently
+        dropped the trailing sub-``metrics_every`` window would
+        undercount every drive whose step count is not a multiple."""
+        if obs_state["every"] == 0 or not obs_state["steps"]:
+            return
+        wall = max(t_end - obs_state["t0"], 1e-9)
+        inst = self._stats_name
+        _M_TRAIN_STEPS.inc(obs_state["steps"], instance=inst)
+        if obs_state["bad"]:
+            _M_TRAIN_SKIPPED.inc(obs_state["bad"], instance=inst)
+        _H_WINDOW_S.observe(wall, instance=inst)
+        if obs_state["items_per_step"]:
+            _G_ITEMS_PER_S.set(
+                obs_state["items_per_step"] * obs_state["steps"] / wall,
+                instance=inst)
+        self._publish_guard_metrics()
+        obs_state["steps"] = 0
+        obs_state["bad"] = 0
+        obs_state["t0"] = t_end
 
     @staticmethod
     def _poison_first_float(darrs, karrs, fn):
@@ -870,7 +962,7 @@ class FusedTrainStep:
     def drive(self, data, steps=None, log_every=None, prefetch=None,
               prefetch_depth=None, on_window=None, checkpoint=None,
               sampler=None, heartbeat=True, handle_preemption=True,
-              sentinel=None):
+              sentinel=None, metrics_every=None):
         """Multi-step driver: dispatch fused steps back-to-back with NO
         per-step host sync, so the device executable queue stays deep while
         the input side is double-buffered by a :class:`DevicePrefetcher`.
@@ -956,6 +1048,20 @@ class FusedTrainStep:
           back identically (a disagreeing rank is a split brain and
           raises).
 
+        **Observability** (``metrics_every=``, ISSUE 10): every window
+        boundary records registry metrics (``train_steps_total``,
+        ``train_skipped_steps_total``, ``train_window_seconds``,
+        ``train_items_per_sec`` — see ``paddle.observability.metrics``)
+        and, when the tracer is enabled, emits per-window spans
+        (``train.window`` / ``train.dispatch`` / ``train.fetch`` /
+        ``train.guard`` / ``train.sentinel`` / ``train.checkpoint``).
+        Everything is host-side arithmetic over values the deferred fetch
+        already brought over, so instrumentation adds ZERO host syncs and
+        the loss trajectory is bit-identical with observability on or
+        off. ``metrics_every=N`` thins the registry updates to boundaries
+        at least ``N`` steps apart; ``0`` disables them for this drive;
+        ``None`` (default) records every window.
+
         Returns ``{"steps", "loss" (per-step floats), "skipped",
         "windows", "host_syncs", "log_every", "deferred", "prefetch",
         "rollbacks", "skipped_windows", "sentinel"}`` (``sentinel`` is the
@@ -1015,6 +1121,17 @@ class FusedTrainStep:
                    "host_syncs": 0, "log_every": log_every,
                    "deferred": True, "prefetch": None, "rollbacks": 0,
                    "skipped_windows": 0, "sentinel": None}
+        # window observability state: metrics_every=None records every
+        # boundary, N thins to >=N-step gaps, 0 disables for this drive.
+        # When the registry itself is disabled, recording is a no-op by
+        # construction (every mutate checks the registry switch).
+        import time as _obs_time
+
+        obs_state = {
+            "every": (None if metrics_every is None
+                      else max(0, int(metrics_every))),
+            "steps": 0, "bad": 0, "items_per_step": None,
+            "t0": _obs_time.perf_counter()}
 
         # resumable-stream cursor: only armed on the resume-enabled path
         # (an explicit sampler=, or a checkpoint manager to persist into) —
@@ -1071,22 +1188,36 @@ class FusedTrainStep:
                     RuntimeWarning, stacklevel=2)
             skipped_before = self._guard["skipped"]
             win_start, win_skips = 0, self._guard["skipped"]
+            win_start_ns = _obs_time.perf_counter_ns()
             it = iter(stream)
 
             def scaler_window_end(final=False):
                 # on_window still fires at every log boundary (it is the
                 # documented checkpoint hook), just with per-step-fetched
                 # values instead of a deferred stack
-                nonlocal win_start, win_skips, it
+                nonlocal win_start, win_skips, win_start_ns, it
                 from .sentinel import make_window
 
                 history["windows"] += 1
+                n_steps = len(history["loss"]) - win_start
+                n_bad = self._guard["skipped"] - win_skips
                 win = make_window(
                     history["loss"][win_start:],
-                    non_finite=self._guard["skipped"] - win_skips,
+                    non_finite=n_bad,
                     step=history["steps"])
+                now_ns = _obs_time.perf_counter_ns()
+                _obs_trace.add_complete(
+                    "train.window", win_start_ns, now_ns, cat="train",
+                    args={"instance": self._stats_name, "steps": n_steps,
+                          "non_finite": n_bad})
+                win_start_ns = now_ns
+                self._record_window_obs(obs_state, n_steps, n_bad,
+                                        _obs_time.perf_counter())
                 if on_window is not None:
-                    on_window(win)
+                    with _obs_trace.span("train.checkpoint", cat="train",
+                                         args={"instance":
+                                               self._stats_name}):
+                        on_window(win)
                 win_start = len(history["loss"])
                 win_skips = self._guard["skipped"]
                 if heartbeat:
@@ -1095,10 +1226,13 @@ class FusedTrainStep:
                     # trailing window: no stream left to rewind/skip —
                     # pass it=None like the deferred path, so a rollback
                     # only restores state for the NEXT drive
-                    new_it = self._sentinel_check(
-                        sentinel, win, history, checkpoint, resumable,
-                        stream, None if final else it, log_every,
-                        scaler=scaler)
+                    with _obs_trace.span("train.sentinel", cat="train",
+                                         args={"instance":
+                                               self._stats_name}):
+                        new_it = self._sentinel_check(
+                            sentinel, win, history, checkpoint, resumable,
+                            stream, None if final else it, log_every,
+                            scaler=scaler)
                     if new_it is not None:
                         it = new_it
 
@@ -1123,6 +1257,9 @@ class FusedTrainStep:
                         except StopIteration:
                             break
                         args, kw = self._call_form(batch)
+                        if obs_state["items_per_step"] is None:
+                            obs_state["items_per_step"] = \
+                                self._batch_items(args, kw)
                         loss = self(*args, **kw)
                         if resumable is not None:
                             resumable.advance(1)
@@ -1138,7 +1275,13 @@ class FusedTrainStep:
                                           - skipped_before)
                 finally:
                     # an exception (dataset error, action='raise') must
-                    # not leak the staging thread parked on the queue
+                    # not leak the staging thread parked on the queue,
+                    # and the trailing sub-metrics_every accumulation
+                    # must still count — *_total counters undercounting
+                    # on a raise would misreport exactly the runs one
+                    # debugs with these metrics
+                    self._publish_window_obs(obs_state,
+                                             _obs_time.perf_counter())
                     if made_prefetcher is not None:
                         made_prefetcher.close()
                         history["prefetch"] = made_prefetcher.stats()
@@ -1170,6 +1313,31 @@ class FusedTrainStep:
         window = []
         sched = (getattr(self.optimizer, "_learning_rate", None)
                  if self._step_lr_scheduler else None)
+        win_start_ns = _obs_time.perf_counter_ns()
+
+        def flush_and_observe(buf):
+            """Flush one window and record its observability: dispatch +
+            window spans bracketing timestamps the host already took, and
+            the registry metrics at the metrics_every cadence."""
+            nonlocal win_start_ns
+            pre_ns = _obs_time.perf_counter_ns()
+            _obs_trace.add_complete(
+                "train.dispatch", win_start_ns, pre_ns, cat="train",
+                args={"instance": self._stats_name, "steps": len(buf)})
+            win = self._flush_window(buf, action, protect, history,
+                                     on_window,
+                                     stall_timeout=step_timeout,
+                                     track_gnorm=track_gnorm)
+            now_ns = _obs_time.perf_counter_ns()
+            _obs_trace.add_complete(
+                "train.window", win_start_ns, now_ns, cat="train",
+                args={"instance": self._stats_name, "steps": len(buf),
+                      "non_finite": win["non_finite"]})
+            win_start_ns = now_ns
+            self._record_window_obs(obs_state, len(buf),
+                                    win["non_finite"],
+                                    _obs_time.perf_counter())
+            return win
         with hb.trap_preemption(enable=handle_preemption) as preempt:
             if heartbeat:
                 hb.write(step=self._step_count)
@@ -1197,6 +1365,9 @@ class FusedTrainStep:
                     except StopIteration:
                         break
                     args, kw = self._call_form(batch)
+                    if obs_state["items_per_step"] is None:
+                        obs_state["items_per_step"] = \
+                            self._batch_items(args, kw)
                     self._step_count += 1
                     self._guard["total"] += 1
                     loss, finite = self._dispatch(args, kw, guard, 1.0,
@@ -1212,16 +1383,16 @@ class FusedTrainStep:
                         # (action='raise'), the trailing flush below must
                         # not replay the same window's bookkeeping
                         full, window = window, []
-                        win = self._flush_window(full, action, protect,
-                                                 history, on_window,
-                                                 stall_timeout=step_timeout,
-                                                 track_gnorm=track_gnorm)
+                        win = flush_and_observe(full)
                         if heartbeat:
                             hb.write(step=self._step_count)
                         if sentinel is not None:
-                            new_it = self._sentinel_check(
-                                sentinel, win, history, checkpoint,
-                                resumable, stream, it, log_every)
+                            with _obs_trace.span(
+                                    "train.sentinel", cat="train",
+                                    args={"instance": self._stats_name}):
+                                new_it = self._sentinel_check(
+                                    sentinel, win, history, checkpoint,
+                                    resumable, stream, it, log_every)
                             if new_it is not None:
                                 it = new_it
                 # trailing partial window: flushed only on clean exit — an
@@ -1230,10 +1401,7 @@ class FusedTrainStep:
                 # state is already correct either way; in-graph semantics
                 # never needed the host)
                 if window:
-                    win = self._flush_window(window, action, protect,
-                                             history, on_window,
-                                             stall_timeout=step_timeout,
-                                             track_gnorm=track_gnorm)
+                    win = flush_and_observe(window)
                     if heartbeat:
                         hb.write(step=self._step_count)
                     if sentinel is not None:
@@ -1242,9 +1410,12 @@ class FusedTrainStep:
                         # warn / raise / health bookkeeping still applies
                         # (the NEXT drive continues from the rolled-back
                         # state and cursor)
-                        self._sentinel_check(
-                            sentinel, win, history, checkpoint,
-                            resumable, stream, None, log_every)
+                        with _obs_trace.span(
+                                "train.sentinel", cat="train",
+                                args={"instance": self._stats_name}):
+                            self._sentinel_check(
+                                sentinel, win, history, checkpoint,
+                                resumable, stream, None, log_every)
             except BaseException:
                 # the unfetched window's finite flags are lost with the
                 # exception — resync the host mirrors from the
@@ -1257,6 +1428,10 @@ class FusedTrainStep:
                         pass
                 raise
             finally:
+                # the trailing sub-metrics_every accumulation must still
+                # count even when the loop exits on an exception
+                self._publish_window_obs(obs_state,
+                                         _obs_time.perf_counter())
                 if made_prefetcher is not None:
                     made_prefetcher.close()
                     history["prefetch"] = made_prefetcher.stats()
@@ -1407,6 +1582,7 @@ class FusedTrainStep:
         # next spike (budget-draining rollback loop)
         sentinel.notify_rollback()
         history["rollbacks"] += 1
+        _M_TRAIN_ROLLBACKS.inc(instance=self._stats_name)
         if it is None:
             # trailing window: the loop is already over — params, moments
             # and cursor are rolled back, and the NEXT drive()/epoch
@@ -1442,7 +1618,10 @@ class FusedTrainStep:
 
         from ..core.exceptions import stall_guard
 
-        with stall_guard(stall_timeout, "window metric fetch"):
+        with stall_guard(stall_timeout, "window metric fetch"), \
+                _obs_trace.span("train.fetch", cat="train",
+                                args={"instance": self._stats_name,
+                                      "steps": len(window)}):
             vals = [jnp.asarray(l, jnp.float32) for l, _ in window]
             if track_gnorm:
                 vals.append(jnp.asarray(self._acc[3], jnp.float32))
@@ -1463,17 +1642,20 @@ class FusedTrainStep:
                 history["host_syncs"] += 1
         n_bad = 0
         if finite is not None:
-            for ok in finite:
-                if ok:
-                    self._guard["consecutive_skips"] = 0
-                else:
-                    n_bad += 1
-                    if action == "warn":
-                        self._guard["warned"] += 1
-                    if protect:
-                        self._guard["skipped"] += 1
-                        self._guard["consecutive_skips"] += 1
-                        self._step_count -= 1  # device step did not advance
+            with _obs_trace.span("train.guard", cat="train",
+                                 args={"instance": self._stats_name}):
+                for ok in finite:
+                    if ok:
+                        self._guard["consecutive_skips"] = 0
+                    else:
+                        n_bad += 1
+                        if action == "warn":
+                            self._guard["warned"] += 1
+                        if protect:
+                            self._guard["skipped"] += 1
+                            self._guard["consecutive_skips"] += 1
+                            # device step did not advance
+                            self._step_count -= 1
             if n_bad and action == "warn":
                 warnings.warn(
                     f"non-finite loss/grads on {n_bad} step(s) in the last "
@@ -1489,7 +1671,9 @@ class FusedTrainStep:
         win = make_window(losses, non_finite=n_bad,
                           step=history["steps"], gnorm_peak=gnorm_peak)
         if on_window is not None:
-            on_window(win)
+            with _obs_trace.span("train.checkpoint", cat="train",
+                                 args={"instance": self._stats_name}):
+                on_window(win)
         if n_bad and action == "raise":
             raise FloatingPointError(
                 f"non-finite loss/grads on {n_bad} step(s) detected at the "

@@ -31,15 +31,52 @@ import itertools
 import math
 import queue
 import threading
+import time
 import warnings
 
 import numpy as np
 
+from ...observability import metrics as _obs_metrics
+from ...observability import trace as _obs_trace
 from .kv_cache import PagedKVCache
-from .scheduler import Request, SamplingParams, Scheduler
+from .scheduler import (Request, SamplingParams, Scheduler,
+                        _M_ADMITTED, _M_EVICTIONS, _M_FINISHED,
+                        _M_QUEUED_EXH)
 
 __all__ = ["LLMEngine", "StepOutput", "save_llama_artifact",
            "load_llama_artifact"]
+
+# engine-owned latency/utilization observability (ISSUE 10): TTFT and
+# inter-token latency are recorded HERE, from host timestamps the engine
+# already takes at its sampling points (post-fetch — sampling is host-side
+# by design), so bench_serving reports serving percentiles from the
+# engine's own histograms instead of bench-side timing. Labeled by engine
+# instance; request ids ride in trace spans (bounded rows), never labels.
+_H_TTFT = _obs_metrics.histogram(
+    "serving_ttft_ms", "time to first token per request (submit -> first "
+    "sampled token)", buckets=_obs_metrics.DEFAULT_MS_BUCKETS)
+_H_ITL = _obs_metrics.histogram(
+    "serving_itl_ms", "inter-token latency per decoded token",
+    buckets=_obs_metrics.DEFAULT_MS_BUCKETS)
+_M_TOKENS = _obs_metrics.counter(
+    "serving_tokens_out_total", "tokens sampled across all requests")
+_M_PREFILLS = _obs_metrics.counter(
+    "serving_prefills_total", "prefill graph executions (incl. eviction "
+    "re-prefills)")
+_G_KV_UTIL = _obs_metrics.gauge(
+    "serving_kv_block_utilization",
+    "fraction of usable KV pool blocks in use after the last step")
+_G_OCCUPANCY = _obs_metrics.gauge(
+    "serving_decode_batch_occupancy",
+    "fraction of decode slots occupied after the last step")
+
+# the ONE list of every serving metric handle an engine instance owns —
+# metrics() and reset_metrics() both iterate it, so a new metric cannot
+# be added to one and silently missed by the other (a reset that skips a
+# histogram would leak warm-phase samples into bench percentiles)
+_SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
+                    _M_PREFILLS, _M_TOKENS, _H_TTFT, _H_ITL, _G_KV_UTIL,
+                    _G_OCCUPANCY)
 
 
 @dataclasses.dataclass
@@ -200,8 +237,11 @@ class LLMEngine:
         dtype = model.llama.layers[0].self_attn.k_proj.weight.dtype
         self.cache = PagedKVCache(self.config, num_blocks, block_size,
                                   dtype=dtype)
+        n = next(LLMEngine._instance_ids)
+        self._name = f"llm_engine#{n}"
         self.scheduler = Scheduler(self.cache.allocator, block_size,
-                                   max_batch_size, max_prefills_per_step)
+                                   max_batch_size, max_prefills_per_step,
+                                   instance=self._name)
         self.max_batch_size = int(max_batch_size)
         buckets = prefill_buckets or _default_buckets(self.block_size,
                                                       self.max_model_len)
@@ -210,8 +250,6 @@ class LLMEngine:
             min(-(-int(b) // self.block_size) * self.block_size,
                 self.max_model_len)
             for b in buckets})
-        n = next(LLMEngine._instance_ids)
-        self._name = f"llm_engine#{n}"
         self._prefill_name = f"llm_engine_prefill#{n}"
         self._decode_name = f"llm_engine_decode#{n}"
         self._params = model._unique_params()
@@ -271,6 +309,9 @@ class LLMEngine:
                 f"bucket is {self.prefill_buckets[-1]}")
         if req.sampling.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        # observability clock zero: TTFT and the queued span both measure
+        # from the moment the engine accepted the request
+        req.t_submit = req.t_queue_start = time.perf_counter_ns()
         self._requests[req.rid] = req
         if self._ingest is not None:
             self._ingest.submit(req)
@@ -502,6 +543,14 @@ class LLMEngine:
 
         # -- prefill (admission) ---------------------------------------
         for slot, req in sched.pick_prefills():
+            # queued->running transition: the span closes here, at a point
+            # where the host is already doing admission bookkeeping
+            t_admit = time.perf_counter_ns()
+            _obs_trace.add_complete(
+                "request.queued", req.t_queue_start, t_admit,
+                cat="request", tid=req.rid,
+                args={"rid": req.rid, "engine": self._name,
+                      "evictions": req.evictions})
             staged = getattr(req, "_staged", None)
             if staged is None or staged[2] != req.num_tokens:
                 self._stage_request(req)  # re-prefill after eviction
@@ -517,7 +566,16 @@ class LLMEngine:
                 self.cache.k, self.cache.v)
             req.num_cached = true_len
             self.stats_extra["prefills"] += 1
+            _M_PREFILLS.inc(instance=self._name)
+            # the _emit below fetches logits (the existing sync point);
+            # the prefill span closes right after it
             outputs.extend(self._emit(req, np.asarray(logits)[0]))
+            req.t_decode_start = time.perf_counter_ns()
+            _obs_trace.add_complete(
+                "request.prefill", t_admit, req.t_decode_start,
+                cat="request", tid=req.rid,
+                args={"rid": req.rid, "engine": self._name,
+                      "bucket": bucket, "true_len": true_len})
 
         # -- decode ------------------------------------------------------
         sched.ensure_decode_room()
@@ -539,6 +597,12 @@ class LLMEngine:
             for i, req in running:
                 req.num_cached += 1
                 outputs.extend(self._emit(req, logits[i]))
+        # utilization gauges: free-list arithmetic the host already holds
+        usable = max(self.cache.num_blocks - 1, 1)
+        _G_KV_UTIL.set(1.0 - self.cache.allocator.num_free / usable,
+                       instance=self._name)
+        _G_OCCUPANCY.set(len(sched.running) / self.max_batch_size,
+                         instance=self._name)
         return outputs
 
     def _emit(self, req, row):
@@ -552,9 +616,28 @@ class LLMEngine:
             top_k=s.top_k, top_p=s.top_p, rng=req._rng)[0])
         req.output_tokens.append(tok)
         self.stats_extra["tokens_out"] += 1
+        # latency observation at the sampling point — the host just
+        # fetched these logits anyway, so the clock read is free
+        now = time.perf_counter_ns()
+        _M_TOKENS.inc(instance=self._name)
+        if req.t_first_token is None:
+            req.t_first_token = now
+            if req.t_submit is not None:
+                _H_TTFT.observe((now - req.t_submit) / 1e6,
+                                instance=self._name)
+        elif req.t_last_token is not None:
+            _H_ITL.observe((now - req.t_last_token) / 1e6,
+                           instance=self._name)
+        req.t_last_token = now
         done = req.should_finish()
         if done:
             self.scheduler.finish(req)
+            start = req.t_decode_start or req.t_first_token or now
+            _obs_trace.add_complete(
+                "request.decode", start, now, cat="request", tid=req.rid,
+                args={"rid": req.rid, "engine": self._name,
+                      "tokens": len(req.output_tokens),
+                      "finish_reason": req.finish_reason()})
         return [StepOutput(req.rid, tok, done,
                            req.finish_reason() if done else None)]
 
@@ -626,6 +709,44 @@ class LLMEngine:
         d["prefill_stats_row"] = self._prefill_name
         d["decode_stats_row"] = self._decode_name
         return d
+
+    def metrics(self):
+        """Engine-owned observability snapshot (ISSUE 10 public surface):
+        lifecycle counters, latency histogram summaries (count/mean/
+        p50/p99, ms) and utilization gauges for THIS engine instance,
+        read from ``paddle.observability.metrics``. This is what
+        ``scripts/bench_serving.py`` reports TTFT / inter-token
+        percentiles from — engine-measured, not bench-side timing."""
+        inst = self._name
+        return {
+            "instance": inst,
+            "admitted": int(_M_ADMITTED.value(instance=inst)),
+            "evictions": int(_M_EVICTIONS.value(instance=inst)),
+            "finished": int(_M_FINISHED.value(instance=inst)),
+            "queued_on_exhaustion": int(
+                _M_QUEUED_EXH.value(instance=inst)),
+            "prefills": int(_M_PREFILLS.value(instance=inst)),
+            "tokens_out": int(_M_TOKENS.value(instance=inst)),
+            "ttft_ms": _H_TTFT.summary(instance=inst),
+            "itl_ms": _H_ITL.summary(instance=inst),
+            "kv_block_utilization": _G_KV_UTIL.value(instance=inst),
+            "decode_batch_occupancy": _G_OCCUPANCY.value(instance=inst),
+        }
+
+    def reset_metrics(self):
+        """Drop THIS instance's registry series (latency histograms and
+        lifecycle counters restart from empty). Benchmarks call it at the
+        start of a timed window so warm-phase observations never pollute
+        the reported percentiles; a production engine has no reason to."""
+        for m in _SERVING_METRICS:
+            m.remove(instance=self._name)
+
+    def reset_block_high_water(self):
+        """Re-anchor the allocator's high-water mark at the current
+        in-use block count — the window-local form benchmarks want
+        (replaces reaching into ``cache.allocator`` privates)."""
+        alloc = self.cache.allocator
+        alloc.high_water = (self.cache.num_blocks - 1) - alloc.num_free
 
     def close(self):
         if self._ingest is not None:

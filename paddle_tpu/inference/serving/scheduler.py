@@ -25,11 +25,30 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 
 import numpy as np
 
+from ...observability import metrics as _obs_metrics
+
 __all__ = ["SamplingParams", "Request", "Scheduler"]
+
+# engine-owned admission/eviction counters (ISSUE 10 satellite): the
+# registry — labeled by the owning engine/scheduler instance — is the
+# authoritative store; ``Scheduler.stats`` is a thin backward-compatible
+# dict view over it, and bench_serving reads the registry instead of
+# recomputing from private fields.
+_M_ADMITTED = _obs_metrics.counter(
+    "serving_requests_admitted_total", "requests admitted to decode slots")
+_M_EVICTIONS = _obs_metrics.counter(
+    "serving_evictions_total",
+    "recompute-preemption evictions under pool pressure")
+_M_FINISHED = _obs_metrics.counter(
+    "serving_requests_finished_total", "requests finished (EOS or length)")
+_M_QUEUED_EXH = _obs_metrics.counter(
+    "serving_queued_on_exhaustion_total",
+    "admissions deferred because the block pool was exhausted")
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 
@@ -59,6 +78,14 @@ class Request:
         self.sampling = sampling or SamplingParams()
         self.arrival_t = arrival_t
         self.state = WAITING
+        # observability timestamps (perf_counter_ns; host clocks only):
+        # queue-entry time for the queued->running span, first/last token
+        # times for TTFT / inter-token latency, decode-phase start
+        self.t_queue_start = time.perf_counter_ns()
+        self.t_submit = None
+        self.t_first_token = None
+        self.t_last_token = None
+        self.t_decode_start = None
         self.output_tokens: list[int] = []
         self.blocks: list[int] = []       # pool block ids, in order
         self.num_cached = 0               # tokens materialized in the pool
@@ -106,18 +133,38 @@ class Request:
 
 
 class Scheduler:
-    """Slots + FIFO wait queue over a :class:`BlockAllocator`."""
+    """Slots + FIFO wait queue over a :class:`BlockAllocator`.
+
+    ``instance`` names this scheduler's registry label (the owning
+    ``LLMEngine`` passes its own name, so every serving counter of one
+    engine shares one label); standalone schedulers get an auto name.
+    """
+
+    _ids = itertools.count(1)
 
     def __init__(self, allocator, block_size, max_batch_size,
-                 max_prefills_per_step=1):
+                 max_prefills_per_step=1, instance=None):
         self.allocator = allocator
         self.block_size = int(block_size)
         self.slots: list[Request | None] = [None] * int(max_batch_size)
         self.waiting: deque[Request] = deque()
         self.max_prefills_per_step = int(max_prefills_per_step)
         self._admit_seq = itertools.count()
-        self.stats = {"admitted": 0, "evictions": 0, "finished": 0,
-                      "queued_on_exhaustion": 0}
+        self.instance = instance or f"scheduler#{next(Scheduler._ids)}"
+        # pre-touch the series so stats reads zeros before any event
+        for m in (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH):
+            m.inc(0, instance=self.instance)
+
+    @property
+    def stats(self):
+        """Backward-compatible dict view over the registry counters."""
+        inst = self.instance
+        return {
+            "admitted": int(_M_ADMITTED.value(instance=inst)),
+            "evictions": int(_M_EVICTIONS.value(instance=inst)),
+            "finished": int(_M_FINISHED.value(instance=inst)),
+            "queued_on_exhaustion": int(_M_QUEUED_EXH.value(instance=inst)),
+        }
 
     # -- queries ---------------------------------------------------------
     @property
@@ -147,7 +194,7 @@ class Scheduler:
             need = -(-(req.num_tokens + 1) // self.block_size)
             blocks = self.allocator.allocate(need)
             if blocks is None:
-                self.stats["queued_on_exhaustion"] += 1
+                _M_QUEUED_EXH.inc(instance=self.instance)
                 break
             self.waiting.popleft()
             slot = self._free_slot()
@@ -155,7 +202,7 @@ class Scheduler:
             req.state = RUNNING
             req.admit_seq = next(self._admit_seq)
             self.slots[slot] = req
-            self.stats["admitted"] += 1
+            _M_ADMITTED.inc(instance=self.instance)
             picked.append((slot, req))
         return picked
 
@@ -195,9 +242,10 @@ class Scheduler:
         req.num_cached = 0
         req.state = WAITING
         req.evictions += 1
+        req.t_queue_start = time.perf_counter_ns()  # re-queued span start
         self.slots[slot] = None
         self.waiting.appendleft(req)
-        self.stats["evictions"] += 1
+        _M_EVICTIONS.inc(instance=self.instance)
 
     # -- completion ------------------------------------------------------
     def finish(self, req):
@@ -206,4 +254,4 @@ class Scheduler:
         req.blocks = []
         req.state = FINISHED
         self.slots[slot] = None
-        self.stats["finished"] += 1
+        _M_FINISHED.inc(instance=self.instance)

@@ -38,8 +38,25 @@ import warnings
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
 
 __all__ = ["DevicePrefetcher", "np_pad_to_bucket"]
+
+# per-INSTANCE overlap telemetry (ISSUE 10 satellite): the legacy
+# jit.cache_stats() row is keyed by the caller-chosen stats name, so two
+# concurrent loaders built with the same name merge their numbers there.
+# These registry series carry an instance label unique per prefetcher
+# object, so they never merge; host_blocked is a histogram (p50/p99 of
+# the per-get block, not just a total).
+_M_HOST_BLOCKED = _obs_metrics.histogram(
+    "io_host_blocked_ms",
+    "ms the consumer blocked per staged-batch get (residual "
+    "host-boundness after overlap)", buckets=_obs_metrics.DEFAULT_MS_BUCKETS)
+_G_QUEUE_DEPTH = _obs_metrics.gauge(
+    "io_queue_depth",
+    "staged-batch queue depth at the last consumer get (0 = host-bound, "
+    "prefetch_depth = device-bound)")
 
 # worker -> consumer token kinds
 _ITEM = "item"
@@ -127,8 +144,13 @@ class DevicePrefetcher:
         self._spec = BucketSpec.normalize(shape_buckets)
         self._bucket_args = (None if bucket_args is None
                              else frozenset(bucket_args))
-        self._stats_name = name or (
-            f"device_prefetcher#{next(DevicePrefetcher._instance_ids)}")
+        uid = next(DevicePrefetcher._instance_ids)
+        self._stats_name = name or f"device_prefetcher#{uid}"
+        # registry label: unique PER OBJECT even when a stable name= is
+        # passed, so two concurrent loaders sharing a legacy stats row
+        # keep distinct io_host_blocked_ms / io_queue_depth series
+        self._metrics_label = (self._stats_name if name is None
+                               else f"{name}#{uid}")
         self._fell_back = False
         self._stats = {"batches": 0, "prefetched": 0, "sync_fallback": 0,
                        "host_blocked_ms": 0.0, "queue_depth_sum": 0,
@@ -176,6 +198,15 @@ class DevicePrefetcher:
             except queue.Full:
                 pass
         self._active = []
+        # bound registry growth: the per-OBJECT instance series must not
+        # outlive the object's working life (drive() builds a fresh
+        # prefetcher per call — leaking one dead histogram + stale gauge
+        # per drive would violate the label-cardinality rule). The
+        # accumulated totals remain in this object's stats() and in the
+        # legacy jit.cache_stats() row; a post-close re-iteration simply
+        # re-creates the series.
+        _M_HOST_BLOCKED.remove(instance=self._metrics_label)
+        _G_QUEUE_DEPTH.remove(instance=self._metrics_label)
 
     def reset(self, sampler_state=None):
         """Discard every staged (read-ahead) batch and restart from the
@@ -312,7 +343,12 @@ class DevicePrefetcher:
                     return
                 try:
                     fault_injection.fire("io.prefetch")
-                    staged, n_pads = self._stage(batch)
+                    # staging runs on the transfer thread — an allowed
+                    # span site (the host thread here exists to block)
+                    with _obs_trace.span("io.prefetch.stage", cat="io",
+                                         args={"instance":
+                                               self._metrics_label}):
+                        staged, n_pads = self._stage(batch)
                 except BaseException as e:
                     # transfer thread dies; hand the un-staged batch back so
                     # the synchronous fallback loses nothing
@@ -333,11 +369,15 @@ class DevicePrefetcher:
                 kind, payload, extra = q.get()
                 blocked_ms = (time.perf_counter() - t0) * 1000.0
                 if kind == _ITEM:
+                    depth = q.qsize()
                     self._stats["host_blocked_ms"] += blocked_ms
-                    self._stats["queue_depth_sum"] += q.qsize()
+                    self._stats["queue_depth_sum"] += depth
                     jit_cache.record_host_blocked(self._stats_name,
                                                   blocked_ms)
-                    jit_cache.record_queue_depth(self._stats_name, q.qsize())
+                    jit_cache.record_queue_depth(self._stats_name, depth)
+                    _M_HOST_BLOCKED.observe(blocked_ms,
+                                            instance=self._metrics_label)
+                    _G_QUEUE_DEPTH.set(depth, instance=self._metrics_label)
                     yield self._deliver(payload, extra, prefetched=True)
                     continue
                 if kind == _DONE:

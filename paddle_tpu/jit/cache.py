@@ -36,6 +36,7 @@ import threading
 import warnings
 
 from ..core.flags import register_flag
+from ..observability import metrics as _obs_metrics
 
 register_flag(
     "jit_compile_warn_threshold", 8,
@@ -242,33 +243,70 @@ def bucketize_tree(tree, spec, lengths=None, per_leaf=False):
 # compile-cache telemetry
 # --------------------------------------------------------------------------
 
-class FunctionCacheStats:
-    """Per-entry-point compile-cache counters (one per function name)."""
+# registry-backed compile-cache counters (ISSUE 10): the numbers live in
+# paddle.observability.metrics under a `function` label and cache_stats()
+# is a thin backward-compatible view over them, so one Prometheus scrape
+# sees the same compile/hit telemetry the dict API reports. Per-shape miss
+# breakdowns stay in the local dict below — shape signatures are unbounded
+# and the registry's label-cardinality rule forbids them as labels.
+_M_COMPILES = _obs_metrics.counter(
+    "jit_compiles_total", "XLA compiles per jitted entry point")
+_M_HITS = _obs_metrics.counter(
+    "jit_cache_hits_total", "compile-cache hits per jitted entry point")
+_M_EAGER = _obs_metrics.counter(
+    "jit_eager_fallbacks_total",
+    "uncompiled per-call executions (the 10-100x cliff)")
+_M_PADS = _obs_metrics.counter(
+    "jit_bucket_pads_total", "inputs zero-padded up to a shape bucket")
+_M_SCALER_FB = _obs_metrics.counter(
+    "jit_scaler_fallbacks_total",
+    "drive() calls degraded to per-step fetch by an enabled GradScaler")
 
-    __slots__ = ("name", "compiles", "hits", "eager_fallbacks",
-                 "bucket_pads", "per_shape_misses", "_warned",
-                 "host_blocked_ms", "queue_depth_sum", "queue_depth_n",
-                 "scaler_fallbacks")
+
+class FunctionCacheStats:
+    """Per-entry-point compile-cache counters (one per function name).
+
+    The counter-valued fields are registry-backed (`jit_*_total{function=
+    <name>}`); this object keeps only what the registry must not hold:
+    the unbounded per-shape miss map and the one-shot warn latch."""
+
+    __slots__ = ("name", "per_shape_misses", "_warned",
+                 "host_blocked_ms", "queue_depth_sum", "queue_depth_n")
 
     def __init__(self, name):
         self.name = name
-        self.compiles = 0
-        self.hits = 0
-        self.eager_fallbacks = 0
-        self.bucket_pads = 0
         self.per_shape_misses = {}
         self._warned = False
-        # drive() calls that fell back from deferred-window metric fetch
-        # to per-step fetch because an enabled GradScaler was attached
-        # (the scale for step N+1 consumes step N's finite flag on host)
-        self.scaler_fallbacks = 0
         # host-device overlap telemetry (DevicePrefetcher / drive): how
         # long the consumer blocked waiting on the transfer thread, and the
         # staged-batch queue depth sampled at each get (depth ~0 means the
-        # host is the bottleneck, depth ~prefetch_depth means the device is)
+        # host is the bottleneck, depth ~prefetch_depth means the device
+        # is). Kept as the legacy name-keyed row; the authoritative
+        # per-instance series are io_host_blocked_ms / io_queue_depth in
+        # the registry (two same-named loaders no longer merge there).
         self.host_blocked_ms = 0.0
         self.queue_depth_sum = 0
         self.queue_depth_n = 0
+
+    @property
+    def compiles(self):
+        return int(_M_COMPILES.value(function=self.name))
+
+    @property
+    def hits(self):
+        return int(_M_HITS.value(function=self.name))
+
+    @property
+    def eager_fallbacks(self):
+        return int(_M_EAGER.value(function=self.name))
+
+    @property
+    def bucket_pads(self):
+        return int(_M_PADS.value(function=self.name))
+
+    @property
+    def scaler_fallbacks(self):
+        return int(_M_SCALER_FB.value(function=self.name))
 
     def as_dict(self):
         return {
@@ -308,8 +346,8 @@ def record_compile(name, shape_sig=""):
     from ..core.flags import flag_value
 
     s = _stats_for(name)
+    _M_COMPILES.inc(function=name)
     with _LOCK:
-        s.compiles += 1
         s.per_shape_misses[shape_sig] = \
             s.per_shape_misses.get(shape_sig, 0) + 1
         compiles, warned = s.compiles, s._warned
@@ -329,8 +367,8 @@ def record_compile(name, shape_sig=""):
 
 
 def record_hit(name):
-    with _LOCK:
-        _stats_for(name).hits += 1
+    _stats_for(name)
+    _M_HITS.inc(function=name)
 
 
 def record_eager_fallback(name):
@@ -339,8 +377,8 @@ def record_eager_fallback(name):
     timelines — callers ``end()`` it after the eager call returns."""
     from ..profiler.utils import RecordEvent
 
-    with _LOCK:
-        _stats_for(name).eager_fallbacks += 1
+    _stats_for(name)
+    _M_EAGER.inc(function=name)
     return RecordEvent(f"jit::eager_fallback::{name}").begin()
 
 
@@ -349,14 +387,14 @@ def record_scaler_fallback(name):
     deferred-window metric fetch to per-step fetch because an enabled
     GradScaler was attached (dynamic loss scaling consumes the finite
     flag every step)."""
-    with _LOCK:
-        _stats_for(name).scaler_fallbacks += 1
+    _stats_for(name)
+    _M_SCALER_FB.inc(function=name)
 
 
 def record_bucket_pads(name, n):
     if n:
-        with _LOCK:
-            _stats_for(name).bucket_pads += n
+        _stats_for(name)
+        _M_PADS.inc(n, function=name)
 
 
 def record_host_blocked(name, ms):
@@ -396,9 +434,15 @@ def cache_stats(name=None):
 
 
 def reset_cache_stats():
-    """Drop all compile-cache counters (does NOT drop compiled executables)."""
+    """Drop all compile-cache counters (does NOT drop compiled executables).
+    The registry-backed series behind cache_stats() are dropped too, so a
+    re-registered function name restarts from zero."""
     with _LOCK:
+        names = list(_STATS)
         _STATS.clear()
+    for m in (_M_COMPILES, _M_HITS, _M_EAGER, _M_PADS, _M_SCALER_FB):
+        for n in names:
+            m.remove(function=n)
 
 
 # --------------------------------------------------------------------------

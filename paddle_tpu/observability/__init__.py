@@ -1,0 +1,23 @@
+"""paddle.observability — unified runtime observability (ISSUE 10).
+
+One metrics registry + one span tracer for the whole runtime:
+
+- :mod:`.metrics` — process-wide labeled counters/gauges/histograms with
+  ``snapshot()``, Prometheus text exposition and JSON export. Every
+  layer's hand-rolled counters (``jit.cache_stats()``, ``guard_stats()``,
+  serving scheduler stats, checkpoint durations, launcher rank liveness)
+  flow through here; the old dict APIs remain as thin backward-compatible
+  views.
+- :mod:`.trace` — Chrome-trace/Perfetto span tracer. Spans open/close
+  only at points where the host already blocks (window boundaries, fetch
+  points, ingest staging) so tracing adds ZERO host syncs; disabled by
+  default and free when off.
+
+Render a run: ``python scripts/trace_report.py --trace t.json
+--metrics m.json`` (see the README "Observability" recipe).
+"""
+
+from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
+
+__all__ = ["metrics", "trace"]

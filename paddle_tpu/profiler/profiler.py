@@ -130,6 +130,13 @@ class Profiler:
         self._device_tracing = False
         self._trace_dir = None
         self._events_snapshot = []
+        # observability-tracer spans captured during the RECORD window
+        # (ISSUE 10: the export path is rebased onto paddle.observability
+        # .trace, so drive/serving/checkpoint spans land in the same
+        # chrome trace as RecordEvent host spans)
+        self._obs_spans = []
+        self._owns_tracer = False
+        self._obs_window_start_ts = 0.0  # chrome-trace us clock
         from .timer import benchmark
 
         self._benchmark = benchmark()
@@ -178,6 +185,18 @@ class Profiler:
                                       ProfilerState.RECORD_AND_RETURN)
         if not recording_old and recording_new:
             RECORDER.enabled = True
+            from ..observability import trace as obs_trace
+
+            # arm the span tracer for the window; if the user already has
+            # it on (collecting their own trace), leave it theirs and
+            # remember where this window starts so export() takes only
+            # in-window spans, not the user's whole history
+            import time as _time
+
+            self._owns_tracer = not obs_trace.TRACER.enabled
+            self._obs_window_start_ts = _time.perf_counter_ns() / 1e3
+            if self._owns_tracer:
+                obs_trace.TRACER.enable()
             self._start_device_trace()
         elif recording_old and not recording_new:
             # a custom scheduler may go RECORD -> CLOSED/READY without ever
@@ -187,9 +206,27 @@ class Profiler:
         self.current_state = new_state
 
     def _finish_window(self):
+        from ..observability import trace as obs_trace
+
         self._events_snapshot = list(RECORDER.events)
         RECORDER.enabled = False
         RECORDER.clear()
+        # capture ONLY the observability spans recorded during this
+        # window (ts cutoff at RECORD start — a user's pre-window
+        # history, enabled or disabled-but-buffered, never leaks into
+        # the profile). If we armed the tracer, drain our window's
+        # events and disarm, leaving any earlier buffered events for the
+        # user's own trace.export(); a user-enabled tracer keeps its
+        # whole buffer — we only copy.
+        if self._owns_tracer:
+            self._obs_spans = obs_trace.TRACER.drain_since(
+                self._obs_window_start_ts)
+            obs_trace.TRACER.disable()
+            self._owns_tracer = False
+        else:
+            self._obs_spans = [
+                e for e in obs_trace.TRACER.events()
+                if e.get("ts", 0.0) >= self._obs_window_start_ts]
         self._stop_device_trace()
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -227,8 +264,11 @@ class Profiler:
 
     # -- output ----------------------------------------------------------
     def export(self, path, format="json"):
-        """Write the captured host spans as a chrome trace. The device
-        trace (if any) lives in self._trace_dir for TensorBoard."""
+        """Write the captured host spans as a chrome trace: RecordEvent
+        spans plus every ``paddle.observability.trace`` span recorded in
+        the window (drive windows, serving request lifecycles, checkpoint
+        IO). The device trace (if any) lives in self._trace_dir for
+        TensorBoard."""
         events = []
         for name, start, end, tid in self._events_snapshot:
             events.append({
@@ -236,6 +276,7 @@ class Profiler:
                 "ts": start / 1e3, "dur": (end - start) / 1e3,
                 "pid": os.getpid(), "tid": tid,
             })
+        events.extend(self._obs_spans)
         doc = {
             "traceEvents": events,
             "metadata": {"device_trace_dir": self._trace_dir},

@@ -125,14 +125,22 @@ def run_engine(model, stream, engine=None, **engine_kwargs):
     """Continuous batching through ``LLMEngine``; admission respects the
     same arrival clock the naive arm slept on. Pass a warmed ``engine``
     (see :func:`warm_arms`) so the timed window starts with its prefill
-    and decode executables already built."""
+    and decode executables already built.
+
+    Serving telemetry is ENGINE-OWNED (ISSUE 10): eviction/admission
+    counts and the TTFT / inter-token percentiles come from
+    ``LLMEngine.metrics()`` — the observability registry — not from bench
+    clocks or engine privates. ``reset_metrics()`` at window start keeps
+    warm-phase observations out of the reported numbers."""
     from paddle_tpu.inference.serving import LLMEngine, SamplingParams
     from paddle_tpu.jit import cache_stats
 
     eng = engine if engine is not None else LLMEngine(model, **engine_kwargs)
     steps0 = eng.stats_extra["steps"]
-    evictions0 = eng.scheduler.stats["evictions"]
-    eng.cache.allocator.high_water = 0  # window-local peak (pool is empty)
+    # window-local serving metrics + high-water: warm-phase pressure and
+    # latencies must not be attributed to the timed run
+    eng.reset_metrics()
+    eng.reset_block_high_water()
     try:
         row = cache_stats().get(eng._decode_name) or {}
         compiles0 = row.get("compiles", 0)
@@ -159,17 +167,28 @@ def run_engine(model, stream, engine=None, **engine_kwargs):
         outs = [eng.output_tokens(rid) for rid in rids]
         row = cache_stats().get(eng._decode_name) or {}
         stats = eng.stats()
+        em = eng.metrics()
     finally:
         if engine is None:
             eng.close()
     gen_tokens = sum(r.max_new for r in stream)
+
+    def _r(v):
+        return round(v, 2) if v is not None else None
+
     return dict(outputs=outs, wall_s=round(wall, 4),
                 tokens_per_sec=round(gen_tokens / wall, 1),
                 gen_tokens=gen_tokens,
                 decode_compiles_in_window=row.get("compiles", 0) - compiles0,
                 engine_steps=stats["steps"] - steps0,
-                evictions=stats["evictions"] - evictions0,
+                evictions=em["evictions"],
+                admitted=em["admitted"],
+                queued_on_exhaustion=em["queued_on_exhaustion"],
                 blocks_high_water=stats["blocks_high_water"],
+                ttft_p50_ms=_r(em["ttft_ms"]["p50"]),
+                ttft_p99_ms=_r(em["ttft_ms"]["p99"]),
+                itl_p50_ms=_r(em["itl_ms"]["p50"]),
+                itl_p99_ms=_r(em["itl_ms"]["p99"]),
                 **_latency_stats(lat))
 
 

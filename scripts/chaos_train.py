@@ -553,6 +553,20 @@ def read_losses(out, rank=0):
     return dict(sorted(seen.items()))
 
 
+def read_liveness(out):
+    """The launch_live_ranks transition sequence the supervisor appended
+    to ``<out>/logs/liveness.log`` (one ``<time> <count>`` line per gauge
+    change)."""
+    path = os.path.join(out, "logs", "liveness.log")
+    vals = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                vals.append(int(parts[1]))
+    return vals
+
+
 def check(cond, msg):
     if not cond:
         raise AssertionError(msg)
@@ -623,6 +637,19 @@ def main(argv=None):
                   "preempt: no crash restarts")
         if sc == "kill":
             check("restart 1/" in r.stderr, "kill: consumed restart budget")
+            # rank-liveness gauge (ISSUE 10): the launcher publishes
+            # launch_live_ranks every supervision tick and appends value
+            # transitions to logs/liveness.log — the kill must show the
+            # gauge dipping below the full rank count and recovering to
+            # full after the budgeted restart
+            vals = read_liveness(out)
+            check(any(v < 2 for v in vals),
+                  "kill: rank-liveness gauge dipped below nproc "
+                  f"(transitions: {vals})")
+            first_dip = next(i for i, v in enumerate(vals) if v < 2)
+            check(any(v == 2 for v in vals[first_dip:]),
+                  "kill: rank-liveness gauge recovered to full after the "
+                  f"restart (transitions: {vals})")
         if sc == "hang":
             check("heartbeats stale" in r.stderr,
                   "hang: watchdog detected the stall")

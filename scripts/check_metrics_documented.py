@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Lint: every registered observability metric must be documented AND
+exercised by at least one test.
+
+``paddle.observability.metrics`` names are the runtime's public telemetry
+contract: dashboards and the bench tripwire key on them. A metric nobody
+documented is a name nobody can interpret; a metric no test exercises is
+a number nobody verified. This lint (the ``check_fault_sites.py``
+discipline applied to ISSUE 10):
+
+1. collects every metric NAME registered with a literal string —
+   ``<alias>.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
+   — across ``paddle_tpu/``;
+2. fails any name missing from DESIGN_DECISIONS.md's "Observability"
+   section (or the explicit ALLOWLIST below);
+3. fails any name that appears in no test (``tests/`` plus the chaos
+   drill, which exercises the launcher gauge end to end).
+
+Registration with a non-literal name is itself a lint failure: dynamic
+metric names defeat both checks AND the label-cardinality rule (dynamics
+belong in labels, bounded; see DESIGN_DECISIONS.md).
+
+Deliberately import-free: sources are parsed, not imported, so the lint
+runs in milliseconds without pulling in jax. Wired tier-1 via
+tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+DOC = os.path.join(REPO, "DESIGN_DECISIONS.md")
+# files that DEFINE the registry rather than register metrics
+EXCLUDE_FILES = (os.path.join("observability", "metrics.py"),)
+# non-test files that legitimately exercise metrics end to end
+EXTRA_EXERCISERS = (os.path.join(REPO, "scripts", "chaos_train.py"),
+                    os.path.join(REPO, "scripts", "bench_serving.py"))
+# documented-elsewhere escapes (keep EMPTY unless a metric genuinely
+# cannot live in DESIGN_DECISIONS.md)
+ALLOWLIST: frozenset = frozenset()
+
+# any alias ENDING in "metrics" (bare `metrics.` included — the
+# documented facade import), plus direct REGISTRY/registry objects:
+# a registration through any of these must be collected, or an
+# undocumented metric could slip past the lint by import style
+_ALIAS = (r"\b(?:(?:[A-Za-z_][A-Za-z0-9_]*)?metrics"
+          r"|(?:[A-Za-z_][A-Za-z0-9_]*\.)?REGISTRY"
+          r"|[A-Za-z_][A-Za-z0-9_]*[Rr]egistry)\.")
+_CALL_RE = re.compile(
+    _ALIAS + r"(counter|gauge|histogram)\(\s*\n?\s*(.)")
+_NAME_RE = re.compile(
+    _ALIAS + r"(counter|gauge|histogram)\(\s*\n?\s*[\"']([A-Za-z0-9_]+)"
+    r"[\"']")
+
+
+def _py_sources(root=PKG):
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                if any(path.endswith(e) for e in EXCLUDE_FILES):
+                    continue
+                yield path
+
+
+def registered_metrics(root=PKG):
+    """``{name: [files]}`` of literally-registered metric names, plus a
+    list of (file, snippet) for non-literal registrations (lint errors)."""
+    names: dict[str, list] = {}
+    dynamic = []
+    for path in _py_sources(root):
+        with open(path, errors="replace") as f:
+            src = f.read()
+        rel = os.path.relpath(path, REPO)
+        for m in _CALL_RE.finditer(src):
+            if m.group(2) not in "\"'":
+                dynamic.append((rel, src[m.start():m.start() + 60]
+                                .replace("\n", " ")))
+        for m in _NAME_RE.finditer(src):
+            names.setdefault(m.group(2), []).append(rel)
+    return names, dynamic
+
+
+def _test_corpus(tests_dir=None, extra=EXTRA_EXERCISERS):
+    tests_dir = tests_dir or os.path.join(REPO, "tests")
+    corpus = ""
+    for root, _dirs, files in os.walk(tests_dir):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(root, fn), errors="replace") as f:
+                    corpus += f.read()
+    for p in extra:
+        if os.path.exists(p):
+            with open(p, errors="replace") as f:
+                corpus += f.read()
+    return corpus
+
+
+def _mentions(text, name):
+    """Word-boundary match: ``serving_ttft`` must NOT pass on the back of
+    ``serving_ttft_ms`` being documented/tested (underscore is a word
+    char, so the boundary check rejects the substring hit)."""
+    return re.search(rf"\b{re.escape(name)}\b", text) is not None
+
+
+def find_undocumented(names=None, doc_path=DOC, allowlist=ALLOWLIST):
+    if names is None:
+        names, _ = registered_metrics()
+    try:
+        with open(doc_path, errors="replace") as f:
+            doc = f.read()
+    except OSError:
+        doc = ""
+    return [n for n in sorted(names)
+            if not _mentions(doc, n) and n not in allowlist]
+
+
+def find_untested(names=None, tests_dir=None, extra=EXTRA_EXERCISERS):
+    if names is None:
+        names, _ = registered_metrics()
+    corpus = _test_corpus(tests_dir, extra)
+    return [n for n in sorted(names) if not _mentions(corpus, n)]
+
+
+def main(argv=None):
+    names, dynamic = registered_metrics()
+    if not names:
+        print("no registered metrics found — lint would be vacuous",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    if dynamic:
+        print("metrics registered with NON-LITERAL names (dynamics belong "
+              "in labels, not names — cardinality rule):", file=sys.stderr)
+        for rel, snip in dynamic:
+            print(f"  - {rel}: {snip!r}", file=sys.stderr)
+        rc = 1
+    undocumented = find_undocumented(names)
+    if undocumented:
+        print("metrics NOT documented in DESIGN_DECISIONS.md "
+              "(add them to the Observability section's metric table):",
+              file=sys.stderr)
+        for n in undocumented:
+            print(f"  - {n} (registered in {', '.join(names[n])})",
+                  file=sys.stderr)
+        rc = 1
+    untested = find_untested(names)
+    if untested:
+        print("metrics with NO exercising test (reference the name in a "
+              "test that records and asserts it):", file=sys.stderr)
+        for n in untested:
+            print(f"  - {n} (registered in {', '.join(names[n])})",
+                  file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"ok: all {len(names)} registered metrics are documented "
+              "and exercised by tests")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Render a run's observability artifacts into a human-readable report.
+
+Input is the artifact pair the runtime exports (ISSUE 10):
+
+- a **trace** JSON (``paddle.observability.trace.export(path)`` or a
+  ``Profiler.export`` file) — chrome-trace ``traceEvents``;
+- a **metrics** JSON (``paddle.observability.metrics.export_json(path)``)
+  — the registry ``snapshot()``.
+
+The report aggregates spans by name (count, total/mean wall, p50/p99 of
+span durations), breaks out per-request serving lifecycles, and tables
+the registry (counters/gauges flat; histograms with count/mean/p50/p99).
+This is the "why was step 4017 slow" entry point: the span table says
+where wall time went, the request table says who waited, the registry
+says what the rates and utilizations were.
+
+Deliberately stdlib-only (like check_fault_sites.py): the report must
+render anywhere, including boxes without jax.
+
+Usage:
+  python scripts/trace_report.py --trace t.json [--metrics m.json]
+  python scripts/trace_report.py --metrics m.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _pct(sorted_vals, p):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def aggregate_spans(events):
+    """``{name: {count, total_ms, mean_ms, p50_ms, p99_ms, max_ms}}`` over
+    the complete (``ph == "X"``) events of a chrome trace."""
+    by_name = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        by_name.setdefault(ev["name"], []).append(ev["dur"] / 1e3)  # ms
+    out = {}
+    for name, durs in by_name.items():
+        durs.sort()
+        out[name] = {
+            "count": len(durs),
+            "total_ms": sum(durs),
+            "mean_ms": sum(durs) / len(durs),
+            "p50_ms": _pct(durs, 50),
+            "p99_ms": _pct(durs, 99),
+            "max_ms": durs[-1],
+        }
+    return out
+
+
+def request_lifecycles(events):
+    """Per-request phase totals from ``cat == "request"`` spans:
+    ``{rid: {queued_ms, prefill_ms, decode_ms}}``."""
+    out = {}
+    for ev in events:
+        if ev.get("cat") != "request" or ev.get("ph") != "X":
+            continue
+        rid = (ev.get("args") or {}).get("rid", ev.get("tid"))
+        phase = ev["name"].split(".", 1)[-1]  # request.queued -> queued
+        d = out.setdefault(rid, {})
+        d[f"{phase}_ms"] = d.get(f"{phase}_ms", 0.0) + ev["dur"] / 1e3
+    return out
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    return f"{v:.{nd}f}"
+
+
+def format_span_report(agg, top_n=30):
+    lines = ["== spans (by total wall) ==",
+             f"{'name':<36} {'count':>7} {'total_ms':>10} {'mean_ms':>9} "
+             f"{'p50_ms':>8} {'p99_ms':>9} {'max_ms':>9}"]
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])
+    for name, s in ranked[:top_n]:
+        lines.append(
+            f"{name:<36} {s['count']:>7} {_fmt(s['total_ms']):>10} "
+            f"{_fmt(s['mean_ms']):>9} {_fmt(s['p50_ms']):>8} "
+            f"{_fmt(s['p99_ms']):>9} {_fmt(s['max_ms']):>9}")
+    if len(ranked) > top_n:
+        lines.append(f"... {len(ranked) - top_n} more span names")
+    return "\n".join(lines)
+
+
+def format_request_report(reqs, top_n=10):
+    if not reqs:
+        return ""
+    lines = [f"== serving requests ({len(reqs)}) ==",
+             f"{'rid':>6} {'queued_ms':>10} {'prefill_ms':>11} "
+             f"{'decode_ms':>10}"]
+
+    def total(d):
+        return sum(d.values())
+
+    ranked = sorted(reqs.items(), key=lambda kv: -total(kv[1]))
+    for rid, d in ranked[:top_n]:
+        lines.append(f"{rid!s:>6} {_fmt(d.get('queued_ms')):>10} "
+                     f"{_fmt(d.get('prefill_ms')):>11} "
+                     f"{_fmt(d.get('decode_ms')):>10}")
+    if len(ranked) > top_n:
+        lines.append(f"... {len(ranked) - top_n} more requests")
+    return "\n".join(lines)
+
+
+def format_metrics_report(snap):
+    lines = ["== metrics registry =="]
+    for name in sorted(snap):
+        m = snap[name]
+        kind = m.get("type", "?")
+        for label, v in sorted(m.get("series", {}).items()):
+            where = f"{name}{{{label}}}" if label else name
+            if kind == "histogram":
+                cnt = v.get("count", 0)
+                mean = (v.get("sum", 0.0) / cnt) if cnt else None
+                lines.append(
+                    f"  {where}: count={cnt} sum={_fmt(v.get('sum'), 4)} "
+                    f"mean={_fmt(mean, 4)} min={_fmt(v.get('min'), 4)} "
+                    f"max={_fmt(v.get('max'), 4)}")
+            else:
+                lines.append(f"  {where}: {v}")
+    if len(lines) == 1:
+        lines.append("  (empty)")
+    return "\n".join(lines)
+
+
+def build_report(trace_doc=None, metrics_snap=None, top_n=30):
+    parts = []
+    if trace_doc is not None:
+        events = trace_doc.get("traceEvents", trace_doc)
+        agg = aggregate_spans(events)
+        parts.append(format_span_report(agg, top_n=top_n))
+        req = format_request_report(request_lifecycles(events))
+        if req:
+            parts.append(req)
+    if metrics_snap is not None:
+        parts.append(format_metrics_report(metrics_snap))
+    if not parts:
+        parts.append("(nothing to report: pass --trace and/or --metrics)")
+    return "\n\n".join(parts)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", default=None,
+                    help="chrome-trace JSON (observability.trace.export "
+                         "or Profiler.export output)")
+    ap.add_argument("--metrics", default=None,
+                    help="metrics snapshot JSON "
+                         "(observability.metrics.export_json output)")
+    ap.add_argument("--top", type=int, default=30,
+                    help="span names to show (by total wall)")
+    args = ap.parse_args(argv)
+    if not args.trace and not args.metrics:
+        ap.error("pass --trace and/or --metrics")
+    trace_doc = metrics_snap = None
+    if args.trace:
+        with open(args.trace) as f:
+            trace_doc = json.load(f)
+    if args.metrics:
+        with open(args.metrics) as f:
+            metrics_snap = json.load(f)
+    print(build_report(trace_doc, metrics_snap, top_n=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
