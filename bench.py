@@ -318,7 +318,11 @@ def bench_deepfm(on_tpu):
     ips, bs = _bench_loop(step, make_batch, sz["batch_sizes"], sz["steps"],
                           sz["warmup"], build)
     _emit({
-        "metric": "deepfm_train_examples_per_sec",
+        # the CPU smoke runs a 10k vocab (vs the real 1M) — its numbers
+        # are not comparable to the TPU rounds, so it gets its own metric
+        # name like every other workload's cpu variant
+        "metric": "deepfm_train_examples_per_sec" if on_tpu
+                  else "deepfm_cpu_train_examples_per_sec",
         "value": round(ips, 1), "unit": "examples/s", "vs_baseline": None,
         "batch_size": bs, "vocab": sz["vocab"],
         "sparse_path": sparse_path,
@@ -604,6 +608,30 @@ def bench_serving(on_tpu):
                          "compiles warmed in both arms (steady-state "
                          "batching is the effect); greedy outputs "
                          "bit-exact across arms",
+    })
+    # prefix-cache sharing A/B (ISSUE 11): its own tracked metric line so
+    # the r06+ regression tripwire guards the sharing win round over round
+    sp = bsv.run_shared_prefix_ab(tiny=not on_tpu)
+    assert sp["bit_exact"], "sharing arm diverged from no-sharing greedy"
+    _emit({
+        "metric": "serving_shared_prefix_tokens_per_sec" if on_tpu
+                  else "serving_cpu_shared_prefix_tokens_per_sec",
+        "value": sp["sharing"]["effective_tokens_per_sec"],
+        "unit": "tokens/s (prompt+generated)",
+        "vs_baseline": None,
+        "effective_tokens_per_sec_no_sharing":
+            sp["no_sharing"]["effective_tokens_per_sec"],
+        "sharing_speedup": sp["speedup"],
+        "prefix_hit_ratio": sp["prefix_hit_ratio"],
+        "prefix_blocks_reused": sp["sharing"]["prefix_blocks_reused"],
+        "itl_p99_ms": sp["sharing"]["itl_p99_ms"],
+        "bit_exact": sp["bit_exact"],
+        "num_requests": sp["num_requests"],
+        "prefix_len": sp["prefix_len"],
+        "baseline_note": "A/B over one seeded shared-prefix multi-tenant "
+                         "stream; effective tokens/s counts prompt tokens "
+                         "served (shared blocks are the avoided work); "
+                         "greedy outputs bit-exact across arms",
     })
 
 

@@ -8,16 +8,19 @@ and the ``LLMEngine`` front-end (``engine``). See DESIGN_DECISIONS.md
 "Paged KV cache & continuous batching" and the README serving recipe.
 """
 
-from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .kv_cache import BlockAllocator, PagedKVCache, PrefixCache  # noqa: F401
 from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
-from .paged_attention import paged_decode_attention  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_decode_attention, paged_multiquery_attention,
+)
 from .engine import (  # noqa: F401
     LLMEngine, StepOutput, is_llama_artifact, load_llama_artifact,
     save_llama_artifact,
 )
 
 __all__ = [
-    "BlockAllocator", "PagedKVCache", "Request", "SamplingParams",
-    "Scheduler", "paged_decode_attention", "LLMEngine", "StepOutput",
+    "BlockAllocator", "PagedKVCache", "PrefixCache", "Request",
+    "SamplingParams", "Scheduler", "paged_decode_attention",
+    "paged_multiquery_attention", "LLMEngine", "StepOutput",
     "save_llama_artifact", "load_llama_artifact", "is_llama_artifact",
 ]

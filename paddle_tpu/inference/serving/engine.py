@@ -1,4 +1,5 @@
-"""LLM serving engine front-end (ISSUE 7 tentpole, part d).
+"""LLM serving engine front-end (ISSUE 7 tentpole, part d; ISSUE 11 adds
+prefix sharing, chunked prefill and speculative decoding).
 
 ``LLMEngine`` turns a ``LlamaForCausalLM`` into a continuously-batched
 server:
@@ -7,11 +8,26 @@ server:
   thread pads it to its prefill bucket (PR-1 ``BucketSpec`` semantics, via
   ``io.prefetch.np_pad_to_bucket``) and starts the host→device transfer
   off the decode thread's critical path;
-* ``step`` runs one scheduler tick: admit + prefill queued prompts
-  (one compiled prefill graph per length bucket), then ONE fixed-shape
-  decode step for every running slot against the paged KV pool — the
-  decode graph compiles once and is reused for the life of the engine
-  (``paddle.jit.cache_stats()`` row ``llm_engine_decode#n`` proves it);
+* ``step`` runs one scheduler tick: admit queued prompts (charging only
+  blocks the prefix cache cannot supply), advance prefills by at most
+  ``max_prefill_tokens_per_step`` tokens of block-aligned chunks (so long
+  prompts interleave with decode instead of monopolizing steps), then ONE
+  fixed-shape decode step for every decode-ready slot against the paged
+  KV pool — the decode graph compiles once and is reused for the life of
+  the engine (``paddle.jit.cache_stats()`` row ``llm_engine_decode#n``
+  proves it);
+* with ``enable_prefix_cache=True``, full prompt blocks are registered
+  under hash-chain identities after prefill: N requests sharing a prompt
+  prefix prefill its full blocks ONCE, later admissions ``acquire`` the
+  shared blocks (ref-counted, copy-on-write guarded) and prefill only
+  their unshared tail;
+* with ``draft_model=``, decode runs **speculative**: the draft llama
+  proposes ``spec_tokens`` greedy continuations per step (its own paged
+  pools indexed by the SAME block tables), and a single multi-query
+  paged-attention verify step scores all k+1 positions at once with
+  in-graph accept counting; rollback rewinds the block-table length and
+  frees over-allocated tail blocks, so greedy outputs stay bit-exact
+  versus the non-speculative arm;
 * ``stream`` iterates steps and yields tokens as they are produced;
 * ``reload_weights`` hot-swaps weights from a ``CheckpointManager``
   (``latest_healthy_step()`` — the divergence-sentinel-approved step)
@@ -38,10 +54,10 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
-from .kv_cache import PagedKVCache
+from .kv_cache import PagedKVCache, PrefixCache
 from .scheduler import (Request, SamplingParams, Scheduler,
-                        _M_ADMITTED, _M_EVICTIONS, _M_FINISHED,
-                        _M_QUEUED_EXH)
+                        _M_ADMITTED, _M_COW, _M_EVICTIONS, _M_FINISHED,
+                        _M_PREFIX_REUSED, _M_QUEUED_EXH)
 
 __all__ = ["LLMEngine", "StepOutput", "save_llama_artifact",
            "load_llama_artifact"]
@@ -61,8 +77,21 @@ _H_ITL = _obs_metrics.histogram(
 _M_TOKENS = _obs_metrics.counter(
     "serving_tokens_out_total", "tokens sampled across all requests")
 _M_PREFILLS = _obs_metrics.counter(
-    "serving_prefills_total", "prefill graph executions (incl. eviction "
+    "serving_prefills_total", "prefill completions (incl. eviction "
     "re-prefills)")
+_M_PREFILL_CHUNKS = _obs_metrics.counter(
+    "serving_prefill_chunks_total",
+    "block-aligned prefill chunk executions (chunked prefill splits one "
+    "prompt across several of these)")
+_M_SPEC_PROPOSED = _obs_metrics.counter(
+    "serving_spec_proposed_total",
+    "draft tokens proposed by the speculative decoder")
+_M_SPEC_ACCEPTED = _obs_metrics.counter(
+    "serving_spec_accepted_total",
+    "draft tokens accepted by the verify step")
+_G_SPEC_RATIO = _obs_metrics.gauge(
+    "serving_spec_accept_ratio",
+    "running accepted/proposed ratio of the speculative decoder")
 _G_KV_UTIL = _obs_metrics.gauge(
     "serving_kv_block_utilization",
     "fraction of usable KV pool blocks in use after the last step")
@@ -75,7 +104,9 @@ _G_OCCUPANCY = _obs_metrics.gauge(
 # be added to one and silently missed by the other (a reset that skips a
 # histogram would leak warm-phase samples into bench percentiles)
 _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
-                    _M_PREFILLS, _M_TOKENS, _H_TTFT, _H_ITL, _G_KV_UTIL,
+                    _M_PREFIX_REUSED, _M_COW, _M_PREFILLS,
+                    _M_PREFILL_CHUNKS, _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED,
+                    _M_TOKENS, _H_TTFT, _H_ITL, _G_SPEC_RATIO, _G_KV_UTIL,
                     _G_OCCUPANCY)
 
 
@@ -193,7 +224,9 @@ class LLMEngine:
 
     def __init__(self, model, *, num_blocks=64, block_size=16,
                  max_batch_size=4, max_model_len=None, prefill_buckets=None,
-                 max_prefills_per_step=1, ingest_async=True, plan=None):
+                 max_prefills_per_step=1, ingest_async=True, plan=None,
+                 enable_prefix_cache=False, max_prefill_tokens_per_step=None,
+                 draft_model=None, spec_tokens=2):
         from ...models.llama import LlamaForCausalLM
 
         if not isinstance(model, LlamaForCausalLM):
@@ -237,11 +270,25 @@ class LLMEngine:
         dtype = model.llama.layers[0].self_attn.k_proj.weight.dtype
         self.cache = PagedKVCache(self.config, num_blocks, block_size,
                                   dtype=dtype)
+        # prefix sharing (ISSUE 11): content-hashed block identity over the
+        # pool — admission charges only unshared blocks
+        self.prefix_cache = (PrefixCache(self.cache.allocator,
+                                         self.block_size)
+                             if enable_prefix_cache else None)
+        # chunked prefill budget: NEW prompt tokens materialized per step.
+        # None = whole prompts in one chunk (the PR-7 behavior); a budget
+        # bounds decode inter-token latency by the chunk, not the prompt.
+        if max_prefill_tokens_per_step is not None:
+            max_prefill_tokens_per_step = int(max_prefill_tokens_per_step)
+            if max_prefill_tokens_per_step < 1:
+                raise ValueError("max_prefill_tokens_per_step must be >= 1")
+        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         n = next(LLMEngine._instance_ids)
         self._name = f"llm_engine#{n}"
         self.scheduler = Scheduler(self.cache.allocator, block_size,
                                    max_batch_size, max_prefills_per_step,
-                                   instance=self._name)
+                                   instance=self._name,
+                                   prefix_cache=self.prefix_cache)
         self.max_batch_size = int(max_batch_size)
         buckets = prefill_buckets or _default_buckets(self.block_size,
                                                       self.max_model_len)
@@ -255,6 +302,43 @@ class LLMEngine:
         self._params = model._unique_params()
         self._prefill_jit = None
         self._decode_jit = None
+        # speculative decoding (ISSUE 11): the draft llama shares the
+        # target's allocator/block tables; its pools are its own shapes
+        self.draft_model = draft_model
+        self._spec_k = 0
+        if draft_model is not None:
+            if not isinstance(draft_model, LlamaForCausalLM):
+                raise TypeError("draft_model must be a LlamaForCausalLM; "
+                                f"got {type(draft_model).__name__}")
+            if draft_model.config.vocab_size != self.config.vocab_size:
+                raise ValueError(
+                    "draft_model vocab_size "
+                    f"{draft_model.config.vocab_size} != target "
+                    f"{self.config.vocab_size}: verify compares token ids")
+            if int(spec_tokens) < 1:
+                raise ValueError("spec_tokens must be >= 1")
+            self._spec_k = int(spec_tokens)
+            self._draft_was_training = draft_model.training
+            draft_model.eval()
+            if plan is not None:
+                plan.apply_to_model(draft_model)
+            ddtype = (draft_model.llama.layers[0].self_attn.k_proj
+                      .weight.dtype)
+            self.draft_cache = PagedKVCache(
+                draft_model.config, num_blocks, block_size, dtype=ddtype,
+                allocator=self.cache.allocator)
+            self._draft_params = draft_model._unique_params()
+            self._draft_prefill_name = f"llm_engine_draft_prefill#{n}"
+            self._draft_decode_name = f"llm_engine_draft_decode#{n}"
+            self._verify_name = f"llm_engine_verify#{n}"
+            self._draft_prefill_jit = None
+            self._draft_decode_jit = None
+            self._verify_jit = None
+        # device block-table cache (ISSUE 11 satellite): rebuilt only when
+        # the scheduler's table version moves, so steady-state decode does
+        # ZERO table H2D
+        self._tables_version = None
+        self._tables_dev = None
         self._requests: dict[int, Request] = {}
         self._ingest = (_IngestThread(self._stage_request, self._name)
                         if ingest_async else None)
@@ -290,13 +374,21 @@ class LLMEngine:
         """Enqueue a prompt; returns the request id. Never blocks on pool
         exhaustion — the request queues until blocks free up."""
         req = Request(prompt_ids, sampling, arrival_t=arrival_t)
+        if self._spec_k and req.sampling.do_sample:
+            raise ValueError(
+                "speculative decoding is greedy-only (the verify step "
+                "accepts by argmax identity); submit do_sample requests "
+                "to an engine without a draft_model")
         total = len(req.prompt) + req.sampling.max_new_tokens
         cap = min(self.max_model_len,
                   (self.cache.num_blocks - 1) * self.block_size)
-        if total > cap:
+        # the speculative verify window writes spec_k lookahead positions
+        # past the final token — they must fit in the pool too
+        if total + self._spec_k > cap:
             raise ValueError(
-                f"request needs {total} tokens but the engine caps at "
-                f"{cap} (max_model_len={self.max_model_len}, pool="
+                f"request needs {total + self._spec_k} tokens (incl. "
+                f"{self._spec_k} speculative lookahead) but the engine "
+                f"caps at {cap} (max_model_len={self.max_model_len}, pool="
                 f"{self.cache.num_blocks - 1} usable blocks x "
                 f"{self.block_size})")
         # an evicted request re-prefills from its full prefix (up to
@@ -352,34 +444,45 @@ class LLMEngine:
     # ------------------------------------------------------------------
     # compiled graphs
     # ------------------------------------------------------------------
-    def _build_jits(self):
-        from ...core import state as _state
-        from ...core.tensor import Tensor
-        from .paged_attention import paged_decode_attention
-
-        model = self.model
-        params = self._params
-        block_size = self.block_size
-
+    def _head_fn(self, model):
         def _head(h):
             from ...nn import functional as F
 
             if model.lm_head is not None:
                 return model.lm_head(h)
             return F.linear(h, model.llama.embed_tokens.weight.t())
+        return _head
 
-        def _arr(x):
-            return x._data if isinstance(x, Tensor) else x
+    @staticmethod
+    def _arr(x):
+        from ...core.tensor import Tensor
 
-        def prefill_pure(param_arrays, ids, true_len, tables_row,
-                         k_pools, v_pools):
-            """ids [1, Sb]; tables_row [Sb // block]; returns (last real
-            position's logits [1, V], pools)."""
+        return x._data if isinstance(x, Tensor) else x
+
+    def _make_chunk_fn(self, model, params):
+        """Pure chunk-prefill step over ``model``: ``(param_arrays,
+        ids [1, C], start, true_upto, tables_row [max_pages], k_pools,
+        v_pools) -> (logits [1, V] at absolute position true_upto-1,
+        pools)``. ``start`` is the block-aligned absolute offset of the
+        chunk (0 for a whole-prompt prefill; the shared-prefix boundary or
+        the previous chunk's end otherwise); queries attend causally over
+        pool pages [0, true_upto) via paged multi-query attention, so one
+        graph per chunk-length bucket serves every offset."""
+        from ...core import state as _state
+        from ...core.tensor import Tensor
+
+        block_size = self.block_size
+        _head = self._head_fn(model)
+        _arr = self._arr
+
+        def chunk_pure(param_arrays, ids, start, true_upto, tables_row,
+                       k_pools, v_pools):
             import jax
             import jax.numpy as jnp
 
-            from ...nn.functional.flash_attention import _sdpa_ref
+            from ...models.llama import _rope_apply_at
             from ...ops import manipulation as M
+            from .paged_attention import paged_multiquery_attention
 
             old = [p._data for p in params]
             try:
@@ -388,17 +491,19 @@ class LLMEngine:
                 with _state.trace_guard():
                     sb = ids.shape[1]
                     pages = sb // block_size
+                    start = jnp.asarray(start, jnp.int32)
+                    upto = jnp.asarray(true_upto, jnp.int32)
+                    page0 = start // block_size
+                    tables2 = tables_row[None]  # [1, P]
                     x = model.llama.embed_tokens(Tensor._wrap(ids))
-                    cos = model.llama.rope_cos[:sb]
-                    sin = model.llama.rope_sin[:sb]
+                    cos_t = _arr(model.llama.rope_cos)
+                    sin_t = _arr(model.llama.rope_sin)
                     new_k, new_v = [], []
                     for layer, kp, vp in zip(model.llama.layers,
                                              k_pools, v_pools):
                         attn = layer.self_attn
                         h = layer.input_layernorm(x)
                         b, s = 1, sb
-                        from ...models.llama import apply_rope
-
                         q = M.reshape(attn.q_proj(h),
                                       [b, s, attn.num_heads, attn.head_dim])
                         k = M.reshape(attn.k_proj(h),
@@ -407,18 +512,23 @@ class LLMEngine:
                         v = M.reshape(attn.v_proj(h),
                                       [b, s, attn.num_kv_heads,
                                        attn.head_dim])
-                        q = apply_rope(q, cos, sin)
-                        k = apply_rope(k, cos, sin)
-                        ka, va = _arr(k), _arr(v)
+                        qa = _rope_apply_at.raw_fn(_arr(q), cos_t, sin_t,
+                                                   start)
+                        ka = _rope_apply_at.raw_fn(_arr(k), cos_t, sin_t,
+                                                   start)
+                        va = _arr(v)
                         for j in range(pages):
                             sl = slice(j * block_size, (j + 1) * block_size)
+                            blk = tables_row[page0 + j]
                             kp = jax.lax.dynamic_update_slice(
                                 kp, ka[0:1, sl].astype(kp.dtype),
-                                (tables_row[j], 0, 0, 0))
+                                (blk, 0, 0, 0))
                             vp = jax.lax.dynamic_update_slice(
                                 vp, va[0:1, sl].astype(vp.dtype),
-                                (tables_row[j], 0, 0, 0))
-                        out = _sdpa_ref.raw_fn(_arr(q), ka, va, causal=True)
+                                (blk, 0, 0, 0))
+                        out = paged_multiquery_attention(
+                            qa, kp, vp, tables2, upto[None], start[None],
+                            scale=1.0 / math.sqrt(attn.head_dim))
                         attn_out = attn.o_proj(
                             M.reshape(Tensor._wrap(out), [b, s, -1]))
                         x = x + attn_out
@@ -428,7 +538,7 @@ class LLMEngine:
                     h = model.llama.norm(x)
                     h_arr = _arr(h)
                     last = jax.lax.dynamic_slice(
-                        h_arr, (0, jnp.asarray(true_len, jnp.int32) - 1, 0),
+                        h_arr, (0, upto - 1 - start, 0),
                         (1, 1, h_arr.shape[-1]))
                     logits = _head(Tensor._wrap(last))
             finally:
@@ -436,16 +546,27 @@ class LLMEngine:
                     p._data = a
             return _arr(logits)[:, 0], new_k, new_v
 
+        return chunk_pure
+
+    def _make_decode_fn(self, model, params):
+        """Pure one-token decode over ``model``: ``(param_arrays,
+        ids [B, 1], positions [B], tables [B, P], k_pools, v_pools) ->
+        (logits [B, V], pools)``. Writes each token at ``positions``,
+        attends over ``positions+1`` ragged lengths."""
+        from ...core import state as _state
+        from ...core.tensor import Tensor
+
+        block_size = self.block_size
+        _head = self._head_fn(model)
+        _arr = self._arr
+
         def decode_pure(param_arrays, ids, positions, tables,
                         k_pools, v_pools):
-            """ids [B, 1] (last sampled token per slot); positions [B]
-            (tokens already cached); tables [B, P]. Writes each token at
-            ``positions``, attends over ``positions+1`` ragged lengths.
-            Returns (logits [B, V], pools)."""
             import jax
             import jax.numpy as jnp
 
             from ...ops import manipulation as M
+            from .paged_attention import paged_decode_attention
 
             old = [p._data for p in params]
             try:
@@ -509,21 +630,236 @@ class LLMEngine:
                     p._data = a
             return _arr(logits)[:, 0], new_k, new_v
 
+        return decode_pure
+
+    def _make_verify_fn(self, model, params):
+        """Pure speculative verify over ``model``: ``(param_arrays,
+        ids [B, K+1], positions [B], tables [B, P], draft_toks [B, K],
+        k_pools, v_pools) -> (accept_counts [B], next_tokens [B],
+        pools)``. ``ids[:, 0]`` is each request's last committed token at
+        absolute position ``positions``; one batched multi-query
+        paged-attention step scores all K+1 positions, writes their K/V,
+        and counts in-graph how many draft tokens match the target's
+        greedy argmax (the accept rule that keeps outputs bit-exact)."""
+        from ...core import state as _state
+        from ...core.tensor import Tensor
+
+        block_size = self.block_size
+        _head = self._head_fn(model)
+        _arr = self._arr
+
+        def verify_pure(param_arrays, ids, positions, tables, draft_toks,
+                        k_pools, v_pools):
+            import jax
+            import jax.numpy as jnp
+
+            from ...ops import manipulation as M
+            from .paged_attention import paged_multiquery_attention
+
+            old = [p._data for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                with _state.trace_guard():
+                    bsz, t_q = ids.shape
+                    x = model.llama.embed_tokens(Tensor._wrap(ids))
+                    cos_t = _arr(model.llama.rope_cos)
+                    sin_t = _arr(model.llama.rope_sin)
+                    pos_grid = (positions[:, None]
+                                + jnp.arange(t_q, dtype=jnp.int32)[None])
+                    c = cos_t[pos_grid][:, :, None, :]
+                    sn = sin_t[pos_grid][:, :, None, :]
+                    new_k, new_v = [], []
+                    for layer, kp, vp in zip(model.llama.layers,
+                                             k_pools, v_pools):
+                        attn = layer.self_attn
+                        h = layer.input_layernorm(x)
+                        q = M.reshape(attn.q_proj(h),
+                                      [bsz, t_q, attn.num_heads,
+                                       attn.head_dim])
+                        k = M.reshape(attn.k_proj(h),
+                                      [bsz, t_q, attn.num_kv_heads,
+                                       attn.head_dim])
+                        v = M.reshape(attn.v_proj(h),
+                                      [bsz, t_q, attn.num_kv_heads,
+                                       attn.head_dim])
+
+                        def rope(t):
+                            a = _arr(t)
+                            d2 = a.shape[-1] // 2
+                            a1, a2 = a[..., :d2], a[..., d2:]
+                            cc = c.astype(a.dtype)
+                            ss = sn.astype(a.dtype)
+                            return jnp.concatenate(
+                                [a1 * cc - a2 * ss, a2 * cc + a1 * ss], -1)
+
+                        qa, ka, va = rope(q), rope(k), _arr(v)
+                        blk = tables[jnp.arange(bsz)[:, None],
+                                     pos_grid // block_size]
+                        off = pos_grid % block_size
+                        for i in range(bsz):
+                            for t in range(t_q):
+                                kp = jax.lax.dynamic_update_slice(
+                                    kp,
+                                    ka[i:i + 1, t:t + 1].astype(kp.dtype),
+                                    (blk[i, t], off[i, t], 0, 0))
+                                vp = jax.lax.dynamic_update_slice(
+                                    vp,
+                                    va[i:i + 1, t:t + 1].astype(vp.dtype),
+                                    (blk[i, t], off[i, t], 0, 0))
+                        out = paged_multiquery_attention(
+                            qa, kp, vp, tables, positions + t_q, positions,
+                            scale=1.0 / math.sqrt(attn.head_dim))
+                        attn_out = attn.o_proj(
+                            M.reshape(Tensor._wrap(out), [bsz, t_q, -1]))
+                        x = x + attn_out
+                        x = x + layer.mlp(layer.post_attention_layernorm(x))
+                        new_k.append(kp)
+                        new_v.append(vp)
+                    h = model.llama.norm(x)
+                    logits = _arr(_head(h))          # [B, K+1, V]
+                    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    # in-graph accept: 1s until the first draft/target
+                    # mismatch; next token = target argmax at the first
+                    # rejected position (or the bonus position on full
+                    # accept) — exactly sequential greedy, verified at once
+                    eq = (tgt[:, :t_q - 1] == draft_toks).astype(jnp.int32)
+                    acc = jnp.cumprod(eq, axis=1)
+                    counts = jnp.sum(acc, axis=1)
+                    nxt = jnp.take_along_axis(
+                        tgt, counts[:, None], axis=1)[:, 0]
+            finally:
+                for p, a in zip(params, old):
+                    p._data = a
+            return counts, nxt, new_k, new_v
+
+        return verify_pure
+
+    def _build_jits(self):
         from ...distributed.plan import compile_step_with_plan
 
         self._prefill_jit = compile_step_with_plan(
-            prefill_pure, self._plan, name=self._prefill_name,
-            donate_argnums=(4, 5))
+            self._make_chunk_fn(self.model, self._params), self._plan,
+            name=self._prefill_name, donate_argnums=(5, 6))
         self._decode_jit = compile_step_with_plan(
-            decode_pure, self._plan, name=self._decode_name,
-            donate_argnums=(4, 5))
+            self._make_decode_fn(self.model, self._params), self._plan,
+            name=self._decode_name, donate_argnums=(4, 5))
+        if self.draft_model is not None:
+            self._draft_prefill_jit = compile_step_with_plan(
+                self._make_chunk_fn(self.draft_model, self._draft_params),
+                self._plan, name=self._draft_prefill_name,
+                donate_argnums=(5, 6))
+            self._draft_decode_jit = compile_step_with_plan(
+                self._make_decode_fn(self.draft_model, self._draft_params),
+                self._plan, name=self._draft_decode_name,
+                donate_argnums=(4, 5))
+            self._verify_jit = compile_step_with_plan(
+                self._make_verify_fn(self.model, self._params), self._plan,
+                name=self._verify_name, donate_argnums=(5, 6))
 
     # ------------------------------------------------------------------
     # the scheduler tick
     # ------------------------------------------------------------------
+    def _tables(self):
+        """Device block-table array for the decode-ready slots, cached
+        against the scheduler's table version + slot readiness (ISSUE 11
+        satellite: steady-state decode re-uploads nothing). Slots that are
+        empty OR still mid-prefill map to the null block: the decode graph
+        writes a K/V row for EVERY batch row, and an inactive row's write
+        must land in the null block — pointing it at a prefilling
+        request's real blocks would corrupt its just-written pages."""
+        sched = self.scheduler
+        mask = tuple(r is not None and not r.prefilling
+                     for r in sched.slots)
+        key = (sched.version, mask)
+        if key != self._tables_version:
+            lists = [(r.blocks if ok else [])
+                     for ok, r in zip(mask, sched.slots)]
+            self._tables_dev = self.cache.table_array(lists, self.max_pages)
+            self._tables_version = key
+        return self._tables_dev
+
+    def _drain_cow(self):
+        """Execute queued copy-on-write page copies (device-side) before
+        the next pool write can touch the replaced blocks."""
+        for src, dst in self.scheduler.pending_cow:
+            self.cache.copy_block(src, dst)
+            if self.draft_model is not None:
+                self.draft_cache.copy_block(src, dst)
+        self.scheduler.pending_cow.clear()
+
+    def _run_chunk(self, req, start, take, outputs):
+        """One block-aligned prefill chunk: materialize ``take`` tokens of
+        ``req`` starting at ``start`` in the pool(s); on the final chunk,
+        sample the first output token from the chunk's last-position
+        logits."""
+        import jax.numpy as jnp
+
+        staged = getattr(req, "_staged", None)
+        if staged is None or staged[2] != req.prefill_upto:
+            self._stage_request(req)  # re-prefill after eviction
+            staged = req._staged
+        ids_dev, bucket, _true_len = staged
+        # chunk length: always a LADDER RUNG (one compiled graph per rung
+        # — an arbitrary C = bucket - start remainder would compile a
+        # fresh executable per distinct prefix-match offset, the
+        # recompile-per-shape cliff). When no rung covering ``take`` fits
+        # the staged room, cap this chunk at the largest rung that does;
+        # the remainder continues next step (progress >= one block).
+        room = bucket - start
+        C = None
+        for b in self.prefill_buckets:
+            if b >= take and b <= room:
+                C = b
+                break
+        if C is None:
+            C = max(b for b in self.prefill_buckets if b <= room)
+            take = min(take, C)
+        ids_chunk = ids_dev[:, start:start + C]
+        tables_row = np.zeros(self.max_pages, np.int32)
+        nblk = min(len(req.blocks), self.max_pages)
+        tables_row[:nblk] = req.blocks[:nblk]
+        tables_dev = jnp.asarray(tables_row)
+        logits, self.cache.k, self.cache.v = self._prefill_jit(
+            [p._data for p in self._params], ids_chunk, np.int32(start),
+            np.int32(start + take), tables_dev, self.cache.k, self.cache.v)
+        if self.draft_model is not None:
+            # mirror every target chunk into the draft pools: the draft
+            # proposes continuations over the same block tables, so its
+            # cache must hold the same prefix
+            _, self.draft_cache.k, self.draft_cache.v = \
+                self._draft_prefill_jit(
+                    [p._data for p in self._draft_params], ids_chunk,
+                    np.int32(start), np.int32(start + take), tables_dev,
+                    self.draft_cache.k, self.draft_cache.v)
+            req.draft_cached = start + take
+        req.num_cached = start + take
+        _M_PREFILL_CHUNKS.inc(instance=self._name)
+        if self.prefix_cache is not None:
+            # publish the identity of every full block now materialized so
+            # later admissions (and this request's own re-prefill after an
+            # eviction) can share them
+            self.prefix_cache.register(req.tokens, req.blocks,
+                                       req.num_cached)
+        if req.num_cached >= req.prefill_upto:
+            req.prefilling = False
+            self.stats_extra["prefills"] += 1
+            _M_PREFILLS.inc(instance=self._name)
+            # the _emit below fetches logits (the existing sync point);
+            # the prefill span closes right after it
+            outputs.extend(self._emit(req, np.asarray(logits)[0]))
+            req.t_decode_start = time.perf_counter_ns()
+            _obs_trace.add_complete(
+                "request.prefill",
+                getattr(req, "_t_admit", req.t_queue_start),
+                req.t_decode_start, cat="request", tid=req.rid,
+                args={"rid": req.rid, "engine": self._name,
+                      "bucket": bucket, "true_len": req.prefill_upto})
+
     def step(self):
-        """One engine tick: drain ingest, admit + prefill, one decode for
-        all running slots. Returns the ``StepOutput`` tokens produced."""
+        """One engine tick: drain ingest, admit, advance chunked prefills
+        under the token budget, one decode (or speculative verify) for all
+        decode-ready slots. Returns the ``StepOutput`` tokens produced."""
         import jax.numpy as jnp
 
         if self._decode_jit is None:
@@ -541,62 +877,45 @@ class LLMEngine:
             return outputs
         self.stats_extra["steps"] += 1
 
-        # -- prefill (admission) ---------------------------------------
+        # -- admission ---------------------------------------------------
         for slot, req in sched.pick_prefills():
             # queued->running transition: the span closes here, at a point
             # where the host is already doing admission bookkeeping
-            t_admit = time.perf_counter_ns()
+            req._t_admit = time.perf_counter_ns()
             _obs_trace.add_complete(
-                "request.queued", req.t_queue_start, t_admit,
+                "request.queued", req.t_queue_start, req._t_admit,
                 cat="request", tid=req.rid,
                 args={"rid": req.rid, "engine": self._name,
                       "evictions": req.evictions})
-            staged = getattr(req, "_staged", None)
-            if staged is None or staged[2] != req.num_tokens:
-                self._stage_request(req)  # re-prefill after eviction
-                staged = req._staged
-            ids_dev, bucket, true_len = staged
-            pages = bucket // self.block_size
-            tables_row = np.zeros(pages, np.int32)
-            n = min(len(req.blocks), pages)
-            tables_row[:n] = req.blocks[:n]
-            logits, self.cache.k, self.cache.v = self._prefill_jit(
-                [p._data for p in self._params], ids_dev,
-                np.int32(true_len), jnp.asarray(tables_row),
-                self.cache.k, self.cache.v)
-            req.num_cached = true_len
-            self.stats_extra["prefills"] += 1
-            _M_PREFILLS.inc(instance=self._name)
-            # the _emit below fetches logits (the existing sync point);
-            # the prefill span closes right after it
-            outputs.extend(self._emit(req, np.asarray(logits)[0]))
-            req.t_decode_start = time.perf_counter_ns()
-            _obs_trace.add_complete(
-                "request.prefill", t_admit, req.t_decode_start,
-                cat="request", tid=req.rid,
-                args={"rid": req.rid, "engine": self._name,
-                      "bucket": bucket, "true_len": true_len})
+
+        # -- chunked prefill (budgeted; interleaves with decode below) ---
+        for req, start, take in sched.prefill_work(
+                self.max_prefill_tokens_per_step):
+            self._run_chunk(req, start, take, outputs)
 
         # -- decode ------------------------------------------------------
-        sched.ensure_decode_room()
-        running = [(i, r) for i, r in enumerate(sched.slots) if r is not None]
-        if running:
-            B = self.max_batch_size
-            ids = np.zeros((B, 1), np.int32)
-            positions = np.zeros(B, np.int32)
-            table_lists = [[] for _ in range(B)]
-            for i, req in running:
-                ids[i, 0] = req.last_token
-                positions[i] = req.num_cached
-                table_lists[i] = req.blocks
-            tables = self.cache.table_array(table_lists, self.max_pages)
-            logits, self.cache.k, self.cache.v = self._decode_jit(
-                [p._data for p in self._params], jnp.asarray(ids),
-                jnp.asarray(positions), tables, self.cache.k, self.cache.v)
-            logits = np.asarray(logits)
-            for i, req in running:
-                req.num_cached += 1
-                outputs.extend(self._emit(req, logits[i]))
+        sched.ensure_decode_room(extra=self._spec_k)
+        self._drain_cow()
+        ready = [(i, r) for i, r in enumerate(sched.slots)
+                 if r is not None and not r.prefilling]
+        if ready:
+            if self._spec_k:
+                self._spec_step(ready, outputs)
+            else:
+                B = self.max_batch_size
+                ids = np.zeros((B, 1), np.int32)
+                positions = np.zeros(B, np.int32)
+                for i, req in ready:
+                    ids[i, 0] = req.last_token
+                    positions[i] = req.num_cached
+                logits, self.cache.k, self.cache.v = self._decode_jit(
+                    [p._data for p in self._params], jnp.asarray(ids),
+                    jnp.asarray(positions), self._tables(),
+                    self.cache.k, self.cache.v)
+                logits = np.asarray(logits)
+                for i, req in ready:
+                    req.num_cached += 1
+                    outputs.extend(self._emit(req, logits[i]))
         # utilization gauges: free-list arithmetic the host already holds
         usable = max(self.cache.num_blocks - 1, 1)
         _G_KV_UTIL.set(1.0 - self.cache.allocator.num_free / usable,
@@ -605,19 +924,140 @@ class LLMEngine:
                          instance=self._name)
         return outputs
 
+    # ------------------------------------------------------------------
+    # speculative decoding
+    # ------------------------------------------------------------------
+    def _draft_propose(self, ready, tables):
+        """Catch the draft pools up to every request's committed tokens,
+        then propose ``spec_k`` greedy draft tokens per request. Returns
+        drafts [B, K] (rows of non-ready slots are zeros/ignored)."""
+        import jax.numpy as jnp
+
+        B, K = self.max_batch_size, self._spec_k
+        toks = {r.rid: r.tokens for _, r in ready}
+        feeds = {}
+        F = 1
+        for _, r in ready:
+            lo = min(r.draft_cached, r.num_tokens - 1)
+            fs = list(range(lo, r.num_tokens))
+            feeds[r.rid] = fs
+            F = max(F, len(fs))
+        for rid, fs in feeds.items():
+            # left-pad by repeating the first feed: re-writing the same
+            # token at the same position is a deterministic no-op, so the
+            # ragged catch-up runs as F uniform batched steps
+            feeds[rid] = [fs[0]] * (F - len(fs)) + fs
+        logits = None
+        for t in range(F):
+            ids = np.zeros((B, 1), np.int32)
+            pos = np.zeros(B, np.int32)
+            for i, r in ready:
+                j = feeds[r.rid][t]
+                ids[i, 0] = toks[r.rid][j]
+                pos[i] = j
+            logits, self.draft_cache.k, self.draft_cache.v = \
+                self._draft_decode_jit(
+                    [p._data for p in self._draft_params],
+                    jnp.asarray(ids), jnp.asarray(pos), tables,
+                    self.draft_cache.k, self.draft_cache.v)
+        prev = np.asarray(logits)
+        drafts = np.zeros((B, K), np.int32)
+        for kstep in range(K):
+            for i, r in ready:
+                drafts[i, kstep] = int(prev[i].argmax())
+            if kstep + 1 < K:
+                ids = np.zeros((B, 1), np.int32)
+                pos = np.zeros(B, np.int32)
+                for i, r in ready:
+                    ids[i, 0] = drafts[i, kstep]
+                    pos[i] = r.num_tokens + kstep
+                prev, self.draft_cache.k, self.draft_cache.v = \
+                    self._draft_decode_jit(
+                        [p._data for p in self._draft_params],
+                        jnp.asarray(ids), jnp.asarray(pos), tables,
+                        self.draft_cache.k, self.draft_cache.v)
+                prev = np.asarray(prev)
+        for _, r in ready:
+            # positions 0 .. num_tokens+K-2 now hold draft K/V
+            r.draft_cached = r.num_tokens + K - 1
+        return drafts
+
+    def _spec_step(self, ready, outputs):
+        """One speculative decode step for the decode-ready slots: draft
+        proposes K tokens, one multi-query verify scores K+1 positions,
+        accepted tokens emit in order (bit-exact vs sequential greedy),
+        rollback rewinds cached lengths and frees over-allocated tail
+        blocks on rejection."""
+        import jax.numpy as jnp
+
+        B, K = self.max_batch_size, self._spec_k
+        tables = self._tables()
+        drafts = self._draft_propose(ready, tables)
+        _M_SPEC_PROPOSED.inc(K * len(ready), instance=self._name)
+        ids_v = np.zeros((B, K + 1), np.int32)
+        pos_v = np.zeros(B, np.int32)
+        n_old = {}
+        for i, r in ready:
+            ids_v[i, 0] = r.last_token
+            ids_v[i, 1:] = drafts[i]
+            pos_v[i] = r.num_cached
+            n_old[r.rid] = r.num_tokens
+        counts, nxt, self.cache.k, self.cache.v = self._verify_jit(
+            [p._data for p in self._params], jnp.asarray(ids_v),
+            jnp.asarray(pos_v), tables, jnp.asarray(drafts[:, :K]),
+            self.cache.k, self.cache.v)
+        counts = np.asarray(counts)
+        nxt = np.asarray(nxt)
+        accepted = 0
+        for i, r in ready:
+            a = int(counts[i])
+            emitted = [int(drafts[i, j]) for j in range(a)] + [int(nxt[i])]
+            m = 0
+            for tok in emitted:
+                outputs.extend(self._emit_token(r, tok))
+                m += 1
+                if r.finished:
+                    break
+            accepted += min(a, m)
+            if r.finished:
+                continue
+            # rollback: positions past the kept tokens hold rejected-draft
+            # K/V — masked by context_lens until overwritten. Rewind the
+            # cached lengths and trim lookahead blocks the shorter window
+            # no longer needs.
+            n0 = n_old[r.rid]
+            r.num_cached = r.num_tokens - 1
+            r.draft_cached = min(n0 + min(min(a, m), K - 1), r.num_tokens)
+            self.scheduler.trim_to_capacity(r, extra=K)
+        _M_SPEC_ACCEPTED.inc(accepted, instance=self._name)
+        prop = _M_SPEC_PROPOSED.value(instance=self._name)
+        if prop:
+            _G_SPEC_RATIO.set(
+                _M_SPEC_ACCEPTED.value(instance=self._name) / prop,
+                instance=self._name)
+
+    # ------------------------------------------------------------------
+    # token emission
+    # ------------------------------------------------------------------
     def _emit(self, req, row):
-        """Sample the next token for ``req`` from logits ``row`` [V],
-        append it, finish bookkeeping. Returns [StepOutput]."""
+        """Sample the next token for ``req`` from logits ``row`` [V] and
+        commit it. Returns [StepOutput]."""
         from ...models.llama import sample_next_tokens
 
         s = req.sampling
         tok = int(sample_next_tokens(
             row[None], do_sample=s.do_sample, temperature=s.temperature,
             top_k=s.top_k, top_p=s.top_p, rng=req._rng)[0])
-        req.output_tokens.append(tok)
+        return self._emit_token(req, tok)
+
+    def _emit_token(self, req, tok):
+        """Commit one already-chosen token (sampled host-side, or accepted
+        by the speculative verify): append it, observe latency metrics,
+        finish bookkeeping. Returns [StepOutput]."""
+        req.output_tokens.append(int(tok))
         self.stats_extra["tokens_out"] += 1
-        # latency observation at the sampling point — the host just
-        # fetched these logits anyway, so the clock read is free
+        # latency observation at the emission point — the host just
+        # fetched logits/verify results anyway, so the clock read is free
         now = time.perf_counter_ns()
         _M_TOKENS.inc(instance=self._name)
         if req.t_first_token is None:
@@ -638,7 +1078,7 @@ class LLMEngine:
                 args={"rid": req.rid, "engine": self._name,
                       "tokens": len(req.output_tokens),
                       "finish_reason": req.finish_reason()})
-        return [StepOutput(req.rid, tok, done,
+        return [StepOutput(req.rid, int(tok), done,
                            req.finish_reason() if done else None)]
 
     def stream(self):
@@ -713,11 +1153,13 @@ class LLMEngine:
     def metrics(self):
         """Engine-owned observability snapshot (ISSUE 10 public surface):
         lifecycle counters, latency histogram summaries (count/mean/
-        p50/p99, ms) and utilization gauges for THIS engine instance,
-        read from ``paddle.observability.metrics``. This is what
+        p50/p99, ms), prefix-cache/chunk/speculative counters and
+        utilization gauges for THIS engine instance, read from
+        ``paddle.observability.metrics``. This is what
         ``scripts/bench_serving.py`` reports TTFT / inter-token
         percentiles from — engine-measured, not bench-side timing."""
         inst = self._name
+        prop = _M_SPEC_PROPOSED.value(instance=inst)
         return {
             "instance": inst,
             "admitted": int(_M_ADMITTED.value(instance=inst)),
@@ -726,6 +1168,15 @@ class LLMEngine:
             "queued_on_exhaustion": int(
                 _M_QUEUED_EXH.value(instance=inst)),
             "prefills": int(_M_PREFILLS.value(instance=inst)),
+            "prefill_chunks": int(_M_PREFILL_CHUNKS.value(instance=inst)),
+            "prefix_blocks_reused": int(
+                _M_PREFIX_REUSED.value(instance=inst)),
+            "cow_copies": int(_M_COW.value(instance=inst)),
+            "spec_proposed": int(prop),
+            "spec_accepted": int(_M_SPEC_ACCEPTED.value(instance=inst)),
+            "spec_accept_ratio": (
+                float(_G_SPEC_RATIO.value(instance=inst)) if prop
+                else None),
             "tokens_out": int(_M_TOKENS.value(instance=inst)),
             "ttft_ms": _H_TTFT.summary(instance=inst),
             "itl_ms": _H_ITL.summary(instance=inst),
@@ -753,6 +1204,8 @@ class LLMEngine:
             self._ingest.close()
         if self._was_training:
             self.model.train()
+        if self.draft_model is not None and self._draft_was_training:
+            self.draft_model.train()
 
     def __enter__(self):
         return self

@@ -1,4 +1,5 @@
-"""Block-allocated paged KV cache (ISSUE 7 tentpole, part a).
+"""Block-allocated paged KV cache (ISSUE 7 tentpole, part a; prefix
+sharing added by ISSUE 11).
 
 The flagship decode loop used to grow its cache by ``concat`` — a fresh
 XLA compile and a full cache copy per generated token, and worse, memory
@@ -20,23 +21,55 @@ static block pool:
 Block 0 is reserved as the **null block**: padded table entries point at
 it, so in-graph writes for padding land somewhere harmless instead of
 clobbering a live request's block. It is never handed out.
+
+ISSUE 11 extends the allocator with **ref-counted block identity** so N
+requests sharing a prompt prefix hold the SAME pool blocks:
+
+* every allocated block carries a refcount; ``acquire`` increfs a block
+  another request already filled, ``free`` decrefs — a block returns to
+  circulation only at refcount 0 (eviction of a shared block waits for
+  the last holder);
+* a refcount-0 block whose content is registered in a :class:`PrefixCache`
+  is not recycled immediately: it parks in an LRU *reusable* pool, still
+  holding its K/V, so a later request with the same prefix can revive it.
+  ``allocate`` reclaims reusable blocks (oldest first, dropping their
+  hash entries) only after the free list runs dry;
+* ``PrefixCache`` maps hash *chains* — ``sha1(parent_hash ‖ block's
+  tokens)`` — to block ids, so block identity is positional content, not
+  raw bytes: the same 16 tokens at a different prefix offset hash
+  differently, exactly like vLLM's prefix tree flattened into a dict.
+
+A partially-filled tail block is never registered (only FULL blocks enter
+the hash index), so in-place writes always land in private blocks; the
+copy-on-write helpers (``BlockAllocator.is_shared`` +
+``PagedKVCache.copy_block``) guard the invariant anyway — a divergent
+write to a block some other request can see must copy first, never
+mutate.
 """
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["BlockAllocator", "PagedKVCache"]
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache"]
 
 
 class BlockAllocator:
-    """LIFO free-list over ``num_blocks`` pool blocks.
+    """Ref-counted LIFO free-list over ``num_blocks`` pool blocks.
 
     Block 0 is the reserved null block (see module docstring) and is never
     allocated. ``allocate`` is all-or-nothing: asking for more blocks than
-    are free returns ``None`` and takes nothing — the scheduler's signal
-    to queue (or evict), never a partial grab to unwind.
+    are available returns ``None`` and takes nothing — the scheduler's
+    signal to queue (or evict), never a partial grab to unwind. ``free``
+    is all-or-nothing too: the whole id list is validated up front, so a
+    bad id (double-free, foreign block, duplicate in one call) raises
+    BEFORE any refcount moves and the allocator is never left
+    half-mutated.
     """
 
     def __init__(self, num_blocks):
@@ -46,29 +79,184 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         # LIFO: recently-freed (cache-warm) blocks are reused first
         self._free = list(range(self.num_blocks - 1, 0, -1))
-        self._allocated = set()
+        self._ref = {}                     # block id -> refcount (>= 1)
+        # refcount-0 blocks still registered in a PrefixCache: content is
+        # intact and revivable; reclaimed LRU-first when the free list is
+        # empty. Insertion order = least recently released first.
+        self._reusable = OrderedDict()
+        # PrefixCache hooks (set by PrefixCache.__init__): ``on_reclaim``
+        # is called with a block id when a reusable block is reclaimed for
+        # a fresh allocation (its cached identity dies); ``cache_probe``
+        # answers ``registered(block_id)`` so ``free`` knows which
+        # refcount-0 blocks are worth parking instead of recycling
+        self.on_reclaim = None
+        self.cache_probe = None
         self.high_water = 0
 
     @property
+    def _allocated(self):
+        """Set view of live (refcount >= 1) blocks — kept for tests and
+        invariant checks that predate refcounting."""
+        return set(self._ref)
+
+    @property
     def num_free(self):
-        return len(self._free)
+        """Blocks available to ``allocate``: the free list plus reusable
+        (refcount-0, cached-content) blocks that can be reclaimed."""
+        return len(self._free) + len(self._reusable)
+
+    def ref(self, block_id):
+        """Current refcount of ``block_id`` (0 if not live)."""
+        return self._ref.get(block_id, 0)
+
+    def is_shared(self, block_id):
+        """True when more than one holder references the block — an
+        in-place write would be visible to another request (COW trigger)."""
+        return self._ref.get(block_id, 0) > 1
 
     def allocate(self, n=1):
-        """``n`` block ids, or ``None`` (and no state change) if fewer
-        than ``n`` are free."""
-        if n > len(self._free):
+        """``n`` fresh private blocks (refcount 1), or ``None`` (and no
+        state change) if fewer than ``n`` are available. Reusable cached
+        blocks are reclaimed (oldest first) only after the free list runs
+        dry — reclaiming drops their prefix-cache identity via
+        ``on_reclaim``."""
+        if n > self.num_free:
             return None
-        ids = [self._free.pop() for _ in range(n)]
-        self._allocated.update(ids)
-        self.high_water = max(self.high_water, len(self._allocated))
+        ids = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                b, _ = self._reusable.popitem(last=False)  # LRU reclaim
+                if self.on_reclaim is not None:
+                    self.on_reclaim(b)
+            self._ref[b] = 1
+            ids.append(b)
+        self.high_water = max(self.high_water, len(self._ref))
         return ids
 
-    def free(self, ids):
+    def acquire(self, ids):
+        """Share existing blocks: incref each id, reviving reusable
+        (refcount-0 cached) blocks. Raises on ids that are neither live
+        nor reusable — validated up front, all-or-nothing."""
         for b in ids:
-            if b not in self._allocated:
+            if b not in self._ref and b not in self._reusable:
+                raise ValueError(f"acquire of free/foreign block {b}")
+        for b in ids:
+            if b in self._ref:
+                self._ref[b] += 1
+            else:
+                del self._reusable[b]
+                self._ref[b] = 1
+        self.high_water = max(self.high_water, len(self._ref))
+
+    def free(self, ids):
+        """Decref every id; a block reaching refcount 0 returns to the
+        free list, or — when the attached :class:`PrefixCache` (via
+        ``cache_probe``) says its content is registered — parks in the
+        reusable pool instead, revivable by a later prefix match.
+
+        All-or-nothing (ISSUE 11 satellite): the WHOLE list is validated
+        before any mutation, so a duplicate id in one call or a foreign/
+        double-freed block raises with the allocator untouched.
+        """
+        seen = set()
+        for b in ids:
+            if b in seen:
+                raise ValueError(f"duplicate block {b} in one free() call")
+            if b not in self._ref:
                 raise ValueError(f"double-free or foreign block {b}")
-            self._allocated.discard(b)
-            self._free.append(b)
+            seen.add(b)
+        probe = self.cache_probe
+        for b in ids:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                if probe is not None and probe.registered(b):
+                    self._reusable[b] = None
+                else:
+                    self._free.append(b)
+
+
+class PrefixCache:
+    """Content-hashed block identity: hash chains -> pool block ids.
+
+    A block's identity is ``sha1(parent_chain_hash ‖ its block_size
+    tokens)`` — the chain makes identity positional (the same tokens
+    after a different prefix are a different block), so a lookup walking
+    chunks from position 0 finds exactly the blocks whose ENTIRE causal
+    content matches. Only FULL blocks are ever registered: the partially
+    filled tail of a prompt stays private (its content is still growing),
+    which is what makes in-place decode writes safe without copies in the
+    common path.
+    """
+
+    def __init__(self, allocator, block_size):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._by_hash = {}      # chain hash (bytes) -> block id
+        self._block_hash = {}   # block id -> chain hash
+        allocator.on_reclaim = self._forget
+        allocator.cache_probe = self
+
+    def __len__(self):
+        return len(self._by_hash)
+
+    def registered(self, block_id):
+        return block_id in self._block_hash
+
+    def _chunk_hash(self, parent, chunk):
+        return hashlib.sha1(
+            parent + np.asarray(chunk, np.int64).tobytes()).digest()
+
+    def match(self, tokens):
+        """Longest chain of cached full blocks covering a PROPER prefix
+        of ``tokens``; returns ``(block_ids, tokens_covered)``. The match
+        is capped at ``len(tokens) - 1`` so admission always has at least
+        one token left to prefill — the last position's logits must be
+        computed to sample the first output token."""
+        tokens = np.asarray(tokens)
+        bs = self.block_size
+        max_chunks = max((len(tokens) - 1) // bs, 0)
+        blocks, parent = [], b""
+        for i in range(max_chunks):
+            h = self._chunk_hash(parent, tokens[i * bs:(i + 1) * bs])
+            b = self._by_hash.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+            parent = h
+        return blocks, len(blocks) * bs
+
+    def register(self, tokens, blocks, upto):
+        """Publish the identity of every FULL block among ``blocks`` whose
+        tokens (``tokens[:upto]``) are materialized in the pool. First
+        writer wins: a chain hash already mapping to a (different) block
+        keeps its mapping and the duplicate block simply stays private;
+        a block already registered under another chain is never re-keyed.
+        """
+        tokens = np.asarray(tokens)
+        bs = self.block_size
+        n_chunks = min(int(upto) // bs, len(blocks))
+        parent = b""
+        for i in range(n_chunks):
+            h = self._chunk_hash(parent, tokens[i * bs:(i + 1) * bs])
+            cur = self._by_hash.get(h)
+            if cur is None and blocks[i] not in self._block_hash:
+                self._by_hash[h] = blocks[i]
+                self._block_hash[blocks[i]] = h
+            parent = h
+
+    def forget(self, block_id):
+        """Drop a block's cached identity (divergent write to a
+        refcount-1 registered block — its content no longer matches the
+        published hash)."""
+        self._forget(block_id)
+
+    def _forget(self, block_id):
+        h = self._block_hash.pop(block_id, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
 
 
 class PagedKVCache:
@@ -81,7 +269,8 @@ class PagedKVCache:
     buffers, exactly like ``FusedTrainStep`` handles optimizer state.
     """
 
-    def __init__(self, config, num_blocks, block_size, dtype=None):
+    def __init__(self, config, num_blocks, block_size, dtype=None,
+                 allocator=None):
         if dtype is None:
             dtype = jnp.float32
         self.block_size = int(block_size)
@@ -91,7 +280,10 @@ class PagedKVCache:
         L = config.num_hidden_layers
         self.k = [jnp.zeros(shape, dtype) for _ in range(L)]
         self.v = [jnp.zeros(shape, dtype) for _ in range(L)]
-        self.allocator = BlockAllocator(num_blocks)
+        # a draft-model pool (speculative decoding) shares the target
+        # pool's allocator: one block table indexes both pools
+        self.allocator = (allocator if allocator is not None
+                          else BlockAllocator(num_blocks))
 
     def blocks_for_tokens(self, n_tokens):
         """Blocks needed to hold ``n_tokens``."""
@@ -100,9 +292,15 @@ class PagedKVCache:
     def table_array(self, block_lists, max_blocks):
         """Host block tables -> device int32 [len(block_lists), max_blocks],
         padded with the null block."""
-        import numpy as np
-
         out = np.zeros((len(block_lists), max_blocks), np.int32)
         for i, blocks in enumerate(block_lists):
             out[i, :len(blocks)] = blocks
         return jax.device_put(out)
+
+    def copy_block(self, src, dst):
+        """Copy one pool block's K/V from ``src`` to ``dst`` across all
+        layers (the COW move: the writer gets a private copy, the shared
+        original is never mutated). Host-triggered and rare — this is NOT
+        inside the compiled step."""
+        self.k = [kp.at[dst].set(kp[src]) for kp in self.k]
+        self.v = [vp.at[dst].set(vp[src]) for vp in self.v]

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ...nn.functional.flash_attention import _sdpa_ref
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "paged_multiquery_attention"]
 
 
 def _lax_fallback(q, k_pool, v_pool, block_tables, context_lens, scale):
@@ -56,3 +56,50 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
         return out[:, None]
     return _lax_fallback(q, k_pool, v_pool, block_tables, context_lens,
                          float(scale))
+
+
+def _lax_multiquery_fallback(q, k_pool, v_pool, block_tables, context_lens,
+                             q_start, scale):
+    """q [B, T, H, D] -> [B, T, H, D]: gather + per-row causal mask."""
+    b, t = q.shape[0], q.shape[1]
+    _, block_size, hkv, d = k_pool.shape
+    p = block_tables.shape[1]
+    k = k_pool[block_tables].reshape(b, p * block_size, hkv, d)
+    v = v_pool[block_tables].reshape(b, p * block_size, hkv, d)
+    pos = jnp.arange(p * block_size, dtype=jnp.int32)[None, None, :]
+    row = jnp.arange(t, dtype=jnp.int32)[None, :, None]
+    # query row i sits at absolute position q_start+i: it may attend to
+    # every token at position <= q_start+i that is inside the context
+    allowed = (pos <= q_start[:, None, None] + row) \
+        & (pos < context_lens[:, None, None])
+    return _sdpa_ref.raw_fn(q, k, v, attn_mask=allowed[:, None], scale=scale)
+
+
+def paged_multiquery_attention(q, k_pool, v_pool, block_tables, context_lens,
+                               q_start, scale=None):
+    """T query tokens per request against the paged pool — the shared
+    primitive behind chunked prefill (a block-aligned chunk of the prompt
+    at offset ``q_start``) and speculative verify (k+1 draft positions
+    scored in one step).
+
+    q: [B, T, H, D] (queries at absolute positions ``q_start[b] + t``);
+    pools [N, block, Hkv, D]; block_tables [B, P] int32; context_lens [B]
+    int32 — total visible tokens INCLUDING the last real query row (rows
+    past ``context_lens - q_start`` are padding; their output is
+    unspecified and must be ignored by the caller). Causal within the
+    window: row t attends to positions <= q_start + t. Returns
+    [B, T, H, D].
+    """
+    d = q.shape[-1]
+    block_size = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    from ...ops.pallas.paged_attention import (
+        paged_multiquery_attention_pallas, use_pallas_paged)
+
+    if use_pallas_paged(d, block_size):
+        return paged_multiquery_attention_pallas(
+            q, k_pool, v_pool, block_tables, context_lens, q_start,
+            float(scale))
+    return _lax_multiquery_fallback(q, k_pool, v_pool, block_tables,
+                                    context_lens, q_start, float(scale))

@@ -1,4 +1,5 @@
-"""Continuous batching scheduler (ISSUE 7 tentpole, part c).
+"""Continuous batching scheduler (ISSUE 7 tentpole, part c; prefix-aware
+admission + chunked prefill added by ISSUE 11).
 
 Token-granularity admission into a fixed set of decode slots:
 
@@ -6,11 +7,25 @@ Token-granularity admission into a fixed set of decode slots:
   ONCE — a finished request's slot is refilled by the next waiting request
   at the very next step (continuous batching), never by re-batching into a
   new shape;
-* **prefill/decode split**: prompts run through their own compiled
-  prefill graphs (one per registered length bucket — the PR-1 shape-bucket
-  discipline), decode runs the shared fixed-shape step; a step admits at
-  most ``max_prefills_per_step`` prompts so decode latency for running
-  requests stays bounded;
+* **prefill/decode split with chunking**: prompts run through compiled
+  chunk-prefill graphs (block-aligned chunks, one graph per chunk-length
+  bucket — the PR-1 shape-bucket discipline); ``prefill_work`` hands out
+  at most ``max_prefill_tokens_per_step`` NEW prompt tokens per engine
+  step, so a 2k-token prompt is interleaved with decode steps instead of
+  monopolizing them — decode inter-token latency is bounded by the chunk
+  budget, not the longest queued prompt;
+* **prefix-aware admission**: when a :class:`~.kv_cache.PrefixCache` is
+  attached, admission matches the request's tokens against the hash-chain
+  index and charges the allocator only for the UNSHARED tail — matched
+  blocks are ``acquire``\\ d (ref-counted), ``num_cached`` starts past
+  them, and the engine prefills only the remainder;
+* **copy-on-write guard**: before decode/verify writes, any block in the
+  write window that another request can see (refcount > 1) is replaced by
+  a private copy (the device-side page copy is queued on ``pending_cow``
+  for the engine to execute); a refcount-1 block that is still registered
+  in the prefix index merely retracts its published identity. By
+  construction only FULL blocks are shared, so the common path never
+  copies — the guard enforces the invariant rather than paying for it;
 * **graceful degradation**: a request that cannot get blocks stays queued
   (FIFO) — the engine never crashes on pool exhaustion. If a RUNNING
   request cannot grow by one block, the scheduler evicts the
@@ -18,7 +33,14 @@ Token-granularity admission into a fixed set of decode slots:
   re-queues at the FRONT and will re-prefill from its full
   prompt+generated prefix later — greedy decode makes the re-derived
   tokens identical), mirroring vLLM's recompute preemption;
-* blocks free the moment a request finishes (EOS or max_new_tokens).
+* blocks free the moment a request finishes (EOS or max_new_tokens) —
+  under prefix sharing "free" means decref: a shared block is reclaimed
+  only when its LAST holder releases it.
+
+``version`` counts every block-table mutation (admission, growth,
+eviction, finish, COW, trim): the engine caches the device block-table
+array against it, so steady-state decode re-uploads nothing (ISSUE 11
+satellite).
 """
 
 from __future__ import annotations
@@ -49,6 +71,12 @@ _M_FINISHED = _obs_metrics.counter(
 _M_QUEUED_EXH = _obs_metrics.counter(
     "serving_queued_on_exhaustion_total",
     "admissions deferred because the block pool was exhausted")
+_M_PREFIX_REUSED = _obs_metrics.counter(
+    "serving_prefix_blocks_reused_total",
+    "pool blocks admitted from the prefix cache instead of fresh prefill")
+_M_COW = _obs_metrics.counter(
+    "serving_cow_copies_total",
+    "copy-on-write block copies (divergent write to a shared block)")
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 
@@ -89,6 +117,13 @@ class Request:
         self.output_tokens: list[int] = []
         self.blocks: list[int] = []       # pool block ids, in order
         self.num_cached = 0               # tokens materialized in the pool
+        # chunked prefill: tokens the CURRENT admission must materialize
+        # before the request is decode-ready; ``prefilling`` is True from
+        # admission until the final chunk's logits were sampled
+        self.prefill_upto = 0
+        self.prefilling = False
+        # speculative decoding: tokens materialized in the DRAFT pool
+        self.draft_cached = 0
         self.admit_seq = -1               # admission order (eviction policy)
         self.evictions = 0
         self._rng = (np.random.RandomState(self.sampling.seed)
@@ -138,12 +173,14 @@ class Scheduler:
     ``instance`` names this scheduler's registry label (the owning
     ``LLMEngine`` passes its own name, so every serving counter of one
     engine shares one label); standalone schedulers get an auto name.
+    ``prefix_cache`` (a :class:`~.kv_cache.PrefixCache`) arms prefix-aware
+    admission; ``None`` keeps the PR-7 charge-everything behavior.
     """
 
     _ids = itertools.count(1)
 
     def __init__(self, allocator, block_size, max_batch_size,
-                 max_prefills_per_step=1, instance=None):
+                 max_prefills_per_step=1, instance=None, prefix_cache=None):
         self.allocator = allocator
         self.block_size = int(block_size)
         self.slots: list[Request | None] = [None] * int(max_batch_size)
@@ -151,8 +188,17 @@ class Scheduler:
         self.max_prefills_per_step = int(max_prefills_per_step)
         self._admit_seq = itertools.count()
         self.instance = instance or f"scheduler#{next(Scheduler._ids)}"
+        self.prefix_cache = prefix_cache
+        # block-table mutation counter: the engine invalidates its cached
+        # device table array on change, so steady-state decode does ZERO
+        # table H2D (ISSUE 11 satellite)
+        self.version = 0
+        # (src, dst) device page copies the engine must run before the
+        # next pool write — queued by the COW guard, drained by step()
+        self.pending_cow: list[tuple[int, int]] = []
         # pre-touch the series so stats reads zeros before any event
-        for m in (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH):
+        for m in (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
+                  _M_PREFIX_REUSED, _M_COW):
             m.inc(0, instance=self.instance)
 
     @property
@@ -164,6 +210,9 @@ class Scheduler:
             "evictions": int(_M_EVICTIONS.value(instance=inst)),
             "finished": int(_M_FINISHED.value(instance=inst)),
             "queued_on_exhaustion": int(_M_QUEUED_EXH.value(instance=inst)),
+            "prefix_blocks_reused": int(
+                _M_PREFIX_REUSED.value(instance=inst)),
+            "cow_copies": int(_M_COW.value(instance=inst)),
         }
 
     # -- queries ---------------------------------------------------------
@@ -182,69 +231,180 @@ class Scheduler:
 
     # -- admission (prefill picks) --------------------------------------
     def pick_prefills(self):
-        """Waiting requests to prefill THIS step: pops up to
+        """Waiting requests to admit THIS step: pops up to
         ``max_prefills_per_step`` requests that fit (a free slot + blocks
-        for prompt-and-first-token). A head-of-queue request that does not
-        fit stays queued — FIFO, no overtaking — and the engine simply
-        decodes with what is running."""
+        for prompt-and-first-token, charging only blocks the prefix cache
+        cannot supply). A head-of-queue request that does not fit stays
+        queued — FIFO, no overtaking — and the engine simply decodes with
+        what is running."""
         picked = []
         while (len(picked) < self.max_prefills_per_step and self.waiting
                and self._free_slot() is not None):
             req = self.waiting[0]
-            need = -(-(req.num_tokens + 1) // self.block_size)
-            blocks = self.allocator.allocate(need)
+            if self.prefix_cache is not None:
+                matched, mtok = self.prefix_cache.match(req.tokens)
+            else:
+                matched, mtok = [], 0
+            need = -(-(req.num_tokens + 1) // self.block_size) - len(matched)
+            if matched:
+                # pin the matched blocks FIRST: the fresh allocation below
+                # may reclaim reusable (refcount-0) blocks, and the match
+                # must not be reclaimed out from under its own admission
+                self.allocator.acquire(matched)
+            blocks = self.allocator.allocate(need) if need > 0 else []
             if blocks is None:
+                if matched:
+                    self.allocator.free(matched)
                 _M_QUEUED_EXH.inc(instance=self.instance)
                 break
             self.waiting.popleft()
             slot = self._free_slot()
-            req.blocks = blocks
+            req.blocks = list(matched) + blocks
+            req.num_cached = mtok          # prefix tokens already in-pool
+            req.draft_cached = mtok        # mirrored draft pool (spec)
+            req.prefilling = True
+            req.prefill_upto = req.num_tokens
             req.state = RUNNING
             req.admit_seq = next(self._admit_seq)
             self.slots[slot] = req
+            self.version += 1
             _M_ADMITTED.inc(instance=self.instance)
+            if matched:
+                _M_PREFIX_REUSED.inc(len(matched), instance=self.instance)
             picked.append((slot, req))
         return picked
 
-    # -- decode-time growth / eviction ----------------------------------
-    def ensure_decode_room(self):
+    # -- chunked prefill work -------------------------------------------
+    def prefill_work(self, budget=None):
+        """Chunk assignments ``[(req, start, n_new_tokens)]`` for this
+        engine step: oldest-admitted prefilling requests first, total NEW
+        tokens bounded by ``budget`` (``None`` = unlimited — whole prompts
+        in one chunk, the PR-7 behavior). Non-final chunks are
+        block-aligned (chunk starts must sit on page boundaries for
+        whole-page pool writes); the head assignment always gets at least
+        one block so prefill can never stall under a tiny budget."""
+        out = []
+        remaining = float("inf") if budget is None else int(budget)
+        for req in sorted((r for r in self.slots
+                           if r is not None and r.prefilling),
+                          key=lambda r: r.admit_seq):
+            todo = req.prefill_upto - req.num_cached
+            if todo <= 0:
+                continue
+            if remaining <= 0:
+                break
+            allowed = remaining
+            if allowed < todo:
+                allowed = int(allowed) // self.block_size * self.block_size
+                if allowed == 0:
+                    if out:
+                        break
+                    allowed = self.block_size  # guaranteed progress
+            take = int(min(todo, allowed))
+            out.append((req, req.num_cached, take))
+            remaining -= take
+        return out
+
+    # -- decode-time growth / eviction / COW ----------------------------
+    def _grow_one(self, req, evicted):
+        """One block for ``req``, evicting peers (then self) on
+        exhaustion. Returns the block id or None if ``req`` itself was
+        evicted."""
+        while True:
+            got = self.allocator.allocate(1)
+            if got is not None:
+                return got[0]
+            victim = max((r for r in self.running if r is not req),
+                         key=lambda r: r.admit_seq, default=None)
+            if victim is None:
+                victim = req  # alone and out of memory: preempt self
+            self._evict(victim)
+            evicted.append(victim)
+            if victim is req:
+                return None
+
+    def ensure_decode_room(self, extra=0):
         """Grow every running request that is about to write past its last
-        block. On exhaustion, evict the most-recently-admitted running
-        request (free its blocks, re-queue at the FRONT) and retry —
-        token-granularity eviction. Returns the evicted requests."""
+        block; ``extra`` reserves additional lookahead positions (the
+        speculative verify window writes ``k+1`` tokens at once). On
+        exhaustion, evict the most-recently-admitted running request (free
+        its blocks, re-queue at the FRONT) and retry — token-granularity
+        eviction. Divergent-write targets that are shared get a private
+        copy queued on ``pending_cow`` (COW). Returns the evicted
+        requests."""
         evicted = []
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
-            # the decode step writes ONE token at position len(tokens)-1,
-            # so capacity len(tokens) is exactly enough — demanding a
-            # lookahead block here would evict needlessly when the pool is
-            # full at a block boundary
-            while req.num_tokens > len(req.blocks) * self.block_size:
-                got = self.allocator.allocate(1)
-                if got is not None:
-                    req.blocks.extend(got)
-                    continue
-                victim = max((r for r in self.running if r is not req),
-                             key=lambda r: r.admit_seq, default=None)
-                if victim is None:
-                    victim = req  # alone and out of memory: preempt self
-                self._evict(victim)
-                evicted.append(victim)
-                if victim is req:
+            # mid-prefill requests already own blocks for prompt+1 tokens
+            # (charged at admission) and take no speculative lookahead
+            lookahead = 0 if req.prefilling else int(extra)
+            # the decode step writes ONE token at position len(tokens)-1
+            # (plus ``lookahead`` speculative positions), so capacity
+            # len(tokens)+lookahead is exactly enough — demanding more
+            # would evict needlessly when the pool is full at a boundary
+            while (req.state == RUNNING and req.num_tokens + lookahead
+                    > len(req.blocks) * self.block_size):
+                got = self._grow_one(req, evicted)
+                if got is None:
                     break
+                req.blocks.append(got)
+                self.version += 1
+            if req.state != RUNNING or req.prefilling:
+                continue
+            # COW guard over the write window [num_cached, num_cached+
+            # lookahead]: a shared block must never be mutated in place
+            first = req.num_cached // self.block_size
+            last = min((req.num_cached + lookahead) // self.block_size,
+                       len(req.blocks) - 1)
+            for bi in range(first, last + 1):
+                b = req.blocks[bi]
+                if self.allocator.is_shared(b):
+                    got = self._grow_one(req, evicted)
+                    if got is None:
+                        break
+                    self.pending_cow.append((b, got))
+                    self.allocator.free([b])
+                    req.blocks[bi] = got
+                    self.version += 1
+                    _M_COW.inc(instance=self.instance)
+                elif (self.prefix_cache is not None
+                        and self.prefix_cache.registered(b)):
+                    # sole holder, but the content is published: the write
+                    # diverges it from its hash — retract the identity
+                    self.prefix_cache.forget(b)
         return evicted
+
+    def trim_to_capacity(self, req, extra=0):
+        """Free tail blocks beyond what ``req.num_tokens + extra`` needs
+        (the speculative-rollback path: a rejected window leaves
+        over-allocated lookahead blocks behind). ``extra`` keeps the NEXT
+        verify window's lookahead room — trimming to the bare token count
+        would free a block that ``ensure_decode_room`` re-allocates one
+        step later, ping-ponging the allocator and invalidating the
+        engine's device table cache every step near a block boundary.
+        Tail blocks are private by construction; ``free`` decrefs anyway,
+        so a forged shared tail is still safe."""
+        keep = max(-(-(req.num_tokens + int(extra)) // self.block_size), 1)
+        if len(req.blocks) > keep:
+            extras = req.blocks[keep:]
+            del req.blocks[keep:]
+            self.allocator.free(extras)
+            self.version += 1
 
     def _evict(self, req):
         slot = self.slots.index(req)
         self.allocator.free(req.blocks)
         req.blocks = []
         req.num_cached = 0
+        req.draft_cached = 0
+        req.prefilling = False
         req.state = WAITING
         req.evictions += 1
         req.t_queue_start = time.perf_counter_ns()  # re-queued span start
         self.slots[slot] = None
         self.waiting.appendleft(req)
+        self.version += 1
         _M_EVICTIONS.inc(instance=self.instance)
 
     # -- completion ------------------------------------------------------
@@ -254,4 +414,5 @@ class Scheduler:
         req.blocks = []
         req.state = FINISHED
         self.slots[slot] = None
+        self.version += 1
         _M_FINISHED.inc(instance=self.instance)

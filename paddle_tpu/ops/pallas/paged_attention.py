@@ -32,7 +32,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import NEG_INF, _interpret
 
-__all__ = ["paged_decode_attention_pallas", "use_pallas_paged"]
+__all__ = ["paged_decode_attention_pallas",
+           "paged_multiquery_attention_pallas", "use_pallas_paged"]
 
 
 def use_pallas_paged(head_dim, block_size):
@@ -118,3 +119,100 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=_interpret(),
     )(tables_flat, lens, q, k_pool, v_pool)
+
+
+def _mq_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref, *, block_size, groups, t_q, scale):
+    """Multi-query variant (ISSUE 11): T query rows per request folded
+    into the accumulator's leading dim ([T*H, D]), per-row causal masking
+    against the row's absolute position ``start + t``. Same one-block-DMA-
+    per-grid-step structure as the decode kernel (CuBridge's iterate-on-
+    the-verify-kernel guidance, PAPERS.md)."""
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    b = pl.program_id(0)
+    ctx = lens_ref[b]
+    start = starts_ref[b]
+    n_pages = (ctx + block_size - 1) // block_size
+
+    @pl.when(p < n_pages)
+    def _page():
+        h = q_ref.shape[2]
+        q = q_ref[0].astype(jnp.float32) * scale          # [T, H, D]
+        q2 = q.reshape(t_q * h, q.shape[-1])              # [T*H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [block, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        kt = jnp.repeat(jnp.swapaxes(k, 0, 1), groups, axis=0)  # [H, blk, D]
+        vt = jnp.repeat(jnp.swapaxes(v, 0, 1), groups, axis=0)
+        # scores per (row=t*H+h, token-in-block): contract D against the
+        # row's head slice of this page
+        s = jax.lax.dot_general(
+            q2.reshape(t_q, h, -1), kt, (((2,), (2,)), ((1,), (0,))),
+        )                                                  # [H, T, blk]
+        s = jnp.swapaxes(s, 0, 1).reshape(t_q * h, block_size)
+        tok = p * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        row_t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // h
+        ok = (tok <= start + row_t) & (tok < ctx)
+        s = jnp.where(ok, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pexp = jnp.exp(s - m_new)
+        pexp = jnp.where(ok, pexp, 0.0)  # rows with no visible token yet
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(pexp, axis=1, keepdims=True)
+        av = jax.lax.dot_general(
+            pexp.reshape(t_q, h, block_size), vt,
+            (((2,), (1,)), ((1,), (0,))))                  # [H, T, D]
+        acc_ref[...] = acc_ref[...] * corr + \
+            jnp.swapaxes(av, 0, 1).reshape(t_q * h, -1)
+        m_ref[...] = m_new
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+                    ).reshape(t_q, h, -1).astype(o_ref.dtype)
+
+
+def paged_multiquery_attention_pallas(q, k_pool, v_pool, block_tables,
+                                      context_lens, q_start, scale):
+    """q [B, T, H, D] at absolute positions ``q_start[b] + t``; pools
+    [N, block, Hkv, D]; block_tables [B, P] int32; context_lens [B] int32
+    (visible tokens including the last real query row). Returns
+    [B, T, H, D]; rows past ``context_lens - q_start`` are padding and
+    undefined."""
+    b, t, h, d = q.shape
+    n, block_size, hkv, _ = k_pool.shape
+    p = block_tables.shape[1]
+    groups = h // hkv
+    tables_flat = block_tables.reshape(-1).astype(jnp.int32)
+    lens = context_lens.astype(jnp.int32)
+    starts = q_start.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, p),
+        in_specs=[
+            pl.BlockSpec((1, t, h, d), lambda i, j, T, L, S: (i, 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda i, j, T, L, S: (T[i * p + j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda i, j, T, L, S: (T[i * p + j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t, h, d),
+                               lambda i, j, T, L, S: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t * h, d), jnp.float32),
+            pltpu.VMEM((t * h, 1), jnp.float32),
+            pltpu.VMEM((t * h, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_mq_kernel, block_size=block_size, groups=groups,
+                          t_q=t, scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, t, h, d), q.dtype),
+        interpret=_interpret(),
+    )(tables_flat, lens, starts, q, k_pool, v_pool)

@@ -23,14 +23,30 @@ batching, not compile amortization.
 Metrics per arm: generated tokens/s over the makespan, and per-request
 latency (finish − arrival) p50/p99.
 
+ISSUE 11 adds three more seeded A/Bs over the same harness:
+
+  --workload shared-prefix   multi-tenant stream with a common system
+           prompt: prefix-cache sharing arm vs charge-everything arm,
+           bit-exact outputs asserted, effective (prompt+generated)
+           tokens/s and prefix-hit ratio reported
+  --workload chunked         long-prompt mix: chunked prefill (budgeted
+           tokens/step) vs whole-prompt prefill — decode ITL p99 is the
+           engine-owned histogram, the chunk budget bounds it
+  --workload spec            speculative decoding arm (draft proposes k,
+           one multi-query verify scores k+1) vs plain decode —
+           bit-exact greedy asserted, accept ratio reported from
+           ``LLMEngine.metrics()``
+
 The harness (``default_sizing`` / ``request_stream`` / ``run_naive`` /
-``run_engine``) is also imported by bench.py's ``serving`` workload and
-tests/test_serving.py's acceptance test so the bench line, the probe and
+``run_engine`` / ``run_shared_prefix_ab`` / ``run_chunked_ab`` /
+``run_spec_ab``) is also imported by bench.py's ``serving`` workload and
+tests/test_serving.py's acceptance tests so the bench line, the probe and
 the test can never drift apart.
 
 Usage:
-  python scripts/bench_serving.py [--requests 16] [--rate 40]
-      [--max-batch 4] [--seed 0] [--tiny]
+  python scripts/bench_serving.py [--workload poisson|shared-prefix|
+      chunked|spec] [--requests 16] [--rate 40] [--max-batch 4]
+      [--seed 0] [--tiny]
 """
 
 from __future__ import annotations
@@ -87,6 +103,28 @@ def request_stream(cfg, *, n, rate, min_prompt, max_prompt, min_new,
         prompt = rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
         out.append(_Req(float(t), prompt, int(rng.randint(min_new,
                                                           max_new + 1))))
+    return out
+
+
+def shared_prefix_stream(cfg, *, n, rate, prefix_len, min_suffix,
+                         max_suffix, min_new, max_new, seed=0,
+                         prefix_seed=None):
+    """Seeded multi-tenant stream: every request shares ONE system-prompt
+    prefix (drawn from ``prefix_seed``, default ``seed``) followed by a
+    unique per-request suffix; Poisson arrivals at ``rate`` req/s. This is
+    the production shape prefix caching targets — N tenants of one
+    application, one template, distinct questions."""
+    rng = np.random.RandomState(seed)
+    prefix = np.random.RandomState(
+        seed if prefix_seed is None else prefix_seed).randint(
+        0, cfg.vocab_size, prefix_len).astype(np.int32)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    out = []
+    for t in arrivals:
+        slen = int(rng.randint(min_suffix, max_suffix + 1))
+        suffix = rng.randint(0, cfg.vocab_size, slen).astype(np.int32)
+        out.append(_Req(float(t), np.concatenate([prefix, suffix]),
+                        int(rng.randint(min_new, max_new + 1))))
     return out
 
 
@@ -176,15 +214,26 @@ def run_engine(model, stream, engine=None, **engine_kwargs):
     def _r(v):
         return round(v, 2) if v is not None else None
 
+    prompt_tokens = sum(len(r.prompt) for r in stream)
     return dict(outputs=outs, wall_s=round(wall, 4),
                 tokens_per_sec=round(gen_tokens / wall, 1),
-                gen_tokens=gen_tokens,
+                # effective throughput counts PROMPT tokens served too —
+                # the number prefix sharing moves (shared prefixes are
+                # served without recomputing them)
+                effective_tokens_per_sec=round(
+                    (gen_tokens + prompt_tokens) / wall, 1),
+                gen_tokens=gen_tokens, prompt_tokens=prompt_tokens,
                 decode_compiles_in_window=row.get("compiles", 0) - compiles0,
                 engine_steps=stats["steps"] - steps0,
                 evictions=em["evictions"],
                 admitted=em["admitted"],
                 queued_on_exhaustion=em["queued_on_exhaustion"],
                 blocks_high_water=stats["blocks_high_water"],
+                prefix_blocks_reused=em["prefix_blocks_reused"],
+                prefill_chunks=em["prefill_chunks"],
+                spec_accept_ratio=(round(em["spec_accept_ratio"], 4)
+                                   if em["spec_accept_ratio"] is not None
+                                   else None),
                 ttft_p50_ms=_r(em["ttft_ms"]["p50"]),
                 ttft_p99_ms=_r(em["ttft_ms"]["p99"]),
                 itl_p50_ms=_r(em["itl_ms"]["p50"]),
@@ -250,11 +299,275 @@ def run_ab(cfg=None, stream_kwargs=None, engine_kwargs=None, *, tiny=True,
     )
 
 
+def _warm_engine(model, stream, **engine_kwargs):
+    """Compile every shape one engine arm will hit by replaying a
+    DISJOINT warm stream (same shape set, different token content and
+    prefix identity) — compiles warm, the prefix cache does NOT: the
+    timed window's leader request genuinely prefills its prefix once."""
+    from paddle_tpu.inference.serving import LLMEngine, SamplingParams
+
+    eng = LLMEngine(model, **engine_kwargs)
+    for req in stream:
+        eng.add_request(req.prompt, SamplingParams(max_new_tokens=req.max_new))
+    for _ in eng.stream():
+        pass
+    return eng
+
+
+def _bit_exact(a_outs, b_outs):
+    return (len(a_outs) == len(b_outs) and all(
+        x.shape == y.shape and (x == y).all()
+        for x, y in zip(a_outs, b_outs)))
+
+
+def shared_prefix_sizing(tiny):
+    import dataclasses as _dc
+
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        # a deeper/wider tiny so chunk COMPUTE (what sharing avoids)
+        # dominates the per-step dispatch overhead even on a loaded CI box
+        cfg = _dc.replace(llama_tiny(), hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=4)
+        stream = dict(n=12, rate=400.0, prefix_len=192, min_suffix=2,
+                      max_suffix=6, min_new=1, max_new=2)
+        engine = dict(num_blocks=320, block_size=8, max_batch_size=8,
+                      max_prefills_per_step=2)
+    else:
+        cfg = llama_small()
+        stream = dict(n=48, rate=200.0, prefix_len=512, min_suffix=16,
+                      max_suffix=64, min_new=16, max_new=48)
+        engine = dict(num_blocks=1024, block_size=16, max_batch_size=8,
+                      max_prefills_per_step=2)
+    return cfg, stream, engine
+
+
+def run_shared_prefix_ab(tiny=True, seed=0, repeat=1):
+    """Prefix-cache A/B (ISSUE 11): ONE seeded shared-prefix multi-tenant
+    stream through two engine arms over the same weights — sharing OFF
+    (every request prefills its whole prompt) vs sharing ON (followers
+    acquire the leader's full prefix blocks and prefill only their
+    suffix). Greedy outputs must be bit-exact across arms; the win is
+    reported as EFFECTIVE (prompt+generated) tokens/s, since prompt
+    tokens served from shared blocks are exactly the work avoided.
+    ``repeat`` replays the window N times per arm and reports each arm's
+    best-throughput run (min-of-N against transient host load)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, stream_kwargs, engine_kwargs = shared_prefix_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = shared_prefix_stream(cfg, seed=seed, **stream_kwargs)
+    warm = shared_prefix_stream(cfg, seed=seed + 1, prefix_seed=seed + 2,
+                                **stream_kwargs)
+    engines = {}
+    runs = {"no_sharing": [], "sharing": []}
+    try:
+        for arm, share in (("no_sharing", False), ("sharing", True)):
+            engines[arm] = _warm_engine(model, warm,
+                                        enable_prefix_cache=share,
+                                        **engine_kwargs)
+        for _ in range(max(int(repeat), 1)):
+            for arm in ("no_sharing", "sharing"):
+                runs[arm].append(
+                    run_engine(model, stream, engine=engines[arm]))
+    finally:
+        for eng in engines.values():
+            eng.close()
+    res = {arm: max(rs, key=lambda r: r["effective_tokens_per_sec"])
+           for arm, rs in runs.items()}
+    bit_exact = all(
+        _bit_exact(runs["no_sharing"][0]["outputs"], r["outputs"])
+        for rs in runs.values() for r in rs)
+    bs = engine_kwargs["block_size"]
+    full_blocks = sum(len(r.prompt) // bs for r in stream)
+    reused = res["sharing"]["prefix_blocks_reused"]
+    out = dict(
+        no_sharing={k: v for k, v in res["no_sharing"].items()
+                    if k != "outputs"},
+        sharing={k: v for k, v in res["sharing"].items()
+                 if k != "outputs"},
+        speedup=round(res["sharing"]["effective_tokens_per_sec"]
+                      / res["no_sharing"]["effective_tokens_per_sec"], 3),
+        prefix_hit_ratio=round(reused / max(full_blocks, 1), 3),
+        repeats=max(int(repeat), 1),
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+        prefix_len=stream_kwargs["prefix_len"],
+    )
+    return out
+
+
+def chunked_sizing(tiny):
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        import dataclasses as _dc
+
+        # long-prompt mix: a background of short decode-heavy requests
+        # with long prompts landing mid-stream to stall them. The
+        # positions cap is raised so the long prompts are long enough
+        # that an unchunked prefill stall dwarfs host-load noise.
+        cfg = _dc.replace(llama_tiny(), max_position_embeddings=1024)
+        stream = dict(n=12, rate=300.0, min_prompt=4, max_prompt=12,
+                      min_new=24, max_new=40)
+        long_prompts = dict(every=3, length=768)
+        engine = dict(num_blocks=512, block_size=8, max_batch_size=8,
+                      max_prefills_per_step=1)
+        budget = 128
+    else:
+        cfg = llama_small()
+        stream = dict(n=32, rate=150.0, min_prompt=16, max_prompt=64,
+                      min_new=64, max_new=128)
+        long_prompts = dict(every=4, length=1024)
+        engine = dict(num_blocks=1024, block_size=16, max_batch_size=8,
+                      max_prefills_per_step=1)
+        budget = 128
+    return cfg, stream, long_prompts, engine, budget
+
+
+def long_prompt_stream(cfg, stream_kwargs, long_prompts, seed=0):
+    """Poisson mix where every ``every``-th request carries a
+    ``length``-token prompt — the workload whose unchunked prefill stalls
+    every in-flight token stream."""
+    stream = request_stream(cfg, seed=seed, **stream_kwargs)
+    rng = np.random.RandomState(seed + 7)
+    for i in range(0, len(stream), long_prompts["every"]):
+        stream[i] = _Req(stream[i].arrival,
+                         rng.randint(0, cfg.vocab_size,
+                                     long_prompts["length"]).astype(np.int32),
+                         stream[i].max_new)
+    return stream
+
+
+def run_chunked_ab(tiny=True, seed=0, repeat=1):
+    """Chunked-prefill A/B (ISSUE 11): the same long-prompt mix through an
+    unchunked arm (whole prompts in one step — in-flight decodes stall for
+    the full prefill) and a chunked arm (``max_prefill_tokens_per_step``
+    budget interleaves prefill chunks with decode steps). Decode ITL p99
+    is the ENGINE-OWNED histogram (``serving_itl_ms``), so the comparison
+    measures exactly the stall the chunk budget bounds. Outputs must be
+    bit-exact across arms. ``repeat`` replays the window N times per arm
+    and reports each arm's best-throughput run — the standard min-of-N
+    defense against transient host-load spikes polluting one arm."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, stream_kwargs, long_prompts, engine_kwargs, budget = \
+        chunked_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = long_prompt_stream(cfg, stream_kwargs, long_prompts, seed=seed)
+    warm = long_prompt_stream(cfg, stream_kwargs, long_prompts,
+                              seed=seed + 1)
+    engines = {}
+    runs = {"unchunked": [], "chunked": []}
+    try:
+        for arm, b in (("unchunked", None), ("chunked", budget)):
+            engines[arm] = _warm_engine(
+                model, warm, max_prefill_tokens_per_step=b, **engine_kwargs)
+        for _ in range(max(int(repeat), 1)):
+            for arm in ("unchunked", "chunked"):
+                runs[arm].append(
+                    run_engine(model, stream, engine=engines[arm]))
+    finally:
+        for eng in engines.values():
+            eng.close()
+    res = {arm: max(rs, key=lambda r: r["tokens_per_sec"])
+           for arm, rs in runs.items()}
+    # each arm's cleanest (least load-polluted) latency observation: noise
+    # only ever INFLATES a p99, so per-arm min across repeats is the
+    # honest structural number
+    itl = {arm: min(r["itl_p99_ms"] for r in rs if r["itl_p99_ms"])
+           for arm, rs in runs.items()}
+    bit_exact = all(
+        _bit_exact(runs["unchunked"][0]["outputs"], r["outputs"])
+        for rs in runs.values() for r in rs)
+    return dict(
+        unchunked={k: v for k, v in res["unchunked"].items()
+                   if k != "outputs"},
+        chunked={k: v for k, v in res["chunked"].items()
+                 if k != "outputs"},
+        itl_p99_ms={"unchunked": itl["unchunked"],
+                    "chunked": itl["chunked"]},
+        itl_p99_ratio=round(itl["chunked"] / max(itl["unchunked"], 1e-9),
+                            3),
+        tokens_per_sec_ratio=round(
+            res["chunked"]["tokens_per_sec"]
+            / res["unchunked"]["tokens_per_sec"], 3),
+        chunk_budget=budget,
+        repeats=max(int(repeat), 1),
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+    )
+
+
+def run_spec_ab(tiny=True, seed=0, spec_tokens=3, draft="self"):
+    """Speculative-decoding A/B (ISSUE 11): the same Poisson stream
+    through a plain greedy arm and a speculative arm (draft proposes
+    ``spec_tokens``, one multi-query verify scores them all). Outputs must
+    be bit-exact — speculation changes WHEN tokens are produced, never
+    WHICH. ``draft='self'`` uses the target model as its own draft
+    (accept ratio 1.0 — the machinery's upper bound; a production draft
+    is a distilled smaller llama, which only changes the ratio)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if draft == "self":
+        draft_model = model
+    else:
+        import dataclasses as _dc
+
+        paddle.seed(seed + 13)
+        draft_model = LlamaForCausalLM(
+            _dc.replace(cfg, num_hidden_layers=1))
+        draft_model.eval()
+    stream = request_stream(cfg, seed=seed, **stream_kwargs)
+    warm = request_stream(cfg, seed=seed + 1, **stream_kwargs)
+    res = {}
+    for arm, dm in (("plain", None), ("spec", draft_model)):
+        kw = dict(engine_kwargs)
+        if dm is not None:
+            kw.update(draft_model=dm, spec_tokens=spec_tokens)
+        eng = _warm_engine(model, warm, **kw)
+        try:
+            res[arm] = run_engine(model, stream, engine=eng)
+        finally:
+            eng.close()
+    bit_exact = _bit_exact(res["plain"]["outputs"], res["spec"]["outputs"])
+    return dict(
+        plain={k: v for k, v in res["plain"].items() if k != "outputs"},
+        spec={k: v for k, v in res["spec"].items() if k != "outputs"},
+        speedup=round(res["spec"]["tokens_per_sec"]
+                      / res["plain"]["tokens_per_sec"], 3),
+        spec_accept_ratio=res["spec"]["spec_accept_ratio"],
+        spec_tokens=spec_tokens,
+        draft=draft,
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", default="poisson",
+                    choices=["poisson", "shared-prefix", "chunked", "spec"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--spec-tokens", type=int, default=3)
+    ap.add_argument("--draft", default="self", choices=["self", "tiny"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--tiny", action="store_true",
                     help="CPU smoke sizing (llama_tiny)")
@@ -268,6 +581,27 @@ def main():
             tiny = jax.default_backend() in ("cpu",)
         except Exception:
             tiny = True
+
+    if args.workload == "shared-prefix":
+        res = run_shared_prefix_ab(tiny=tiny, seed=args.seed)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: sharing arm diverges from no-sharing greedy")
+        return
+    if args.workload == "chunked":
+        res = run_chunked_ab(tiny=tiny, seed=args.seed)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: chunked arm diverges from unchunked greedy")
+        return
+    if args.workload == "spec":
+        res = run_spec_ab(tiny=tiny, seed=args.seed,
+                          spec_tokens=args.spec_tokens, draft=args.draft)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: speculative arm diverges from plain greedy")
+        return
+
     cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)
     if args.requests is not None:
         stream_kwargs["n"] = args.requests

@@ -16,6 +16,14 @@ wire it after the bench run so a regressing round cannot land silently.
 The fast test in tests/test_perf_tools.py runs these checks on the
 repo's committed artifacts (tier-1), so the tripwire itself cannot rot.
 
+**Platform grouping** (ISSUE 11 / BENCH_r06 re-anchor): an artifact may
+carry a top-level ``"platform"`` field ("tpu" when absent — r01–r05
+predate it). Rounds are compared WITHIN a platform: the CPU-smoke
+trajectory (r06+, cpu metric names like ``serving_cpu_engine_…``)
+anchors and guards its own history without reading the TPU rounds'
+metrics as "vanished", and vice versa — each platform's LATEST round is
+checked against that platform's prior rounds.
+
 **Multichip strategy-parity tripwire** (ISSUE 8 satellite): the LATEST
 ``MULTICHIP_r*.json`` artifact's dryrun lines are checked too. Since the
 plan rewrite the dryrun prints ``PLAN <strategy> loss=<x>
@@ -51,7 +59,9 @@ sys.path.insert(0, _REPO)
 def load_rounds(dirpath):
     """{round number: {metric: record}} from every BENCH_r*.json (each
     artifact stores the bench run's stdout tail: one JSON line per
-    workload)."""
+    workload). Each record is stamped with the artifact's top-level
+    ``platform`` ("tpu" when absent) so :func:`check` can compare rounds
+    within a platform."""
     rounds = {}
     for path in sorted(glob.glob(os.path.join(dirpath, "BENCH_r*.json"))):
         m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
@@ -61,6 +71,7 @@ def load_rounds(dirpath):
             data = json.load(open(path))
         except Exception:
             continue
+        platform = str(data.get("platform", "tpu"))
         recs = {}
         for line in str(data.get("tail", "")).splitlines():
             line = line.strip()
@@ -71,6 +82,7 @@ def load_rounds(dirpath):
             except Exception:
                 continue
             if rec.get("metric") and rec.get("value"):
+                rec.setdefault("platform", platform)
                 recs[rec["metric"]] = rec
         if recs:
             rounds[int(m.group(1))] = recs
@@ -89,9 +101,27 @@ def default_floors():
 
 
 def check(rounds, ratio=0.95, floors=None):
-    """Failure strings for the latest round (empty == all clear)."""
+    """Failure strings across platforms: each platform's latest round is
+    checked against that platform's prior rounds (empty == all clear).
+    Records without a ``platform`` stamp group under "tpu", so synthetic
+    single-platform histories behave exactly as before."""
     if not rounds:
         return ["FAIL: no BENCH_r*.json artifacts found"]
+    by_platform = {}
+    for rnd, recs in rounds.items():
+        for metric, rec in recs.items():
+            plat = rec.get("platform", "tpu")
+            by_platform.setdefault(plat, {}).setdefault(rnd, {})[
+                metric] = rec
+    failures = []
+    for plat in sorted(by_platform):
+        failures += _check_one_platform(by_platform[plat], ratio=ratio,
+                                        floors=floors)
+    return failures
+
+
+def _check_one_platform(rounds, ratio=0.95, floors=None):
+    """Single-platform round history check (the pre-ISSUE-11 logic)."""
     floors = dict(default_floors() if floors is None else floors)
     latest = max(rounds)
     prev_rounds = sorted((r for r in rounds if r < latest), reverse=True)

@@ -143,6 +143,28 @@ class TestBenchRegression:
         rounds = _rounds(r1={"m": 100.0}, r2={"m": 90.0})
         fails = cbr.check(rounds, ratio=0.95, floors={})
         assert len(fails) == 1 and "m" in fails[0]
+
+    def test_platform_grouping_isolates_trajectories(self):
+        """ISSUE 11 re-anchor: a CPU round appearing after TPU rounds
+        must not read the TPU metrics as vanished (and vice versa);
+        each platform's latest round anchors its own history."""
+        _scripts()
+        import check_bench_regression as cbr
+
+        rounds = _rounds(r5={"m_tpu": 100.0}, r6={"m_cpu": 50.0})
+        for rec in rounds[6].values():
+            rec["platform"] = "cpu"
+        assert cbr.check(rounds, floors={}) == []
+        # a later cpu round regressing vs the cpu anchor still fails
+        rounds[7] = {"m_cpu": {"metric": "m_cpu", "value": 40.0,
+                               "platform": "cpu"}}
+        fails = cbr.check(rounds, floors={})
+        assert len(fails) == 1 and "m_cpu" in fails[0]
+        # and a cpu metric vanishing from the latest cpu round fails too
+        rounds[7] = {"other_cpu": {"metric": "other_cpu", "value": 1.0,
+                                   "platform": "cpu"}}
+        fails = cbr.check(rounds, floors={})
+        assert any("m_cpu" in f and "missing" in f for f in fails)
         assert cbr.check(_rounds(r1={"m": 100.0}, r2={"m": 96.0}),
                          floors={}) == []
 
