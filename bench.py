@@ -633,6 +633,49 @@ def bench_serving(on_tpu):
                          "served (shared blocks are the avoided work); "
                          "greedy outputs bit-exact across arms",
     })
+    # fleet scaling A/B (ISSUE 12): 1-replica vs N-replica subprocess
+    # fleets behind the same Router/RPC path, so the tracked line is pure
+    # replica parallelism — the ROADMAP item 1 tokens/s-scaling evidence,
+    # guarded by the per-platform regression tripwire from the next round.
+    # ALWAYS the CPU smoke, even on a TPU box: ReplicaSupervisor pins
+    # replica subprocesses to the CPU backend (N processes cannot share
+    # one accelerator), so the reference engine must run on CPU too —
+    # bit-exactness is a within-backend guarantee — and labeling the line
+    # as a TPU metric would misrepresent CPU throughput. A TPU-replica
+    # fleet line lands with the sharded-replica work (ROADMAP item 1
+    # remainder). The A/B runs in a CPU SUBPROCESS: this process may
+    # already hold the TPU backend, and jax backends are process-wide.
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [_sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts", "bench_serving.py"),
+         "--workload", "fleet", "--fleet", "3", "--tiny"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, f"fleet A/B failed: {r.stderr[-2000:]}"
+    fl = _json.loads(r.stdout)
+    assert fl["bit_exact"], \
+        "fleet diverged from the in-process engine greedy reference"
+    _emit({
+        "metric": "serving_cpu_fleet_tokens_per_sec",
+        "value": fl["fleet"]["tokens_per_sec"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "tokens_per_sec_single_replica": fl["single"]["tokens_per_sec"],
+        "fleet_scaling": fl["scaling"],
+        "n_replicas": fl["n_replicas"],
+        "redispatches": fl["fleet"]["redispatches"],
+        "bit_exact": fl["bit_exact"],
+        "num_requests": fl["num_requests"],
+        "baseline_note": "one seeded Poisson burst through 1-replica vs "
+                         "N-replica subprocess fleets (same Router/RPC "
+                         "path in both arms, CPU replicas by design); "
+                         "outputs bit-exact vs the in-process CPU "
+                         "engine",
+    })
 
 
 def make_llama(on_tpu):

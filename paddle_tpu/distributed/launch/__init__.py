@@ -12,6 +12,13 @@ children (fault-tolerance = kill-all + relaunch, the reference's
 FAULT_TOLERANCE elastic level; checkpoint-resume does the rest).
 """
 
+from .controllers.collective import (  # noqa: F401
+    CrashLoopError, RestartBudget,
+)
 from .main import main  # noqa: F401
 
-__all__ = ["main"]
+# RestartBudget/CrashLoopError are exported here because supervision is
+# no longer training-only: the serving fleet's ReplicaSupervisor
+# (inference.serving.fleet) reuses the same leaky-bucket budget, backoff
+# and crash-loop semantics — one supervision vocabulary for both sides.
+__all__ = ["main", "RestartBudget", "CrashLoopError"]

@@ -8,6 +8,10 @@ and the ``LLMEngine`` front-end (``engine``). See DESIGN_DECISIONS.md
 "Paged KV cache & continuous batching" and the README serving recipe.
 """
 
+from .errors import (  # noqa: F401
+    EngineClosedError, FleetOverloadedError, ReplicaCrashLoopError,
+    RequestTimeoutError,
+)
 from .kv_cache import BlockAllocator, PagedKVCache, PrefixCache  # noqa: F401
 from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
 from .paged_attention import (  # noqa: F401
@@ -17,10 +21,13 @@ from .engine import (  # noqa: F401
     LLMEngine, StepOutput, is_llama_artifact, load_llama_artifact,
     save_llama_artifact,
 )
+from . import fleet  # noqa: F401  (fleet.Router — the ISSUE-12 layer)
 
 __all__ = [
     "BlockAllocator", "PagedKVCache", "PrefixCache", "Request",
     "SamplingParams", "Scheduler", "paged_decode_attention",
     "paged_multiquery_attention", "LLMEngine", "StepOutput",
     "save_llama_artifact", "load_llama_artifact", "is_llama_artifact",
+    "fleet", "RequestTimeoutError", "FleetOverloadedError",
+    "EngineClosedError", "ReplicaCrashLoopError",
 ]

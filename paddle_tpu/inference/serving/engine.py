@@ -54,13 +54,15 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
+from .errors import EngineClosedError, RequestTimeoutError
 from .kv_cache import PagedKVCache, PrefixCache
 from .scheduler import (Request, SamplingParams, Scheduler,
                         _M_ADMITTED, _M_COW, _M_EVICTIONS, _M_FINISHED,
                         _M_PREFIX_REUSED, _M_QUEUED_EXH)
 
 __all__ = ["LLMEngine", "StepOutput", "save_llama_artifact",
-           "load_llama_artifact"]
+           "load_llama_artifact", "EngineClosedError",
+           "RequestTimeoutError"]
 
 # engine-owned latency/utilization observability (ISSUE 10): TTFT and
 # inter-token latency are recorded HERE, from host timestamps the engine
@@ -98,6 +100,11 @@ _G_KV_UTIL = _obs_metrics.gauge(
 _G_OCCUPANCY = _obs_metrics.gauge(
     "serving_decode_batch_occupancy",
     "fraction of decode slots occupied after the last step")
+_M_DEADLINE = _obs_metrics.counter(
+    "serving_deadline_expired_total",
+    "requests aborted by the engine because their deadline expired "
+    "(admission-time rejections raise before a request exists and are "
+    "not counted here)")
 
 # the ONE list of every serving metric handle an engine instance owns —
 # metrics() and reset_metrics() both iterate it, so a new metric cannot
@@ -106,8 +113,8 @@ _G_OCCUPANCY = _obs_metrics.gauge(
 _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
                     _M_PREFIX_REUSED, _M_COW, _M_PREFILLS,
                     _M_PREFILL_CHUNKS, _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED,
-                    _M_TOKENS, _H_TTFT, _H_ITL, _G_SPEC_RATIO, _G_KV_UTIL,
-                    _G_OCCUPANCY)
+                    _M_TOKENS, _M_DEADLINE, _H_TTFT, _H_ITL, _G_SPEC_RATIO,
+                    _G_KV_UTIL, _G_OCCUPANCY)
 
 
 @dataclasses.dataclass
@@ -340,9 +347,17 @@ class LLMEngine:
         self._tables_version = None
         self._tables_dev = None
         self._requests: dict[int, Request] = {}
+        self._closed = False
         self._ingest = (_IngestThread(self._stage_request, self._name)
                         if ingest_async else None)
         self.stats_extra = {"steps": 0, "prefills": 0, "tokens_out": 0}
+
+    def _ensure_open(self):
+        if self._closed:
+            raise EngineClosedError(
+                f"{self._name} is closed; create a new LLMEngine "
+                "(close() joined the ingest thread, freed scheduler "
+                "blocks and removed this instance's metric series)")
 
     # ------------------------------------------------------------------
     # request lifecycle
@@ -370,10 +385,25 @@ class LLMEngine:
         req._staged = (jax.device_put(ids), bucket, len(toks))
 
     def add_request(self, prompt_ids, sampling: SamplingParams | None = None,
-                    arrival_t=None):
+                    arrival_t=None, deadline=None):
         """Enqueue a prompt; returns the request id. Never blocks on pool
-        exhaustion — the request queues until blocks free up."""
-        req = Request(prompt_ids, sampling, arrival_t=arrival_t)
+        exhaustion — the request queues until blocks free up.
+
+        ``deadline`` is an absolute ``time.time()`` wall-clock deadline
+        (ISSUE 12): an already-expired deadline raises
+        :class:`RequestTimeoutError` HERE — before the request is
+        registered, staged, or any allocator/scheduler state moves — and
+        a deadline expiring later aborts the request at the next step
+        (blocks freed, slot recycled, stream finished with reason
+        ``"timeout"``)."""
+        self._ensure_open()
+        if deadline is not None and time.time() >= float(deadline):
+            raise RequestTimeoutError(
+                f"deadline {deadline} already expired at admission "
+                f"(now={time.time():.3f}); request rejected before any "
+                "block allocation", deadline=deadline)
+        req = Request(prompt_ids, sampling, arrival_t=arrival_t,
+                      deadline=deadline)
         if self._spec_k and req.sampling.do_sample:
             raise ValueError(
                 "speculative decoding is greedy-only (the verify step "
@@ -436,7 +466,40 @@ class LLMEngine:
                              "finished requests can be released")
         del self._requests[rid]
 
+    def cancel(self, rid, reason="cancelled"):
+        """Abort a live request: blocks freed (decref under sharing), its
+        decode slot recycled for the next admission, and the request
+        finishes with ``finish_reason() == reason``. No-op on unknown or
+        already-finished ids (cancellation races are benign). Returns
+        True when a live request was actually aborted."""
+        req = self._requests.get(rid)
+        if req is None or req.finished:
+            return False
+        self._abort(req, reason)
+        return True
+
+    def _abort(self, req, reason):
+        self.scheduler.abort(req, reason)
+        if reason == "timeout":
+            _M_DEADLINE.inc(instance=self._name)
+
+    def _expire_deadlines(self, outputs):
+        """Abort every queued/running request whose deadline has passed
+        (checked once per step, BEFORE admission and decode, so an
+        expired request never takes blocks it is about to release). Each
+        expiry emits a final ``StepOutput`` (token ``-1``, finished,
+        reason ``"timeout"``) so stream consumers see the typed end of
+        the partial stream."""
+        now = time.time()
+        for req in (list(self.scheduler.waiting)
+                    + list(self.scheduler.running)):
+            if req.deadline is not None and now >= req.deadline:
+                self._abort(req, "timeout")
+                outputs.append(StepOutput(req.rid, -1, True, "timeout"))
+
     def has_work(self):
+        if self._closed:
+            return False
         if self._ingest is not None and self._ingest.pending:
             return True
         return self.scheduler.has_work()
@@ -862,6 +925,7 @@ class LLMEngine:
         decode-ready slots. Returns the ``StepOutput`` tokens produced."""
         import jax.numpy as jnp
 
+        self._ensure_open()
         if self._decode_jit is None:
             self._build_jits()
         sched = self.scheduler
@@ -869,10 +933,19 @@ class LLMEngine:
             # block (briefly) only when the scheduler would otherwise spin
             # empty while requests are in flight on the ingest thread
             for req in self._ingest.drain(wait=not sched.has_work()):
+                # a request cancelled/expired while still on the ingest
+                # thread is already FINISHED — queueing it would let
+                # pick_prefills admit a dead request
+                if req.finished:
+                    continue
                 if not hasattr(req, "_staged"):  # ingest thread died
                     self._stage_request(req)
                 sched.waiting.append(req)
         outputs = []
+        # deadline scan BEFORE admission/decode: an expired request must
+        # never be admitted or decoded one last time, and its freed
+        # blocks/slot are available to this very step's admissions
+        self._expire_deadlines(outputs)
         if not sched.has_work():
             return outputs
         self.stats_extra["steps"] += 1
@@ -1082,17 +1155,48 @@ class LLMEngine:
                            req.finish_reason() if done else None)]
 
     def stream(self):
-        """Yield ``StepOutput`` s until the engine drains."""
+        """Yield ``StepOutput`` s until the engine drains. Raises
+        :class:`EngineClosedError` (instead of silently yielding nothing
+        or hanging on a joined ingest thread) when the engine is
+        closed."""
+        self._ensure_open()
         while self.has_work():
             yield from self.step()
 
-    def generate(self, prompts, sampling: SamplingParams | None = None):
+    def generate(self, prompts, sampling: SamplingParams | None = None,
+                 deadline=None):
         """Convenience batch API: submit every prompt, run to completion,
-        return the full token arrays (prompt + generated) in order."""
-        rids = [self.add_request(p, dataclasses.replace(sampling)
-                                 if sampling else None) for p in prompts]
+        return the full token arrays (prompt + generated) in order.
+        With ``deadline`` set, a request the deadline kills raises
+        :class:`RequestTimeoutError` after the batch drains (partial
+        outputs are only reachable through ``stream()``)."""
+        self._ensure_open()
+        rids = []
+        try:
+            for p in prompts:
+                rids.append(self.add_request(
+                    p, dataclasses.replace(sampling) if sampling else None,
+                    deadline=deadline))
+        except BaseException:
+            # a mid-batch admission failure (e.g. the deadline expiring
+            # between prompts) must not orphan the already-admitted
+            # requests in the queue — they would decode to completion on
+            # the NEXT stream() and leak bookkeeping forever
+            for r in rids:
+                self.cancel(r)
+                self.release(r)
+            raise
         for _ in self.stream():
             pass
+        timed_out = [r for r in rids
+                     if self._requests[r].abort_reason == "timeout"]
+        if timed_out:
+            for r in rids:
+                self.release(r)
+            raise RequestTimeoutError(
+                f"{len(timed_out)} of {len(rids)} requests hit the "
+                f"deadline mid-generation: rids {timed_out}",
+                rid=timed_out[0], deadline=deadline)
         outs = [self.output_tokens(r) for r in rids]
         for r in rids:
             self.release(r)
@@ -1177,6 +1281,7 @@ class LLMEngine:
             "spec_accept_ratio": (
                 float(_G_SPEC_RATIO.value(instance=inst)) if prop
                 else None),
+            "deadline_expired": int(_M_DEADLINE.value(instance=inst)),
             "tokens_out": int(_M_TOKENS.value(instance=inst)),
             "ttft_ms": _H_TTFT.summary(instance=inst),
             "itl_ms": _H_ITL.summary(instance=inst),
@@ -1200,8 +1305,30 @@ class LLMEngine:
         alloc.high_water = (self.cache.num_blocks - 1) - alloc.num_free
 
     def close(self):
+        """Tear the engine down (ISSUE 12 satellite, mirroring
+        ``DevicePrefetcher.close``): join the ingest thread, abort every
+        live request so the scheduler's blocks return to the allocator,
+        drop request bookkeeping, and remove THIS instance's registry
+        series — so a process that constructs engines in a loop (tests,
+        notebooks, a supervisor restarting replicas in-process) does not
+        grow the metrics registry forever. Idempotent; after close,
+        ``add_request``/``step``/``stream``/``generate`` raise
+        :class:`EngineClosedError` instead of hanging on the joined
+        ingest thread."""
+        if self._closed:
+            return
+        self._closed = True
         if self._ingest is not None:
             self._ingest.close()
+            # anything still staged on the (now joined) ingest thread
+            # was never admitted — no blocks to free, just bookkeeping
+            self._ingest.drain()
+        for req in list(self.scheduler.running):
+            self.scheduler.abort(req, "closed")
+        for req in list(self.scheduler.waiting):
+            self.scheduler.abort(req, "closed")
+        self._requests.clear()
+        self.reset_metrics()
         if self._was_training:
             self.model.train()
         if self.draft_model is not None and self._draft_was_training:

@@ -1,0 +1,63 @@
+"""Typed serving errors (ISSUE 12): the fault-tolerant fleet's contract
+is that NOTHING fails silently — a request that cannot be served gets a
+typed error naming why, and a fleet that cannot stay up raises instead
+of flapping forever.
+
+* :class:`RequestTimeoutError` — the request's deadline expired: at
+  admission (rejected before any allocator state moved) or mid-stream
+  (blocks freed, slot recycled, the partial stream ends with this).
+* :class:`FleetOverloadedError` — the router's bounded admission queue
+  is full; shedding with a typed error replaces unbounded queue growth.
+* :class:`EngineClosedError` — ``LLMEngine``/``Router`` used after
+  ``close()``: a typed raise instead of a hang on a dead ingest thread.
+* :class:`ReplicaCrashLoopError` — a replica exhausted its leaky-bucket
+  :class:`~paddle_tpu.distributed.launch.controllers.collective.RestartBudget`
+  (the SAME budget/backoff machinery training supervision uses); it
+  subclasses the launcher's ``CrashLoopError`` so one except-clause
+  handles crash loops from either side of the house.
+"""
+
+from __future__ import annotations
+
+from ...distributed.launch.controllers.collective import CrashLoopError
+
+__all__ = ["RequestTimeoutError", "FleetOverloadedError",
+           "EngineClosedError", "ReplicaCrashLoopError"]
+
+
+class RequestTimeoutError(TimeoutError):
+    """A request's deadline expired. ``rid`` names the request (None when
+    raised at admission before an id was assigned); ``deadline`` is the
+    absolute ``time.time()`` deadline that passed."""
+
+    def __init__(self, msg, rid=None, deadline=None):
+        super().__init__(msg)
+        self.rid = rid
+        self.deadline = deadline
+
+
+class FleetOverloadedError(RuntimeError):
+    """The fleet's bounded admission queue is full — the request was shed
+    at submit time (load shedding: a typed error now beats an unbounded
+    queue that times everyone out later). ``queue_depth`` records the
+    bound that was hit."""
+
+    def __init__(self, msg, queue_depth=None):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+
+
+class EngineClosedError(RuntimeError):
+    """The engine/router was used after ``close()``. Typed so servers can
+    distinguish a lifecycle bug from a serving failure."""
+
+
+class ReplicaCrashLoopError(CrashLoopError):
+    """One replica kept dying until its restart budget ran out
+    (``max_restarts`` within the rolling window). Carries the launcher
+    ``CrashLoopError`` fields (``exit_code``, ``restarts``) plus the
+    ``replica`` id, so the operator knows WHICH slot is poisoned."""
+
+    def __init__(self, msg, replica=None, exit_code=1, restarts=0):
+        super().__init__(msg, exit_code=exit_code, restarts=restarts)
+        self.replica = replica

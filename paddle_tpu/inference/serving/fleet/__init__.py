@@ -1,0 +1,26 @@
+"""paddle.inference.serving.fleet — fault-tolerant serving fleet
+(ISSUE 12).
+
+The layer above ``LLMEngine`` that the "millions of users" north star
+needs: N replica worker processes (``replica``) supervised PR-4-style
+(``supervisor``: heartbeats, hang watchdog with SIGTERM→SIGKILL
+escalation, leaky-bucket restart budget, checkpoint rejoin) behind a
+front-end ``Router`` (least-loaded + session-affinity dispatch,
+per-request deadlines, bounded admission with load shedding, redispatch
+of in-flight requests off dead replicas, graceful drain for zero-drop
+rolling updates). See DESIGN_DECISIONS.md "Serving fleet supervision &
+redispatch" and ``scripts/chaos_serve.py`` — the acceptance drill.
+"""
+
+from ..errors import (  # noqa: F401
+    EngineClosedError, FleetOverloadedError, ReplicaCrashLoopError,
+    RequestTimeoutError,
+)
+from .supervisor import ReplicaHandle, ReplicaSupervisor  # noqa: F401
+from .router import FleetRequest, Router  # noqa: F401
+
+__all__ = [
+    "Router", "FleetRequest", "ReplicaSupervisor", "ReplicaHandle",
+    "RequestTimeoutError", "FleetOverloadedError", "EngineClosedError",
+    "ReplicaCrashLoopError",
+]

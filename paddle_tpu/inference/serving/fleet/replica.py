@@ -1,0 +1,256 @@
+"""Replica worker process (ISSUE 12): one ``LLMEngine`` behind a
+line-JSON RPC loop, runnable as
+``python -m paddle_tpu.inference.serving.fleet.replica``.
+
+Config arrives in ``PADDLE_REPLICA_CONFIG`` (JSON: ``artifact`` path
+from :func:`~..engine.save_llama_artifact`, ``engine`` kwargs,
+``hb_dir`` heartbeat directory, optional ``ckpt_root``). Protocol
+(stdin commands → stdout events, one JSON object per line):
+
+  {"op":"submit","gid":g,"gen":k,"prompt":[...],"max_new":n,
+   "eos":t|null,"deadline":s|null}      -> tok events as tokens emerge
+  {"op":"cancel","gid":g}               -> blocks freed, slot recycled
+  {"op":"reload","root":path}           -> {"e":"reloaded","step":s}
+  {"op":"stats"}                        -> {"e":"stats",...}
+  {"op":"shutdown"}                     -> drain in-flight, {"e":"bye"}
+
+Events: ``ready`` (engine built, weights loaded — with the checkpoint
+step it rejoined from, when a ``ckpt_root`` was given), ``tok``
+(``{"gid","gen","toks":[...],"fin","reason"}``; ``gen`` echoes the
+dispatch generation so the router can drop emissions from a superseded
+assignment), ``load`` (kv-utilization / decode-occupancy after each
+step — the router's least-loaded signal), ``stats``, ``reloaded``,
+``bye``. stdout carries ONLY these lines; everything chatty goes to
+stderr (the supervisor routes it to a per-replica log file).
+
+Heartbeats (``distributed.launch.heartbeat.write`` — the PR-4 files)
+are written at every loop tick, engine-stepping or idle; the two chaos
+sites fire at the loop head:
+
+* ``serve.replica_crash`` — SIGKILL self (the OOM-killer/node-loss
+  shape; nothing is flushed, the supervisor must recover everything);
+* ``serve.replica_hang``  — wedge forever without heartbeating (the
+  stuck-collective shape; only the supervisor's watchdog can end it).
+
+Chaos arming is env-driven so drills can poison exactly one replica:
+``CHAOS_SERVE_SITE`` + ``CHAOS_SERVE_REPLICA`` + optional
+``CHAOS_SERVE_AFTER_STEPS`` — armed only in incarnation 0, so the
+respawned replica runs clean (the marker-file discipline of
+``chaos_train.py``, enforced by the incarnation counter instead).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+import time
+
+from .supervisor import ENV_CONFIG, ENV_ID, ENV_INCARNATION
+
+__all__ = ["replica_worker_main"]
+
+
+def _emit(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+# the armed inject() context manager must outlive _arm_chaos: a GC'd
+# contextmanager generator runs its finally block, silently DISARMING
+# the site — module-global keeps it alive for the process lifetime
+_CHAOS_CM = None
+
+
+def _arm_chaos(replica_id):
+    site = os.environ.get("CHAOS_SERVE_SITE")
+    if not site:
+        return
+    if os.environ.get("CHAOS_SERVE_REPLICA") != str(replica_id):
+        return
+    if int(os.environ.get(ENV_INCARNATION, "0") or 0) != 0:
+        return  # restarted incarnations run clean
+    from ....utils import fault_injection as fi
+
+    global _CHAOS_CM
+    after = int(os.environ.get("CHAOS_SERVE_AFTER_STEPS", "1") or 1)
+    # armed for the process lifetime (the fault ends this incarnation)
+    _CHAOS_CM = fi.inject(site, every_n=after)
+    _CHAOS_CM.__enter__()
+
+
+def replica_worker_main():
+    replica_id = int(os.environ[ENV_ID])
+    cfg = json.loads(os.environ[ENV_CONFIG])
+    _arm_chaos(replica_id)
+
+    import numpy as np
+
+    from ....distributed.launch import heartbeat as hb
+    from ....utils import fault_injection as fi
+    from ..engine import LLMEngine, load_llama_artifact
+    from ..errors import RequestTimeoutError
+    from ..scheduler import SamplingParams
+
+    model = load_llama_artifact(cfg["artifact"])
+    eng = LLMEngine(model, ingest_async=False, **cfg.get("engine") or {})
+    reloaded = None
+    root = cfg.get("ckpt_root")
+    if root:
+        # rejoin contract: a (re)started replica serves the newest
+        # healthy checkpoint, never the artifact's possibly-stale weights
+        from ....distributed.checkpoint.manager import CheckpointManager
+
+        mgr = CheckpointManager(root)
+        if (mgr.latest_healthy_step() is not None
+                or mgr.latest_valid_step() is not None):
+            reloaded = eng.reload_weights(mgr)
+    hb_dir = cfg.get("hb_dir")
+    hb.write(step=0, dir=hb_dir, rank=replica_id)
+    _emit({"e": "ready", "replica": replica_id,
+           "incarnation": int(os.environ.get(ENV_INCARNATION, "0") or 0),
+           "reloaded_step": reloaded})
+
+    cmd_q: queue.Queue = queue.Queue()
+
+    def _reader():
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                cmd_q.put(json.loads(line))
+            except ValueError:
+                continue
+        cmd_q.put({"op": "shutdown"})  # EOF: the router is gone
+
+    threading.Thread(target=_reader, daemon=True).start()
+
+    rid_of = {}   # gid -> engine rid
+    meta = {}     # gid -> {"gen": k}
+    steps = 0
+    shutting = False
+
+    def _handle(cmd):
+        nonlocal shutting
+        op = cmd.get("op")
+        if op == "submit":
+            gid = cmd["gid"]
+            try:
+                rid = eng.add_request(
+                    np.asarray(cmd["prompt"], np.int32),
+                    SamplingParams(max_new_tokens=int(cmd["max_new"]),
+                                   eos_token_id=cmd.get("eos")),
+                    deadline=cmd.get("deadline"))
+            except RequestTimeoutError:
+                _emit({"e": "tok", "gid": gid, "gen": cmd.get("gen", 0),
+                       "toks": [], "fin": True, "reason": "timeout"})
+                return
+            except Exception as ex:  # typed errors -> router surfaces
+                _emit({"e": "err", "gid": gid,
+                       "kind": type(ex).__name__, "msg": str(ex)})
+                return
+            rid_of[gid] = rid
+            meta[gid] = {"gen": cmd.get("gen", 0)}
+        elif op == "cancel":
+            gid = cmd["gid"]
+            rid = rid_of.get(gid)
+            if rid is not None:
+                eng.cancel(rid, reason=cmd.get("reason", "cancelled"))
+                # cancelled requests emit no fin event — drop the
+                # bookkeeping here or it grows for the server's life
+                rid_of.pop(gid, None)
+                meta.pop(gid, None)
+                eng.release(rid)
+        elif op == "reload":
+            from ....distributed.checkpoint.manager import CheckpointManager
+
+            step = eng.reload_weights(CheckpointManager(cmd["root"]))
+            _emit({"e": "reloaded", "replica": replica_id, "step": step})
+        elif op == "stats":
+            s = eng.stats()
+            _emit({"e": "stats", "replica": replica_id,
+                   "blocks_free": s["blocks_free"],
+                   "blocks_high_water": s["blocks_high_water"],
+                   "waiting": s["waiting"], "running": s["running"],
+                   "steps": s["steps"], "tokens_out": s["tokens_out"]})
+        elif op == "shutdown":
+            shutting = True
+
+    gid_by_rid = {}
+    # heartbeat/load-report throttles: an atomic file replace and a JSON
+    # line per ~1ms engine step is pure overhead — the watchdog judges
+    # in seconds and the router's load signal tolerates 100ms staleness
+    last_hb = [0.0]
+    last_load = [0.0]
+
+    def _beat():
+        now = time.monotonic()
+        if now - last_hb[0] >= 0.25:
+            last_hb[0] = now
+            hb.write(step=steps, dir=hb_dir, rank=replica_id)
+
+    while True:
+        # chaos probes count BUSY ticks only: a crash/hang while idle
+        # exercises nothing — the interesting failure is mid-serve, with
+        # in-flight requests for the router to recover
+        if eng.has_work():
+            if fi.should_fire("serve.replica_crash"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            if fi.should_fire("serve.replica_hang"):
+                while True:  # wedged: no heartbeat, no service, no exit
+                    time.sleep(3600)
+        try:
+            cmd = (cmd_q.get_nowait() if eng.has_work() or shutting
+                   else cmd_q.get(timeout=0.05))
+        except queue.Empty:
+            cmd = None
+        while cmd is not None:
+            _handle(cmd)
+            try:
+                cmd = cmd_q.get_nowait()
+            except queue.Empty:
+                cmd = None
+        if eng.has_work():
+            gid_by_rid = {rid: gid for gid, rid in rid_of.items()}
+            per_gid = {}
+            for out in eng.step():
+                gid = gid_by_rid.get(out.rid)
+                if gid is None:
+                    continue
+                rec = per_gid.setdefault(
+                    gid, {"toks": [], "fin": False, "reason": None})
+                if out.token >= 0:
+                    rec["toks"].append(int(out.token))
+                if out.finished:
+                    rec["fin"] = True
+                    rec["reason"] = out.finish_reason
+            for gid, rec in per_gid.items():
+                _emit({"e": "tok", "gid": gid, "gen": meta[gid]["gen"],
+                       "toks": rec["toks"], "fin": rec["fin"],
+                       "reason": rec["reason"]})
+                if rec["fin"]:
+                    rid = rid_of.pop(gid)
+                    meta.pop(gid, None)
+                    eng.release(rid)
+            now = time.monotonic()
+            if now - last_load[0] >= 0.1:
+                last_load[0] = now
+                m = eng.metrics()
+                _emit({"e": "load", "replica": replica_id,
+                       "kv": m["kv_block_utilization"] or 0.0,
+                       "occ": m["decode_batch_occupancy"] or 0.0,
+                       "waiting": len(eng.scheduler.waiting)})
+        steps += 1
+        _beat()
+        if shutting and not eng.has_work():
+            eng.close()
+            _emit({"e": "bye", "replica": replica_id})
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(replica_worker_main())

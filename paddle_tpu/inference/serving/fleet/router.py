@@ -1,0 +1,599 @@
+"""Fleet front-end router (ISSUE 12 tentpole, parts b + c).
+
+``Router`` dispatches requests over the replicas a
+:class:`~.supervisor.ReplicaSupervisor` keeps alive:
+
+* **Least-loaded placement** scored from router-tracked in-flight
+  counts plus each replica's self-reported ``metrics()`` gauges
+  (kv-block utilization + decode occupancy, the PR-10 load signal),
+  with **session affinity**: requests carrying the same ``session`` key
+  prefer the replica that served the session last, so a prefix-cached
+  replica keeps its warm blocks hot.
+* **Deadlines**: every request may carry ``deadline_s``; expiry is
+  checked at admission (an already-expired request is rejected with a
+  typed :class:`~..errors.RequestTimeoutError` before anything is
+  queued) and at every router tick for queued AND placed requests
+  (placed expiries also cancel on the replica, freeing its blocks).
+* **Load shedding**: the admission queue is bounded (``max_queue``);
+  a full queue sheds with a typed
+  :class:`~..errors.FleetOverloadedError` instead of growing without
+  bound — under overload, a fast typed no beats a slow timeout.
+* **Redispatch**: when a replica dies (crash or hang — the supervisor
+  reports it), its in-flight requests are replayed on a healthy
+  replica from their recorded prompt + already-emitted tokens (greedy
+  decode is deterministic, so the resumed stream is bit-identical —
+  the chaos drill asserts it against an undisturbed baseline). Token
+  events carry the dispatch *generation* and source replica; emissions
+  from a superseded assignment are dropped, so a slow-but-alive
+  replica can never double-emit into a redispatched stream.
+* **Graceful drain** (part c): ``drain(i)`` stops admission to a
+  replica, lets its in-flight requests finish, then runs the
+  ``then=`` action — ``"resume"``, ``"reload"`` (hot weight swap via
+  the worker's ``reload_weights``) or ``"retire"`` — giving zero-drop
+  rolling weight updates across the fleet.
+
+The router is single-threaded by design: all state mutates inside
+:meth:`step` (the pump), mirroring ``LLMEngine.step``. ``submit`` +
+``join``/``step`` + ``result`` is the whole client API.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+
+import numpy as np
+
+from ....observability import metrics as _obs_metrics
+from ....utils import fault_injection as _fi
+from ..errors import (EngineClosedError, FleetOverloadedError,
+                      RequestTimeoutError)
+from .supervisor import ReplicaSupervisor
+
+__all__ = ["Router", "FleetRequest"]
+
+_M_REDISPATCH = _obs_metrics.counter(
+    "fleet_redispatches_total",
+    "in-flight requests replayed on a healthy replica after their "
+    "replica died (or a dispatch failed)")
+_M_SHED = _obs_metrics.counter(
+    "fleet_requests_shed_total",
+    "requests rejected with FleetOverloadedError because the bounded "
+    "admission queue was full")
+_M_TIMEOUTS = _obs_metrics.counter(
+    "fleet_deadline_expired_total",
+    "requests finished with RequestTimeoutError by the router "
+    "(admission-time rejections included)")
+_G_QUEUE = _obs_metrics.gauge(
+    "fleet_queue_depth", "requests waiting in the router's admission "
+    "queue (bounded by max_queue)")
+_G_DRAINING = _obs_metrics.gauge(
+    "fleet_replicas_draining",
+    "replicas currently draining (no new placements)")
+
+QUEUED, PLACED, DONE, FAILED = "queued", "placed", "done", "failed"
+
+
+class FleetRequest:
+    """Router-side record of one request: the original prompt/sampling
+    (the redispatch replay source), emitted tokens so far, the absolute
+    deadline, and the current assignment (replica + generation)."""
+
+    __slots__ = ("gid", "prompt", "max_new", "eos", "deadline", "session",
+                 "state", "replica", "generation", "emitted", "error",
+                 "finish_reason", "t_submit", "t_first", "t_done",
+                 "redispatches")
+
+    def __init__(self, gid, prompt, max_new, eos, deadline, session):
+        self.gid = gid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.eos = eos
+        self.deadline = deadline
+        self.session = session
+        self.state = QUEUED
+        self.replica = None
+        self.generation = 0
+        self.emitted: list[int] = []
+        self.error = None
+        self.finish_reason = None
+        self.t_submit = time.perf_counter()
+        self.t_first = None
+        self.t_done = None
+        self.redispatches = 0
+
+    @property
+    def finished(self):
+        return self.state in (DONE, FAILED)
+
+    @property
+    def remaining(self):
+        return self.max_new - len(self.emitted)
+
+
+class Router:
+    """Fault-tolerant request dispatch over a replica fleet."""
+
+    _ids = itertools.count(1)
+    # session-affinity map bound (LRU eviction): affinity is a locality
+    # hint, so forgetting a cold session costs one prefix re-prefill —
+    # never correctness
+    MAX_SESSIONS = 4096
+
+    def __init__(self, supervisor=None, *, artifact=None, n_replicas=None,
+                 engine_kwargs=None, ckpt_root=None, max_queue=64,
+                 max_inflight_per_replica=None, session_affinity=True,
+                 hang_timeout_s=0.0, max_restarts=3, log_dir=None,
+                 env_extra=None, wait_ready=True):
+        self._name = f"fleet#{next(Router._ids)}"
+        engine_kwargs = dict(engine_kwargs or {})
+        if supervisor is None:
+            if artifact is None or n_replicas is None:
+                raise ValueError("pass either a supervisor or "
+                                 "artifact= + n_replicas=")
+            supervisor = ReplicaSupervisor(
+                n_replicas,
+                {"artifact": artifact, "engine": engine_kwargs,
+                 "ckpt_root": ckpt_root},
+                hang_timeout_s=hang_timeout_s, max_restarts=max_restarts,
+                log_dir=log_dir, env_extra=env_extra, instance=self._name)
+            if wait_ready:
+                try:
+                    supervisor.wait_ready()
+                except BaseException:
+                    supervisor.shutdown()  # never leak worker processes
+                    raise
+        self.supervisor = supervisor
+        self._ckpt_root = ckpt_root
+        self.max_queue = int(max_queue)
+        self.max_inflight_per_replica = int(
+            max_inflight_per_replica
+            or 2 * int(engine_kwargs.get("max_batch_size", 4) or 4))
+        self.session_affinity = bool(session_affinity)
+        self._reqs: dict[int, FleetRequest] = {}
+        self._queue: deque[FleetRequest] = deque()
+        self._inflight: dict[int, set] = {
+            h.id: set() for h in supervisor.handles}
+        self._load: dict[int, dict] = {}
+        self._sessions: dict = {}
+        self._draining: dict[int, dict] = {}
+        self.drains_completed = 0
+        self.reloads: list[tuple] = []  # (replica_id, checkpoint step)
+        self._gids = itertools.count(1)
+        self._closed = False
+        for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS):
+            m.inc(0, instance=self._name)
+        _G_QUEUE.set(0, instance=self._name)
+        _G_DRAINING.set(0, instance=self._name)
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    def submit(self, prompt, *, max_new=32, eos=None, deadline_s=None,
+               session=None):
+        """Admit a request; returns its fleet-wide id. Raises
+        :class:`RequestTimeoutError` when the deadline is already spent
+        and :class:`FleetOverloadedError` when the bounded queue is full
+        — in both cases NOTHING was queued or placed."""
+        if self._closed:
+            raise EngineClosedError(f"{self._name} is closed")
+        deadline = (time.time() + float(deadline_s)
+                    if deadline_s is not None else None)
+        if deadline is not None and time.time() >= deadline:
+            _M_TIMEOUTS.inc(instance=self._name)
+            raise RequestTimeoutError(
+                f"deadline_s={deadline_s} already expired at admission",
+                deadline=deadline)
+        if len(self._queue) >= self.max_queue:
+            _M_SHED.inc(instance=self._name)
+            raise FleetOverloadedError(
+                f"admission queue full ({self.max_queue} requests "
+                "waiting); shedding instead of queuing unboundedly",
+                queue_depth=len(self._queue))
+        req = FleetRequest(next(self._gids), prompt, max_new, eos,
+                           deadline, session)
+        self._reqs[req.gid] = req
+        self._queue.append(req)
+        _G_QUEUE.set(len(self._queue), instance=self._name)
+        return req.gid
+
+    def request(self, gid):
+        return self._reqs[gid]
+
+    def tokens(self, gid):
+        """Tokens emitted so far (partial results survive a stored
+        error — a deadline-killed stream keeps what it produced)."""
+        return list(self._reqs[gid].emitted)
+
+    def result(self, gid):
+        """Full prompt+generated array for a DONE request; re-raises the
+        stored typed error for a FAILED one."""
+        req = self._reqs[gid]
+        if req.error is not None:
+            raise req.error
+        if req.state != DONE:
+            raise RuntimeError(f"request {gid} is {req.state}")
+        return np.concatenate(
+            [req.prompt, np.asarray(req.emitted, np.int32)])
+
+    def release(self, gid):
+        req = self._reqs.get(gid)
+        if req is not None and not req.finished:
+            raise ValueError(f"request {gid} is {req.state}; only "
+                             "finished requests can be released")
+        self._reqs.pop(gid, None)
+
+    def pending(self):
+        return [r.gid for r in self._reqs.values() if not r.finished]
+
+    def inflight(self, replica_id):
+        """Request ids currently assigned to ``replica_id`` (the chaos
+        drill picks its SIGKILL victim by load)."""
+        return sorted(self._inflight.get(replica_id, ()))
+
+    def join(self, timeout=None, poll_s=0.005):
+        """Pump :meth:`step` until every submitted request finished."""
+        deadline = (time.time() + float(timeout)
+                    if timeout is not None else None)
+        while self.pending():
+            progressed = self.step()
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f"fleet join timed out with {len(self.pending())} "
+                    "requests unfinished")
+            if not progressed:
+                time.sleep(poll_s)
+
+    # ------------------------------------------------------------------
+    # the pump
+    # ------------------------------------------------------------------
+    def step(self):
+        """One router tick: consume replica events, recover deaths
+        (redispatch), enforce deadlines, place queued requests, advance
+        drains. Returns the number of events processed + placements made
+        (0 = nothing to do right now)."""
+        if self._closed:
+            raise EngineClosedError(f"{self._name} is closed")
+        progressed = 0
+        # 1. replica events (tokens, loads, ready/reloaded acks)
+        for h in list(self.supervisor.handles):
+            for ev in h.events():
+                progressed += 1
+                self._handle_event(h, ev)
+        # 2. supervision: deaths drain their final events first, then
+        #    their in-flight requests are replayed elsewhere
+        for death in self.supervisor.check():
+            progressed += 1
+            for ev in death["events"]:
+                self._handle_event_from(death["replica"], ev)
+            self._recover_replica(death["replica"])
+        # 3. deadlines (queued + placed)
+        self._expire_deadlines()
+        # 4. placement
+        progressed += self._place()
+        # 5. drains
+        self._advance_drains()
+        _G_QUEUE.set(len(self._queue), instance=self._name)
+        _G_DRAINING.set(len(self._draining), instance=self._name)
+        return progressed
+
+    # -- events ----------------------------------------------------------
+    def _handle_event(self, handle, ev):
+        self._handle_event_from(handle.id, ev)
+
+    def _handle_event_from(self, replica_id, ev):
+        kind = ev.get("e")
+        if kind == "tok":
+            req = self._reqs.get(ev.get("gid"))
+            if req is None or req.finished:
+                return
+            # dedup contract: accept only the CURRENT assignment — same
+            # replica AND same dispatch generation. A slow-but-alive
+            # replica still emitting a superseded copy is ignored.
+            if (req.state != PLACED or req.replica != replica_id
+                    or ev.get("gen") != req.generation):
+                return
+            for tok in ev.get("toks", ()):
+                if req.t_first is None:
+                    req.t_first = time.perf_counter()
+                req.emitted.append(int(tok))
+            if ev.get("fin"):
+                reason = ev.get("reason")
+                self._inflight[replica_id].discard(req.gid)
+                if reason == "timeout":
+                    self._fail(req, RequestTimeoutError(
+                        f"request {req.gid} hit its deadline mid-stream "
+                        f"on replica {replica_id}", rid=req.gid,
+                        deadline=req.deadline), reason)
+                else:
+                    req.state = DONE
+                    req.finish_reason = reason
+                    req.t_done = time.perf_counter()
+        elif kind == "load":
+            self._load[replica_id] = ev
+        elif kind == "err":
+            req = self._reqs.get(ev.get("gid"))
+            if req is not None and not req.finished:
+                self._inflight[replica_id].discard(req.gid)
+                self._fail(req, RuntimeError(
+                    f"replica {replica_id} rejected request {req.gid}: "
+                    f"{ev.get('kind')}: {ev.get('msg')}"), "error")
+        elif kind == "reloaded":
+            self.reloads.append((replica_id, ev.get("step")))
+            d = self._draining.get(replica_id)
+            if d is not None and d.get("state") == "reloading":
+                d["reloaded_step"] = ev.get("step")
+                self._finish_drain(replica_id)
+        # "ready"/"stats"/"bye" need no router action (ready flips the
+        # handle flag inside handle.events())
+
+    def _fail(self, req, error, reason):
+        req.state = FAILED
+        req.error = error
+        req.finish_reason = reason
+        req.t_done = time.perf_counter()
+        if isinstance(error, RequestTimeoutError):
+            _M_TIMEOUTS.inc(instance=self._name)
+
+    # -- death recovery --------------------------------------------------
+    def _recover_replica(self, replica_id):
+        """Requeue (at the FRONT, preserving age order) every in-flight
+        request of a dead replica for replay elsewhere. The replay
+        prompt is prompt + emitted-so-far; greedy determinism makes the
+        resumed stream bit-identical to an undisturbed one."""
+        gids = sorted(self._inflight.get(replica_id, ()))
+        self._inflight[replica_id] = set()
+        self._load.pop(replica_id, None)
+        # a dying replica cancels any drain it was serving
+        self._draining.pop(replica_id, None)
+        for gid in reversed(gids):
+            req = self._reqs.get(gid)
+            if req is None or req.finished:
+                continue
+            if req.remaining <= 0:
+                # everything was emitted; only the fin event was lost
+                req.state = DONE
+                req.finish_reason = "length"
+                req.t_done = time.perf_counter()
+                continue
+            req.state = QUEUED
+            req.replica = None
+            req.redispatches += 1
+            self._queue.appendleft(req)
+            _M_REDISPATCH.inc(instance=self._name)
+
+    # -- deadlines -------------------------------------------------------
+    def _expire_deadlines(self):
+        now = time.time()
+        for req in list(self._reqs.values()):
+            if req.finished or req.deadline is None or now < req.deadline:
+                continue
+            if req.state == QUEUED:
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass
+            elif req.state == PLACED:
+                # free the replica's blocks; its own engine-side deadline
+                # check races with this cancel — both are idempotent
+                h = self._handle(req.replica)
+                if h is not None:
+                    h.send({"op": "cancel", "gid": req.gid,
+                            "reason": "timeout"})
+                self._inflight[req.replica].discard(req.gid)
+            self._fail(req, RequestTimeoutError(
+                f"request {req.gid} deadline expired "
+                f"({'queued' if req.state == QUEUED else 'in flight'})",
+                rid=req.gid, deadline=req.deadline), "timeout")
+
+    # -- placement -------------------------------------------------------
+    def _handle(self, replica_id):
+        for h in self.supervisor.handles:
+            if h.id == replica_id:
+                return h
+        return None
+
+    def _placeable(self, h):
+        return (h.ready and h.alive and not h.retired
+                and h.id not in self._draining
+                and len(self._inflight[h.id])
+                < self.max_inflight_per_replica)
+
+    def _pick_replica(self, req):
+        if self.session_affinity and req.session is not None:
+            rid = self._sessions.get(req.session)
+            if rid is not None:
+                h = self._handle(rid)
+                if h is not None and self._placeable(h):
+                    return h
+        best, best_score = None, None
+        for h in self.supervisor.handles:
+            if not self._placeable(h):
+                continue
+            load = self._load.get(h.id, {})
+            score = (len(self._inflight[h.id]),
+                     float(load.get("kv", 0.0))
+                     + float(load.get("occ", 0.0)), h.id)
+            if best_score is None or score < best_score:
+                best, best_score = h, score
+        return best
+
+    def _place(self):
+        placed = 0
+        while self._queue:
+            req = self._queue[0]
+            h = self._pick_replica(req)
+            if h is None:
+                break
+            self._queue.popleft()
+            req.generation += 1
+            req.replica = h.id
+            req.state = PLACED
+            payload = {
+                "op": "submit", "gid": req.gid, "gen": req.generation,
+                # replay source: original prompt + everything already
+                # emitted — the greedy continuation is bit-identical
+                "prompt": np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.emitted, np.int32)]).tolist(),
+                "max_new": req.remaining, "eos": req.eos,
+                "deadline": req.deadline,
+            }
+            ok = True
+            try:
+                _fi.fire("serve.dispatch")
+            except Exception:
+                ok = False
+            if ok:
+                ok = h.send(payload)
+            if not ok:
+                # dispatch failed (dead pipe or injected fault): replay
+                # elsewhere; the bumped generation invalidates this copy
+                # even if it half-arrived
+                req.state = QUEUED
+                req.replica = None
+                req.redispatches += 1
+                self._queue.appendleft(req)
+                _M_REDISPATCH.inc(instance=self._name)
+                # one retry per tick; if the pipe is really dead the
+                # supervisor's next check() reports the death and the
+                # replica leaves the placeable set
+                break
+            self._inflight[h.id].add(req.gid)
+            if self.session_affinity and req.session is not None:
+                # LRU-bounded: one entry per session key forever would
+                # grow without bound on a long-lived server (the replica
+                # worker bounds its gid bookkeeping the same way)
+                self._sessions.pop(req.session, None)
+                self._sessions[req.session] = h.id
+                while len(self._sessions) > self.MAX_SESSIONS:
+                    self._sessions.pop(next(iter(self._sessions)))
+            placed += 1
+        return placed
+
+    # ------------------------------------------------------------------
+    # graceful drain (part c)
+    # ------------------------------------------------------------------
+    def drain(self, replica_id, then="resume", ckpt_root=None, wait=False,
+              timeout=120.0):
+        """Stop placing requests on ``replica_id``; once its in-flight
+        requests finish, run ``then``:
+
+        * ``"resume"`` — just rejoin the placeable set;
+        * ``"reload"`` — hot-swap weights from ``ckpt_root`` (default:
+          the fleet's checkpoint root) via the worker's
+          ``reload_weights``, then rejoin: the zero-drop rolling-update
+          primitive;
+        * ``"retire"`` — shut the replica down permanently.
+
+        ``wait=True`` pumps :meth:`step` until the drain completes."""
+        if then not in ("resume", "reload", "retire"):
+            raise ValueError(f"unknown drain action {then!r}")
+        if self._handle(replica_id) is None:
+            raise ValueError(f"unknown replica {replica_id}")
+        if then == "reload" and not (ckpt_root or self._ckpt_root):
+            raise ValueError("drain(then='reload') needs ckpt_root= "
+                             "(none configured on the fleet)")
+        self._draining[replica_id] = {
+            "state": "draining", "then": then,
+            "root": ckpt_root or self._ckpt_root}
+        _G_DRAINING.set(len(self._draining), instance=self._name)
+        if wait:
+            deadline = time.time() + float(timeout)
+            while replica_id in self._draining:
+                if not self.step():
+                    time.sleep(0.005)
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"drain of replica {replica_id} timed out")
+
+    def _advance_drains(self):
+        for rid, d in list(self._draining.items()):
+            if d["state"] != "draining" or self._inflight.get(rid):
+                continue
+            if d["then"] == "retire":
+                self.supervisor.retire(rid)
+                self._finish_drain(rid)
+            elif d["then"] == "reload":
+                h = self._handle(rid)
+                if h is None or not h.send({"op": "reload",
+                                            "root": d["root"]}):
+                    self._draining.pop(rid, None)  # died; recovery owns it
+                else:
+                    d["state"] = "reloading"
+            else:  # resume
+                self._finish_drain(rid)
+
+    def _finish_drain(self, replica_id):
+        self._draining.pop(replica_id, None)
+        self.drains_completed += 1
+        _G_DRAINING.set(len(self._draining), instance=self._name)
+
+    # ------------------------------------------------------------------
+    # introspection + teardown
+    # ------------------------------------------------------------------
+    def metrics(self):
+        """Fleet-owned observability snapshot (the ``LLMEngine.metrics``
+        discipline): registry-backed counters/gauges for THIS fleet."""
+        inst = self._name
+        from .supervisor import _G_LIVE, _M_RESTARTS
+
+        # supervisor-owned series live under the SUPERVISOR's instance
+        # label — identical to ours when we built it, but an injected
+        # supervisor keeps its own name
+        sup_inst = getattr(self.supervisor, "instance", inst)
+        return {
+            "instance": inst,
+            "replicas_live": _G_LIVE.value(instance=sup_inst),
+            "replica_restarts": int(_M_RESTARTS.value(instance=sup_inst)),
+            "redispatches": int(_M_REDISPATCH.value(instance=inst)),
+            "requests_shed": int(_M_SHED.value(instance=inst)),
+            "deadline_expired": int(_M_TIMEOUTS.value(instance=inst)),
+            "queue_depth": _G_QUEUE.value(instance=inst),
+            "replicas_draining": _G_DRAINING.value(instance=inst),
+            "drains_completed": self.drains_completed,
+        }
+
+    def ttft_seconds(self):
+        """Per-request submit→first-token latencies (finished requests
+        that produced at least one token) — the drill's p99 source."""
+        return [r.t_first - r.t_submit for r in self._reqs.values()
+                if r.t_first is not None]
+
+    def replica_stats(self, replica_id, timeout=10.0):
+        """Synchronous ``stats`` RPC to one replica (allocator cleanliness
+        assertions in drills/tests). Every non-stats event drained while
+        waiting is routed through the normal pump — ``events()`` is
+        destructive, so returning mid-batch would drop live tokens."""
+        h = self._handle(replica_id)
+        if h is None or not h.send({"op": "stats"}):
+            return None
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            stats = None
+            for ev in h.events():
+                if ev.get("e") == "stats" and stats is None:
+                    stats = ev
+                else:
+                    self._handle_event(h, ev)
+            if stats is not None:
+                return stats
+            time.sleep(0.005)
+        return None
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.supervisor.shutdown()
+        for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _G_QUEUE,
+                  _G_DRAINING):
+            m.remove(instance=self._name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,372 @@
+"""Replica process supervision (ISSUE 12 tentpole, part a).
+
+``ReplicaSupervisor`` is the serving-side twin of the training
+launcher's ``CollectiveController`` (PR 4): it spawns N replica worker
+processes (``fleet.replica``, each owning one ``LLMEngine`` over a
+shared model artifact) and keeps them alive:
+
+* **Crash**: a replica exiting for any reason (SIGKILL'd by the OOM
+  killer, a real crash, a chaos drill) is detected by ``check()`` and
+  respawned under a per-replica leaky-bucket
+  :class:`~paddle_tpu.distributed.launch.controllers.collective.RestartBudget`
+  — the SAME budget/backoff machinery the training launcher uses, with
+  a typed :class:`~..errors.ReplicaCrashLoopError` once a slot's budget
+  is exhausted (a poisoned replica must not flap forever).
+* **Hang**: replicas heartbeat through ``distributed.launch.heartbeat``
+  (atomic ``hb.<replica>`` files, written at every engine ``step()``
+  boundary and on idle ticks); a heartbeat older than
+  ``hang_timeout_s`` triggers the SIGTERM→SIGKILL escalation and the
+  replica is restarted like a crash — a worker wedged in a compile or a
+  device call cannot silently hold its share of the fleet.
+* **Rejoin**: a restarted replica reloads weights from the fleet's
+  checkpoint root (``reload_weights(latest_healthy_step())`` inside the
+  worker) before reporting ready, so a crash during a rolling weight
+  update cannot resurrect stale weights.
+
+The supervisor only manages processes; request-level recovery
+(redispatching the dead replica's in-flight requests) is the Router's
+job — ``check()`` hands it the death events WITH the dying process's
+final token events (drained to EOF first), so tokens emitted before the
+crash are never lost and never double-counted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ....distributed.launch import heartbeat as _hb
+from ....distributed.launch.controllers.collective import RestartBudget
+from ....observability import metrics as _obs_metrics
+from ..errors import ReplicaCrashLoopError
+
+__all__ = ["ReplicaHandle", "ReplicaSupervisor"]
+
+# fleet liveness (ISSUE 12): how many replicas look alive RIGHT NOW —
+# process running and (when the hang watchdog is armed) heartbeat fresh.
+# Transitions are appended to <log_dir>/fleet_liveness.log so the chaos
+# drill can assert the gauge dipped during a kill/hang and recovered.
+_G_LIVE = _obs_metrics.gauge(
+    "fleet_replicas_live",
+    "replicas currently alive (process running + heartbeat fresh when "
+    "the hang watchdog is armed)")
+_M_RESTARTS = _obs_metrics.counter(
+    "fleet_replica_restarts_total",
+    "replica respawns performed by the supervisor (crash or hang)")
+
+# repo root (five levels up: fleet/serving/inference/paddle_tpu/<repo>)
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+ENV_ID = "PADDLE_REPLICA_ID"
+ENV_CONFIG = "PADDLE_REPLICA_CONFIG"
+ENV_INCARNATION = "PADDLE_REPLICA_INCARNATION"
+
+
+class ReplicaHandle:
+    """One replica worker process + its line-JSON RPC plumbing.
+
+    Commands go down the child's stdin (one JSON object per line);
+    events come back on stdout, pumped by a daemon reader thread into an
+    internal queue that :meth:`events` drains. stderr goes to a per-
+    replica log file (jax chatter must never corrupt the RPC stream).
+    """
+
+    def __init__(self, replica_id, config, *, env=None, log_path=None,
+                 incarnation=0):
+        self.id = int(replica_id)
+        self.incarnation = int(incarnation)
+        self.spawn_time = time.time()
+        self.ready = False
+        self.ready_info = None
+        self.retired = False
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._log_file = open(log_path, "ab") if log_path else None
+        child_env = dict(env if env is not None else os.environ)
+        child_env[ENV_ID] = str(self.id)
+        child_env[ENV_CONFIG] = json.dumps(config)
+        child_env[ENV_INCARNATION] = str(self.incarnation)
+        child_env["PYTHONPATH"] = (_REPO + os.pathsep
+                                   + child_env.get("PYTHONPATH", ""))
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", "-m",
+             "paddle_tpu.inference.serving.fleet.replica"],
+            env=child_env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=(self._log_file or subprocess.DEVNULL), text=True,
+            bufsize=1)
+        self._reader = threading.Thread(target=self._read, daemon=True,
+                                        name=f"replica{self.id}-reader")
+        self._reader.start()
+
+    def _read(self):
+        try:
+            for line in self.proc.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # stray non-RPC print; never kill the reader
+                with self._lock:
+                    self._events.append(ev)
+        except (OSError, ValueError):
+            pass
+
+    @property
+    def alive(self):
+        return not self.retired and self.proc.poll() is None
+
+    @property
+    def pid(self):
+        return self.proc.pid
+
+    def send(self, obj):
+        """Write one command line; False when the pipe is gone (the
+        caller treats it as a dead replica and redispatches)."""
+        try:
+            with self._lock:
+                self.proc.stdin.write(json.dumps(obj) + "\n")
+                self.proc.stdin.flush()
+            return True
+        except (OSError, ValueError, AttributeError):
+            return False
+
+    def events(self):
+        """Drain queued events (ready events also flip :attr:`ready`)."""
+        with self._lock:
+            out, self._events = self._events, []
+        for ev in out:
+            if ev.get("e") == "ready":
+                self.ready = True
+                self.ready_info = ev
+        return out
+
+    def push_back(self, evs):
+        """Requeue events at the front (``wait_ready`` peeks without
+        consuming the router's view of the stream)."""
+        with self._lock:
+            self._events = list(evs) + self._events
+
+    def final_events(self, timeout=2.0):
+        """Join the reader (EOF after death) and drain what's left —
+        tokens the replica emitted before dying must reach the router."""
+        self._reader.join(timeout=timeout)
+        return self.events()
+
+    def kill(self, grace_s=5.0):
+        """SIGTERM → wait ``grace_s`` → SIGKILL (the launcher's
+        escalation, per process)."""
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                self.proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+
+    def close(self):
+        """Polite shutdown: ask, wait briefly, then escalate."""
+        self.send({"op": "shutdown"})
+        try:
+            self.proc.wait(timeout=3.0)
+        except subprocess.TimeoutExpired:
+            pass
+        self.kill(grace_s=1.0)
+
+
+class ReplicaSupervisor:
+    """Spawn + watch ``n_replicas`` replica workers (see module doc)."""
+
+    def __init__(self, n_replicas, config, *, hang_timeout_s=0.0,
+                 max_restarts=3, term_grace_s=5.0, boot_grace_s=120.0,
+                 log_dir=None, env_extra=None, instance="fleet"):
+        if int(n_replicas) < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.instance = instance
+        self.hang_timeout_s = float(hang_timeout_s or 0.0)
+        self.term_grace_s = float(term_grace_s)
+        # a replica writes its first heartbeat only after the framework
+        # import + engine build, so a booting (not-yet-ready) replica is
+        # judged against this LONGER grace — otherwise a tight watchdog
+        # condemns every restart before it can possibly beat, and the
+        # budget drains on phantom hangs (the launch bootstrap solves
+        # this with a pre-jax heartbeat; here the import IS the boot)
+        self.boot_grace_s = max(float(boot_grace_s), self.hang_timeout_s)
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._hb_dir = os.path.join(log_dir, "heartbeats")
+        else:
+            self._hb_dir = tempfile.mkdtemp(prefix="paddle_fleet_hb.")
+        os.makedirs(self._hb_dir, exist_ok=True)
+        self._config = dict(config)
+        self._config["hb_dir"] = self._hb_dir
+        self._env = dict(os.environ)
+        self._env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the TPU
+        # replicas default to the CPU backend: N extra processes fighting
+        # over one accelerator is never what a test/drill wants; a real
+        # deployment overrides via env_extra
+        self._env.setdefault("JAX_PLATFORMS", "cpu")
+        self._env.update(env_extra or {})
+        # sleep=no-op: backoff() only COMPUTES the delay — the supervisor
+        # schedules the respawn at now+delay instead of sleeping inside
+        # the router's single-threaded pump (a synchronous backoff sleep
+        # would freeze token events, placements and the redispatch the
+        # death just triggered, for every healthy replica too)
+        self._budgets = [RestartBudget(max_restarts, sleep=lambda s: None)
+                         for _ in range(int(n_replicas))]
+        self._pending_respawn: dict[int, float] = {}
+        self.handles = [self._spawn(i, 0) for i in range(int(n_replicas))]
+        self._last_live = None
+        self._note_liveness()
+
+    # -- lifecycle -------------------------------------------------------
+    def _spawn(self, i, incarnation):
+        log_path = (os.path.join(self.log_dir, f"replica.{i}.log")
+                    if self.log_dir else None)
+        return ReplicaHandle(i, self._config, env=self._env,
+                             log_path=log_path, incarnation=incarnation)
+
+    def wait_ready(self, timeout=180.0):
+        """Block until every live replica reported ``ready`` (engine
+        built, weights loaded/reloaded). Peeked events are pushed back
+        for the router's pump."""
+        deadline = time.time() + float(timeout)
+        for h in self.handles:
+            while not h.ready and not h.retired:
+                evs = h.events()
+                if evs:
+                    h.push_back(evs)
+                if h.ready:
+                    break
+                if h.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {h.id} died during startup "
+                        f"(rc={h.proc.poll()}); see its log"
+                        + (f" in {self.log_dir}" if self.log_dir else ""))
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"replica {h.id} not ready within {timeout}s")
+                time.sleep(0.05)
+
+    def retire(self, i):
+        """Permanently stop replica ``i`` (the drain-then-retire path) —
+        no restart, excluded from liveness."""
+        h = self.handles[i]
+        h.retired = True
+        h.close()
+        self._note_liveness()
+
+    def shutdown(self):
+        for h in self.handles:
+            if not h.retired:
+                h.close()
+        _G_LIVE.remove(instance=self.instance)
+        _M_RESTARTS.remove(instance=self.instance)
+
+    # -- the watchdog tick ----------------------------------------------
+    def _hung(self, h, beats, now):
+        if self.hang_timeout_s <= 0 or not h.alive:
+            return False
+        if not h.ready:
+            # still booting: only the boot grace can condemn it
+            return (now - h.spawn_time) > self.boot_grace_s
+        t = beats.get(str(h.id), {}).get("time")
+        if t is None:
+            t = h.spawn_time  # not-yet-written grace, like launch.stale
+        return (now - float(t)) > self.hang_timeout_s
+
+    def check(self, now=None):
+        """One supervision tick. Detects dead and hung replicas, kills
+        the hung ones, respawns both under the per-replica restart
+        budget, and returns the death events for the router::
+
+            [{"replica": i, "reason": "crash"|"hang", "rc": rc,
+              "events": [<final events drained after EOF>]}]
+
+        Raises :class:`ReplicaCrashLoopError` when a slot's budget is
+        exhausted. Also refreshes the ``fleet_replicas_live`` gauge
+        (transition log: ``<log_dir>/fleet_liveness.log``)."""
+        now = time.time() if now is None else now
+        beats = _hb.read_all(self._hb_dir)
+        deaths = []
+        for i, h in enumerate(self.handles):
+            if h.retired:
+                continue
+            if i in self._pending_respawn:
+                # death already reported; respawn when the backoff lapses
+                if now >= self._pending_respawn[i]:
+                    del self._pending_respawn[i]
+                    # stale heartbeat must not re-condemn the new life
+                    try:
+                        os.remove(os.path.join(self._hb_dir, f"hb.{i}"))
+                    except OSError:
+                        pass
+                    self.handles[i] = self._spawn(i, h.incarnation + 1)
+                    _M_RESTARTS.inc(instance=self.instance)
+                continue
+            reason = None
+            if h.proc.poll() is not None:
+                reason = "crash"
+            elif self._hung(h, beats, now):
+                reason = "hang"
+                h.kill(grace_s=self.term_grace_s)
+            if reason is None:
+                continue
+            rc = h.proc.poll()
+            leftovers = h.final_events()
+            # the dip must be visible BEFORE the respawn restores it
+            self._note_liveness()
+            budget = self._budgets[i]
+            if not budget.try_acquire():
+                self.shutdown()
+                raise ReplicaCrashLoopError(
+                    f"replica {i} crash loop ({reason}, rc={rc}): restart "
+                    f"budget exhausted ({budget.max_restarts} per "
+                    f"{budget.window_s:.0f}s window, "
+                    f"{budget.total_restarts} performed)",
+                    replica=i, exit_code=rc if rc is not None else 1,
+                    restarts=budget.total_restarts)
+            # schedule (never sleep in the pump): the death event returns
+            # NOW so the router redispatches immediately; the slot stays
+            # un-placeable (dead handle) until the delayed respawn
+            self._pending_respawn[i] = now + budget.backoff()
+            deaths.append({"replica": i, "reason": reason, "rc": rc,
+                           "events": leftovers})
+        self._note_liveness(beats=beats, now=now)
+        return deaths
+
+    def _note_liveness(self, beats=None, now=None):
+        now = time.time() if now is None else now
+        if beats is None:
+            beats = _hb.read_all(self._hb_dir)
+        n = sum(1 for h in self.handles
+                if h.alive and not self._hung(h, beats, now))
+        _G_LIVE.set(n, instance=self.instance)
+        if n != self._last_live:
+            self._last_live = n
+            if self.log_dir:
+                try:
+                    with open(os.path.join(self.log_dir,
+                                           "fleet_liveness.log"), "a") as f:
+                        f.write(f"{now:.3f} {n}\n")
+                except OSError:
+                    pass
+        return n

@@ -98,13 +98,20 @@ class Request:
     _ids = itertools.count(1)
 
     def __init__(self, prompt_ids, sampling: SamplingParams | None = None,
-                 rid=None, arrival_t=None):
+                 rid=None, arrival_t=None, deadline=None):
         self.rid = rid if rid is not None else next(Request._ids)
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
         self.sampling = sampling or SamplingParams()
         self.arrival_t = arrival_t
+        # absolute wall-clock deadline (time.time() seconds, ISSUE 12):
+        # the engine checks it at admission and at every step; expiry
+        # aborts the request with a typed RequestTimeoutError finish
+        self.deadline = float(deadline) if deadline is not None else None
+        # set by Scheduler.abort: "timeout" / "cancelled" — overrides the
+        # eos/length finish reasons
+        self.abort_reason = None
         self.state = WAITING
         # observability timestamps (perf_counter_ns; host clocks only):
         # queue-entry time for the queued->running span, first/last token
@@ -153,6 +160,8 @@ class Request:
     def finish_reason(self):
         if self.state != FINISHED:
             return None
+        if self.abort_reason is not None:
+            return self.abort_reason
         s = self.sampling
         if (s.eos_token_id is not None and self.output_tokens
                 and self.output_tokens[-1] == s.eos_token_id):
@@ -406,6 +415,34 @@ class Scheduler:
         self.waiting.appendleft(req)
         self.version += 1
         _M_EVICTIONS.inc(instance=self.instance)
+
+    # -- early termination (deadline expiry / cancel / engine close) -----
+    def abort(self, req, reason="cancelled"):
+        """Finish ``req`` early, releasing everything it holds: a RUNNING
+        request frees its blocks (decref under sharing) and recycles its
+        slot for the very next admission; a WAITING request just leaves
+        the queue. Idempotent on already-finished requests. The typed
+        reason lands in ``finish_reason()`` — deliberately NOT counted as
+        ``serving_requests_finished_total`` (an aborted request did not
+        finish; the fleet's completed+typed-error accounting depends on
+        the distinction)."""
+        if req.state == FINISHED:
+            return
+        if req.state == RUNNING:
+            slot = self.slots.index(req)
+            if req.blocks:
+                self.allocator.free(req.blocks)
+            req.blocks = []
+            self.slots[slot] = None
+            self.version += 1
+        else:  # WAITING
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        req.prefilling = False
+        req.abort_reason = reason
+        req.state = FINISHED
 
     # -- completion ------------------------------------------------------
     def finish(self, req):

@@ -36,6 +36,18 @@ Sites wired into the framework:
   finite-but-huge — the NaN guard stays silent and the divergence sentinel
   (FLAGS_sentinel_action) must detect the spike at the next metric-fetch
   window boundary and warn/skip/rollback/raise.
+- ``serve.replica_crash`` — fleet replica worker loop head (boolean site):
+  the replica SIGKILLs itself mid-serve; the ReplicaSupervisor must see
+  the death, respawn under the restart budget, and the Router must replay
+  the replica's in-flight requests bit-exactly on a healthy peer.
+- ``serve.replica_hang``  — fleet replica worker loop head (boolean site):
+  the replica wedges forever WITHOUT heartbeating; only the supervisor's
+  hang watchdog (SIGTERM→SIGKILL escalation) can end it — the redispatch
+  dedup must also survive the window where the replica is presumed dead.
+- ``serve.dispatch``      — Router placement, fired as a request is sent
+  to a replica: the dispatch fails, the request requeues at the front
+  with a bumped generation, and a half-delivered copy can never
+  double-emit into the replayed stream.
 
 Arming a site is scoped and seeded::
 
@@ -62,7 +74,8 @@ __all__ = ["SITES", "InjectedFault", "inject", "fire", "should_fire"]
 
 SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "io.prefetch", "proc.kill", "hb.write", "train.stall",
-         "train.spike")
+         "train.spike", "serve.replica_crash", "serve.replica_hang",
+         "serve.dispatch")
 
 
 class InjectedFault(OSError):

@@ -559,15 +559,144 @@ def run_spec_ab(tiny=True, seed=0, spec_tokens=3, draft="self"):
     )
 
 
+def fleet_sizing(tiny):
+    """Stream/engine sizing for the fleet A/B: per-step COMPUTE must
+    dominate the per-step RPC/dispatch overhead (a deeper/wider tiny,
+    the shared-prefix-sizing trick) and the burst must saturate ONE
+    replica's batch, so adding replicas buys real throughput instead of
+    just splitting batch occupancy."""
+    import dataclasses as _dc
+
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        cfg = _dc.replace(llama_tiny(), hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=4)
+        stream = dict(n=36, rate=400.0, min_prompt=4, max_prompt=24,
+                      min_new=24, max_new=40)
+        engine = dict(num_blocks=256, block_size=8, max_batch_size=4,
+                      max_prefills_per_step=2)
+    else:
+        cfg = llama_small()
+        stream = dict(n=64, rate=300.0, min_prompt=16, max_prompt=128,
+                      min_new=32, max_new=64)
+        engine = dict(num_blocks=512, block_size=16, max_batch_size=4)
+    return cfg, stream, engine
+
+
+def run_fleet(artifact, stream, *, n_replicas, engine_kwargs,
+              warm_stream=None, log_dir=None):
+    """One timed window through a real replica fleet (ISSUE 12):
+    ``n_replicas`` worker processes behind the Router, requests admitted
+    on the stream's arrival clock. ``warm_stream`` is replayed first so
+    every replica's prefill/decode graphs are compiled before timing."""
+    from paddle_tpu.inference.serving.fleet import Router
+
+    fleet = Router(artifact=artifact, n_replicas=n_replicas,
+                   engine_kwargs=engine_kwargs, log_dir=log_dir,
+                   max_queue=1_000_000)
+    try:
+        if warm_stream is not None:
+            for r in warm_stream:
+                fleet.submit(r.prompt, max_new=r.max_new)
+            fleet.join(timeout=600)
+        gids = []
+        i = 0
+        t0 = time.perf_counter()
+        while i < len(stream) or fleet.pending():
+            now = time.perf_counter() - t0
+            while i < len(stream) and stream[i].arrival <= now:
+                gids.append(fleet.submit(stream[i].prompt,
+                                         max_new=stream[i].max_new))
+                i += 1
+            progressed = fleet.step()
+            if not progressed:
+                if fleet.pending():
+                    time.sleep(0.001)
+                elif i < len(stream):
+                    time.sleep(max(0.0, stream[i].arrival - now))
+        fleet.join(timeout=600)
+        wall = time.perf_counter() - t0
+        outs = [fleet.result(g) for g in gids]
+        fm = fleet.metrics()
+    finally:
+        fleet.close()
+    gen_tokens = sum(r.max_new for r in stream)
+    return dict(outputs=outs, wall_s=round(wall, 4),
+                tokens_per_sec=round(gen_tokens / wall, 1),
+                gen_tokens=gen_tokens, n_replicas=n_replicas,
+                redispatches=fm["redispatches"],
+                requests_shed=fm["requests_shed"])
+
+
+def run_fleet_ab(tiny=True, seed=0, fleet=3):
+    """Fleet scaling A/B (ISSUE 12 / ROADMAP item 1 acceptance): ONE
+    seeded Poisson burst through a 1-replica fleet and an N-replica
+    fleet — both real subprocess fleets behind the same Router/RPC path,
+    so the delta is pure replica parallelism, not RPC overhead — plus an
+    in-process engine reference that both fleets' greedy outputs must
+    match bit-exactly. Reports tokens/s per arm and the scaling factor
+    (near-linear on an unloaded box with >= ``fleet`` cores)."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              save_llama_artifact)
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, stream_kwargs, engine_kwargs = fleet_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = request_stream(cfg, seed=seed, **stream_kwargs)
+    warm = request_stream(cfg, seed=seed + 1, **stream_kwargs)
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet.")
+    try:
+        artifact = os.path.join(tmp, "model")
+        save_llama_artifact(model, artifact)
+        eng = LLMEngine(model, ingest_async=False, **engine_kwargs)
+        try:
+            rids = [eng.add_request(
+                r.prompt, SamplingParams(max_new_tokens=r.max_new))
+                for r in stream]
+            for _ in eng.stream():
+                pass
+            refs = [eng.output_tokens(r) for r in rids]
+        finally:
+            eng.close()
+        one = run_fleet(artifact, stream, n_replicas=1,
+                        engine_kwargs=engine_kwargs, warm_stream=warm)
+        many = run_fleet(artifact, stream, n_replicas=fleet,
+                         engine_kwargs=engine_kwargs, warm_stream=warm)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    bit_exact = (_bit_exact(refs, one["outputs"])
+                 and _bit_exact(refs, many["outputs"]))
+    return dict(
+        single={k: v for k, v in one.items() if k != "outputs"},
+        fleet={k: v for k, v in many.items() if k != "outputs"},
+        scaling=round(many["tokens_per_sec"] / one["tokens_per_sec"], 3),
+        n_replicas=fleet,
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="poisson",
-                    choices=["poisson", "shared-prefix", "chunked", "spec"])
+                    choices=["poisson", "shared-prefix", "chunked", "spec",
+                             "fleet"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--spec-tokens", type=int, default=3)
     ap.add_argument("--draft", default="self", choices=["self", "tiny"])
+    ap.add_argument("--fleet", type=int, default=3,
+                    help="replica count for --workload fleet")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--tiny", action="store_true",
                     help="CPU smoke sizing (llama_tiny)")
@@ -600,6 +729,13 @@ def main():
         print(json.dumps(res, indent=2))
         if not res["bit_exact"]:
             sys.exit("FAIL: speculative arm diverges from plain greedy")
+        return
+    if args.workload == "fleet":
+        res = run_fleet_ab(tiny=tiny, seed=args.seed, fleet=args.fleet)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: fleet outputs diverge from the in-process "
+                     "engine greedy reference")
         return
 
     cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)

@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Chaos drill for the fault-tolerant serving fleet (ISSUE 12): SIGKILL
+a replica, hang another, drain a third mid-burst — and prove NOTHING is
+lost: every accepted request completes with greedy output bit-identical
+to an undisturbed single-engine baseline, every rejected request gets a
+typed error (completed + typed-error counts == submitted), and the
+fleet liveness gauge dips and recovers.
+
+Usage::
+
+    python scripts/chaos_serve.py [--drill kill|hang|drain|shed|all]
+        [--fleet 3] [--out DIR]
+
+Drills (each runs against a fresh fleet of ``--fleet`` replica worker
+processes over one shared model artifact + checkpoint root):
+
+- ``kill``:  the acceptance storm — one replica is SIGKILLed from
+  outside (picked by in-flight load, the OOM-killer shape) AND, with
+  >= 3 replicas, another is armed to wedge mid-serve (fault site
+  ``serve.replica_hang`` via env, the stuck-collective shape). The
+  supervisor detects both (exit code; stale heartbeats →
+  SIGTERM→SIGKILL), respawns them under the restart budget — the
+  respawned workers rejoin via ``reload_weights(latest_healthy_step())``
+  — and the router replays their in-flight requests from prompt +
+  already-emitted tokens on healthy peers. Asserts: all requests
+  complete bit-exact, redispatches happened, liveness dipped and
+  recovered, restarted replicas report the rejoin checkpoint step,
+  p99 TTFT stays bounded.
+- ``hang``:  hang-only variant (fault site ``serve.replica_hang``).
+- ``drain``: graceful drain mid-burst — ``drain(replica,
+  then='reload')`` stops admission, lets in-flight requests finish,
+  hot-swaps weights from the checkpoint root, rejoins. Asserts: zero
+  drops, zero typed errors, the drain completed with the expected
+  checkpoint step (the zero-drop rolling-update primitive).
+- ``shed``:  overload + deadline typed-error accounting — a tiny
+  admission queue sheds a fast burst with FleetOverloadedError, an
+  expired deadline is rejected at admission and a too-tight one dies
+  queued, both with RequestTimeoutError; afterwards every replica's
+  allocator is PROVEN clean (all blocks free, nothing waiting/running).
+
+``--drill all`` (the default) runs kill, hang, drain, shed in order.
+Wired into the slow tier of tests/test_serving.py, the chaos_train.py
+discipline applied to serving. Everything runs on CPU
+(JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_REQUESTS = 18
+RATE = 60.0            # req/s Poisson arrivals — the whole burst in ~0.3s
+ENGINE_KW = dict(num_blocks=64, block_size=8, max_batch_size=4,
+                 max_prefills_per_step=2)
+
+
+def check(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+    print(f"  ok: {msg}")
+
+
+def request_stream(cfg, seed=0, n=N_REQUESTS, rate=RATE):
+    """The bench_serving seeded Poisson generator (ONE workload source —
+    the drill and the fleet A/B must never drift apart), drill-sized."""
+    import bench_serving as bsv
+
+    return bsv.request_stream(cfg, n=n, rate=rate, min_prompt=4,
+                              max_prompt=16, min_new=6, max_new=12,
+                              seed=seed)
+
+
+def build_fixture(out):
+    """Deterministic tiny llama + serving artifact + a committed
+    checkpoint (step 1) replicas rejoin/reload from."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+    from paddle_tpu.inference.serving import save_llama_artifact
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(0)
+    np.random.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    artifact = os.path.join(out, "model")
+    save_llama_artifact(model, artifact)
+    ckpt_root = os.path.join(out, "ckpt")
+    CheckpointManager(ckpt_root, keep_last_n=2).save(1, model=model)
+    return model, artifact, ckpt_root
+
+
+def baseline_outputs(model, stream):
+    """Undisturbed single-engine greedy outputs, one per request index —
+    the bit-exactness reference for every drill."""
+    from paddle_tpu.inference.serving import LLMEngine, SamplingParams
+
+    eng = LLMEngine(model, ingest_async=False, **ENGINE_KW)
+    try:
+        rids = [eng.add_request(r.prompt,
+                                SamplingParams(max_new_tokens=r.max_new))
+                for r in stream]
+        for _ in eng.stream():
+            pass
+        return [eng.output_tokens(r) for r in rids]
+    finally:
+        eng.close()
+
+
+def run_burst(fleet, stream, chaos=None):
+    """Submit the seeded Poisson burst through the fleet, firing the
+    ``chaos(fleet)`` callback mid-burst (re-tried until it reports
+    success by returning truthy); pump to completion. Returns
+    ({idx: gid}, [(idx, error)] shed, wall seconds)."""
+    gids, shed = {}, []
+    fired = False
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(stream) or fleet.pending():
+        now = time.perf_counter() - t0
+        while i < len(stream) and stream[i].arrival <= now:
+            try:
+                gids[i] = fleet.submit(stream[i].prompt,
+                                       max_new=stream[i].max_new)
+            except Exception as e:
+                shed.append((i, e))
+            i += 1
+        progressed = fleet.step()
+        if chaos is not None and not fired and i >= len(stream) // 2:
+            fired = bool(chaos(fleet))
+        if not fleet.pending() and i < len(stream):
+            time.sleep(max(0.0, stream[i].arrival - now))
+        elif not progressed:
+            # don't busy-spin the pump while the replica processes do
+            # the actual decoding — on a shared box the spinning parent
+            # steals their cycles
+            time.sleep(0.001)
+    fleet.join(timeout=300)
+    return gids, shed, time.perf_counter() - t0
+
+
+def wait_all_ready(fleet, timeout=120.0):
+    """Pump until every live replica (including just-restarted ones)
+    reported ready — restart assertions and stats RPCs need them up.
+    Also waits out scheduled (backoff-delayed) respawns."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        fleet.step()
+        pending = getattr(fleet.supervisor, "_pending_respawn", {})
+        if not pending and all(h.ready for h in fleet.supervisor.handles
+                               if h.alive and not h.retired):
+            return
+        time.sleep(0.05)
+    raise AssertionError("restarted replicas never became ready")
+
+
+def read_liveness(out):
+    vals = []
+    try:
+        with open(os.path.join(out, "fleet_liveness.log")) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 2:
+                    vals.append(int(parts[1]))
+    except OSError:
+        pass
+    return vals
+
+
+def assert_complete_bitexact(fleet, gids, baseline):
+    done = 0
+    for idx, gid in gids.items():
+        out = fleet.result(gid)  # raises the typed error if any
+        ref = baseline[idx]
+        check_quiet = np.array_equal(out, ref)
+        if not check_quiet:
+            raise AssertionError(
+                f"request {idx} diverged from the undisturbed baseline: "
+                f"{out.tolist()} vs {ref.tolist()}")
+        done += 1
+    print(f"  ok: all {done} accepted requests completed bit-identical "
+          "to the undisturbed single-engine baseline")
+    return done
+
+
+def assert_replicas_clean(fleet):
+    for h in fleet.supervisor.handles:
+        if h.retired or not h.alive:
+            continue
+        s = fleet.replica_stats(h.id)
+        check(s is not None, f"replica {h.id} answers the stats RPC")
+        usable = ENGINE_KW["num_blocks"] - 1
+        check(s["blocks_free"] == usable and s["waiting"] == 0
+              and s["running"] == 0,
+              f"replica {h.id} allocator/scheduler clean after the burst "
+              f"({s['blocks_free']}/{usable} blocks free, "
+              f"waiting={s['waiting']}, running={s['running']})")
+
+
+def _fleet(out, n, **kw):
+    from paddle_tpu.inference.serving.fleet import Router
+
+    args = dict(artifact=os.path.join(out, "model"),
+                n_replicas=n, engine_kwargs=ENGINE_KW,
+                ckpt_root=os.path.join(out, "ckpt"),
+                log_dir=out, max_queue=100, hang_timeout_s=0.0,
+                max_restarts=3)
+    args.update(kw)
+    return Router(**args)
+
+
+def drill_kill(out, model, n, hang_too=True):
+    """The acceptance storm: SIGKILL the busiest replica mid-burst and
+    (with >= 3 replicas) wedge another via ``serve.replica_hang``."""
+    stream = request_stream(_cfg(model))
+    baseline = baseline_outputs(model, stream)
+    env = {}
+    arm_hang = hang_too and n >= 3
+    if arm_hang:
+        env = {"CHAOS_SERVE_SITE": "serve.replica_hang",
+               "CHAOS_SERVE_REPLICA": str(n - 1),
+               "CHAOS_SERVE_AFTER_STEPS": "12"}
+    fleet = _fleet(out, n, hang_timeout_s=3.0, env_extra=env)
+    try:
+        victim = {}
+
+        def chaos(fl):
+            # the OOM-killer shape: kill the replica carrying the most
+            # in-flight requests (never the one armed to hang). Retried
+            # (return False) until somebody actually holds requests, so
+            # the redispatch path is guaranteed to be exercised.
+            cand = [h for h in fl.supervisor.handles
+                    if h.alive and (not arm_hang or h.id != n - 1)]
+            h = max(cand, key=lambda h: len(fl.inflight(h.id)))
+            if not fl.inflight(h.id):
+                return False
+            victim["id"], victim["load"] = h.id, len(fl.inflight(h.id))
+            print(f"[chaos] SIGKILL replica {h.id} "
+                  f"({victim['load']} requests in flight)")
+            os.kill(h.pid, signal.SIGKILL)
+            return True
+
+        gids, shed, wall = run_burst(fleet, stream, chaos)
+        wait_all_ready(fleet)
+        check(not shed, f"no request shed (queue bound ample): {shed}")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done == len(stream),
+              f"completed == submitted ({done}/{len(stream)}): nothing "
+              "dropped silently")
+        m = fleet.metrics()
+        check(m["redispatches"] >= 1,
+              f"in-flight requests were redispatched "
+              f"({m['redispatches']}x) off the killed"
+              + ("/hung" if arm_hang else "") + " replica")
+        check(m["replica_restarts"] >= (2 if arm_hang else 1),
+              f"supervisor restarted the dead replica(s) "
+              f"({m['replica_restarts']} restarts)")
+        vals = read_liveness(out)
+        check(any(v < n for v in vals),
+              f"fleet liveness gauge dipped below {n} (transitions: "
+              f"{vals})")
+        first_dip = next(i for i, v in enumerate(vals) if v < n)
+        check(any(v == n for v in vals[first_dip:]),
+              f"fleet liveness gauge recovered to {n} (transitions: "
+              f"{vals})")
+        h = fleet.supervisor.handles[victim["id"]]
+        check(h.incarnation >= 1
+              and h.ready_info.get("reloaded_step") == 1,
+              "restarted replica rejoined via reload_weights("
+              "latest_healthy_step()) at checkpoint step 1")
+        ttfts = sorted(fleet.ttft_seconds())
+        p99 = ttfts[min(len(ttfts) - 1,
+                        int(0.99 * len(ttfts)))] if ttfts else 0.0
+        check(p99 < 60.0, f"p99 TTFT bounded under chaos ({p99:.2f}s)")
+        toks = sum(len(fleet.tokens(g)) for g in gids.values())
+        print(f"  [report] {toks} tokens in {wall:.1f}s "
+              f"({toks / wall:.1f} tok/s, fleet={n}, one killed"
+              + (", one hung" if arm_hang else "") + ")")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
+def drill_hang(out, model, n):
+    """Hang-only: replica ``n-1`` wedges mid-serve; the heartbeat
+    watchdog SIGTERM→SIGKILLs it and the burst still completes."""
+    stream = request_stream(_cfg(model))
+    baseline = baseline_outputs(model, stream)
+    env = {"CHAOS_SERVE_SITE": "serve.replica_hang",
+           "CHAOS_SERVE_REPLICA": str(n - 1),
+           "CHAOS_SERVE_AFTER_STEPS": "12"}
+    fleet = _fleet(out, n, hang_timeout_s=3.0, env_extra=env)
+    try:
+        gids, shed, wall = run_burst(fleet, stream)
+        wait_all_ready(fleet)
+        check(not shed, "no request shed")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done == len(stream), "completed == submitted")
+        m = fleet.metrics()
+        check(m["replica_restarts"] >= 1,
+              f"watchdog killed + restarted the hung replica "
+              f"({m['replica_restarts']} restarts)")
+        vals = read_liveness(out)
+        check(any(v < n for v in vals) and vals and vals[-1] == n,
+              f"liveness dipped and recovered (transitions: {vals})")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
+def drill_drain(out, model, n):
+    """Graceful drain mid-burst: zero drops, zero typed errors, weight
+    hot-swap from the checkpoint root."""
+    stream = request_stream(_cfg(model))
+    baseline = baseline_outputs(model, stream)
+    fleet = _fleet(out, n)
+    try:
+        def chaos(fl):
+            print("[chaos] draining replica 0 (then=reload)")
+            fl.drain(0, then="reload")
+            return True
+
+        gids, shed, wall = run_burst(fleet, stream, chaos)
+        fleet.join(timeout=120)
+        deadline = time.time() + 60
+        while fleet.metrics()["replicas_draining"] and \
+                time.time() < deadline:
+            fleet.step()
+            time.sleep(0.005)
+        check(not shed, "no request shed during the drain")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done == len(stream),
+              "zero-drop rolling update: completed == submitted")
+        check(fleet.drains_completed == 1
+              and fleet.metrics()["replicas_draining"] == 0,
+              "drain completed and the replica rejoined")
+        check((0, 1) in fleet.reloads,
+              f"drained replica hot-swapped weights from checkpoint "
+              f"step 1 (reloads: {fleet.reloads})")
+        check(fleet.metrics()["deadline_expired"] == 0
+              and fleet.metrics()["redispatches"] == 0,
+              "no typed errors, no redispatches — the drain was "
+              "invisible to clients")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
+def drill_shed(out, model, n):
+    """Overload + deadline accounting: a tiny queue sheds with
+    FleetOverloadedError, deadlines reject/expire with
+    RequestTimeoutError, and afterwards the allocators are clean."""
+    from paddle_tpu.inference.serving import (FleetOverloadedError,
+                                              RequestTimeoutError)
+
+    cfg = _cfg(model)
+    stream = request_stream(cfg, n=30, rate=1e6)  # instant burst
+    baseline = baseline_outputs(model, stream)
+    fleet = _fleet(out, min(n, 2), max_queue=4,
+                   max_inflight_per_replica=2)
+    try:
+        check(fleet.submit(stream[0].prompt, max_new=4,
+                           deadline_s=30) is not None or True,
+              "sanity: a generous deadline admits")
+        try:
+            fleet.submit(stream[0].prompt, max_new=4, deadline_s=0.0)
+            raise AssertionError("expired deadline was admitted")
+        except RequestTimeoutError:
+            print("  ok: already-expired deadline rejected at admission "
+                  "with RequestTimeoutError")
+        doomed = fleet.submit(stream[1].prompt, max_new=4,
+                              deadline_s=0.01)
+        time.sleep(0.05)
+        fleet.step()
+        try:
+            fleet.result(doomed)
+            raise AssertionError("queued past-deadline request returned")
+        except RequestTimeoutError:
+            print("  ok: deadline expiring in the queue surfaced as "
+                  "RequestTimeoutError at the next tick")
+        fleet.join(timeout=120)
+        gids, shed = {}, []
+        for i, req in enumerate(stream):
+            try:
+                gids[i] = fleet.submit(req.prompt, max_new=req.max_new)
+            except FleetOverloadedError:
+                shed.append(i)
+            fleet.step()
+        fleet.join(timeout=300)
+        check(shed, f"the instant burst shed {len(shed)} requests with "
+              "FleetOverloadedError (bounded queue, typed error)")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done + len(shed) == len(stream),
+              f"completed ({done}) + typed-error ({len(shed)}) == "
+              f"submitted ({len(stream)}): nothing dropped silently")
+        m = fleet.metrics()
+        check(m["requests_shed"] == len(shed)
+              and m["deadline_expired"] >= 2,
+              f"fleet metrics account for every rejection "
+              f"(shed={m['requests_shed']}, "
+              f"deadline={m['deadline_expired']})")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
+def _cfg(model):
+    return model.config
+
+
+DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
+          "shed": drill_shed}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--drill", default="all",
+                    choices=["kill", "hang", "drain", "shed", "all"])
+    ap.add_argument("--fleet", type=int, default=3)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    out_root = args.out or tempfile.mkdtemp(prefix="chaos_serve.")
+    print(f"[chaos] serving fleet drill, scratch: {out_root}, "
+          f"fleet={args.fleet}")
+    drills = (["kill", "hang", "drain", "shed"] if args.drill == "all"
+              else [args.drill])
+    model = None
+    for name in drills:
+        out = os.path.join(out_root, name)
+        os.makedirs(out, exist_ok=True)
+        model, _, _ = build_fixture(out)
+        print(f"[chaos] drill {name!r} (fleet of {args.fleet})...")
+        t0 = time.time()
+        DRILLS[name](out, model, args.fleet)
+        print(f"  done in {time.time() - t0:.1f}s")
+    print(f"[chaos] SERVE DRILL PASSED ({', '.join(drills)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,8 @@ from paddle_tpu.inference.serving import (
     paged_multiquery_attention, save_llama_artifact,
 )
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def tiny_cfg():
     from paddle_tpu.models import llama_tiny
@@ -1297,23 +1299,25 @@ class TestSpeculativeDecoding:
                        draft_model=model, spec_tokens=3) as eng:
             outs = eng.generate(prompts, SamplingParams(max_new_tokens=9))
             em = eng.metrics()
+            # registry names (metrics lint): serving_spec_proposed_total,
+            # serving_spec_accepted_total, serving_spec_accept_ratio —
+            # read INSIDE the context: close() removes the instance's
+            # registry series (ISSUE 12)
+            from paddle_tpu.observability import metrics as om
+
+            inst = em["instance"]
+            assert om.REGISTRY.get("serving_spec_proposed_total").value(
+                instance=inst) == em["spec_proposed"]
+            assert om.REGISTRY.get("serving_spec_accepted_total").value(
+                instance=inst) == em["spec_accepted"]
+            assert om.REGISTRY.get("serving_spec_accept_ratio").value(
+                instance=inst) == em["spec_accept_ratio"]
         for got, ref in zip(outs, refs):
             np.testing.assert_array_equal(got, ref)
-        # registry names (metrics lint): serving_spec_proposed_total,
-        # serving_spec_accepted_total, serving_spec_accept_ratio
         assert em["spec_proposed"] > 0
         assert em["spec_accepted"] > 0
         assert em["spec_accept_ratio"] is not None
         assert em["spec_accept_ratio"] > 0.5
-        from paddle_tpu.observability import metrics as om
-
-        inst = em["instance"]
-        assert om.REGISTRY.get("serving_spec_proposed_total").value(
-            instance=inst) == em["spec_proposed"]
-        assert om.REGISTRY.get("serving_spec_accepted_total").value(
-            instance=inst) == em["spec_accepted"]
-        assert om.REGISTRY.get("serving_spec_accept_ratio").value(
-            instance=inst) == em["spec_accept_ratio"]
 
     def test_independent_draft_bit_exact(self, model, draft_model):
         cfg = model.config
@@ -1452,3 +1456,239 @@ class TestBenchServingRawSpeed:
         assert res["bit_exact"]
         assert res["spec_accept_ratio"] is not None
         assert res["spec_accept_ratio"] > 0.5  # self-draft upper bound
+
+
+# ---------------------------------------------------------------------------
+# per-request deadlines (ISSUE 12 satellite: the edge matrix)
+# ---------------------------------------------------------------------------
+
+class TestEngineDeadlines:
+    def test_expired_at_add_request_allocator_untouched(self, model):
+        """An already-expired deadline is rejected BEFORE any block
+        allocation or staging — typed RequestTimeoutError, allocator and
+        request table bit-identical to before."""
+        import time
+
+        from paddle_tpu.inference.serving import RequestTimeoutError
+
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2, ingest_async=False) as eng:
+            free0 = eng.cache.allocator.num_free
+            n_reqs = len(eng._requests)
+            with pytest.raises(RequestTimeoutError):
+                eng.add_request(np.arange(1, 6, dtype=np.int32),
+                                SamplingParams(max_new_tokens=4),
+                                deadline=time.time() - 1.0)
+            assert eng.cache.allocator.num_free == free0
+            assert len(eng._requests) == n_reqs
+            assert not eng.has_work()
+            assert eng.metrics()["deadline_expired"] == 0  # never admitted
+
+    def test_mid_decode_expiry_frees_blocks_and_recycles_slot(self, model):
+        """A deadline expiring mid-decode ends the partial stream with
+        the typed reason, frees every block (high-water returns to the
+        burst baseline) and recycles the slot for the next admission."""
+        import time
+
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=1, ingest_async=False) as eng:
+            free0 = eng.cache.allocator.num_free
+            eng.reset_block_high_water()
+            rid = eng.add_request(np.arange(1, 7, dtype=np.int32),
+                                  SamplingParams(max_new_tokens=200),
+                                  deadline=time.time() + 0.4)
+            outs = []
+            while eng.has_work():
+                outs.extend(eng.step())
+            # partial stream: some tokens, then the typed end
+            assert outs[-1].finished and outs[-1].finish_reason == "timeout"
+            assert len(eng.request(rid).output_tokens) > 0
+            assert eng.request(rid).finish_reason() == "timeout"
+            # registry name (metrics lint): serving_deadline_expired_total
+            from paddle_tpu.observability import metrics as om
+
+            assert om.REGISTRY.get(
+                "serving_deadline_expired_total").value(
+                instance=eng._name) == 1
+            assert eng.metrics()["deadline_expired"] == 1
+            # allocator clean: all blocks back, slot reusable immediately
+            assert eng.cache.allocator.num_free == free0
+            out2 = eng.generate([np.arange(1, 5, dtype=np.int32)],
+                                SamplingParams(max_new_tokens=3))
+            assert len(out2[0]) == 4 + 3
+            assert eng.cache.allocator.num_free == free0
+            eng.reset_block_high_water()
+            assert eng.cache.allocator.high_water == 0
+
+    def test_generate_raises_after_drain(self, model):
+        import time
+
+        from paddle_tpu.inference.serving import RequestTimeoutError
+
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=1, ingest_async=False) as eng:
+            with pytest.raises(RequestTimeoutError):
+                eng.generate([np.arange(1, 7, dtype=np.int32)],
+                             SamplingParams(max_new_tokens=200),
+                             deadline=time.time() + 0.3)
+            # failed batch released its bookkeeping
+            assert not eng._requests
+
+    def test_generate_mid_admission_expiry_leaves_no_orphans(
+            self, model, monkeypatch):
+        """A deadline expiring BETWEEN a batch's admissions must not
+        orphan the already-admitted requests — they would decode to
+        completion on the next stream() and leak bookkeeping."""
+        import time
+
+        from paddle_tpu.inference.serving import RequestTimeoutError
+
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2, ingest_async=False) as eng:
+            free0 = eng.cache.allocator.num_free
+            real = time.time
+            deadline = real() + 30.0
+            calls = {"n": 0}
+
+            def fake_time():
+                # the SECOND add_request's admission check (and later
+                # reads) sees a clock past the deadline
+                calls["n"] += 1
+                return real() + (60.0 if calls["n"] >= 2 else 0.0)
+
+            monkeypatch.setattr(time, "time", fake_time)
+            with pytest.raises(RequestTimeoutError):
+                eng.generate([np.arange(1, 5, dtype=np.int32),
+                              np.arange(1, 7, dtype=np.int32)],
+                             SamplingParams(max_new_tokens=4),
+                             deadline=deadline)
+            monkeypatch.undo()
+            assert not eng._requests
+            assert not eng.has_work()
+            assert eng.cache.allocator.num_free == free0
+
+    def test_cancel_frees_and_types_reason(self, model):
+        with LLMEngine(model, num_blocks=32, block_size=8,
+                       max_batch_size=2, ingest_async=False) as eng:
+            free0 = eng.cache.allocator.num_free
+            rid = eng.add_request(np.arange(1, 9, dtype=np.int32),
+                                  SamplingParams(max_new_tokens=20))
+            eng.step()
+            assert eng.cancel(rid)
+            assert eng.request(rid).finish_reason() == "cancelled"
+            assert eng.cache.allocator.num_free == free0
+            assert not eng.cancel(rid)  # idempotent on finished
+
+
+# ---------------------------------------------------------------------------
+# LLMEngine.close() lifecycle (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+class TestEngineClose:
+    def test_close_frees_blocks_joins_ingest_and_guards(self, model):
+        from paddle_tpu.inference.serving import EngineClosedError
+
+        eng = LLMEngine(model, num_blocks=32, block_size=8,
+                        max_batch_size=2)  # async ingest on
+        free0 = eng.cache.allocator.num_free
+        eng.add_request(np.arange(1, 9, dtype=np.int32),
+                        SamplingParams(max_new_tokens=20))
+        eng.step()  # admitted: blocks held
+        assert eng.cache.allocator.num_free < free0
+        eng.close()
+        assert eng.cache.allocator.num_free == free0
+        assert eng._ingest._thread.is_alive() is False
+        for call in (eng.step, lambda: next(iter(eng.stream())),
+                     lambda: eng.add_request(np.arange(3, dtype=np.int32)),
+                     lambda: eng.generate([np.arange(3, dtype=np.int32)])):
+            with pytest.raises(EngineClosedError):
+                call()
+        eng.close()  # idempotent
+
+    def test_repeated_engines_do_not_grow_registry(self, model):
+        """Mirrors DevicePrefetcher.close(): per-instance registry series
+        are removed, so constructing engines in a loop keeps the metrics
+        registry bounded."""
+        from paddle_tpu.observability import metrics as om
+
+        names = []
+        for _ in range(3):
+            with LLMEngine(model, num_blocks=16, block_size=8,
+                           max_batch_size=1, ingest_async=False) as eng:
+                names.append(eng._name)
+                eng.generate([np.arange(1, 5, dtype=np.int32)],
+                             SamplingParams(max_new_tokens=2))
+        snap = om.REGISTRY.snapshot()
+        for metric in ("serving_requests_admitted_total",
+                       "serving_tokens_out_total", "serving_ttft_ms",
+                       "serving_deadline_expired_total"):
+            series = snap.get(metric, {"series": {}})["series"]
+            for name in names:
+                assert not any(name in k for k in series), (metric, name)
+
+
+# ---------------------------------------------------------------------------
+# fleet chaos drill + scaling (ISSUE 12 acceptance, slow tier — the
+# chaos_train.py discipline applied to serving)
+# ---------------------------------------------------------------------------
+
+def _chaos_env():
+    import os as _os
+
+    env = dict(_os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + _os.pathsep
+                + env.get("PYTHONPATH", "")})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+@pytest.mark.slow
+class TestChaosServeDrill:
+    @pytest.mark.parametrize("drill", ["kill", "hang", "drain"])
+    def test_drill(self, drill, tmp_path):
+        """ISSUE 12 acceptance: scripts/chaos_serve.py --drill kill runs
+        the storm (one replica SIGKILLed AND one hung mid-burst with
+        fleet >= 3); hang and drain exercise their paths in isolation.
+        Every drill asserts bit-exact outputs vs an undisturbed baseline,
+        typed-error accounting, liveness dip+recovery and clean
+        allocators — see the script for the full checklist."""
+        import subprocess
+        import sys as _sys
+
+        r = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "scripts",
+                                           "chaos_serve.py"),
+             "--drill", drill, "--fleet", "3", "--out", str(tmp_path)],
+            env=_chaos_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=560)
+        assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+        assert "SERVE DRILL PASSED" in r.stdout
+
+    def test_drill_shed(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        r = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "scripts",
+                                           "chaos_serve.py"),
+             "--drill", "shed", "--fleet", "2", "--out", str(tmp_path)],
+            env=_chaos_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=560)
+        assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+        assert "SERVE DRILL PASSED" in r.stdout
+
+
+@pytest.mark.slow
+class TestFleetScaling:
+    def test_fleet_ab_bit_exact_and_scales(self):
+        """ROADMAP item 1 / ISSUE 12: bench_serving --workload fleet —
+        1-replica vs 3-replica subprocess fleets over one seeded Poisson
+        burst, bit-exact vs the in-process engine, with real tokens/s
+        scaling from replica parallelism (threshold is deliberately
+        conservative vs near-linear: CI boxes share cores)."""
+        bsv = _bench_mod()
+        res = bsv.run_fleet_ab(tiny=True, seed=0, fleet=3)
+        assert res["bit_exact"], res
+        assert res["fleet"]["requests_shed"] == 0
+        assert res["scaling"] >= 1.3, res
