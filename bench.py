@@ -563,6 +563,49 @@ def bench_overlap(on_tpu):
     })
 
 
+def bench_streaming(on_tpu):
+    """Streaming data-plane A/B (ISSUE 13): the SAME deterministic record
+    stream driven through an identically-seeded fused step from memory vs
+    from atomic ``*.pdstream`` shards with per-record decode cost, a host
+    thread pool, and an injected-flaky filesystem ("io.stream.read"
+    transients riding the retry budget). The tracked value is the
+    device-utilization RATIO (stream/mem), each util read off the PR-10
+    ``io_host_blocked_ms`` backpressure telemetry — the ROADMAP item 3
+    acceptance is >= 0.9x at CPU smoke scale. Per-step losses must be
+    bit-identical across arms. Harness: scripts/bench_streaming.py."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import bench_streaming as bst
+
+    res = bst.run_ab(tiny=not on_tpu)
+    assert res["bit_exact"], "streaming arm diverged from in-memory arm"
+    _emit({
+        "metric": "ingest_stream_device_util_ratio" if on_tpu
+                  else "ingest_cpu_stream_device_util_ratio",
+        "value": res["util_ratio"], "unit": "ratio (stream/mem)",
+        "vs_baseline": None,
+        "device_util_stream": res["stream"]["device_util"],
+        "device_util_mem": res["mem"]["device_util"],
+        "examples_per_sec_stream": res["stream"]["examples_per_sec"],
+        "examples_per_sec_mem": res["mem"]["examples_per_sec"],
+        "host_blocked_ms_stream": res["stream"]["host_blocked_ms"],
+        "avg_queue_depth_stream": res["stream"]["avg_queue_depth"],
+        "bit_exact": res["bit_exact"],
+        "n_records": res["n_records"],
+        "batch_size": res["batch_size"],
+        "decode_delay_s": res["decode_delay_s"],
+        "flaky_read_period": res["flaky_read_period"],
+        "baseline_note": "A/B over one deterministic record stream; util "
+                         "= 1 - io_host_blocked_ms/wall per arm (the "
+                         "PR-10 backpressure telemetry); losses bit-equal "
+                         "across arms; stream arm includes injected "
+                         "transient read failures absorbed by the retry "
+                         "budget",
+    })
+
+
 def bench_serving(on_tpu):
     """LLM serving A/B (ISSUE 7 tentpole): one seeded Poisson multi-tenant
     request stream replayed through a naive batch-of-one ``model.generate``
@@ -810,6 +853,8 @@ if __name__ == "__main__":
         bench_ppyoloe(_on_tpu)
     elif workload == "overlap":
         bench_overlap(_on_tpu)
+    elif workload == "streaming":
+        bench_streaming(_on_tpu)
     elif workload == "serving":
         bench_serving(_on_tpu)
     elif workload == "llama":
@@ -822,6 +867,7 @@ if __name__ == "__main__":
                    lambda: bench_bert(_on_tpu),
                    lambda: bench_bert_varlen(_on_tpu),
                    lambda: bench_overlap(_on_tpu),
+                   lambda: bench_streaming(_on_tpu),
                    lambda: bench_serving(_on_tpu),
                    lambda: bench_ppyoloe(_on_tpu)):
             try:
@@ -832,4 +878,4 @@ if __name__ == "__main__":
     else:
         sys.exit(f"unknown workload {workload!r}; expected llama | resnet50 "
                  "| deepfm | bert | bert_varlen | ppyoloe | overlap | "
-                 "serving | all")
+                 "streaming | serving | all")

@@ -70,6 +70,9 @@ from .framework.io import CheckpointCorruptionError, load, save  # noqa: F401
 from .core.exceptions import (  # noqa: F401
     TrainDivergenceError, TrainStallError,
 )
+from .io.streaming import (  # noqa: F401
+    StreamCorruptionError, StreamReadError,
+)
 
 
 def in_dynamic_mode():

@@ -89,6 +89,13 @@ def _dir_bytes(path):
 _OPT_FILE = "optimizer.pdopt"
 _SCALER_FILE = "scaler.pdscaler"
 _SAMPLER_FILE = "sampler.pdsampler"
+# rank-local data-stream cursors (sharded streaming ingestion): one file
+# per rank beside the coordinator's legacy single-cursor file
+_RANK_SAMPLER_RE = re.compile(r"^sampler\.rank(\d+)\.pdsampler$")
+
+
+def _rank_sampler_file(rank):
+    return f"sampler.rank{int(rank)}.pdsampler"
 _HEALTH_FILE = "HEALTHY"
 _PLAN_FILE = "plan.json"
 
@@ -363,6 +370,11 @@ class CheckpointManager:
         self.wait()  # land the previous async write + run its retention
         if async_save is None:
             async_save = self.async_save
+        # one snapshot serves the legacy file and this rank's cursor
+        # file: state_dict() is not assumed cheap or pure, and two calls
+        # could yield two diverging files under concurrent consumption
+        sampler_state = (None if sampler is None
+                         else _resolve_sampler(sampler).state_dict())
         d = self.step_dir(step)
         # directory lifecycle (quarantine / cleanup / aux pickles) is
         # coordinator-only: in a multi-process save every rank enters here,
@@ -385,9 +397,8 @@ class CheckpointManager:
             if scaler is not None:
                 _fio.save(scaler.state_dict(),
                           os.path.join(d, _SCALER_FILE))
-            if sampler is not None:
-                _fio.save(_resolve_sampler(sampler).state_dict(),
-                          os.path.join(d, _SAMPLER_FILE))
+            if sampler_state is not None:
+                _fio.save(sampler_state, os.path.join(d, _SAMPLER_FILE))
             if plan is not None:
                 # step metadata: mesh shape + rule/strategy digest, so a
                 # restore onto an incompatible mesh fails typed instead
@@ -408,6 +419,32 @@ class CheckpointManager:
 
             sync_processes(f"ckpt_mgr_prepare:{d}")
             os.makedirs(d, exist_ok=True)  # non-shared-fs local mkdir
+        if sampler_state is not None:
+            # rank-LOCAL stream cursors (ISSUE 13): a sharded-by-rank
+            # StreamingDataset has a different position per rank, so the
+            # coordinator's sampler.pdsampler (kept above for back-compat
+            # and single-cursor samplers) is not enough — every rank
+            # writes its own sampler.rank{i}.pdsampler (its own file: no
+            # write races), before the shard-write commit barrier so
+            # COMMIT still implies all of them landed. Written in
+            # single-process runs too: a world-1 checkpoint must stay
+            # resumable into a LARGER world (auto_resume hands the rank
+            # states to set_group_state, which re-balances). The file is
+            # named from the STATE's own rank when it has one (under
+            # coordination-free launches, PADDLE_SKIP_DIST_INIT, every
+            # worker is jax process 0 regardless of its data rank).
+            # NOTE the supported topologies: multi-rank managers over
+            # ONE root require the coordination service (the barriers
+            # above serialize the directory lifecycle); coordination-
+            # free workers must each own a PRIVATE root (the chaos
+            # stream drill's ckpt.rank{i} pattern) — two uncoordinated
+            # saves into one root would race the quarantine/commit
+            # lifecycle no matter how the cursor files are named.
+            rank = sampler_state.get("rank", jax.process_index()) \
+                if isinstance(sampler_state, dict) else \
+                jax.process_index()
+            _fio.save(sampler_state,
+                      os.path.join(d, _rank_sampler_file(rank)))
         sd = {}
         if model is not None:
             sd.update(model.state_dict())
@@ -540,11 +577,40 @@ class CheckpointManager:
         sc_p = os.path.join(d, _SCALER_FILE)
         if scaler is not None and os.path.exists(sc_p):
             scaler.load_state_dict(_fio.load(sc_p))
-        sp_p = os.path.join(d, _SAMPLER_FILE)
-        if sampler is not None and os.path.exists(sp_p):
-            _resolve_sampler(sampler).set_state_dict(_fio.load(sp_p))
+        if sampler is not None:
+            self._restore_sampler(sampler, d)
         t1_ns = time.perf_counter_ns()
         _H_RESTORE_S.observe((t1_ns - t0_ns) / 1e9)
         _obs_trace.add_complete("ckpt.restore", t0_ns, t1_ns, cat="ckpt",
                                 args={"step": int(step)})
         return step
+
+    def _restore_sampler(self, sampler, d):
+        """Restore the data-stream cursor(s) recorded in step dir ``d``.
+
+        Precedence: per-rank cursor files (``sampler.rank{i}.pdsampler``,
+        written by multi-process saves) beat the coordinator's legacy
+        single file. A resumable that understands group state (a
+        sharded-by-rank ``StreamingDataset``) receives EVERY rank's
+        state via ``set_group_state`` — that is what lets an elastic
+        restart under a different world size re-balance the unconsumed
+        shards while preserving in-progress cursors; everything else
+        restores its own rank's file (same-world restarts), falling back
+        to the legacy file."""
+        r = _resolve_sampler(sampler)
+        rank_states = {}
+        for fn in os.listdir(d):
+            m = _RANK_SAMPLER_RE.match(fn)
+            if m:
+                rank_states[int(m.group(1))] = os.path.join(d, fn)
+        if rank_states and hasattr(r, "set_group_state"):
+            r.set_group_state([_fio.load(rank_states[k])
+                               for k in sorted(rank_states)])
+            return
+        mine = rank_states.get(jax.process_index())
+        if mine is not None:
+            r.set_state_dict(_fio.load(mine))
+            return
+        sp_p = os.path.join(d, _SAMPLER_FILE)
+        if os.path.exists(sp_p):
+            r.set_state_dict(_fio.load(sp_p))

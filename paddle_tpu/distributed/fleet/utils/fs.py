@@ -76,14 +76,21 @@ class LocalFS(FS):
     (the checkpoint-publish operations) retry transient OSErrors with the
     shared exponential-backoff shape (``FLAGS_ckpt_save_retries``) — on NFS
     and FUSE mounts a rename can fail transiently under server load — and
-    carry the ``fs.rename`` fault-injection site."""
+    carry the ``fs.rename`` fault-injection site. ``upload``/``download``
+    publish through ``utils.retry.atomic_copy`` (tmp → fsync → rename), so
+    a killed copy can never leave a torn destination visible — the same
+    guarantee ``rename`` already had. Listings are SORTED: the streaming
+    data plane derives its shard→rank assignment from ``ls_dir``, and
+    readdir order is filesystem-dependent (ext4 hash order vs HDFS
+    lexicographic), so an unsorted listing would silently train different
+    data per platform."""
 
     def ls_dir(self, fs_path):
-        """(dirs, files) directly under ``fs_path``."""
+        """(dirs, files) directly under ``fs_path``, each sorted."""
         if not self.is_exist(fs_path):
             return [], []
         dirs, files = [], []
-        for entry in os.listdir(fs_path):
+        for entry in sorted(os.listdir(fs_path)):
             if os.path.isdir(os.path.join(fs_path, entry)):
                 dirs.append(entry)
             else:
@@ -138,8 +145,30 @@ class LocalFS(FS):
             if not exist_ok:
                 raise FSFileExistsError(fs_path)
             return
-        with open(fs_path, "a"):
-            pass
+        from ....utils.retry import atomic_write, retry_os
+
+        # atomic empty-file publication: the path either exists complete
+        # (trivially, for an empty file) or not at all — uniform with the
+        # other write paths, and safe for sentinel-file callers
+        retry_os(lambda: atomic_write(fs_path, lambda f: None))
+
+    def upload(self, local_path, fs_path):
+        """Copy ``local_path`` into the filesystem at ``fs_path``
+        atomically: a crash mid-copy leaves no torn file visible."""
+        if not os.path.exists(local_path):
+            raise FSFileNotExistsError(local_path)
+        from ....utils.retry import atomic_copy, retry_os
+
+        retry_os(lambda: atomic_copy(local_path, fs_path))
+
+    def download(self, fs_path, local_path):
+        """Copy ``fs_path`` out to ``local_path`` atomically (same
+        contract as :meth:`upload`, mirrored)."""
+        if not self.is_exist(fs_path):
+            raise FSFileNotExistsError(fs_path)
+        from ....utils.retry import atomic_copy, retry_os
+
+        retry_os(lambda: atomic_copy(fs_path, local_path))
 
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
         if not self.is_exist(src_path):
@@ -151,10 +180,10 @@ class LocalFS(FS):
         return self.rename(src_path, dst_path)
 
     def list_dirs(self, fs_path):
-        """All sub-directory names directly under ``fs_path``."""
+        """All sub-directory names directly under ``fs_path``, sorted."""
         if not self.is_exist(fs_path):
             return []
-        return [entry for entry in os.listdir(fs_path)
+        return [entry for entry in sorted(os.listdir(fs_path))
                 if os.path.isdir(os.path.join(fs_path, entry))]
 
 
@@ -191,6 +220,9 @@ class HDFSClient(FS):
         return proc.stdout
 
     def ls_dir(self, fs_path):
+        """(dirs, files), each sorted — the FS-parity contract with
+        LocalFS (hadoop already lists lexicographically, but the sort
+        makes the determinism explicit rather than inherited)."""
         out = self._run("-ls", fs_path)
         dirs, files = [], []
         for line in out.splitlines():
@@ -199,7 +231,7 @@ class HDFSClient(FS):
                 continue
             name = os.path.basename(fields[-1])
             (dirs if fields[0].startswith("d") else files).append(name)
-        return dirs, files
+        return sorted(dirs), sorted(files)
 
     def is_exist(self, fs_path):
         try:

@@ -18,7 +18,13 @@ def main():
 
     heartbeat.write(step=None)
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if nprocs > 1:
+    # PADDLE_SKIP_DIST_INIT: launcher-supervised workers that shard only
+    # DATA (independent replicas over a sharded stream — no cross-rank
+    # collectives, per-rank checkpoints) opt out of the coordination
+    # service: they must not share commit barriers that would couple
+    # their otherwise-independent checkpoint directories. Supervision
+    # (heartbeats, watchdog, restart budget) is unaffected.
+    if nprocs > 1 and not os.environ.get("PADDLE_SKIP_DIST_INIT"):
         import jax
 
         # sitecustomize-style PJRT plugins can override JAX_PLATFORMS;

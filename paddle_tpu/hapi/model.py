@@ -381,7 +381,13 @@ class Model:
         return batch[:-1], batch[-1:]
 
     def _as_loader(self, data, batch_size, shuffle, num_workers, drop_last):
-        if data is None or isinstance(data, DataLoader):
+        from ..io.streaming import StreamingDataset
+
+        # a StreamingDataset already yields collated BATCHES (its own
+        # batch_size, sharding and resume cursor) — wrapping it in a
+        # DataLoader would re-batch batches; pass it through like a
+        # loader so fit() streams it via the DevicePrefetcher unchanged
+        if data is None or isinstance(data, (DataLoader, StreamingDataset)):
             return data
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)

@@ -768,6 +768,17 @@ from .prefetch import DevicePrefetcher  # noqa: E402,F401
 
 __all__ += ["DevicePrefetcher"]
 
+# fault-tolerant streaming data plane (sharded ingestion over the fleet
+# FS surface; resumable through the same sampler-state protocol)
+from .streaming import (  # noqa: E402,F401
+    ShardManifest, StreamCorruptionError, StreamReadError,
+    StreamingDataset, pack_arrays, read_stream_shard, unpack_arrays,
+    write_stream_shard)
+
+__all__ += ["StreamingDataset", "ShardManifest", "StreamReadError",
+            "StreamCorruptionError", "write_stream_shard",
+            "read_stream_shard", "pack_arrays", "unpack_arrays"]
+
 
 def resolve_resumable(stream):
     """Unwrap pipeline layers (DevicePrefetcher → its source, DataLoader →

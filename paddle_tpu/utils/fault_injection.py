@@ -48,6 +48,16 @@ Sites wired into the framework:
   to a replica: the dispatch fails, the request requeues at the front
   with a bumped generation, and a half-delivered copy can never
   double-emit into the replayed stream.
+- ``io.stream.open``      — StreamingDataset shard open, fired before the
+  file handle is acquired: transient failures ride the shared retry/
+  backoff budget; exhaustion surfaces as typed StreamReadError.
+- ``io.stream.read``      — StreamingDataset frame read, fired before
+  each positioned read (the retry re-seeks, so a flaky read can never
+  skew the record framing); exhaustion surfaces as StreamReadError.
+- ``io.stream.corrupt``   — StreamingDataset record decode: the record
+  is treated as corrupt and must be QUARANTINED (skipped under the
+  per-epoch skip budget, counted in io_records_quarantined_total) —
+  never retried, never silently dropped past the budget.
 
 Arming a site is scoped and seeded::
 
@@ -75,7 +85,8 @@ __all__ = ["SITES", "InjectedFault", "inject", "fire", "should_fire"]
 SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "io.prefetch", "proc.kill", "hb.write", "train.stall",
          "train.spike", "serve.replica_crash", "serve.replica_hang",
-         "serve.dispatch")
+         "serve.dispatch", "io.stream.open", "io.stream.read",
+         "io.stream.corrupt")
 
 
 class InjectedFault(OSError):

@@ -26,7 +26,7 @@ import random
 import shutil
 import time
 
-__all__ = ["retry_os", "atomic_write", "replace_across_fs"]
+__all__ = ["retry_os", "atomic_write", "replace_across_fs", "atomic_copy"]
 
 # deterministic failures: retrying can't fix a missing path, a permission
 # wall, or a path-type mismatch — surface them immediately, no backoff
@@ -88,17 +88,7 @@ def replace_across_fs(src, dst):
     tmp = f"{dst}.xfs.{os.getpid()}"
     try:
         if os.path.isdir(src):
-            if os.path.isdir(tmp):
-                shutil.rmtree(tmp)
-            shutil.copytree(src, tmp)
-            # copytree does not fsync: without this walk a power loss
-            # after the publish could leave dst as a complete-looking
-            # directory of truncated files (the single-file branch below
-            # fsyncs for the same reason)
-            for root, _dirs, files in os.walk(tmp):
-                for fn in files:
-                    with open(os.path.join(root, fn), "rb") as f:
-                        os.fsync(f.fileno())
+            _copytree_fsynced(src, tmp)
         else:
             with open(src, "rb") as fsrc, open(tmp, "wb") as fdst:
                 shutil.copyfileobj(fsrc, fdst)
@@ -123,6 +113,76 @@ def replace_across_fs(src, dst):
             os.remove(src)
     except OSError:
         pass
+
+
+def _copytree_fsynced(src, tmp):
+    """Copy ``src`` to the fresh tmp tree ``tmp`` and fsync every file:
+    copytree alone does not fsync, and without the walk a power loss
+    after a later publish could leave the destination as a
+    complete-looking directory of truncated files."""
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    shutil.copytree(src, tmp)
+    for root, _dirs, files in os.walk(tmp):
+        for fn in files:
+            with open(os.path.join(root, fn), "rb") as f:
+                os.fsync(f.fileno())
+
+
+def atomic_copy(src, dst):
+    """Copy ``src`` (file or directory) to ``dst`` with atomic
+    visibility: the payload lands under a tmp name next to ``dst``,
+    is fsynced, and publishes with one rename — a torn ``dst`` is never
+    visible. Files route through :func:`atomic_write` (fully atomic).
+
+    Directory destinations are atomic-or-RECOVERABLE: ``os.replace``
+    cannot clobber a non-empty directory, so an existing ``dst`` is
+    first moved to the deterministic tool-owned quarantine name
+    ``dst + ".__atomic_copy_old__"`` and deleted only after the new
+    tree publishes. A process killed inside that window leaves ``dst``
+    absent with the old tree intact under the quarantine name — the
+    NEXT ``atomic_copy`` to the same destination restores it before
+    doing anything else, so the previous contents are never lost (an
+    in-process failure restores it immediately). The quarantine name is
+    deliberately ugly: it belongs to this function, and anything found
+    there is treated as its own crash leftover."""
+    if os.path.isdir(src):
+        old = f"{dst}.__atomic_copy_old__"
+        # crash recovery from a previous copy killed between quarantine
+        # and publish: the old tree is authoritative while dst is
+        # missing; once dst exists again the leftover is stale
+        if os.path.isdir(old):
+            if not os.path.exists(dst):
+                os.replace(old, dst)
+            else:
+                shutil.rmtree(old)
+        tmp = f"{dst}.cp.{os.getpid()}"
+        try:
+            _copytree_fsynced(src, tmp)
+            if os.path.isdir(dst):
+                os.replace(dst, old)
+                try:
+                    replace_across_fs(tmp, dst)
+                except BaseException:
+                    try:
+                        if not os.path.exists(dst):
+                            os.replace(old, dst)
+                    except OSError:
+                        pass
+                    raise
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                replace_across_fs(tmp, dst)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return
+
+    def body(f):
+        with open(src, "rb") as fsrc:
+            shutil.copyfileobj(fsrc, f)
+
+    atomic_write(dst, body)
 
 
 def atomic_write(dest, write_body, fire_site=None):

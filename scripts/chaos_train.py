@@ -9,6 +9,16 @@ Orchestrator mode (default — run it directly)::
     python scripts/chaos_train.py [--out DIR] [--scenarios kill,preempt,hang]
     python scripts/chaos_train.py --drill spike
     python scripts/chaos_train.py --drill plan
+    python scripts/chaos_train.py --drill stream
+
+``--drill stream`` (ISSUE 13) reruns kill/preempt with the workers
+training over a slow+flaky SHARDED RECORD STREAM (``io.StreamingDataset``
+over atomic ``*.pdstream`` shards, per-rank shard assignment, thread-pool
+decode, injected ``io.stream.read`` transients riding the retry budget)
+with per-rank cursor checkpoints — recovery must be bit-exact on BOTH
+ranks — plus a corrupt-shard arm that must finish via the quarantine
+skip budget (``io_records_quarantined_total`` counted) instead of
+crashing.
 
 ``--drill plan`` reruns the kill/preempt/hang scenarios with the worker
 training under a dp=2 x tp=2 **sharded plan** (column/row tp split,
@@ -105,6 +115,7 @@ def worker_main():
     chaos_rank = int(os.environ.get("CHAOS_RANK", "-1"))
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     with_plan = bool(os.environ.get("CHAOS_PLAN"))
+    stream_dir = os.environ.get("CHAOS_STREAM")
 
     paddle.seed(0)
     np.random.seed(0)
@@ -175,13 +186,41 @@ def worker_main():
         opt = paddle.optimizer.SGD(learning_rate=0.1,
                                    parameters=model.parameters())
     fstep = FusedTrainStep(model, opt, plan=plan)
-    sampler = io.BucketedBatchSampler(
-        VarLen(), batch_size=BATCH, boundaries=BOUNDARIES, shuffle=True,
-        seed=11, lengths=lengths.tolist(), drop_last=True)
-    loader = io.DataLoader(VarLen(), batch_sampler=sampler,
-                           collate_fn=io.PadToBucket(BOUNDARIES))
+    if stream_dir:
+        # the --drill stream data plane: a slow+flaky sharded record
+        # stream read through StreamingDataset instead of in-memory
+        # arrays. Each rank owns its shard slice (sorted-manifest
+        # round-robin), decodes on the host thread pool (the sleep is
+        # the simulated tokenize cost), pads through the SAME
+        # PadToBucket collate as the base drill, and checkpoints its
+        # cursor per rank. Workers run coordination-free
+        # (PADDLE_SKIP_DIST_INIT): ranks train DIFFERENT data, so their
+        # model replicas diverge by design and each rank owns a private
+        # checkpoint directory — the supervision layer (heartbeats,
+        # watchdog, restart budget) still covers the whole group.
+        import time as _time_mod
 
-    mgr = paddle.CheckpointManager(os.path.join(out, "ckpt"), keep_last_n=3)
+        def slow_decode(payload):
+            _time_mod.sleep(0.002)
+            return io.unpack_arrays(payload)
+
+        loader = io.StreamingDataset(
+            stream_dir, batch_size=BATCH, num_workers=2,
+            decode_fn=slow_decode,
+            collate_fn=io.PadToBucket(BOUNDARIES, as_tensor=False),
+            max_skips_per_epoch=int(
+                os.environ.get("CHAOS_STREAM_SKIPS", "0")),
+            name=f"chaos_stream.rank{rank}")
+        ckpt_dir = os.path.join(out, f"ckpt.rank{rank}")
+    else:
+        sampler = io.BucketedBatchSampler(
+            VarLen(), batch_size=BATCH, boundaries=BOUNDARIES, shuffle=True,
+            seed=11, lengths=lengths.tolist(), drop_last=True)
+        loader = io.DataLoader(VarLen(), batch_sampler=sampler,
+                               collate_fn=io.PadToBucket(BOUNDARIES))
+        ckpt_dir = os.path.join(out, "ckpt")
+
+    mgr = paddle.CheckpointManager(ckpt_dir, keep_last_n=3)
     # plan= arms the fingerprint gate: a restore under a DIFFERENT mesh /
     # rule table raises PlanMismatchError instead of mis-sharding
     resumed = mgr.auto_resume(model, fstep, sampler=loader, plan=plan)
@@ -215,6 +254,15 @@ def worker_main():
     import contextlib
 
     with contextlib.ExitStack() as stack:
+        flaky_n = int(os.environ.get("CHAOS_STREAM_FLAKY", "0"))
+        if stream_dir and flaky_n > 0:
+            # the FLAKY filesystem: every Nth positioned shard read
+            # fails transiently (InjectedFault is an OSError, so the
+            # shared retry/backoff path absorbs it) — armed in baseline
+            # and chaos arms alike so every arm trains over the same
+            # flaky stream and recovery is invisible to the data
+            stack.enter_context(
+                fi.inject("io.stream.read", every_n=flaky_n))
         hit = (scenario in ("kill", "hang") and rank == chaos_rank
                and chaos_step > base and not os.path.exists(marker))
         if hit:
@@ -230,6 +278,14 @@ def worker_main():
                               checkpoint=mgr, sampler=loader)
             base += res["steps"]
 
+    if stream_dir:
+        import json
+
+        with open(os.path.join(out, f"stream_stats.rank{rank}.json"),
+                  "w") as f:
+            st = loader.stats()
+            st.pop("quarantine_log", None)
+            json.dump(st, f)
     open(os.path.join(out, f"done.rank{rank}"), "w").write(str(base))
     return 0
 
@@ -501,6 +557,172 @@ def plan_drill(out_root, scenarios=("kill", "preempt", "hang")):
 
 
 # ---------------------------------------------------------------------------
+# stream drill (fault-tolerant streaming data plane — ISSUE 13)
+# ---------------------------------------------------------------------------
+
+N_STREAM_SHARDS = 6     # 48 samples -> 8 records/shard; world 2 -> 3/rank
+
+
+def stream_make_main():
+    """Shard-maker worker mode (``CHAOS_STREAM_MAKE=<dest>``): writes the
+    drill's deterministic varlen dataset as ``N_STREAM_SHARDS`` atomic
+    ``*.pdstream`` shards. Runs as a subprocess so the orchestrator never
+    imports jax."""
+    import numpy as np
+
+    import paddle_tpu.io as io
+
+    dest = os.environ["CHAOS_STREAM_MAKE"]
+    os.makedirs(dest, exist_ok=True)
+    rng = np.random.RandomState(5)
+    lengths = rng.randint(3, 25, size=N_SAMPLES)
+    xs = [rng.randn(int(n), FEATS).astype("float32") for n in lengths]
+    ys = rng.randn(N_SAMPLES).astype("float32")
+    per = N_SAMPLES // N_STREAM_SHARDS
+    for s in range(N_STREAM_SHARDS):
+        recs = [(xs[i], np.float32(ys[i]))
+                for i in range(s * per, (s + 1) * per)]
+        io.write_stream_shard(
+            os.path.join(dest, f"shard-{s:02d}.pdstream"), recs)
+    return 0
+
+
+def make_stream_shards(dest):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "CHAOS_STREAM_MAKE": dest,
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, cwd=REPO, capture_output=True, text=True,
+                       timeout=300)
+    if r.returncode != 0:
+        raise AssertionError(f"shard maker failed: {r.stderr[-800:]}")
+
+
+def corrupt_one_record(shards_dir, shard_name="shard-02.pdstream",
+                       byte_offset=40):
+    """Flip one byte inside a record payload (past the 8-byte magic and
+    the first 8-byte frame header), so the record's CRC no longer
+    matches — the quarantine path's on-disk trigger."""
+    p = os.path.join(shards_dir, shard_name)
+    raw = bytearray(open(p, "rb").read())
+    raw[byte_offset] ^= 0xFF
+    with open(p, "wb") as f:
+        f.write(bytes(raw))
+
+
+def read_stream_stats(out, rank=0):
+    import json
+
+    with open(os.path.join(out, f"stream_stats.rank{rank}.json")) as f:
+        return json.load(f)
+
+
+def stream_drill(out_root, scenarios=("kill", "preempt")):
+    """The ISSUE-13 acceptance drill: a 2-worker launcher job trains over
+    a slow (thread-pool decode with per-record cost) + flaky (injected
+    ``io.stream.read`` transients, absorbed by the retry budget) sharded
+    record stream, with per-rank shard assignment and per-rank cursor
+    checkpoints. SIGKILL and graceful preemption mid-epoch must resume to
+    per-step loss sequences bit-identical to the undisturbed baseline —
+    on BOTH ranks (they train different shards). A separate corrupt-shard
+    arm flips a byte on disk and must FINISH via quarantine (counted)
+    under the skip budget instead of crashing."""
+    print(f"[chaos] stream drill, scratch: {out_root}")
+    shards = os.path.join(out_root, "shards")
+    make_stream_shards(shards)
+    stream_env = {
+        "CHAOS_STREAM": shards,
+        "CHAOS_STREAM_FLAKY": "17",
+        # ranks shard the DATA and keep private model replicas/ckpt dirs;
+        # no cross-rank collectives -> no coordination service
+        "PADDLE_SKIP_DIST_INIT": "1",
+    }
+
+    print("[chaos] stream baseline (uninterrupted 2-worker run)...")
+    base_out = os.path.join(out_root, "stream_baseline")
+    r = run_job(base_out, "none", extra_env=stream_env)
+    check(r.returncode == 0,
+          f"stream baseline exits 0 (got {r.returncode}): "
+          f"{r.stderr[-800:]}")
+    baseline = {rk: read_losses(base_out, rank=rk) for rk in (0, 1)}
+    for rk in (0, 1):
+        check(baseline[rk] and sorted(baseline[rk])
+              == list(range(1, len(baseline[rk]) + 1)),
+              f"stream baseline rank{rk} logged a contiguous "
+              f"{len(baseline[rk])}-step sequence")
+    stats = read_stream_stats(base_out)
+    check(stats["retries"] >= 1 and stats["quarantined"] == 0,
+          f"baseline stream was flaky-but-clean: {stats['retries']} "
+          "transient read failures retried, 0 records quarantined")
+
+    results = {}
+    for sc in scenarios:
+        out = os.path.join(out_root, f"stream_{sc}")
+        print(f"[chaos] stream scenario {sc!r}...")
+        if sc == "kill":
+            r = run_job(out, "kill", chaos_step=5, chaos_rank=1,
+                        max_restart=2, extra_env=stream_env)
+        elif sc == "preempt":
+            r = run_job(out, "preempt", chaos_step=WINDOW,
+                        max_restart=0, extra_env=stream_env)
+        else:
+            raise SystemExit(f"unknown stream scenario {sc!r}")
+        check(r.returncode == 0,
+              f"stream {sc}: job completes within budget "
+              f"(rc={r.returncode}): {r.stderr[-800:]}")
+        for rk in (0, 1):
+            losses = read_losses(out, rank=rk)
+            check(losses == baseline[rk],
+                  f"stream {sc} rank{rk}: loss sequence bit-identical to "
+                  f"baseline ({len(losses)} steps)")
+        if sc == "kill":
+            check("restart 1/" in r.stderr,
+                  "stream kill: consumed restart budget")
+        if sc == "preempt":
+            check("restart budget untouched" in r.stderr,
+                  "stream preempt: relaunch consumed zero restart budget")
+        results[sc] = r.elapsed
+        print(f"  done in {r.elapsed:.1f}s")
+
+    # corrupt-shard arm: single worker, one flipped byte on disk, a skip
+    # budget that admits it — the job must FINISH (quarantine, counted),
+    # not crash, and train strictly fewer records than the clean stream
+    print("[chaos] stream scenario 'corrupt'...")
+    cshards = os.path.join(out_root, "shards_corrupt")
+    import shutil as _shutil
+
+    _shutil.copytree(shards, cshards)
+    corrupt_one_record(cshards)
+    out = os.path.join(out_root, "stream_corrupt")
+    r = run_job(out, "none", nproc=1,
+                extra_env=dict(stream_env, CHAOS_STREAM=cshards,
+                               CHAOS_STREAM_SKIPS="4"))
+    check(r.returncode == 0,
+          f"corrupt arm finishes via quarantine (rc={r.returncode}): "
+          f"{r.stderr[-800:]}")
+    cstats = read_stream_stats(out)
+    check(cstats["quarantined"] >= 1,
+          f"corrupt record was quarantined and counted "
+          f"({cstats['quarantined']}x, io_records_quarantined_total)")
+    total = EPOCHS * N_SAMPLES
+    check(cstats["records"] + cstats["quarantined"] == total
+          and cstats["records"] < total,
+          f"quarantined records were SKIPPED, not trained: "
+          f"{cstats['records']} delivered + {cstats['quarantined']} "
+          f"quarantined == {total} read")
+    results["corrupt"] = r.elapsed
+    print(f"  done in {r.elapsed:.1f}s")
+
+    print("[chaos] STREAM DRILL PASSED:",
+          ", ".join(f"{k}={v:.1f}s" for k, v in results.items()))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
 
@@ -578,16 +800,22 @@ def main(argv=None):
     ap.add_argument("--out", default=None,
                     help="scratch dir (default: a fresh tempdir)")
     ap.add_argument("--scenarios", default="kill,preempt,hang")
-    ap.add_argument("--drill", default=None, choices=["spike", "plan"],
+    ap.add_argument("--drill", default=None,
+                    choices=["spike", "plan", "stream"],
                     help="run one named drill instead of the launcher "
                          "scenarios (spike: divergence-sentinel "
                          "detect/rollback/skip/recover; plan: kill/"
                          "preempt/hang under a dp x tp sharded plan, "
-                         "restart bit-exact)")
+                         "restart bit-exact; stream: kill/preempt over a "
+                         "slow+flaky sharded record stream, per-rank "
+                         "cursors resume bit-exact + corrupt-shard "
+                         "quarantine arm)")
     args = ap.parse_args(argv)
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_train.")
     if args.drill == "spike":
         return spike_drill(out_root)
+    if args.drill == "stream":
+        return stream_drill(out_root)
     if args.drill == "plan":
         return plan_drill(
             out_root, tuple(s for s in args.scenarios.split(",") if s))
@@ -662,6 +890,8 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
+    if os.environ.get("CHAOS_STREAM_MAKE"):
+        sys.exit(stream_make_main())
     if os.environ.get("CHAOS_OUT") and os.environ.get("CHAOS_SPIKE_MODE"):
         sys.exit(spike_worker_main())
     if os.environ.get("CHAOS_OUT") and os.environ.get("PADDLE_TRAINER_ID"):

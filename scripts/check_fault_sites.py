@@ -31,11 +31,14 @@ FLAGS_SOURCE = os.path.join(REPO, "paddle_tpu", "core", "flags.py")
 # flag families under the exercised-by-a-test contract
 FLAG_PREFIXES = ("sentinel_", "ckpt_")
 # non-test files that legitimately exercise sites end to end: the
-# training chaos drill AND (ISSUE 12) the serving chaos drill — the
+# training chaos drill, (ISSUE 12) the serving chaos drill — the
 # serve.* sites are armed via env in replica subprocesses, so the drill
-# script is where the site strings live
+# script is where the site strings live — and (ISSUE 13) the streaming
+# bench, whose measured arm arms io.stream.read flakiness so robustness
+# is part of the benched path
 EXTRA_EXERCISERS = (os.path.join(REPO, "scripts", "chaos_train.py"),
-                    os.path.join(REPO, "scripts", "chaos_serve.py"))
+                    os.path.join(REPO, "scripts", "chaos_serve.py"),
+                    os.path.join(REPO, "scripts", "bench_streaming.py"))
 
 
 def registered_sites(source_path=SITES_SOURCE):
