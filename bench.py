@@ -676,6 +676,52 @@ def bench_serving(on_tpu):
                          "served (shared blocks are the avoided work); "
                          "greedy outputs bit-exact across arms",
     })
+    # quantized-serving A/B (ISSUE 14): int8 paged-KV pools at the SAME
+    # pool byte budget as the fp32 arm — the tracked line is the int8
+    # arm's tokens/s, plus a second line pinning the capacity ratio
+    # (usable int8 blocks per fp32 block at equal bytes; deterministic
+    # arithmetic, so the tripwire holds it exactly round over round)
+    qz = bsv.run_quantized_ab(tiny=not on_tpu)
+    assert qz["deterministic"], \
+        "int8-KV greedy decode was not deterministic run-to-run"
+    _emit({
+        "metric": "serving_quantized_tokens_per_sec" if on_tpu
+                  else "serving_cpu_quantized_tokens_per_sec",
+        "value": qz["int8"]["tokens_per_sec"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "tokens_per_sec_fp32": qz["fp32"]["tokens_per_sec"],
+        "tokens_per_sec_ratio": qz["tokens_per_sec_ratio"],
+        "capacity_ratio": qz["capacity_ratio"],
+        "pool_blocks_fp32": qz["pool_blocks_fp32"],
+        "pool_blocks_int8": qz["pool_blocks_int8"],
+        "kv_bytes_saved": qz["kv_bytes_saved"],
+        "queued_on_exhaustion_fp32": qz["fp32"]["queued_on_exhaustion"],
+        "queued_on_exhaustion_int8": qz["int8"]["queued_on_exhaustion"],
+        "evictions_fp32": qz["fp32"]["evictions"],
+        "evictions_int8": qz["int8"]["evictions"],
+        "deterministic": qz["deterministic"],
+        "token_agreement_vs_fp32": qz["token_agreement_vs_fp32"],
+        "num_requests": qz["num_requests"],
+        "baseline_note": "A/B over one seeded Poisson burst; both arms "
+                         "hold the SAME pool byte budget (int8 codes + "
+                         "f32 scale sidecars vs fp32 payload); int8 "
+                         "greedy token ids asserted identical "
+                         "run-to-run",
+    })
+    _emit({
+        "metric": "serving_quantized_capacity_ratio" if on_tpu
+                  else "serving_cpu_quantized_capacity_ratio",
+        "value": qz["capacity_ratio"],
+        "unit": "ratio (int8 blocks / fp32 blocks at equal bytes)",
+        "vs_baseline": None,
+        "pool_blocks_fp32": qz["pool_blocks_fp32"],
+        "pool_blocks_int8": qz["pool_blocks_int8"],
+        "kv_bytes_saved": qz["kv_bytes_saved"],
+        "baseline_note": "static pool arithmetic "
+                         "(kv_pool_bytes_per_block) — the >=1.5x "
+                         "concurrent-capacity acceptance, held exactly "
+                         "by the regression tripwire",
+    })
     # fleet scaling A/B (ISSUE 12): 1-replica vs N-replica subprocess
     # fleets behind the same Router/RPC path, so the tracked line is pure
     # replica parallelism — the ROADMAP item 1 tokens/s-scaling evidence,

@@ -12,14 +12,18 @@ from .errors import (  # noqa: F401
     EngineClosedError, FleetOverloadedError, ReplicaCrashLoopError,
     RequestTimeoutError,
 )
-from .kv_cache import BlockAllocator, PagedKVCache, PrefixCache  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    BlockAllocator, KV_QMAX, PagedKVCache, PrefixCache,
+    kv_pool_bytes_per_block, quantize_kv_rows,
+)
 from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
 from .paged_attention import (  # noqa: F401
     paged_decode_attention, paged_multiquery_attention,
 )
 from .engine import (  # noqa: F401
-    LLMEngine, StepOutput, is_llama_artifact, load_llama_artifact,
-    save_llama_artifact,
+    LLMEngine, StepOutput, dequantize_state_dict, is_llama_artifact,
+    is_quantized_artifact, load_llama_artifact, load_llama_state_dict,
+    quantize_state_dict, save_llama_artifact,
 )
 from . import fleet  # noqa: F401  (fleet.Router — the ISSUE-12 layer)
 
@@ -28,6 +32,9 @@ __all__ = [
     "SamplingParams", "Scheduler", "paged_decode_attention",
     "paged_multiquery_attention", "LLMEngine", "StepOutput",
     "save_llama_artifact", "load_llama_artifact", "is_llama_artifact",
+    "is_quantized_artifact", "load_llama_state_dict",
+    "quantize_state_dict", "dequantize_state_dict", "KV_QMAX",
+    "quantize_kv_rows", "kv_pool_bytes_per_block",
     "fleet", "RequestTimeoutError", "FleetOverloadedError",
     "EngineClosedError", "ReplicaCrashLoopError",
 ]

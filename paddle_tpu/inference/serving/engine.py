@@ -61,7 +61,9 @@ from .scheduler import (Request, SamplingParams, Scheduler,
                         _M_PREFIX_REUSED, _M_QUEUED_EXH)
 
 __all__ = ["LLMEngine", "StepOutput", "save_llama_artifact",
-           "load_llama_artifact", "EngineClosedError",
+           "load_llama_artifact", "load_llama_state_dict",
+           "is_quantized_artifact", "quantize_state_dict",
+           "dequantize_state_dict", "EngineClosedError",
            "RequestTimeoutError"]
 
 # engine-owned latency/utilization observability (ISSUE 10): TTFT and
@@ -105,6 +107,16 @@ _M_DEADLINE = _obs_metrics.counter(
     "requests aborted by the engine because their deadline expired "
     "(admission-time rejections raise before a request exists and are "
     "not counted here)")
+_M_KV_SAVED = _obs_metrics.counter(
+    "serving_kv_bytes_saved_total",
+    "pool bytes saved by int8 KV quantization vs the same pool in the "
+    "model dtype (scale sidecars charged against the saving; counted "
+    "once at engine construction)")
+_G_QUANT_BLOCKS = _obs_metrics.gauge(
+    "serving_quantized_kv_blocks_in_use",
+    "int8-quantized KV pool blocks held by live requests after the last "
+    "step (0 series absent on unquantized engines) — the occupancy the "
+    "halved block memory buys")
 
 # the ONE list of every serving metric handle an engine instance owns —
 # metrics() and reset_metrics() both iterate it, so a new metric cannot
@@ -113,8 +125,9 @@ _M_DEADLINE = _obs_metrics.counter(
 _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
                     _M_PREFIX_REUSED, _M_COW, _M_PREFILLS,
                     _M_PREFILL_CHUNKS, _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED,
-                    _M_TOKENS, _M_DEADLINE, _H_TTFT, _H_ITL, _G_SPEC_RATIO,
-                    _G_KV_UTIL, _G_OCCUPANCY)
+                    _M_TOKENS, _M_DEADLINE, _M_KV_SAVED, _H_TTFT, _H_ITL,
+                    _G_SPEC_RATIO, _G_KV_UTIL, _G_OCCUPANCY,
+                    _G_QUANT_BLOCKS)
 
 
 @dataclasses.dataclass
@@ -233,7 +246,7 @@ class LLMEngine:
                  max_batch_size=4, max_model_len=None, prefill_buckets=None,
                  max_prefills_per_step=1, ingest_async=True, plan=None,
                  enable_prefix_cache=False, max_prefill_tokens_per_step=None,
-                 draft_model=None, spec_tokens=2):
+                 draft_model=None, spec_tokens=2, kv_dtype=None):
         from ...models.llama import LlamaForCausalLM
 
         if not isinstance(model, LlamaForCausalLM):
@@ -275,8 +288,15 @@ class LLMEngine:
                 RuntimeWarning)
         self.max_pages = self.max_model_len // self.block_size
         dtype = model.llama.layers[0].self_attn.k_proj.weight.dtype
+        # int8 paged-KV quantization (ISSUE 14): pools store codes +
+        # per-row scale sidecars, dequantized inside the attention
+        # kernels; everything identity-shaped (allocator, prefix cache,
+        # COW, tables) is payload-dtype-blind and composes unchanged
+        self.kv_dtype = kv_dtype
         self.cache = PagedKVCache(self.config, num_blocks, block_size,
-                                  dtype=dtype)
+                                  dtype=dtype, kv_dtype=kv_dtype)
+        self._kv_bytes_saved = self.cache.bytes_saved_vs_unquantized(
+            self.config)
         # prefix sharing (ISSUE 11): content-hashed block identity over the
         # pool — admission charges only unshared blocks
         self.prefix_cache = (PrefixCache(self.cache.allocator,
@@ -296,6 +316,9 @@ class LLMEngine:
                                    max_batch_size, max_prefills_per_step,
                                    instance=self._name,
                                    prefix_cache=self.prefix_cache)
+        if self.cache.quantized:
+            _M_KV_SAVED.inc(self._kv_bytes_saved, instance=self._name)
+            _G_QUANT_BLOCKS.set(0, instance=self._name)
         self.max_batch_size = int(max_batch_size)
         buckets = prefill_buckets or _default_buckets(self.block_size,
                                                       self.max_model_len)
@@ -333,7 +356,7 @@ class LLMEngine:
                       .weight.dtype)
             self.draft_cache = PagedKVCache(
                 draft_model.config, num_blocks, block_size, dtype=ddtype,
-                allocator=self.cache.allocator)
+                allocator=self.cache.allocator, kv_dtype=kv_dtype)
             self._draft_params = draft_model._unique_params()
             self._draft_prefill_name = f"llm_engine_draft_prefill#{n}"
             self._draft_decode_name = f"llm_engine_draft_decode#{n}"
@@ -525,12 +548,15 @@ class LLMEngine:
     def _make_chunk_fn(self, model, params):
         """Pure chunk-prefill step over ``model``: ``(param_arrays,
         ids [1, C], start, true_upto, tables_row [max_pages], k_pools,
-        v_pools) -> (logits [1, V] at absolute position true_upto-1,
-        pools)``. ``start`` is the block-aligned absolute offset of the
-        chunk (0 for a whole-prompt prefill; the shared-prefix boundary or
-        the previous chunk's end otherwise); queries attend causally over
-        pool pages [0, true_upto) via paged multi-query attention, so one
-        graph per chunk-length bucket serves every offset."""
+        v_pools, k_scales, v_scales) -> (logits [1, V] at absolute
+        position true_upto-1, pools, scale pools)``. ``start`` is the
+        block-aligned absolute offset of the chunk (0 for a whole-prompt
+        prefill; the shared-prefix boundary or the previous chunk's end
+        otherwise); queries attend causally over pool pages
+        [0, true_upto) via paged multi-query attention, so one graph per
+        chunk-length bucket serves every offset. Quantized caches
+        (non-empty scale lists) quantize each page's rows on write and
+        store the per-row scales beside the codes (ISSUE 14)."""
         from ...core import state as _state
         from ...core.tensor import Tensor
 
@@ -539,14 +565,18 @@ class LLMEngine:
         _arr = self._arr
 
         def chunk_pure(param_arrays, ids, start, true_upto, tables_row,
-                       k_pools, v_pools):
+                       k_pools, v_pools, k_scales, v_scales):
             import jax
             import jax.numpy as jnp
 
             from ...models.llama import _rope_apply_at
             from ...ops import manipulation as M
+            from .kv_cache import quantize_kv_rows
             from .paged_attention import paged_multiquery_attention
 
+            quantized = len(k_scales) > 0
+            ks_in = k_scales if quantized else [None] * len(k_pools)
+            vs_in = v_scales if quantized else [None] * len(v_pools)
             old = [p._data for p in params]
             try:
                 for p, a in zip(params, param_arrays):
@@ -561,9 +591,10 @@ class LLMEngine:
                     x = model.llama.embed_tokens(Tensor._wrap(ids))
                     cos_t = _arr(model.llama.rope_cos)
                     sin_t = _arr(model.llama.rope_sin)
-                    new_k, new_v = [], []
-                    for layer, kp, vp in zip(model.llama.layers,
-                                             k_pools, v_pools):
+                    new_k, new_v, new_ks, new_vs = [], [], [], []
+                    for layer, kp, vp, ksc, vsc in zip(model.llama.layers,
+                                                       k_pools, v_pools,
+                                                       ks_in, vs_in):
                         attn = layer.self_attn
                         h = layer.input_layernorm(x)
                         b, s = 1, sb
@@ -583,21 +614,37 @@ class LLMEngine:
                         for j in range(pages):
                             sl = slice(j * block_size, (j + 1) * block_size)
                             blk = tables_row[page0 + j]
-                            kp = jax.lax.dynamic_update_slice(
-                                kp, ka[0:1, sl].astype(kp.dtype),
-                                (blk, 0, 0, 0))
-                            vp = jax.lax.dynamic_update_slice(
-                                vp, va[0:1, sl].astype(vp.dtype),
-                                (blk, 0, 0, 0))
+                            if quantized:
+                                qk, sk = quantize_kv_rows(ka[0:1, sl])
+                                qv, sv = quantize_kv_rows(va[0:1, sl])
+                                kp = jax.lax.dynamic_update_slice(
+                                    kp, qk, (blk, 0, 0, 0))
+                                vp = jax.lax.dynamic_update_slice(
+                                    vp, qv, (blk, 0, 0, 0))
+                                ksc = jax.lax.dynamic_update_slice(
+                                    ksc, sk, (blk, 0, 0))
+                                vsc = jax.lax.dynamic_update_slice(
+                                    vsc, sv, (blk, 0, 0))
+                            else:
+                                kp = jax.lax.dynamic_update_slice(
+                                    kp, ka[0:1, sl].astype(kp.dtype),
+                                    (blk, 0, 0, 0))
+                                vp = jax.lax.dynamic_update_slice(
+                                    vp, va[0:1, sl].astype(vp.dtype),
+                                    (blk, 0, 0, 0))
                         out = paged_multiquery_attention(
                             qa, kp, vp, tables2, upto[None], start[None],
-                            scale=1.0 / math.sqrt(attn.head_dim))
+                            scale=1.0 / math.sqrt(attn.head_dim),
+                            k_scale=ksc, v_scale=vsc)
                         attn_out = attn.o_proj(
                             M.reshape(Tensor._wrap(out), [b, s, -1]))
                         x = x + attn_out
                         x = x + layer.mlp(layer.post_attention_layernorm(x))
                         new_k.append(kp)
                         new_v.append(vp)
+                        if quantized:
+                            new_ks.append(ksc)
+                            new_vs.append(vsc)
                     h = model.llama.norm(x)
                     h_arr = _arr(h)
                     last = jax.lax.dynamic_slice(
@@ -607,15 +654,17 @@ class LLMEngine:
             finally:
                 for p, a in zip(params, old):
                     p._data = a
-            return _arr(logits)[:, 0], new_k, new_v
+            return _arr(logits)[:, 0], new_k, new_v, new_ks, new_vs
 
         return chunk_pure
 
     def _make_decode_fn(self, model, params):
         """Pure one-token decode over ``model``: ``(param_arrays,
-        ids [B, 1], positions [B], tables [B, P], k_pools, v_pools) ->
-        (logits [B, V], pools)``. Writes each token at ``positions``,
-        attends over ``positions+1`` ragged lengths."""
+        ids [B, 1], positions [B], tables [B, P], k_pools, v_pools,
+        k_scales, v_scales) -> (logits [B, V], pools, scale pools)``.
+        Writes each token at ``positions``, attends over ``positions+1``
+        ragged lengths. Quantized caches quantize the written row and
+        store its per-head scale beside the codes (ISSUE 14)."""
         from ...core import state as _state
         from ...core.tensor import Tensor
 
@@ -624,13 +673,17 @@ class LLMEngine:
         _arr = self._arr
 
         def decode_pure(param_arrays, ids, positions, tables,
-                        k_pools, v_pools):
+                        k_pools, v_pools, k_scales, v_scales):
             import jax
             import jax.numpy as jnp
 
             from ...ops import manipulation as M
+            from .kv_cache import quantize_kv_rows
             from .paged_attention import paged_decode_attention
 
+            quantized = len(k_scales) > 0
+            ks_in = k_scales if quantized else [None] * len(k_pools)
+            vs_in = v_scales if quantized else [None] * len(v_pools)
             old = [p._data for p in params]
             try:
                 for p, a in zip(params, param_arrays):
@@ -643,9 +696,10 @@ class LLMEngine:
                     # batched rope at per-request positions
                     c = cos_t[positions][:, None, None, :]
                     sn = sin_t[positions][:, None, None, :]
-                    new_k, new_v = [], []
-                    for layer, kp, vp in zip(model.llama.layers,
-                                             k_pools, v_pools):
+                    new_k, new_v, new_ks, new_vs = [], [], [], []
+                    for layer, kp, vp, ksc, vsc in zip(model.llama.layers,
+                                                       k_pools, v_pools,
+                                                       ks_in, vs_in):
                         attn = layer.self_attn
                         h = layer.input_layernorm(x)
                         q = M.reshape(attn.q_proj(h),
@@ -670,40 +724,58 @@ class LLMEngine:
                         blk = tables[jnp.arange(bsz),
                                      positions // block_size]
                         off = positions % block_size
+                        if quantized:
+                            qk, sk = quantize_kv_rows(ka)   # [B,1,Hkv,D]
+                            qv, sv = quantize_kv_rows(va)
                         for i in range(bsz):
-                            kp = jax.lax.dynamic_update_slice(
-                                kp, ka[i:i + 1].astype(kp.dtype),
-                                (blk[i], off[i], 0, 0))
-                            vp = jax.lax.dynamic_update_slice(
-                                vp, va[i:i + 1].astype(vp.dtype),
-                                (blk[i], off[i], 0, 0))
+                            if quantized:
+                                kp = jax.lax.dynamic_update_slice(
+                                    kp, qk[i:i + 1], (blk[i], off[i], 0, 0))
+                                vp = jax.lax.dynamic_update_slice(
+                                    vp, qv[i:i + 1], (blk[i], off[i], 0, 0))
+                                ksc = jax.lax.dynamic_update_slice(
+                                    ksc, sk[i:i + 1], (blk[i], off[i], 0))
+                                vsc = jax.lax.dynamic_update_slice(
+                                    vsc, sv[i:i + 1], (blk[i], off[i], 0))
+                            else:
+                                kp = jax.lax.dynamic_update_slice(
+                                    kp, ka[i:i + 1].astype(kp.dtype),
+                                    (blk[i], off[i], 0, 0))
+                                vp = jax.lax.dynamic_update_slice(
+                                    vp, va[i:i + 1].astype(vp.dtype),
+                                    (blk[i], off[i], 0, 0))
                         out = paged_decode_attention(
                             qa, kp, vp, tables, positions + 1,
-                            scale=1.0 / math.sqrt(attn.head_dim))
+                            scale=1.0 / math.sqrt(attn.head_dim),
+                            k_scale=ksc, v_scale=vsc)
                         attn_out = attn.o_proj(
                             M.reshape(Tensor._wrap(out), [bsz, 1, -1]))
                         x = x + attn_out
                         x = x + layer.mlp(layer.post_attention_layernorm(x))
                         new_k.append(kp)
                         new_v.append(vp)
+                        if quantized:
+                            new_ks.append(ksc)
+                            new_vs.append(vsc)
                     h = model.llama.norm(x)
                     logits = _head(h[:, -1:])
             finally:
                 for p, a in zip(params, old):
                     p._data = a
-            return _arr(logits)[:, 0], new_k, new_v
+            return _arr(logits)[:, 0], new_k, new_v, new_ks, new_vs
 
         return decode_pure
 
     def _make_verify_fn(self, model, params):
         """Pure speculative verify over ``model``: ``(param_arrays,
         ids [B, K+1], positions [B], tables [B, P], draft_toks [B, K],
-        k_pools, v_pools) -> (accept_counts [B], next_tokens [B],
-        pools)``. ``ids[:, 0]`` is each request's last committed token at
-        absolute position ``positions``; one batched multi-query
-        paged-attention step scores all K+1 positions, writes their K/V,
-        and counts in-graph how many draft tokens match the target's
-        greedy argmax (the accept rule that keeps outputs bit-exact)."""
+        k_pools, v_pools, k_scales, v_scales) -> (accept_counts [B],
+        next_tokens [B], pools, scale pools)``. ``ids[:, 0]`` is each
+        request's last committed token at absolute position
+        ``positions``; one batched multi-query paged-attention step
+        scores all K+1 positions, writes their K/V, and counts in-graph
+        how many draft tokens match the target's greedy argmax (the
+        accept rule that keeps outputs bit-exact)."""
         from ...core import state as _state
         from ...core.tensor import Tensor
 
@@ -712,13 +784,17 @@ class LLMEngine:
         _arr = self._arr
 
         def verify_pure(param_arrays, ids, positions, tables, draft_toks,
-                        k_pools, v_pools):
+                        k_pools, v_pools, k_scales, v_scales):
             import jax
             import jax.numpy as jnp
 
             from ...ops import manipulation as M
+            from .kv_cache import quantize_kv_rows
             from .paged_attention import paged_multiquery_attention
 
+            quantized = len(k_scales) > 0
+            ks_in = k_scales if quantized else [None] * len(k_pools)
+            vs_in = v_scales if quantized else [None] * len(v_pools)
             old = [p._data for p in params]
             try:
                 for p, a in zip(params, param_arrays):
@@ -732,9 +808,10 @@ class LLMEngine:
                                 + jnp.arange(t_q, dtype=jnp.int32)[None])
                     c = cos_t[pos_grid][:, :, None, :]
                     sn = sin_t[pos_grid][:, :, None, :]
-                    new_k, new_v = [], []
-                    for layer, kp, vp in zip(model.llama.layers,
-                                             k_pools, v_pools):
+                    new_k, new_v, new_ks, new_vs = [], [], [], []
+                    for layer, kp, vp, ksc, vsc in zip(model.llama.layers,
+                                                       k_pools, v_pools,
+                                                       ks_in, vs_in):
                         attn = layer.self_attn
                         h = layer.input_layernorm(x)
                         q = M.reshape(attn.q_proj(h),
@@ -760,25 +837,48 @@ class LLMEngine:
                         blk = tables[jnp.arange(bsz)[:, None],
                                      pos_grid // block_size]
                         off = pos_grid % block_size
+                        if quantized:
+                            qk, sk = quantize_kv_rows(ka)  # [B,T,Hkv,D]
+                            qv, sv = quantize_kv_rows(va)
                         for i in range(bsz):
                             for t in range(t_q):
-                                kp = jax.lax.dynamic_update_slice(
-                                    kp,
-                                    ka[i:i + 1, t:t + 1].astype(kp.dtype),
-                                    (blk[i, t], off[i, t], 0, 0))
-                                vp = jax.lax.dynamic_update_slice(
-                                    vp,
-                                    va[i:i + 1, t:t + 1].astype(vp.dtype),
-                                    (blk[i, t], off[i, t], 0, 0))
+                                if quantized:
+                                    kp = jax.lax.dynamic_update_slice(
+                                        kp, qk[i:i + 1, t:t + 1],
+                                        (blk[i, t], off[i, t], 0, 0))
+                                    vp = jax.lax.dynamic_update_slice(
+                                        vp, qv[i:i + 1, t:t + 1],
+                                        (blk[i, t], off[i, t], 0, 0))
+                                    ksc = jax.lax.dynamic_update_slice(
+                                        ksc, sk[i:i + 1, t:t + 1],
+                                        (blk[i, t], off[i, t], 0))
+                                    vsc = jax.lax.dynamic_update_slice(
+                                        vsc, sv[i:i + 1, t:t + 1],
+                                        (blk[i, t], off[i, t], 0))
+                                else:
+                                    kp = jax.lax.dynamic_update_slice(
+                                        kp,
+                                        ka[i:i + 1, t:t + 1].astype(
+                                            kp.dtype),
+                                        (blk[i, t], off[i, t], 0, 0))
+                                    vp = jax.lax.dynamic_update_slice(
+                                        vp,
+                                        va[i:i + 1, t:t + 1].astype(
+                                            vp.dtype),
+                                        (blk[i, t], off[i, t], 0, 0))
                         out = paged_multiquery_attention(
                             qa, kp, vp, tables, positions + t_q, positions,
-                            scale=1.0 / math.sqrt(attn.head_dim))
+                            scale=1.0 / math.sqrt(attn.head_dim),
+                            k_scale=ksc, v_scale=vsc)
                         attn_out = attn.o_proj(
                             M.reshape(Tensor._wrap(out), [bsz, t_q, -1]))
                         x = x + attn_out
                         x = x + layer.mlp(layer.post_attention_layernorm(x))
                         new_k.append(kp)
                         new_v.append(vp)
+                        if quantized:
+                            new_ks.append(ksc)
+                            new_vs.append(vsc)
                     h = model.llama.norm(x)
                     logits = _arr(_head(h))          # [B, K+1, V]
                     tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -794,31 +894,33 @@ class LLMEngine:
             finally:
                 for p, a in zip(params, old):
                     p._data = a
-            return counts, nxt, new_k, new_v
+            return counts, nxt, new_k, new_v, new_ks, new_vs
 
         return verify_pure
 
     def _build_jits(self):
         from ...distributed.plan import compile_step_with_plan
 
+        # scale pools donate beside the payload pools (empty pytrees on
+        # the fp path — a zero-leaf donation is a no-op)
         self._prefill_jit = compile_step_with_plan(
             self._make_chunk_fn(self.model, self._params), self._plan,
-            name=self._prefill_name, donate_argnums=(5, 6))
+            name=self._prefill_name, donate_argnums=(5, 6, 7, 8))
         self._decode_jit = compile_step_with_plan(
             self._make_decode_fn(self.model, self._params), self._plan,
-            name=self._decode_name, donate_argnums=(4, 5))
+            name=self._decode_name, donate_argnums=(4, 5, 6, 7))
         if self.draft_model is not None:
             self._draft_prefill_jit = compile_step_with_plan(
                 self._make_chunk_fn(self.draft_model, self._draft_params),
                 self._plan, name=self._draft_prefill_name,
-                donate_argnums=(5, 6))
+                donate_argnums=(5, 6, 7, 8))
             self._draft_decode_jit = compile_step_with_plan(
                 self._make_decode_fn(self.draft_model, self._draft_params),
                 self._plan, name=self._draft_decode_name,
-                donate_argnums=(4, 5))
+                donate_argnums=(4, 5, 6, 7))
             self._verify_jit = compile_step_with_plan(
                 self._make_verify_fn(self.model, self._params), self._plan,
-                name=self._verify_name, donate_argnums=(5, 6))
+                name=self._verify_name, donate_argnums=(5, 6, 7, 8))
 
     # ------------------------------------------------------------------
     # the scheduler tick
@@ -883,18 +985,22 @@ class LLMEngine:
         nblk = min(len(req.blocks), self.max_pages)
         tables_row[:nblk] = req.blocks[:nblk]
         tables_dev = jnp.asarray(tables_row)
-        logits, self.cache.k, self.cache.v = self._prefill_jit(
-            [p._data for p in self._params], ids_chunk, np.int32(start),
-            np.int32(start + take), tables_dev, self.cache.k, self.cache.v)
+        cache = self.cache
+        (logits, cache.k, cache.v, cache.k_scale, cache.v_scale) = \
+            self._prefill_jit(
+                [p._data for p in self._params], ids_chunk,
+                np.int32(start), np.int32(start + take), tables_dev,
+                cache.k, cache.v, cache.k_scale, cache.v_scale)
         if self.draft_model is not None:
             # mirror every target chunk into the draft pools: the draft
             # proposes continuations over the same block tables, so its
             # cache must hold the same prefix
-            _, self.draft_cache.k, self.draft_cache.v = \
+            dc = self.draft_cache
+            (_, dc.k, dc.v, dc.k_scale, dc.v_scale) = \
                 self._draft_prefill_jit(
                     [p._data for p in self._draft_params], ids_chunk,
                     np.int32(start), np.int32(start + take), tables_dev,
-                    self.draft_cache.k, self.draft_cache.v)
+                    dc.k, dc.v, dc.k_scale, dc.v_scale)
             req.draft_cached = start + take
         req.num_cached = start + take
         _M_PREFILL_CHUNKS.inc(instance=self._name)
@@ -981,10 +1087,12 @@ class LLMEngine:
                 for i, req in ready:
                     ids[i, 0] = req.last_token
                     positions[i] = req.num_cached
-                logits, self.cache.k, self.cache.v = self._decode_jit(
-                    [p._data for p in self._params], jnp.asarray(ids),
-                    jnp.asarray(positions), self._tables(),
-                    self.cache.k, self.cache.v)
+                c = self.cache
+                (logits, c.k, c.v, c.k_scale, c.v_scale) = \
+                    self._decode_jit(
+                        [p._data for p in self._params], jnp.asarray(ids),
+                        jnp.asarray(positions), self._tables(),
+                        c.k, c.v, c.k_scale, c.v_scale)
                 logits = np.asarray(logits)
                 for i, req in ready:
                     req.num_cached += 1
@@ -995,6 +1103,9 @@ class LLMEngine:
                        instance=self._name)
         _G_OCCUPANCY.set(len(sched.running) / self.max_batch_size,
                          instance=self._name)
+        if self.cache.quantized:
+            _G_QUANT_BLOCKS.set(usable - self.cache.allocator.num_free,
+                                instance=self._name)
         return outputs
 
     # ------------------------------------------------------------------
@@ -1028,11 +1139,12 @@ class LLMEngine:
                 j = feeds[r.rid][t]
                 ids[i, 0] = toks[r.rid][j]
                 pos[i] = j
-            logits, self.draft_cache.k, self.draft_cache.v = \
+            dc = self.draft_cache
+            (logits, dc.k, dc.v, dc.k_scale, dc.v_scale) = \
                 self._draft_decode_jit(
                     [p._data for p in self._draft_params],
                     jnp.asarray(ids), jnp.asarray(pos), tables,
-                    self.draft_cache.k, self.draft_cache.v)
+                    dc.k, dc.v, dc.k_scale, dc.v_scale)
         prev = np.asarray(logits)
         drafts = np.zeros((B, K), np.int32)
         for kstep in range(K):
@@ -1044,11 +1156,12 @@ class LLMEngine:
                 for i, r in ready:
                     ids[i, 0] = drafts[i, kstep]
                     pos[i] = r.num_tokens + kstep
-                prev, self.draft_cache.k, self.draft_cache.v = \
+                dc = self.draft_cache
+                (prev, dc.k, dc.v, dc.k_scale, dc.v_scale) = \
                     self._draft_decode_jit(
                         [p._data for p in self._draft_params],
                         jnp.asarray(ids), jnp.asarray(pos), tables,
-                        self.draft_cache.k, self.draft_cache.v)
+                        dc.k, dc.v, dc.k_scale, dc.v_scale)
                 prev = np.asarray(prev)
         for _, r in ready:
             # positions 0 .. num_tokens+K-2 now hold draft K/V
@@ -1075,10 +1188,11 @@ class LLMEngine:
             ids_v[i, 1:] = drafts[i]
             pos_v[i] = r.num_cached
             n_old[r.rid] = r.num_tokens
-        counts, nxt, self.cache.k, self.cache.v = self._verify_jit(
+        c = self.cache
+        (counts, nxt, c.k, c.v, c.k_scale, c.v_scale) = self._verify_jit(
             [p._data for p in self._params], jnp.asarray(ids_v),
             jnp.asarray(pos_v), tables, jnp.asarray(drafts[:, :K]),
-            self.cache.k, self.cache.v)
+            c.k, c.v, c.k_scale, c.v_scale)
         counts = np.asarray(counts)
         nxt = np.asarray(nxt)
         accepted = 0
@@ -1118,6 +1232,11 @@ class LLMEngine:
         from ...models.llama import sample_next_tokens
 
         s = req.sampling
+        # last sampled-from logits row, kept for the quantization
+        # tolerance tests (bounded logit delta vs the fp32 engine) and as
+        # a logprobs hook; [V] f32, overwritten per emission, dropped
+        # with the request at release()
+        req.last_logits = np.asarray(row)
         tok = int(sample_next_tokens(
             row[None], do_sample=s.do_sample, temperature=s.temperature,
             top_k=s.top_k, top_p=s.top_p, rng=req._rng)[0])
@@ -1240,6 +1359,12 @@ class LLMEngine:
         if os.path.isdir(path):
             load_state_dict(self.model.state_dict(), path)
             return None
+        if is_llama_artifact(path):
+            # serving artifact (possibly the ISSUE-14 int8 format):
+            # dequantized to the live params' dtype, so the hot-swap
+            # never changes an executable's input avals — no recompile
+            self.model.set_state_dict(load_llama_state_dict(path))
+            return None
         self.model.set_state_dict(_fio.load(path))
         return None
 
@@ -1287,6 +1412,11 @@ class LLMEngine:
             "itl_ms": _H_ITL.summary(instance=inst),
             "kv_block_utilization": _G_KV_UTIL.value(instance=inst),
             "decode_batch_occupancy": _G_OCCUPANCY.value(instance=inst),
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_saved": int(_M_KV_SAVED.value(instance=inst)),
+            "quantized_blocks_in_use": (
+                int(_G_QUANT_BLOCKS.value(instance=inst))
+                if self.cache.quantized else None),
         }
 
     def reset_metrics(self):
@@ -1296,6 +1426,11 @@ class LLMEngine:
         the reported percentiles; a production engine has no reason to."""
         for m in _SERVING_METRICS:
             m.remove(instance=self._name)
+        if self.cache.quantized and not self._closed:
+            # bytes saved is a construction-time constant of THIS pool,
+            # not window activity — republish it so a benchmark window
+            # reset doesn't erase the capacity accounting
+            _M_KV_SAVED.inc(self._kv_bytes_saved, instance=self._name)
 
     def reset_block_high_water(self):
         """Re-anchor the allocator's high-water mark at the current
@@ -1346,20 +1481,92 @@ class LLMEngine:
 # llama serving artifacts (consumed by inference.create_predictor)
 # ----------------------------------------------------------------------
 
-def save_llama_artifact(model, path):
+ARTIFACT_QMAX = 127.0
+
+
+def quantize_state_dict(state_dict, qmax=ARTIFACT_QMAX):
+    """Per-channel int8 quantization of a weights state dict (ISSUE 14
+    artifact format): every float array with >= 2 dims is packed as int8
+    codes + a float32 per-channel scale row (abs-max over all axes
+    except the LAST — the output channel of every ``Linear`` here), 1-D
+    params (norms, biases) pass through untouched. Returns
+    ``(packed, scales)`` where ``scales`` holds ONLY the quantized
+    names, each scale being the DEQUANT MULTIPLIER (``absmax / qmax`` —
+    dequant is a single ``codes * scale``). The quantization math is
+    the quantization package's shared
+    :func:`~paddle_tpu.quantization.base.per_channel_int8`, so the
+    artifact path and the PTQ convert path can never drift."""
+    from ...quantization.base import per_channel_int8
+
+    packed, scales = {}, {}
+    for name, val in state_dict.items():
+        arr = np.asarray(val.numpy() if hasattr(val, "numpy") else val)
+        if arr.ndim >= 2 and arr.dtype.kind == "f":
+            codes, absmax = per_channel_int8(arr, qmax=qmax)
+            packed[name] = codes
+            scales[name] = (absmax / qmax).astype(np.float32)
+        else:
+            packed[name] = arr
+    return packed, scales
+
+
+def dequantize_state_dict(packed, scales, dtype=np.float32):
+    """Inverse of :func:`quantize_state_dict`: codes x scale back to
+    ``dtype`` host arrays, passthrough entries untouched."""
+    out = {}
+    for name, arr in packed.items():
+        arr = np.asarray(arr.numpy() if hasattr(arr, "numpy") else arr)
+        if name in scales:
+            s = np.asarray(scales[name].numpy()
+                           if hasattr(scales[name], "numpy")
+                           else scales[name])
+            out[name] = (arr.astype(np.float32) * s).astype(dtype)
+        else:
+            out[name] = arr
+    return out
+
+
+def save_llama_artifact(model, path, quantize=None):
     """Persist a llama model as a serving artifact: ``<path>.llamacfg.json``
     (the LlamaConfig) + ``<path>.pdiparams`` (weights). The engine-backed
     predictor (``Config.enable_llm_engine``) detects the sidecar config and
-    rebuilds the model around it."""
+    rebuilds the model around it.
+
+    ``quantize="int8"`` (ISSUE 14) writes the QUANTIZED artifact format:
+    ``<path>.pdiparams`` holds packed int8 weight tensors (per-channel
+    abs-max, ~4x smaller — the replica-boot / fleet-transfer win), the
+    scales live in the ``<path>.qscales.pdiparams`` sidecar, and
+    ``<path>.quant.json`` records the scheme. Loaders dequantize back to
+    the model dtype, so a running ``LLMEngine.reload_weights`` hot-swap
+    sees same-shape/same-dtype arrays and never recompiles."""
     import json
     import os
 
     from ...framework.io import save as fsave
 
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8'; got "
+                         f"{quantize!r}")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".llamacfg.json", "w") as f:
         json.dump(dataclasses.asdict(model.config), f)
-    fsave(model.state_dict(), path + ".pdiparams")
+    if quantize == "int8":
+        packed, scales = quantize_state_dict(model.state_dict())
+        fsave(packed, path + ".pdiparams")
+        fsave(scales, path + ".qscales.pdiparams")
+        with open(path + ".quant.json", "w") as f:
+            json.dump({"scheme": "int8_per_channel",
+                       "qmax": ARTIFACT_QMAX,
+                       "quantized_tensors": sorted(scales)}, f)
+    else:
+        fsave(model.state_dict(), path + ".pdiparams")
+        # a resave over a previously-quantized path must not leave a
+        # stale scheme sidecar claiming the fp weights are codes
+        for ext in (".quant.json", ".qscales.pdiparams"):
+            try:
+                os.remove(path + ext)
+            except OSError:
+                pass
 
 
 def is_llama_artifact(path):
@@ -1370,11 +1577,44 @@ def is_llama_artifact(path):
     return os.path.exists(path + ".llamacfg.json")
 
 
-def load_llama_artifact(path):
-    """Rebuild the model from :func:`save_llama_artifact` output."""
+def is_quantized_artifact(path):
+    import os
+
+    if path.endswith(".pdmodel"):
+        path = path[: -len(".pdmodel")]
+    return os.path.exists(path + ".quant.json")
+
+
+def load_llama_state_dict(path):
+    """Host-array weights of an artifact, dequantizing the int8 format
+    when its ``.quant.json`` sidecar is present (the
+    ``LLMEngine.reload_weights`` hot-swap entry: same shapes and dtypes
+    as the live params, so nothing recompiles)."""
     import json
 
     from ...framework.io import load as fload
+
+    if path.endswith(".pdmodel"):
+        path = path[: -len(".pdmodel")]
+    if is_quantized_artifact(path):
+        with open(path + ".quant.json") as f:
+            meta = json.load(f)
+        if meta.get("scheme") != "int8_per_channel":
+            raise ValueError(
+                f"unknown quantized-artifact scheme {meta.get('scheme')!r} "
+                f"in {path}.quant.json")
+        packed = fload(path + ".pdiparams", return_numpy=True)
+        scales = fload(path + ".qscales.pdiparams", return_numpy=True)
+        return dequantize_state_dict(packed, scales)
+    return fload(path + ".pdiparams")
+
+
+def load_llama_artifact(path):
+    """Rebuild the model from :func:`save_llama_artifact` output
+    (quantized artifacts are dequantized into the fresh model's
+    dtype)."""
+    import json
+
     from ...models.llama import LlamaConfig, LlamaForCausalLM
 
     if path.endswith(".pdmodel"):
@@ -1382,6 +1622,6 @@ def load_llama_artifact(path):
     with open(path + ".llamacfg.json") as f:
         cfg = LlamaConfig(**json.load(f))
     model = LlamaForCausalLM(cfg)
-    model.set_state_dict(fload(path + ".pdiparams"))
+    model.set_state_dict(load_llama_state_dict(path))
     model.eval()
     return model

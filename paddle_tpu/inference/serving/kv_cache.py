@@ -45,6 +45,20 @@ copy-on-write helpers (``BlockAllocator.is_shared`` +
 ``PagedKVCache.copy_block``) guard the invariant anyway — a divergent
 write to a block some other request can see must copy first, never
 mutate.
+
+ISSUE 14 adds **quantized pools** (``kv_dtype="int8"``): the K/V payload
+is stored as int8 codes with a float32 abs-max scale per (block,
+position, kv-head) row kept in sidecar scale pools the engine threads
+through its compiled steps exactly like the payload pools. The scale
+granularity is deliberately PER ROW (one scalar per written token per
+head), not one scalar per block: a row's codes are then a pure function
+of that row's values alone, so prefill (whole pages at once), decode
+(one token at a time), eviction re-prefill and fleet redispatch replay
+all quantize a given token identically — greedy decode stays
+deterministic and bit-reproducible across every write path, which a
+block-scalar scale (write-order-dependent rescaling) cannot guarantee.
+Block identity, refcounts, prefix hashes and COW never touch payload
+dtype, so sharing/eviction/speculation compose unchanged.
 """
 
 from __future__ import annotations
@@ -56,7 +70,46 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache"]
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "KV_QMAX",
+           "quantize_kv_rows", "kv_pool_bytes_per_block"]
+
+# symmetric int8: codes in [-127, 127], scale = absmax/127 per row.
+# -128 is deliberately unused so the scheme stays symmetric (dequant is
+# a single multiply, no zero point).
+KV_QMAX = 127.0
+
+
+def quantize_kv_rows(x):
+    """Quantize K/V rows ``[..., Hkv, D]`` to int8 codes + per-row scales.
+
+    Returns ``(codes int8 [..., Hkv, D], scales f32 [..., Hkv])`` with
+    ``scale = max(|row|) / 127`` (floored at 1e-8 so an all-zero row
+    dequantizes to exact zeros instead of NaN). Pure per-row function —
+    the SAME row values always produce the SAME codes regardless of how
+    many tokens share the block or which write path (prefill chunk,
+    decode step, verify window, re-prefill) materializes them. That
+    purity is the determinism contract the fleet's redispatch replay and
+    the scheduler's eviction re-prefill rely on.
+    """
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=-1) / KV_QMAX
+    s = jnp.maximum(s, 1e-8)
+    codes = jnp.clip(jnp.round(xf / s[..., None]), -KV_QMAX, KV_QMAX)
+    return codes.astype(jnp.int8), s
+
+
+def kv_pool_bytes_per_block(block_size, num_kv_heads, head_dim,
+                            kv_dtype=None, base_dtype=None):
+    """Bytes ONE pool block costs (K and V together, one layer),
+    including the f32 scale sidecar rows for ``kv_dtype="int8"``. The
+    bench's same-memory-budget capacity A/B and the engine's
+    ``serving_kv_bytes_saved_total`` accounting both use this, so the
+    claim and the telemetry can never disagree."""
+    payload = block_size * num_kv_heads * head_dim
+    if kv_dtype == "int8":
+        return 2 * (payload + block_size * num_kv_heads * 4)
+    itemsize = jnp.dtype(base_dtype or jnp.float32).itemsize
+    return 2 * payload * itemsize
 
 
 class BlockAllocator:
@@ -267,23 +320,63 @@ class PagedKVCache:
     plain jax arrays deliberately: the engine threads them through its
     compiled step functions (donated on TPU) and rebinds the returned
     buffers, exactly like ``FusedTrainStep`` handles optimizer state.
+
+    ``kv_dtype="int8"`` (ISSUE 14) stores the payload as int8 codes and
+    adds per-layer ``k_scale``/``v_scale`` pools of shape
+    ``[num_blocks, block_size, num_kv_heads]`` f32 — one abs-max scale
+    per written row per head (see :func:`quantize_kv_rows` for why the
+    granularity is per-row, not per-block-scalar). Scale pools are
+    threaded through compiled steps exactly like the payload pools;
+    ``kv_dtype=None`` keeps ``k_scale``/``v_scale`` as empty lists so
+    the fp path's pytrees carry zero extra leaves.
     """
 
     def __init__(self, config, num_blocks, block_size, dtype=None,
-                 allocator=None):
+                 allocator=None, kv_dtype=None):
         if dtype is None:
             dtype = jnp.float32
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8'; got "
+                f"{kv_dtype!r}")
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
+        self.base_dtype = dtype
         shape = (self.num_blocks, self.block_size,
                  config.num_key_value_heads, config.head_dim)
         L = config.num_hidden_layers
-        self.k = [jnp.zeros(shape, dtype) for _ in range(L)]
-        self.v = [jnp.zeros(shape, dtype) for _ in range(L)]
+        pool_dtype = jnp.int8 if self.quantized else dtype
+        self.k = [jnp.zeros(shape, pool_dtype) for _ in range(L)]
+        self.v = [jnp.zeros(shape, pool_dtype) for _ in range(L)]
+        if self.quantized:
+            sshape = shape[:-1]
+            self.k_scale = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(L)]
+            self.v_scale = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(L)]
+        else:
+            self.k_scale = []
+            self.v_scale = []
         # a draft-model pool (speculative decoding) shares the target
         # pool's allocator: one block table indexes both pools
         self.allocator = (allocator if allocator is not None
                           else BlockAllocator(num_blocks))
+
+    def bytes_saved_vs_unquantized(self, config):
+        """Total pool bytes an int8 cache saves versus the SAME pool in
+        the model's dtype (0 for an unquantized cache) — scale sidecars
+        charged against the saving."""
+        if not self.quantized:
+            return 0
+        fp = kv_pool_bytes_per_block(
+            self.block_size, config.num_key_value_heads, config.head_dim,
+            kv_dtype=None, base_dtype=self.base_dtype)
+        q8 = kv_pool_bytes_per_block(
+            self.block_size, config.num_key_value_heads, config.head_dim,
+            kv_dtype="int8")
+        return (fp - q8) * self.num_blocks * config.num_hidden_layers
 
     def blocks_for_tokens(self, n_tokens):
         """Blocks needed to hold ``n_tokens``."""
@@ -301,6 +394,10 @@ class PagedKVCache:
         """Copy one pool block's K/V from ``src`` to ``dst`` across all
         layers (the COW move: the writer gets a private copy, the shared
         original is never mutated). Host-triggered and rare — this is NOT
-        inside the compiled step."""
+        inside the compiled step. Quantized pools copy the scale rows
+        too: codes without their scales are not a copy."""
         self.k = [kp.at[dst].set(kp[src]) for kp in self.k]
         self.v = [vp.at[dst].set(vp[src]) for vp in self.v]
+        if self.quantized:
+            self.k_scale = [s.at[dst].set(s[src]) for s in self.k_scale]
+            self.v_scale = [s.at[dst].set(s[src]) for s in self.v_scale]

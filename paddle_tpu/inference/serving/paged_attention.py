@@ -24,24 +24,42 @@ from ...nn.functional.flash_attention import _sdpa_ref
 __all__ = ["paged_decode_attention", "paged_multiquery_attention"]
 
 
-def _lax_fallback(q, k_pool, v_pool, block_tables, context_lens, scale):
+def _gather_kv(pool, scale_pool, block_tables):
+    """Gather a request-major [B, P*block, Hkv, D] view of the pool,
+    dequantizing int8 codes with their per-row scales when a scale pool
+    is given — the SAME ``codes * scale`` multiply the Pallas kernel
+    does in VMEM, just materialized (this is the fallback's documented
+    memory-traffic difference)."""
+    b, p = block_tables.shape
+    n, block_size, hkv, d = pool.shape
+    g = pool[block_tables].reshape(b, p * block_size, hkv, d)
+    if scale_pool is not None:
+        s = scale_pool[block_tables].reshape(b, p * block_size, hkv)
+        g = g.astype(jnp.float32) * s[..., None]
+    return g
+
+
+def _lax_fallback(q, k_pool, v_pool, block_tables, context_lens, scale,
+                  k_scale=None, v_scale=None):
     """q [B, 1, H, D] -> [B, 1, H, D] via gather + masked dense sdpa."""
     b, p = block_tables.shape
-    n, block_size, hkv, d = k_pool.shape
-    k = k_pool[block_tables].reshape(b, p * block_size, hkv, d)
-    v = v_pool[block_tables].reshape(b, p * block_size, hkv, d)
+    block_size = k_pool.shape[1]
+    k = _gather_kv(k_pool, k_scale, block_tables)
+    v = _gather_kv(v_pool, v_scale, block_tables)
     pos = jnp.arange(p * block_size, dtype=jnp.int32)[None, :]
     mask = (pos < context_lens[:, None])[:, None, None, :]  # [B,1,1,S]
     return _sdpa_ref.raw_fn(q, k, v, attn_mask=mask, scale=scale)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
-                           scale=None):
+                           scale=None, k_scale=None, v_scale=None):
     """One decode token per request against the paged pool.
 
     q: [B, 1, H, D] (the just-written token's query); pools
     [N, block, Hkv, D]; block_tables [B, P] int32; context_lens [B] int32
     counting tokens INCLUDING the one just written. Returns [B, 1, H, D].
+    ``k_scale``/``v_scale`` ([N, block, Hkv] f32) arm the int8
+    dequant-in-kernel path (ISSUE 14) when the pools hold codes.
     """
     d = q.shape[-1]
     block_size = k_pool.shape[1]
@@ -52,20 +70,21 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
 
     if use_pallas_paged(d, block_size):
         out = paged_decode_attention_pallas(
-            q[:, 0], k_pool, v_pool, block_tables, context_lens, scale)
+            q[:, 0], k_pool, v_pool, block_tables, context_lens, scale,
+            k_scale=k_scale, v_scale=v_scale)
         return out[:, None]
     return _lax_fallback(q, k_pool, v_pool, block_tables, context_lens,
-                         float(scale))
+                         float(scale), k_scale=k_scale, v_scale=v_scale)
 
 
 def _lax_multiquery_fallback(q, k_pool, v_pool, block_tables, context_lens,
-                             q_start, scale):
+                             q_start, scale, k_scale=None, v_scale=None):
     """q [B, T, H, D] -> [B, T, H, D]: gather + per-row causal mask."""
     b, t = q.shape[0], q.shape[1]
-    _, block_size, hkv, d = k_pool.shape
+    block_size = k_pool.shape[1]
     p = block_tables.shape[1]
-    k = k_pool[block_tables].reshape(b, p * block_size, hkv, d)
-    v = v_pool[block_tables].reshape(b, p * block_size, hkv, d)
+    k = _gather_kv(k_pool, k_scale, block_tables)
+    v = _gather_kv(v_pool, v_scale, block_tables)
     pos = jnp.arange(p * block_size, dtype=jnp.int32)[None, None, :]
     row = jnp.arange(t, dtype=jnp.int32)[None, :, None]
     # query row i sits at absolute position q_start+i: it may attend to
@@ -76,7 +95,8 @@ def _lax_multiquery_fallback(q, k_pool, v_pool, block_tables, context_lens,
 
 
 def paged_multiquery_attention(q, k_pool, v_pool, block_tables, context_lens,
-                               q_start, scale=None):
+                               q_start, scale=None, k_scale=None,
+                               v_scale=None):
     """T query tokens per request against the paged pool — the shared
     primitive behind chunked prefill (a block-aligned chunk of the prompt
     at offset ``q_start``) and speculative verify (k+1 draft positions
@@ -100,6 +120,7 @@ def paged_multiquery_attention(q, k_pool, v_pool, block_tables, context_lens,
     if use_pallas_paged(d, block_size):
         return paged_multiquery_attention_pallas(
             q, k_pool, v_pool, block_tables, context_lens, q_start,
-            float(scale))
+            float(scale), k_scale=k_scale, v_scale=v_scale)
     return _lax_multiquery_fallback(q, k_pool, v_pool, block_tables,
-                                    context_lens, q_start, float(scale))
+                                    context_lens, q_start, float(scale),
+                                    k_scale=k_scale, v_scale=v_scale)

@@ -19,6 +19,21 @@ Layouts:
 
 GQA: kv heads are broadcast to q heads inside the kernel (VMEM-local
 repeat, the pool stays at Hkv).
+
+**Quantized pools (ISSUE 14, dequant-in-kernel):** with
+``kv_dtype="int8"`` the pools hold int8 codes and two sidecar scale
+pools ``[N, block, Hkv]`` f32 ride along. The kernels take two extra
+scalar-prefetch-indexed operands — the scale rows of exactly the block
+being DMA'd — and dequantize IN VMEM (``codes.astype(f32) *
+scale[..., None]``) right before the existing online-softmax fold, so
+HBM traffic per page drops ~4x while the attention math past the
+dequant is bit-identical to the fp kernel fed the dequantized values.
+The lax fallback in ``inference/serving/paged_attention.py`` mirrors
+the same gather + multiply, so CPU tier-1 tests the same semantics.
+Scale operands use an Hkv-lane layout — fine in interpret mode and on
+CPU; a real-TPU deployment at MXU widths would pad the scale lane dim
+to the tile boundary (the gate below already restricts the real-TPU
+path to MXU-friendly shapes).
 """
 
 from __future__ import annotations
@@ -46,8 +61,14 @@ def use_pallas_paged(head_dim, block_size):
     return head_dim % 128 == 0 and block_size % 8 == 0
 
 
-def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, block_size, groups, scale):
+def _kernel(tables_ref, lens_ref, *refs, block_size, groups, scale,
+            quantized=False):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     p = pl.program_id(1)
 
     @pl.when(p == 0)
@@ -64,6 +85,13 @@ def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32) * scale          # [H, D]
         k = k_ref[0].astype(jnp.float32)                  # [block, Hkv, D]
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # dequant-in-kernel: the DMA'd block is int8 codes; its scale
+            # rows [block, Hkv] ride in as scalar-prefetch-indexed
+            # operands and the multiply happens here in VMEM — HBM never
+            # sees a dequantized page
+            k = k * ks_ref[0][..., None]
+            v = v * vs_ref[0][..., None]
         kt = jnp.repeat(jnp.swapaxes(k, 0, 1), groups, axis=0)  # [H, blk, D]
         vt = jnp.repeat(jnp.swapaxes(v, 0, 1), groups, axis=0)
         s = jax.lax.dot_general(q, kt, (((1,), (2,)), ((0,), (0,))),
@@ -85,26 +113,40 @@ def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
-                                  context_lens, scale):
+                                  context_lens, scale,
+                                  k_scale=None, v_scale=None):
     """q [B, H, D]; pools [N, block, Hkv, D]; block_tables [B, P] int32;
-    context_lens [B] int32. Returns [B, H, D]."""
+    context_lens [B] int32. Returns [B, H, D]. With int8 pools,
+    ``k_scale``/``v_scale`` [N, block, Hkv] f32 arm dequant-in-kernel."""
     b, h, d = q.shape
     n, block_size, hkv, _ = k_pool.shape
     p = block_tables.shape[1]
     groups = h // hkv
+    quantized = k_scale is not None
     tables_flat = block_tables.reshape(-1).astype(jnp.int32)
     lens = context_lens.astype(jnp.int32)
 
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda i, j, T, L: (i, 0, 0)),
+        pl.BlockSpec((1, block_size, hkv, d),
+                     lambda i, j, T, L: (T[i * p + j], 0, 0, 0)),
+        pl.BlockSpec((1, block_size, hkv, d),
+                     lambda i, j, T, L: (T[i * p + j], 0, 0, 0)),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_size, hkv),
+                         lambda i, j, T, L: (T[i * p + j], 0, 0)),
+            pl.BlockSpec((1, block_size, hkv),
+                         lambda i, j, T, L: (T[i * p + j], 0, 0)),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, p),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda i, j, T, L: (i, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda i, j, T, L: (T[i * p + j], 0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda i, j, T, L: (T[i * p + j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda i, j, T, L: (i, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, d), jnp.float32),
@@ -114,20 +156,27 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
     )
     return pl.pallas_call(
         functools.partial(_kernel, block_size=block_size, groups=groups,
-                          scale=float(scale)),
+                          scale=float(scale), quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=_interpret(),
-    )(tables_flat, lens, q, k_pool, v_pool)
+    )(tables_flat, lens, *operands)
 
 
-def _mq_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref, o_ref,
-               acc_ref, m_ref, l_ref, *, block_size, groups, t_q, scale):
+def _mq_kernel(tables_ref, lens_ref, starts_ref, *refs, block_size,
+               groups, t_q, scale, quantized=False):
     """Multi-query variant (ISSUE 11): T query rows per request folded
     into the accumulator's leading dim ([T*H, D]), per-row causal masking
     against the row's absolute position ``start + t``. Same one-block-DMA-
     per-grid-step structure as the decode kernel (CuBridge's iterate-on-
-    the-verify-kernel guidance, PAPERS.md)."""
+    the-verify-kernel guidance, PAPERS.md). ``quantized`` dequantizes the
+    DMA'd int8 block in VMEM from its sidecar scale rows (ISSUE 14)."""
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = None
     p = pl.program_id(1)
 
     @pl.when(p == 0)
@@ -148,6 +197,9 @@ def _mq_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref, o_ref,
         q2 = q.reshape(t_q * h, q.shape[-1])              # [T*H, D]
         k = k_ref[0].astype(jnp.float32)                  # [block, Hkv, D]
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0][..., None]
+            v = v * vs_ref[0][..., None]
         kt = jnp.repeat(jnp.swapaxes(k, 0, 1), groups, axis=0)  # [H, blk, D]
         vt = jnp.repeat(jnp.swapaxes(v, 0, 1), groups, axis=0)
         # scores per (row=t*H+h, token-in-block): contract D against the
@@ -177,30 +229,44 @@ def _mq_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_multiquery_attention_pallas(q, k_pool, v_pool, block_tables,
-                                      context_lens, q_start, scale):
+                                      context_lens, q_start, scale,
+                                      k_scale=None, v_scale=None):
     """q [B, T, H, D] at absolute positions ``q_start[b] + t``; pools
     [N, block, Hkv, D]; block_tables [B, P] int32; context_lens [B] int32
     (visible tokens including the last real query row). Returns
     [B, T, H, D]; rows past ``context_lens - q_start`` are padding and
-    undefined."""
+    undefined. With int8 pools, ``k_scale``/``v_scale`` [N, block, Hkv]
+    f32 arm dequant-in-kernel."""
     b, t, h, d = q.shape
     n, block_size, hkv, _ = k_pool.shape
     p = block_tables.shape[1]
     groups = h // hkv
+    quantized = k_scale is not None
     tables_flat = block_tables.reshape(-1).astype(jnp.int32)
     lens = context_lens.astype(jnp.int32)
     starts = q_start.astype(jnp.int32)
 
+    in_specs = [
+        pl.BlockSpec((1, t, h, d), lambda i, j, T, L, S: (i, 0, 0, 0)),
+        pl.BlockSpec((1, block_size, hkv, d),
+                     lambda i, j, T, L, S: (T[i * p + j], 0, 0, 0)),
+        pl.BlockSpec((1, block_size, hkv, d),
+                     lambda i, j, T, L, S: (T[i * p + j], 0, 0, 0)),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_size, hkv),
+                         lambda i, j, T, L, S: (T[i * p + j], 0, 0)),
+            pl.BlockSpec((1, block_size, hkv),
+                         lambda i, j, T, L, S: (T[i * p + j], 0, 0)),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, p),
-        in_specs=[
-            pl.BlockSpec((1, t, h, d), lambda i, j, T, L, S: (i, 0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda i, j, T, L, S: (T[i * p + j], 0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda i, j, T, L, S: (T[i * p + j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, t, h, d),
                                lambda i, j, T, L, S: (i, 0, 0, 0)),
         scratch_shapes=[
@@ -211,8 +277,8 @@ def paged_multiquery_attention_pallas(q, k_pool, v_pool, block_tables,
     )
     return pl.pallas_call(
         functools.partial(_mq_kernel, block_size=block_size, groups=groups,
-                          t_q=t, scale=float(scale)),
+                          t_q=t, scale=float(scale), quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, t, h, d), q.dtype),
         interpret=_interpret(),
-    )(tables_flat, lens, starts, q, k_pool, v_pool)
+    )(tables_flat, lens, starts, *operands)

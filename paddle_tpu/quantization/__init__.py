@@ -13,7 +13,7 @@ from .config import (  # noqa: F401
 )
 from .factory import ObserverFactory, QuanterFactory  # noqa: F401
 from .ptq import PTQ  # noqa: F401
-from .qat import QAT  # noqa: F401
+from .qat import QAT, UncalibratedQuanterError  # noqa: F401
 from .quantize import Quantization  # noqa: F401
 from .wrapper import (  # noqa: F401
     Int8InferenceLinear,
@@ -25,6 +25,7 @@ from . import observers, quanters  # noqa: F401
 
 __all__ = [
     "QuantConfig", "SingleLayerConfig", "QAT", "PTQ", "Quantization",
+    "UncalibratedQuanterError",
     "BaseQuanter", "BaseObserver", "QuanterFactory", "ObserverFactory",
     "ObserveWrapper", "QuantedLinear", "QuantedConv2D",
     "Int8InferenceLinear", "observers", "quanters",

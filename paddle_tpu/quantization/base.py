@@ -13,12 +13,40 @@ from __future__ import annotations
 
 import abc
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..core.dispatch import op
 from ..nn.layer.layers import Layer
 
-__all__ = ["BaseQuanter", "BaseObserver", "fake_quant", "quant_dequant_ste"]
+__all__ = ["BaseQuanter", "BaseObserver", "fake_quant", "quant_dequant_ste",
+           "per_channel_int8"]
+
+
+def per_channel_int8(arr, absmax=None, qmax=127.0, floor=1e-9):
+    """THE per-channel symmetric int8 quantizer (host-side numpy) —
+    shared by ``PerChannelAbsmaxObserverLayer.quantize_weight`` and the
+    serving artifact packer (``serving.engine.quantize_state_dict``), so
+    the clipping/floor/rounding rules can never drift between the PTQ
+    path and the artifact path.
+
+    Channels are the LAST axis; ``absmax`` (per-channel [C]) defaults to
+    the array's own abs-max — pass calibrated scales to quantize against
+    frozen thresholds. Returns ``(codes int8, absmax f32 [C])``; dequant
+    is ``codes * (absmax / qmax)`` (callers choose whether to STORE
+    absmax or the pre-divided multiplier)."""
+    a = np.asarray(arr, np.float32)
+    if a.ndim < 2:
+        raise ValueError(
+            f"per_channel_int8 needs >= 2 dims (got shape {a.shape}); "
+            "per-channel scales over a 1-D tensor are per-element — use "
+            "a per-tensor scheme")
+    if absmax is None:
+        absmax = np.abs(a).max(axis=tuple(range(a.ndim - 1)))
+    absmax = np.maximum(np.asarray(absmax, np.float32), floor)
+    codes = np.clip(np.round(a / absmax * qmax), -qmax,
+                    qmax).astype(np.int8)
+    return codes, absmax
 
 
 @op("fake_quant_dequant")

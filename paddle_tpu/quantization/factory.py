@@ -1,21 +1,50 @@
 """Quanter/Observer factories (reference factory.py:1 — a QuanterFactory is
 a picklable recipe; ``_instance(layer)`` builds the concrete quanter Layer
-for one host layer)."""
+for one host layer).
+
+ISSUE 14: factories are the calibration entry point — a configured
+factory stamps one observer Layer per wrapped host layer, and those
+instances are what ``PTQ.calibrate`` drives data through. ``_instance``
+validates the recipe eagerly (a typo'd kwarg fails at quantize() time,
+at the offending layer, instead of surfacing as a mid-calibration
+TypeError deep in a forward).
+"""
 
 from __future__ import annotations
+
+import inspect
 
 __all__ = ["QuanterFactory", "ObserverFactory"]
 
 
 class ObserverFactory:
     def __init__(self, **kwargs):
-        self._kwargs = kwargs
+        self._kwargs = dict(kwargs)
+
+    @property
+    def kwargs(self):
+        """The recipe (picklable plain dict) this factory stamps
+        instances from."""
+        return dict(self._kwargs)
 
     def _get_class(self):
-        raise NotImplementedError
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement _get_class() returning "
+            "the observer Layer class this factory instantiates")
 
     def _instance(self, layer):
-        return self._get_class()(layer, **self._kwargs)
+        cls = self._get_class()
+        # validate the SIGNATURE up front, so only genuine recipe/
+        # constructor mismatches wear the "recipe" error — a TypeError
+        # raised inside the constructor BODY (validating values, a
+        # downstream call) propagates untouched with its real message
+        try:
+            inspect.signature(cls).bind(layer, **self._kwargs)
+        except TypeError as e:
+            raise TypeError(
+                f"{type(self).__name__} recipe {self._kwargs!r} does not "
+                f"match {cls.__name__}'s constructor: {e}") from e
+        return cls(layer, **self._kwargs)
 
 
 class QuanterFactory(ObserverFactory):

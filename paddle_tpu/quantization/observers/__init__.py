@@ -1,3 +1,9 @@
-from .abs_max import AbsmaxObserver, AbsmaxObserverLayer  # noqa: F401
+from .abs_max import (  # noqa: F401
+    AbsmaxObserver,
+    AbsmaxObserverLayer,
+    PerChannelAbsmaxObserver,
+    PerChannelAbsmaxObserverLayer,
+)
 
-__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer",
+           "PerChannelAbsmaxObserver", "PerChannelAbsmaxObserverLayer"]

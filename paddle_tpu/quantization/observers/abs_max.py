@@ -11,10 +11,11 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
-from ..base import BaseObserver, fake_quant
+from ..base import BaseObserver, fake_quant, per_channel_int8
 from ..factory import ObserverFactory
 
-__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer",
+           "PerChannelAbsmaxObserver", "PerChannelAbsmaxObserverLayer"]
 
 
 class AbsmaxObserver(ObserverFactory):
@@ -57,6 +58,70 @@ class AbsmaxObserverLayer(BaseObserver):
         q = jnp.clip(jnp.round(arr.astype(jnp.float32) / max(scale, 1e-9)
                                * self.qmax), -self.qmax, self.qmax)
         return q.astype(jnp.int8), float(scale)
+
+    def fake_quant(self, x):
+        return fake_quant(x, self.scales(), qmax=self.qmax)
+
+
+class PerChannelAbsmaxObserver(ObserverFactory):
+    """Per-channel PTQ observer (ISSUE 14): one abs-max scale per
+    channel along ``quant_axis`` (restricted to the LAST axis so the
+    fake-quant/dequant broadcast is a plain trailing-dim multiply —
+    ``Linear``'s ``[in, out]`` weight quantizes per OUTPUT channel, the
+    granularity the int8 serving artifacts use)."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__(quant_bits=quant_bits, quant_axis=quant_axis)
+
+    def _get_class(self):
+        return PerChannelAbsmaxObserverLayer
+
+
+class PerChannelAbsmaxObserverLayer(BaseObserver):
+    """Per-channel running abs-max: forward records the elementwise max
+    of per-channel abs-maxes across calibration batches and passes the
+    input through untouched; ``cal_thresholds`` freezes the vector."""
+
+    def __init__(self, layer=None, quant_bits=8, quant_axis=-1):
+        super().__init__(quant_bits=quant_bits, quant_axis=quant_axis)
+        if quant_axis not in (-1,):
+            raise ValueError(
+                "PerChannelAbsmaxObserver supports quant_axis=-1 (last "
+                f"axis) only; got {quant_axis} — transpose the tensor or "
+                "use the per-tensor AbsmaxObserver")
+        self._max = None          # np [C], running per-channel abs-max
+        self._scale = None
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else x
+        arr = jnp.abs(data.astype(jnp.float32))
+        cur = np.asarray(jnp.max(
+            arr.reshape(-1, arr.shape[-1]), axis=0))
+        self._max = cur if self._max is None else np.maximum(self._max,
+                                                             cur)
+        return x
+
+    def cal_thresholds(self):
+        if self._max is None:
+            raise RuntimeError(
+                "PerChannelAbsmaxObserver never observed data — run "
+                "calibration forwards (PTQ.calibrate) before convert()")
+        self._scale = np.maximum(self._max, 1e-9).astype(np.float32)
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return Tensor(np.asarray(self._scale, np.float32))
+
+    def quantize_weight(self, w):
+        """int8 weight + f32 per-channel scale vector [C] (quantized
+        against the CALIBRATED thresholds via the shared
+        :func:`~paddle_tpu.quantization.base.per_channel_int8`)."""
+        arr = w._data if isinstance(w, Tensor) else w
+        codes, absmax = per_channel_int8(
+            np.asarray(arr), absmax=self.scales().numpy(),
+            qmax=self.qmax)
+        return jnp.asarray(codes), absmax
 
     def fake_quant(self, x):
         return fake_quant(x, self.scales(), qmax=self.qmax)

@@ -1,5 +1,23 @@
 """Post-training quantization (reference ptq.py:24 — PTQ.quantize inserts
-observers; calibration forwards collect abs-max; convert freezes scales)."""
+observers; calibration forwards collect abs-max; convert freezes scales).
+
+ISSUE 14 finishes the stub into the real PTQ flow::
+
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                          weight=PerChannelAbsmaxObserver()))
+    qmodel = ptq.quantize(model)          # observers wrap Linears/Convs
+    ptq.calibrate(qmodel, batches)        # observer-driven calibration
+    int8_model = ptq.convert(qmodel)      # genuine int8 weight freeze
+
+``calibrate`` drives eval-mode forwards over real data so every observer
+sees the activation/weight ranges it will freeze; ``convert`` then
+``cal_thresholds()``-freezes every observer and swaps each simulated
+``QuantedLinear`` for an ``Int8InferenceLinear`` holding int8 codes +
+the dequant epilogue scale (scalar per-tensor or per-output-channel
+vector, depending on the observer). The converted forward must agree
+with the SIMULATED (fake-quant) forward to float-assoc precision — that
+parity is the convert contract tests/test_quantization.py pins.
+"""
 
 from __future__ import annotations
 
@@ -11,6 +29,34 @@ __all__ = ["PTQ"]
 class PTQ(Quantization):
     def __init__(self, config):
         super().__init__(config)
+
+    def calibrate(self, model, data, max_batches=None):
+        """Run observer-collection forwards over ``data`` (an iterable of
+        input batches; a tuple/list batch is splatted into ``model(*b)``)
+        with the model in eval mode. Returns the number of batches
+        observed; zero batches is an error — silent no-op calibration is
+        exactly the dead-stub failure mode this replaces."""
+        was_training = model.training
+        model.eval()
+        n = 0
+        try:
+            for batch in data:
+                if max_batches is not None and n >= int(max_batches):
+                    break
+                if isinstance(batch, (tuple, list)):
+                    model(*batch)
+                else:
+                    model(batch)
+                n += 1
+        finally:
+            if was_training:
+                model.train()
+        if n == 0:
+            raise ValueError(
+                "PTQ.calibrate saw no batches — observers would freeze "
+                "their init scales and convert() would emit garbage int8 "
+                "weights; pass at least one calibration batch")
+        return n
 
     def convert(self, model, inplace=False):
         # freeze observer thresholds before conversion

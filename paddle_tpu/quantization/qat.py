@@ -1,13 +1,49 @@
 """Quantization-aware training (reference qat.py:23 — QAT.quantize inserts
-fake quanters; training then runs with the straight-through estimator)."""
+fake quanters; training then runs with the straight-through estimator).
+
+ISSUE 14 satellite: the 13-line stub silently imported as a no-op —
+``QAT.convert`` now genuinely freezes the TRAINED moving-average scales
+into int8 inference layers, and converting a model whose quanters never
+observed data raises a typed error instead of emitting garbage codes
+quantized against the init scale.
+"""
 
 from __future__ import annotations
 
 from .quantize import Quantization
 
-__all__ = ["QAT"]
+__all__ = ["QAT", "UncalibratedQuanterError"]
+
+
+class UncalibratedQuanterError(RuntimeError):
+    """A fake quanter reached ``convert`` without ever observing a
+    batch — no training/calibration forward updated its moving-average
+    abs-max, so the frozen int8 weights would be quantized against a
+    meaningless range. (The check is the quanter's observed-batch
+    count, not a scale sentinel: all-zero training data legitimately
+    leaves the scale at its floor and must still convert.)"""
 
 
 class QAT(Quantization):
     def __init__(self, config):
         super().__init__(config)
+
+    def convert(self, model, inplace=False):
+        """Freeze the trained quanters into int8 inference layers.
+
+        The fake quanters' moving-average abs-max IS the calibration —
+        training forwards updated it — so convert is a plain freeze; the
+        guard below catches the silent-no-op shape (quantize() -> never
+        trained -> convert()) with a typed error pointing at the fix.
+        """
+        from .quanters.abs_max import FakeQuanterWithAbsMaxObserverLayer
+
+        for name, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, FakeQuanterWithAbsMaxObserverLayer) \
+                    and layer._observed == 0:
+                raise UncalibratedQuanterError(
+                    f"quanter at {name!r} never observed a batch — run "
+                    "training (or at least one forward pass in train "
+                    "mode) between QAT.quantize() and QAT.convert() so "
+                    "the moving-average abs-max observes real data")
+        return super().convert(model, inplace=inplace)

@@ -44,8 +44,13 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
         self._state = 1.0
         self._accum = 1.0
         self._scale = 1e-9
+        # batches this quanter has observed — QAT.convert's calibration
+        # guard checks THIS, not a magic scale value (all-zero training
+        # data legitimately leaves the scale at its floor)
+        self._observed = 0
 
     def _update(self, x):
+        self._observed += 1
         data = x._data if isinstance(x, Tensor) else x
         cur = float(jnp.max(jnp.abs(data.astype(jnp.float32))))
         r = self._moving_rate

@@ -63,21 +63,28 @@ class QuantedLinear(Layer):
         return F.linear(x, w, self._inner.bias)
 
     def convert(self):
-        """Freeze into an int8-weight inference layer."""
+        """Freeze into an int8-weight inference layer. ``wscale`` is a
+        scalar (per-tensor quanters) or a per-output-channel vector [out]
+        (``PerChannelAbsmaxObserver``) — both broadcast through the
+        dequant epilogue."""
         wq, wscale = self.weight_quanter.quantize_weight(self._inner.weight)
-        ascale = (float(self.activation_quanter.scales().numpy())
+        ascale = (self.activation_quanter.scales().numpy()
                   if self.activation_quanter is not None else None)
         return Int8InferenceLinear(wq, wscale, self._inner.bias, ascale,
                                    qmax=self.weight_quanter.qmax)
 
 
 @op("int8_linear_dequant")
-def _int8_linear(x, wq, bias=None, wscale=1.0, qmax=127.0):
+def _int8_linear(x, wq, wdeq, bias=None):
     """int8-weight matmul with dequant epilogue; accumulation in f32/int32
-    is XLA's choice — the dequant scale folds into the epilogue."""
+    is XLA's choice — the dequant scale folds into the epilogue. ``wdeq``
+    is ``wscale / qmax`` as a traced array (0-d per-tensor, or [out]
+    per-channel — both broadcast over the matmul's last dim; it rides as
+    a positional tensor arg because the op dispatch keys executables on
+    kwargs, which must stay hashable)."""
     xf = x.astype(jnp.float32)
     wf = wq.astype(jnp.float32)  # int8 storage; MXU consumes the upcast
-    out = jnp.matmul(xf, wf) * (wscale / qmax)
+    out = jnp.matmul(xf, wf) * wdeq
     if bias is not None:
         out = out + bias.astype(jnp.float32)
     return out.astype(x.dtype)
@@ -85,19 +92,28 @@ def _int8_linear(x, wq, bias=None, wscale=1.0, qmax=127.0):
 
 class Int8InferenceLinear(Layer):
     """Converted inference layer: int8 weights resident in HBM (4x smaller
-    than f32), dequant fused into the matmul epilogue."""
+    than f32), dequant fused into the matmul epilogue. ``wscale`` is a
+    scalar (per-tensor) or a per-output-channel vector [out] — the
+    epilogue multiply broadcasts either."""
 
     def __init__(self, wq, wscale, bias, ascale=None, qmax=127.0):
         super().__init__()
         self.register_buffer("weight_q", Tensor._wrap(wq))
-        self._wscale = float(wscale)
+        self._wscale = np.asarray(wscale, np.float32)  # () or [out]
         self._ascale = ascale
         self._qmax = float(qmax)
+        # the dequant epilogue multiplier, precomputed once
+        self.register_buffer(
+            "weight_deq", Tensor._wrap(jnp.asarray(
+                self._wscale / self._qmax, jnp.float32)))
         self.bias = bias
 
+    @property
+    def wscale(self):
+        return self._wscale
+
     def forward(self, x):
-        return _int8_linear(x, self.weight_q, self.bias,
-                            wscale=self._wscale, qmax=self._qmax)
+        return _int8_linear(x, self.weight_q, self.weight_deq, self.bias)
 
 
 class QuantedConv2D(Layer):

@@ -37,8 +37,18 @@ processes over one shared model artifact + checkpoint root):
   expired deadline is rejected at admission and a too-tight one dies
   queued, both with RequestTimeoutError; afterwards every replica's
   allocator is PROVEN clean (all blocks free, nothing waiting/running).
+- ``quant``: the kill drill over a QUANTIZED fleet (ISSUE 14): replicas
+  boot from an int8 per-channel weight artifact and serve with
+  ``kv_dtype="int8"`` paged-KV pools. int8-KV greedy decode is
+  deterministic (per-row quantization is a pure function of the row),
+  so redispatching an in-flight request off the killed replica and
+  replaying prompt + emitted tokens on a survivor must reproduce
+  IDENTICAL token ids — asserted against an undisturbed quantized
+  single-engine baseline, like the fp32 kill drill asserts against its
+  fp32 baseline.
 
-``--drill all`` (the default) runs kill, hang, drain, shed in order.
+``--drill all`` (the default) runs kill, hang, drain, shed, quant in
+order.
 Wired into the slow tier of tests/test_serving.py, the chaos_train.py
 discipline applied to serving. Everything runs on CPU
 (JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
@@ -100,12 +110,12 @@ def build_fixture(out):
     return model, artifact, ckpt_root
 
 
-def baseline_outputs(model, stream):
+def baseline_outputs(model, stream, engine_kw=None):
     """Undisturbed single-engine greedy outputs, one per request index —
     the bit-exactness reference for every drill."""
     from paddle_tpu.inference.serving import LLMEngine, SamplingParams
 
-    eng = LLMEngine(model, ingest_async=False, **ENGINE_KW)
+    eng = LLMEngine(model, ingest_async=False, **(engine_kw or ENGINE_KW))
     try:
         rids = [eng.add_request(r.prompt,
                                 SamplingParams(max_new_tokens=r.max_new))
@@ -207,11 +217,11 @@ def assert_replicas_clean(fleet):
               f"waiting={s['waiting']}, running={s['running']})")
 
 
-def _fleet(out, n, **kw):
+def _fleet(out, n, engine_kw=None, **kw):
     from paddle_tpu.inference.serving.fleet import Router
 
     args = dict(artifact=os.path.join(out, "model"),
-                n_replicas=n, engine_kwargs=ENGINE_KW,
+                n_replicas=n, engine_kwargs=engine_kw or ENGINE_KW,
                 ckpt_root=os.path.join(out, "ckpt"),
                 log_dir=out, max_queue=100, hang_timeout_s=0.0,
                 max_restarts=3)
@@ -414,18 +424,82 @@ def drill_shed(out, model, n):
         fleet.close()
 
 
+def drill_quant(out, model, n):
+    """Kill drill over an int8 fleet (ISSUE 14 satellite): quantized
+    weight artifact + int8 paged-KV replicas; redispatch replay after
+    the SIGKILL must reproduce token ids IDENTICAL to the undisturbed
+    quantized single-engine baseline (int8-KV greedy is deterministic —
+    per-row quantization is write-order-independent)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+    from paddle_tpu.inference.serving import (
+        is_quantized_artifact, load_llama_artifact, save_llama_artifact)
+
+    engine_kw = dict(ENGINE_KW, kv_dtype="int8")
+    # re-publish the artifact QUANTIZED and rebuild the fixture around
+    # the DEQUANTIZED weights: replicas boot from the artifact, the
+    # rejoin checkpoint must hold the same weights or a restarted
+    # replica would serve a different model than the baseline
+    artifact = os.path.join(out, "model")
+    save_llama_artifact(model, artifact, quantize="int8")
+    check(is_quantized_artifact(artifact),
+          "artifact re-published in the int8 per-channel format")
+    model_q = load_llama_artifact(artifact)
+    CheckpointManager(os.path.join(out, "ckpt"), keep_last_n=2).save(
+        1, model=model_q)
+    stream = request_stream(_cfg(model_q))
+    baseline = baseline_outputs(model_q, stream, engine_kw=engine_kw)
+    fleet = _fleet(out, n, engine_kw=engine_kw, hang_timeout_s=3.0)
+    try:
+        victim = {}
+
+        def chaos(fl):
+            cand = [h for h in fl.supervisor.handles if h.alive]
+            h = max(cand, key=lambda h: len(fl.inflight(h.id)))
+            if not fl.inflight(h.id):
+                return False
+            victim["id"] = h.id
+            print(f"[chaos] SIGKILL quantized replica {h.id} "
+                  f"({len(fl.inflight(h.id))} requests in flight)")
+            os.kill(h.pid, signal.SIGKILL)
+            return True
+
+        gids, shed, wall = run_burst(fleet, stream, chaos)
+        wait_all_ready(fleet)
+        check(not shed, f"no request shed: {shed}")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done == len(stream),
+              f"completed == submitted ({done}/{len(stream)})")
+        m = fleet.metrics()
+        check(m["redispatches"] >= 1,
+              f"in-flight requests were redispatched "
+              f"({m['redispatches']}x) — int8-KV replay reproduced "
+              "identical token ids on the surviving replica")
+        check(m["replica_restarts"] >= 1,
+              f"supervisor restarted the killed replica "
+              f"({m['replica_restarts']} restarts)")
+        h = fleet.supervisor.handles[victim["id"]]
+        check(h.incarnation >= 1
+              and h.ready_info.get("reloaded_step") == 1,
+              "restarted quantized replica rejoined at checkpoint step 1")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
 def _cfg(model):
     return model.config
 
 
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
-          "shed": drill_shed}
+          "shed": drill_shed, "quant": drill_quant}
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--drill", default="all",
-                    choices=["kill", "hang", "drain", "shed", "all"])
+                    choices=["kill", "hang", "drain", "shed", "quant",
+                             "all"])
     ap.add_argument("--fleet", type=int, default=3)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
@@ -434,8 +508,8 @@ def main(argv=None):
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_serve.")
     print(f"[chaos] serving fleet drill, scratch: {out_root}, "
           f"fleet={args.fleet}")
-    drills = (["kill", "hang", "drain", "shed"] if args.drill == "all"
-              else [args.drill])
+    drills = (["kill", "hang", "drain", "shed", "quant"]
+              if args.drill == "all" else [args.drill])
     model = None
     for name in drills:
         out = os.path.join(out_root, name)

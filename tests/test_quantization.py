@@ -18,8 +18,12 @@ from paddle_tpu.quantization import (
     QuantConfig,
     QuantedConv2D,
     QuantedLinear,
+    UncalibratedQuanterError,
 )
-from paddle_tpu.quantization.observers import AbsmaxObserver
+from paddle_tpu.quantization.observers import (
+    AbsmaxObserver,
+    PerChannelAbsmaxObserver,
+)
 from paddle_tpu.quantization.quanters import FakeQuanterWithAbsMaxObserver
 
 
@@ -145,3 +149,140 @@ class TestObserveWrapper:
         wrapped(paddle.to_tensor(np.array([-5.0, 7.0], "float32")))
         obs.cal_thresholds()
         assert float(obs.scales().numpy()) == pytest.approx(7.0)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellites: real PTQ calibration, per-channel observers, the
+# convert parity contract, and the QAT typed guard
+# ---------------------------------------------------------------------------
+
+def _calib_batches(n=4, bs=16, dim=8):
+    return [paddle.to_tensor(
+        np.random.RandomState(i).randn(bs, dim).astype("float32"))
+        for i in range(n)]
+
+
+class TestPTQCalibration:
+    def test_calibrate_counts_batches_and_restores_mode(self):
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                              weight=AbsmaxObserver()))
+        qm = ptq.quantize(small_net())
+        qm.train()
+        assert ptq.calibrate(qm, _calib_batches()) == 4
+        assert qm.training  # train mode restored after eval forwards
+        assert ptq.calibrate(qm, _calib_batches(), max_batches=2) == 2
+
+    def test_calibrate_with_zero_batches_is_typed_error(self):
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                              weight=AbsmaxObserver()))
+        qm = ptq.quantize(small_net())
+        with pytest.raises(ValueError, match="no batches"):
+            ptq.calibrate(qm, [])
+
+    def test_per_channel_observer_collects_running_max(self):
+        obs = PerChannelAbsmaxObserver()._instance(None)
+        obs(paddle.to_tensor(np.array([[1.0, -2.0], [0.5, 1.0]],
+                                      "float32")))
+        obs(paddle.to_tensor(np.array([[-3.0, 0.1]], "float32")))
+        obs.cal_thresholds()
+        np.testing.assert_allclose(obs.scales().numpy(), [3.0, 2.0])
+
+    def test_per_channel_unobserved_convert_is_typed_error(self):
+        obs = PerChannelAbsmaxObserver()._instance(None)
+        with pytest.raises(RuntimeError, match="never observed"):
+            obs.cal_thresholds()
+
+    def test_per_channel_non_last_axis_rejected(self):
+        with pytest.raises(ValueError, match="quant_axis"):
+            PerChannelAbsmaxObserver(quant_axis=0)._instance(None)
+
+    def test_factory_recipe_mismatch_is_typed(self):
+        f = AbsmaxObserver()
+        f._kwargs["bogus"] = 1  # a typo'd recipe kwarg
+        with pytest.raises(TypeError, match="recipe"):
+            f._instance(None)
+
+
+class TestConvertParity:
+    """The ISSUE 14 'first end-to-end parity test' for the int8 freeze:
+    quantize -> calibrate -> convert -> forward must match the
+    SIMULATED-quant forward (fake-quant weights, fp math) to float-assoc
+    precision — convert only changes the storage/epilogue, never the
+    quantization math."""
+
+    def _simulated_forward(self, net, qm, x):
+        """Manual fake-quant-weight forward with the observers' frozen
+        scales — the simulation convert() must reproduce."""
+        def fq(w, obs):
+            s = np.asarray(obs.scales().numpy())
+            q = np.clip(np.round(w / s * 127.0), -127, 127)
+            return q * (s / 127.0)
+
+        h = x @ fq(net[0].weight.numpy(), qm[0].weight_quanter) \
+            + net[0].bias.numpy()
+        h = np.maximum(h, 0)
+        return h @ fq(net[2].weight.numpy(), qm[2].weight_quanter) \
+            + net[2].bias.numpy()
+
+    @pytest.mark.parametrize("observer_cls", [AbsmaxObserver,
+                                              PerChannelAbsmaxObserver])
+    def test_convert_matches_simulated_forward(self, observer_cls):
+        net = small_net()
+        ptq = PTQ(QuantConfig(activation=None, weight=observer_cls()))
+        qm = ptq.quantize(net)
+        ptq.calibrate(qm, _calib_batches())
+        x = np.random.RandomState(7).randn(6, 8).astype("float32")
+        sim = self._simulated_forward(net, qm, x)
+        conv = ptq.convert(qm)
+        assert isinstance(conv[0], Int8InferenceLinear)
+        got = conv(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, sim, atol=2e-4)
+
+    def test_per_channel_convert_close_to_fp32(self):
+        net = small_net()
+        ptq = PTQ(QuantConfig(activation=None,
+                              weight=PerChannelAbsmaxObserver()))
+        qm = ptq.quantize(net)
+        ptq.calibrate(qm, _calib_batches())
+        conv = ptq.convert(qm)
+        assert conv[0].wscale.shape == (32,)  # per-output-channel
+        assert str(conv[0].weight_q.dtype).endswith("int8")
+        x = paddle.to_tensor(np.ones((4, 8), "float32"))
+        fp = net(x).numpy()
+        got = conv(x).numpy()
+        assert np.abs(got - fp).max() <= 0.02 * np.abs(fp).max() + 0.02
+
+
+class TestQATConvertGuard:
+    def test_untrained_quanter_convert_raises_typed(self):
+        q = FakeQuanterWithAbsMaxObserver()
+        qat = QAT(QuantConfig(activation=q, weight=q))
+        qnet = qat.quantize(small_net())
+        with pytest.raises(UncalibratedQuanterError,
+                           match="never observed"):
+            qat.convert(qnet)
+
+    def test_all_zero_training_data_still_converts(self):
+        # the observed-count check (not a scale sentinel): a quanter fed
+        # only zeros has scale == floor but DID calibrate — convert must
+        # succeed instead of misdiagnosing it as untrained
+        q = FakeQuanterWithAbsMaxObserver()
+        qat = QAT(QuantConfig(activation=q, weight=q))
+        qnet = qat.quantize(nn.Sequential(nn.Linear(8, 4)))
+        qnet.train()
+        qnet(paddle.to_tensor(np.zeros((4, 8), "float32")))
+        qnet.eval()
+        assert isinstance(qat.convert(qnet)[0], Int8InferenceLinear)
+
+    def test_trained_quanter_converts(self):
+        q = FakeQuanterWithAbsMaxObserver()
+        qat = QAT(QuantConfig(activation=q, weight=q))
+        qnet = qat.quantize(small_net())
+        qnet.train()
+        qnet(paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 8).astype("float32")))
+        qnet.eval()
+        conv = qat.convert(qnet)
+        assert isinstance(conv[0], Int8InferenceLinear)
+        out = conv(paddle.to_tensor(np.ones((2, 8), "float32")))
+        assert out.shape == [2, 4]
