@@ -765,6 +765,44 @@ def bench_serving(on_tpu):
                          "outputs bit-exact vs the in-process CPU "
                          "engine",
     })
+    # disaggregated prefill/decode A/B (ISSUE 15): colocated vs
+    # role-split fleets of the SAME size on the long-prompt mix. The
+    # tracked line is the split arm's tokens/s; the headline contract —
+    # decode-worker ITL p99 at or under the colocated arm's — rides the
+    # line as fields (engine-owned histograms via the stats RPC). CPU
+    # subprocess for the same backend reasons as the fleet line.
+    r = subprocess.run(
+        [_sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts", "bench_serving.py"),
+         "--workload", "disagg", "--fleet", "3", "--tiny"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, f"disagg A/B failed: {r.stderr[-2000:]}"
+    dg = _json.loads(r.stdout)
+    assert dg["bit_exact"], \
+        "disagg fleet diverged from the in-process engine reference"
+    _emit({
+        "metric": "serving_cpu_disagg_tokens_per_sec",
+        "value": dg["disagg"]["tokens_per_sec"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "tokens_per_sec_colocated": dg["colocated"]["tokens_per_sec"],
+        "decode_itl_p99_ms_disagg": dg["disagg"]["decode_itl_p99_ms"],
+        "decode_itl_p99_ms_colocated":
+            dg["colocated"]["decode_itl_p99_ms"],
+        "itl_p99_ratio": dg["itl_p99_ratio"],
+        "prefill_handoffs": dg["disagg"]["prefill_handoffs"],
+        "kv_transfer_retries": dg["disagg"]["kv_transfer_retries"],
+        "n_replicas": dg["n_replicas"],
+        "roles": dg["roles"],
+        "bit_exact": dg["bit_exact"],
+        "num_requests": dg["num_requests"],
+        "long_prompt_len": dg["long_prompt_len"],
+        "baseline_note": "one seeded long-prompt mix through colocated "
+                         "vs 1-prefill+2-decode subprocess fleets of "
+                         "equal size; decode-worker ITL p99 is "
+                         "engine-owned (stats RPC after a post-warm "
+                         "metrics reset); outputs bit-exact vs the "
+                         "in-process CPU engine",
+    })
 
 
 def make_llama(on_tpu):

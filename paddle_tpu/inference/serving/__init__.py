@@ -9,12 +9,13 @@ and the ``LLMEngine`` front-end (``engine``). See DESIGN_DECISIONS.md
 """
 
 from .errors import (  # noqa: F401
-    EngineClosedError, FleetOverloadedError, ReplicaCrashLoopError,
-    RequestTimeoutError,
+    EngineClosedError, FleetOverloadedError, KVTransferError,
+    ReplicaCrashLoopError, RequestTimeoutError,
 )
 from .kv_cache import (  # noqa: F401
     BlockAllocator, KV_QMAX, PagedKVCache, PrefixCache,
-    kv_pool_bytes_per_block, quantize_kv_rows,
+    kv_pool_bytes_per_block, pack_kv_pages, quantize_kv_rows,
+    unpack_kv_pages,
 )
 from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
 from .paged_attention import (  # noqa: F401
@@ -34,7 +35,8 @@ __all__ = [
     "save_llama_artifact", "load_llama_artifact", "is_llama_artifact",
     "is_quantized_artifact", "load_llama_state_dict",
     "quantize_state_dict", "dequantize_state_dict", "KV_QMAX",
-    "quantize_kv_rows", "kv_pool_bytes_per_block",
+    "quantize_kv_rows", "kv_pool_bytes_per_block", "pack_kv_pages",
+    "unpack_kv_pages",
     "fleet", "RequestTimeoutError", "FleetOverloadedError",
-    "EngineClosedError", "ReplicaCrashLoopError",
+    "EngineClosedError", "ReplicaCrashLoopError", "KVTransferError",
 ]

@@ -246,7 +246,8 @@ class LLMEngine:
                  max_batch_size=4, max_model_len=None, prefill_buckets=None,
                  max_prefills_per_step=1, ingest_async=True, plan=None,
                  enable_prefix_cache=False, max_prefill_tokens_per_step=None,
-                 draft_model=None, spec_tokens=2, kv_dtype=None):
+                 draft_model=None, spec_tokens=2, kv_dtype=None,
+                 prefill_only=False):
         from ...models.llama import LlamaForCausalLM
 
         if not isinstance(model, LlamaForCausalLM):
@@ -332,6 +333,16 @@ class LLMEngine:
         self._params = model._unique_params()
         self._prefill_jit = None
         self._decode_jit = None
+        # prefill-only mode (ISSUE 15): the disaggregated prefill worker
+        # runs prefills (and samples each request's FIRST token from the
+        # final chunk's logits) but never decodes — requests sit
+        # decode-ready until the caller exports their pages
+        # (export_kv_pages) and cancels them; step() skips the decode
+        # phase entirely, so the decode graph never compiles here.
+        self.prefill_only = bool(prefill_only)
+        if self.prefill_only and draft_model is not None:
+            raise ValueError("prefill_only engines never decode; a "
+                             "draft_model would be dead weight")
         # speculative decoding (ISSUE 11): the draft llama shares the
         # target's allocator/block tables; its pools are its own shapes
         self.draft_model = draft_model
@@ -427,6 +438,24 @@ class LLMEngine:
                 "block allocation", deadline=deadline)
         req = Request(prompt_ids, sampling, arrival_t=arrival_t,
                       deadline=deadline)
+        self._check_admissible(req)
+        # observability clock zero: TTFT and the queued span both measure
+        # from the moment the engine accepted the request
+        req.t_submit = req.t_queue_start = time.perf_counter_ns()
+        self._requests[req.rid] = req
+        if self._ingest is not None:
+            self._ingest.submit(req)
+        else:
+            self._stage_request(req)
+            self.scheduler.waiting.append(req)
+        return req.rid
+
+    def _check_admissible(self, req):
+        """Admission validation shared by ``add_request`` and
+        ``add_request_with_pages`` (ISSUE 15): greedy-only under
+        speculation, pool/length caps, re-prefill bucket coverage, sane
+        budget — all typed, all BEFORE any request or allocator state
+        moves. One copy, so the two admission doors can never drift."""
         if self._spec_k and req.sampling.do_sample:
             raise ValueError(
                 "speculative decoding is greedy-only (the verify step "
@@ -454,16 +483,98 @@ class LLMEngine:
                 f"bucket is {self.prefill_buckets[-1]}")
         if req.sampling.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        # observability clock zero: TTFT and the queued span both measure
-        # from the moment the engine accepted the request
+
+    # -- disaggregated prefill/decode handoff (ISSUE 15) ----------------
+    def export_kv_pages(self, rid):
+        """Export a request's materialized KV pages (the prefill-worker
+        side of the handoff): the pool content of its blocks holding the
+        ``num_cached`` tokens written so far, scales included on int8
+        pools. The request must have finished prefill (decode-ready) —
+        exporting a half-prefilled request would hand off pages the
+        first token was never sampled from."""
+        req = self._requests[rid]
+        if req.finished or req.prefilling or req.num_cached < 1:
+            raise ValueError(
+                f"request {rid} is not decode-ready "
+                f"(state={req.state}, prefilling={req.prefilling}); only "
+                "a completed prefill exports pages")
+        n_pages = -(-req.num_cached // self.block_size)
+        return self.cache.export_request_pages(req.blocks[:n_pages],
+                                               req.num_cached)
+
+    def add_request_with_pages(self, prompt_ids, pages,
+                               sampling: SamplingParams | None = None,
+                               deadline=None):
+        """Admit a request whose prompt KV pages were computed by a
+        prefill worker (the decode side of the disaggregated handoff):
+        ``prompt_ids`` is the original prompt PLUS the first token the
+        prefill worker sampled, and ``pages`` (an ``export_kv_pages``
+        payload) covers every position but the last. Admission allocates
+        blocks normally (queues on exhaustion, FIFO); the next ``step``
+        imports the payload into them and the request decodes from its
+        first step — no prefill graph runs, and greedy continuation is
+        bit-identical to a colocated engine because the imported pages
+        are byte-identical to what local prefill would have written.
+
+        An expired ``deadline`` raises :class:`RequestTimeoutError` HERE,
+        before any request or allocator state moves; a deadline expiring
+        while the request waits for admission aborts it with the typed
+        reason and the never-imported pages are simply dropped."""
+        self._ensure_open()
+        if self.prefill_only:
+            raise ValueError("prefill_only engines never decode; "
+                             "imported pages have nowhere to go")
+        if deadline is not None and time.time() >= float(deadline):
+            raise RequestTimeoutError(
+                f"deadline {deadline} already expired at admission "
+                f"(now={time.time():.3f}); imported pages rejected before "
+                "any block allocation", deadline=deadline)
+        req = Request(prompt_ids, sampling, deadline=deadline)
+        covered = int(pages["covered"])
+        if covered != len(req.prompt) - 1:
+            raise ValueError(
+                f"pages cover {covered} tokens but the prompt has "
+                f"{len(req.prompt)} — the handoff prompt is the original "
+                "prompt plus the prefill worker's first sampled token, "
+                "so coverage must be len(prompt) - 1")
+        # full geometry validation (dtype/block_size/shapes/scale rows)
+        # happens HERE, before the request exists — not at import time,
+        # when blocks are already allocated and pools about to move
+        n_payload = self.cache.validate_request_pages(pages)
+        if n_payload != -(-covered // self.block_size):
+            raise ValueError(
+                f"pages hold {n_payload} blocks but cover {covered} "
+                f"tokens ({-(-covered // self.block_size)} blocks at "
+                f"block_size={self.block_size})")
+        self._check_admissible(req)
+        req.preloaded = pages
         req.t_submit = req.t_queue_start = time.perf_counter_ns()
         self._requests[req.rid] = req
-        if self._ingest is not None:
-            self._ingest.submit(req)
-        else:
-            self._stage_request(req)
-            self.scheduler.waiting.append(req)
+        # no staging needed (nothing to prefill): straight to the queue
+        self.scheduler.waiting.append(req)
         return req.rid
+
+    def _adopt_preloaded(self, req):
+        """Write a just-admitted preloaded request's imported pages into
+        its allocated blocks (host-triggered, before this step's decode)
+        and publish their identities to the prefix cache so later
+        admissions can share them. One-shot: after this, the request is
+        indistinguishable from one prefilled locally — an eviction
+        re-prefills through the normal staged path."""
+        pages = req.preloaded
+        req.preloaded = None
+        self.cache.import_request_pages(req.blocks, pages)
+        if self.prefix_cache is not None:
+            # sound because imported pages are byte-identical to local
+            # prefill output (per-row quantization is pure)
+            self.prefix_cache.register(req.tokens, req.blocks,
+                                       req.num_cached)
+        req.t_decode_start = time.perf_counter_ns()
+        _obs_trace.add_complete(
+            "request.import", getattr(req, "_t_admit", req.t_queue_start),
+            req.t_decode_start, cat="request", tid=req.rid,
+            args={"rid": req.rid, "engine": self._name,
+                  "covered": req.num_cached})
 
     def request(self, rid):
         return self._requests[rid]
@@ -1066,11 +1177,21 @@ class LLMEngine:
                 cat="request", tid=req.rid,
                 args={"rid": req.rid, "engine": self._name,
                       "evictions": req.evictions})
+            if req.preloaded is not None:
+                # disaggregated handoff: imported pages land in the
+                # freshly allocated blocks before this step decodes
+                self._adopt_preloaded(req)
 
         # -- chunked prefill (budgeted; interleaves with decode below) ---
         for req, start, take in sched.prefill_work(
                 self.max_prefill_tokens_per_step):
             self._run_chunk(req, start, take, outputs)
+
+        if self.prefill_only:
+            # disaggregated prefill worker: decode-ready requests wait
+            # for export_kv_pages + cancel; nothing decodes here
+            self._update_gauges()
+            return outputs
 
         # -- decode ------------------------------------------------------
         sched.ensure_decode_room(extra=self._spec_k)
@@ -1097,16 +1218,19 @@ class LLMEngine:
                 for i, req in ready:
                     req.num_cached += 1
                     outputs.extend(self._emit(req, logits[i]))
+        self._update_gauges()
+        return outputs
+
+    def _update_gauges(self):
         # utilization gauges: free-list arithmetic the host already holds
         usable = max(self.cache.num_blocks - 1, 1)
         _G_KV_UTIL.set(1.0 - self.cache.allocator.num_free / usable,
                        instance=self._name)
-        _G_OCCUPANCY.set(len(sched.running) / self.max_batch_size,
+        _G_OCCUPANCY.set(len(self.scheduler.running) / self.max_batch_size,
                          instance=self._name)
         if self.cache.quantized:
             _G_QUANT_BLOCKS.set(usable - self.cache.allocator.num_free,
                                 instance=self._name)
-        return outputs
 
     # ------------------------------------------------------------------
     # speculative decoding

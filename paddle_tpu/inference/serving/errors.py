@@ -15,6 +15,11 @@ of flapping forever.
   (the SAME budget/backoff machinery training supervision uses); it
   subclasses the launcher's ``CrashLoopError`` so one except-clause
   handles crash loops from either side of the house.
+* :class:`KVTransferError` — the disaggregated prefill→decode KV-page
+  handoff (ISSUE 15) failed past its retry budget: corrupt frames or
+  failed deliveries were re-driven (the prefill re-runs elsewhere, never
+  decoded-on-garbage) until the budget ran out — the streaming
+  ``StreamReadError`` idiom applied to the transfer channel.
 """
 
 from __future__ import annotations
@@ -22,7 +27,8 @@ from __future__ import annotations
 from ...distributed.launch.controllers.collective import CrashLoopError
 
 __all__ = ["RequestTimeoutError", "FleetOverloadedError",
-           "EngineClosedError", "ReplicaCrashLoopError"]
+           "EngineClosedError", "ReplicaCrashLoopError",
+           "KVTransferError"]
 
 
 class RequestTimeoutError(TimeoutError):
@@ -61,3 +67,17 @@ class ReplicaCrashLoopError(CrashLoopError):
     def __init__(self, msg, replica=None, exit_code=1, restarts=0):
         super().__init__(msg, exit_code=exit_code, restarts=restarts)
         self.replica = replica
+
+
+class KVTransferError(RuntimeError):
+    """The KV-page handoff between a prefill and a decode worker failed
+    past its retry budget (ISSUE 15). Every transient failure (corrupt
+    frame, failed delivery) re-drives the prefill — partial pages are
+    discarded atomically, never decoded — so this error means the
+    transfer channel itself is persistently broken. ``gid`` names the
+    fleet request, ``retries`` how many re-drives were burned."""
+
+    def __init__(self, msg, gid=None, retries=0):
+        super().__init__(msg)
+        self.gid = gid
+        self.retries = retries

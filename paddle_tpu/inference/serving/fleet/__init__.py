@@ -13,8 +13,8 @@ redispatch" and ``scripts/chaos_serve.py`` — the acceptance drill.
 """
 
 from ..errors import (  # noqa: F401
-    EngineClosedError, FleetOverloadedError, ReplicaCrashLoopError,
-    RequestTimeoutError,
+    EngineClosedError, FleetOverloadedError, KVTransferError,
+    ReplicaCrashLoopError, RequestTimeoutError,
 )
 from .supervisor import ReplicaHandle, ReplicaSupervisor  # noqa: F401
 from .router import FleetRequest, Router  # noqa: F401
@@ -22,5 +22,5 @@ from .router import FleetRequest, Router  # noqa: F401
 __all__ = [
     "Router", "FleetRequest", "ReplicaSupervisor", "ReplicaHandle",
     "RequestTimeoutError", "FleetOverloadedError", "EngineClosedError",
-    "ReplicaCrashLoopError",
+    "ReplicaCrashLoopError", "KVTransferError",
 ]

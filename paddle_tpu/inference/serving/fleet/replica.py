@@ -4,24 +4,44 @@ line-JSON RPC loop, runnable as
 
 Config arrives in ``PADDLE_REPLICA_CONFIG`` (JSON: ``artifact`` path
 from :func:`~..engine.save_llama_artifact`, ``engine`` kwargs,
-``hb_dir`` heartbeat directory, optional ``ckpt_root``). Protocol
+``hb_dir`` heartbeat directory, optional ``ckpt_root``, optional
+``role`` — ``"both"``/``"prefill"``/``"decode"``, ISSUE 15). Protocol
 (stdin commands → stdout events, one JSON object per line):
 
   {"op":"submit","gid":g,"gen":k,"prompt":[...],"max_new":n,
    "eos":t|null,"deadline":s|null}      -> tok events as tokens emerge
+  {"op":"prefill","gid":g,"gen":k,"hid":h,...}  -> kvpage* + kvdone
+  {"op":"kvpage","gid":g,"seq":i,"total":T,"crc":c,"data":b64}
+  {"op":"submit_pages","gid":g,"gen":k,"prompt":[...],"frames":T,
+   "crc":c,...}                         -> import pages, then tok events
   {"op":"cancel","gid":g}               -> blocks freed, slot recycled
   {"op":"reload","root":path}           -> {"e":"reloaded","step":s}
   {"op":"stats"}                        -> {"e":"stats",...}
   {"op":"shutdown"}                     -> drain in-flight, {"e":"bye"}
 
 Events: ``ready`` (engine built, weights loaded — with the checkpoint
-step it rejoined from, when a ``ckpt_root`` was given), ``tok``
-(``{"gid","gen","toks":[...],"fin","reason"}``; ``gen`` echoes the
-dispatch generation so the router can drop emissions from a superseded
-assignment), ``load`` (kv-utilization / decode-occupancy after each
-step — the router's least-loaded signal), ``stats``, ``reloaded``,
-``bye``. stdout carries ONLY these lines; everything chatty goes to
-stderr (the supervisor routes it to a per-replica log file).
+step it rejoined from, when a ``ckpt_root`` was given, and the slot's
+``role``), ``tok`` (``{"gid","gen","toks":[...],"fin","reason"}``;
+``gen`` echoes the dispatch generation so the router can drop emissions
+from a superseded assignment), ``load`` (kv-utilization /
+decode-occupancy after each step — the router's least-loaded signal),
+``stats``, ``reloaded``, ``bye``.
+
+Disaggregated handoff (ISSUE 15): a ``prefill``-role worker runs its
+engine in ``prefill_only`` mode. On ``{"op":"prefill"}`` it admits the
+request, and the moment the engine samples the request's FIRST token
+(prefill complete) it exports the KV pages, streams them up as
+CRC-framed ``kvpage`` events (``crc`` = zlib.crc32 of the raw chunk;
+``hid`` echoes the dispatch's handoff id so the router can drop a
+zombie's stale frames) followed by a ``kvdone`` carrying the first
+token and the whole-payload CRC, then frees the request's blocks. A
+``decode``-capable worker buffers ``kvpage`` command frames, verifies
+each CRC, and on ``submit_pages`` imports the payload via
+``LLMEngine.add_request_with_pages`` — a corrupt or incomplete buffer
+is rejected with a typed ``err`` event (kind ``KVTransferError``) so
+the router re-drives the prefill instead of decoding on garbage.
+stdout carries ONLY protocol lines; everything chatty goes to stderr
+(the supervisor routes it to a per-replica log file).
 
 Heartbeats (``distributed.launch.heartbeat.write`` — the PR-4 files)
 are written at every loop tick, engine-stepping or idle; the two chaos
@@ -30,13 +50,21 @@ sites fire at the loop head:
 * ``serve.replica_crash`` — SIGKILL self (the OOM-killer/node-loss
   shape; nothing is flushed, the supervisor must recover everything);
 * ``serve.replica_hang``  — wedge forever without heartbeating (the
-  stuck-collective shape; only the supervisor's watchdog can end it).
+  stuck-collective shape; only the supervisor's watchdog can end it);
+* ``serve.prefill_crash`` — fired between kvpage frame emissions:
+  SIGKILL self MID-TRANSFER, the partial-pages recovery shape;
+* ``serve.kv_transfer_corrupt`` — fired per kvpage frame: the frame's
+  payload is corrupted after its CRC was computed, so the receiver's
+  CRC check must catch it.
 
 Chaos arming is env-driven so drills can poison exactly one replica:
 ``CHAOS_SERVE_SITE`` + ``CHAOS_SERVE_REPLICA`` + optional
 ``CHAOS_SERVE_AFTER_STEPS`` — armed only in incarnation 0, so the
 respawned replica runs clean (the marker-file discipline of
-``chaos_train.py``, enforced by the incarnation counter instead).
+``chaos_train.py``, enforced by the incarnation counter instead). A
+drill that poisons SEVERAL replicas at once (the disagg storm) sets
+``CHAOS_SERVE_SITES`` instead: a JSON list of
+``{"site","replica","after"}`` specs.
 """
 
 from __future__ import annotations
@@ -48,7 +76,9 @@ import signal
 import sys
 import threading
 import time
+import zlib
 
+from .framing import decode_frame, encode_frame, join_frames, split_frames
 from .supervisor import ENV_CONFIG, ENV_ID, ENV_INCARNATION
 
 __all__ = ["replica_worker_main"]
@@ -59,27 +89,41 @@ def _emit(obj):
     sys.stdout.flush()
 
 
-# the armed inject() context manager must outlive _arm_chaos: a GC'd
+# the armed inject() context managers must outlive _arm_chaos: a GC'd
 # contextmanager generator runs its finally block, silently DISARMING
-# the site — module-global keeps it alive for the process lifetime
-_CHAOS_CM = None
+# the site — module-global keeps them alive for the process lifetime
+_CHAOS_CMS: list = []
+
+
+def _chaos_specs(replica_id):
+    multi = os.environ.get("CHAOS_SERVE_SITES")
+    if multi:
+        try:
+            specs = json.loads(multi)
+        except ValueError:
+            return []
+        return [(s["site"], int(s.get("after", 1) or 1),
+                 s.get("max_fires")) for s in specs
+                if str(s.get("replica")) == str(replica_id)]
+    site = os.environ.get("CHAOS_SERVE_SITE")
+    if site and os.environ.get("CHAOS_SERVE_REPLICA") == str(replica_id):
+        return [(site,
+                 int(os.environ.get("CHAOS_SERVE_AFTER_STEPS", "1") or 1),
+                 None)]
+    return []
 
 
 def _arm_chaos(replica_id):
-    site = os.environ.get("CHAOS_SERVE_SITE")
-    if not site:
-        return
-    if os.environ.get("CHAOS_SERVE_REPLICA") != str(replica_id):
-        return
     if int(os.environ.get(ENV_INCARNATION, "0") or 0) != 0:
         return  # restarted incarnations run clean
     from ....utils import fault_injection as fi
 
-    global _CHAOS_CM
-    after = int(os.environ.get("CHAOS_SERVE_AFTER_STEPS", "1") or 1)
-    # armed for the process lifetime (the fault ends this incarnation)
-    _CHAOS_CM = fi.inject(site, every_n=after)
-    _CHAOS_CM.__enter__()
+    for site, after, max_fires in _chaos_specs(replica_id):
+        # armed for the process lifetime (the fault ends or taints only
+        # this incarnation)
+        cm = fi.inject(site, every_n=after, max_fires=max_fires)
+        cm.__enter__()
+        _CHAOS_CMS.append(cm)
 
 
 def replica_worker_main():
@@ -93,10 +137,14 @@ def replica_worker_main():
     from ....utils import fault_injection as fi
     from ..engine import LLMEngine, load_llama_artifact
     from ..errors import RequestTimeoutError
+    from ..kv_cache import pack_kv_pages, unpack_kv_pages
     from ..scheduler import SamplingParams
 
     model = load_llama_artifact(cfg["artifact"])
-    eng = LLMEngine(model, ingest_async=False, **cfg.get("engine") or {})
+    role = cfg.get("role") or "both"
+    eng = LLMEngine(model, ingest_async=False,
+                    prefill_only=(role == "prefill"),
+                    **cfg.get("engine") or {})
     reloaded = None
     root = cfg.get("ckpt_root")
     if root:
@@ -110,7 +158,7 @@ def replica_worker_main():
             reloaded = eng.reload_weights(mgr)
     hb_dir = cfg.get("hb_dir")
     hb.write(step=0, dir=hb_dir, rank=replica_id)
-    _emit({"e": "ready", "replica": replica_id,
+    _emit({"e": "ready", "replica": replica_id, "role": role,
            "incarnation": int(os.environ.get(ENV_INCARNATION, "0") or 0),
            "reloaded_step": reloaded})
 
@@ -129,10 +177,55 @@ def replica_worker_main():
 
     threading.Thread(target=_reader, daemon=True).start()
 
-    rid_of = {}   # gid -> engine rid
-    meta = {}     # gid -> {"gen": k}
+    rid_of = {}    # gid -> engine rid
+    meta = {}      # gid -> {"gen": k}
+    handoff = {}   # gid -> {"gen","hid"}: op=prefill requests (ISSUE 15)
+    page_buf = {}  # gid -> {"frames": {seq: bytes}, "bad": reason|None}
     steps = 0
     shutting = False
+
+    def _stream_pages(gid, out):
+        """Prefill finished for a handed-off request: export its pages,
+        stream CRC-framed ``kvpage`` events (the mid-transfer chaos
+        probes fire between frames), emit ``kvdone`` with the first
+        sampled token, then free the request's blocks — the decode
+        worker owns it from here."""
+        hm = handoff.pop(gid)
+        rid = rid_of.pop(gid)
+        if out.token < 0:
+            # aborted before/without a first token (deadline expiry):
+            # typed end, no pages, nothing held
+            _emit({"e": "kvdone", "gid": gid, "hid": hm["hid"],
+                   "first_tok": None, "fin": True,
+                   "reason": out.finish_reason, "frames": 0, "crc": 0})
+            eng.release(rid)
+            return
+        if out.finished:
+            # the first token already ends the request (max_new=1 or
+            # EOS): nothing left to decode, nothing to transfer
+            _emit({"e": "kvdone", "gid": gid, "hid": hm["hid"],
+                   "first_tok": int(out.token), "fin": True,
+                   "reason": out.finish_reason, "frames": 0, "crc": 0})
+            eng.release(rid)
+            return
+        pages = eng.export_kv_pages(rid)
+        blob = pack_kv_pages(pages)
+        frames = split_frames(blob)
+        for seq, chunk in enumerate(frames):
+            if fi.should_fire("serve.prefill_crash"):
+                os.kill(os.getpid(), signal.SIGKILL)  # mid-transfer
+            fr = encode_frame(
+                chunk,
+                corrupt=fi.should_fire("serve.kv_transfer_corrupt"))
+            _emit({"e": "kvpage", "gid": gid, "hid": hm["hid"],
+                   "seq": seq, "total": len(frames), **fr})
+        _emit({"e": "kvdone", "gid": gid, "hid": hm["hid"],
+               "first_tok": int(out.token), "fin": False, "reason": None,
+               "frames": len(frames), "crc": zlib.crc32(blob),
+               "nbytes": len(blob), "covered": int(pages["covered"])})
+        # handoff delivered: this worker's part is done — free the blocks
+        eng.cancel(rid, reason="handoff")
+        eng.release(rid)
 
     def _handle(cmd):
         nonlocal shutting
@@ -155,8 +248,85 @@ def replica_worker_main():
                 return
             rid_of[gid] = rid
             meta[gid] = {"gen": cmd.get("gen", 0)}
+        elif op == "prefill":
+            # disaggregated stage 1 (ISSUE 15): admit normally; the
+            # output loop intercepts the first sampled token and streams
+            # the KV pages up instead of emitting it as a tok event
+            gid = cmd["gid"]
+            try:
+                rid = eng.add_request(
+                    np.asarray(cmd["prompt"], np.int32),
+                    SamplingParams(max_new_tokens=int(cmd["max_new"]),
+                                   eos_token_id=cmd.get("eos")),
+                    deadline=cmd.get("deadline"))
+            except RequestTimeoutError:
+                _emit({"e": "kvdone", "gid": gid,
+                       "hid": cmd.get("hid", 0), "first_tok": None,
+                       "fin": True, "reason": "timeout", "frames": 0,
+                       "crc": 0})
+                return
+            except Exception as ex:
+                _emit({"e": "err", "gid": gid,
+                       "kind": type(ex).__name__, "msg": str(ex)})
+                return
+            rid_of[gid] = rid
+            handoff[gid] = {"gen": cmd.get("gen", 0),
+                            "hid": cmd.get("hid", 0)}
+        elif op == "kvpage":
+            # disaggregated stage 2, inbound frame: buffer + verify CRC
+            gid = cmd["gid"]
+            buf = page_buf.setdefault(gid, {"frames": {}, "bad": None})
+            chunk = decode_frame(cmd)
+            if chunk is None:
+                buf["bad"] = f"frame {cmd.get('seq')} corrupt"
+                return
+            buf["frames"][int(cmd["seq"])] = chunk
+            # bound the staging dict: frames whose submit_pages never
+            # arrives (router died mid-send) must not grow forever
+            while len(page_buf) > 32:
+                page_buf.pop(next(iter(page_buf)))
+        elif op == "submit_pages":
+            gid = cmd["gid"]
+            buf = page_buf.pop(gid, None) or {"frames": {}, "bad": None}
+            why = buf["bad"]
+            pages = None
+            if why is None:
+                blob, why = join_frames(buf["frames"],
+                                        cmd.get("frames", 0),
+                                        cmd.get("crc"))
+            if why is None:
+                try:
+                    pages = unpack_kv_pages(blob)
+                except ValueError as ex:
+                    why = str(ex)
+            if why is not None:
+                # typed rejection: the router re-drives the prefill under
+                # its transfer retry budget — NEVER decode on garbage
+                _emit({"e": "err", "gid": gid, "kind": "KVTransferError",
+                       "msg": f"rejecting handed-off pages: {why}"})
+                return
+            try:
+                rid = eng.add_request_with_pages(
+                    np.asarray(cmd["prompt"], np.int32), pages,
+                    SamplingParams(max_new_tokens=int(cmd["max_new"]),
+                                   eos_token_id=cmd.get("eos")),
+                    deadline=cmd.get("deadline"))
+            except RequestTimeoutError:
+                # expired between prefill completion and decode
+                # admission: imported pages dropped, typed end
+                _emit({"e": "tok", "gid": gid, "gen": cmd.get("gen", 0),
+                       "toks": [], "fin": True, "reason": "timeout"})
+                return
+            except Exception as ex:
+                _emit({"e": "err", "gid": gid,
+                       "kind": type(ex).__name__, "msg": str(ex)})
+                return
+            rid_of[gid] = rid
+            meta[gid] = {"gen": cmd.get("gen", 0)}
         elif op == "cancel":
             gid = cmd["gid"]
+            page_buf.pop(gid, None)
+            handoff.pop(gid, None)
             rid = rid_of.get(gid)
             if rid is not None:
                 eng.cancel(rid, reason=cmd.get("reason", "cancelled"))
@@ -172,11 +342,23 @@ def replica_worker_main():
             _emit({"e": "reloaded", "replica": replica_id, "step": step})
         elif op == "stats":
             s = eng.stats()
-            _emit({"e": "stats", "replica": replica_id,
+            m = eng.metrics()
+            _emit({"e": "stats", "replica": replica_id, "role": role,
                    "blocks_free": s["blocks_free"],
                    "blocks_high_water": s["blocks_high_water"],
                    "waiting": s["waiting"], "running": s["running"],
-                   "steps": s["steps"], "tokens_out": s["tokens_out"]})
+                   "steps": s["steps"], "tokens_out": s["tokens_out"],
+                   # engine-owned latency percentiles (ISSUE 15): the
+                   # disagg bench reads DECODE-worker ITL from here, so
+                   # the comparison is engine-measured, not bench-timed
+                   "itl_p50_ms": m["itl_ms"]["p50"],
+                   "itl_p99_ms": m["itl_ms"]["p99"],
+                   "ttft_p99_ms": m["ttft_ms"]["p99"]})
+        elif op == "reset_metrics":
+            # window discipline (bench): warm-phase latency observations
+            # must not pollute the timed window's percentiles
+            eng.reset_metrics()
+            eng.reset_block_high_water()
         elif op == "shutdown":
             shutting = True
 
@@ -220,6 +402,11 @@ def replica_worker_main():
             for out in eng.step():
                 gid = gid_by_rid.get(out.rid)
                 if gid is None:
+                    continue
+                if gid in handoff:
+                    # prefill handoff: the first token triggers the page
+                    # transfer instead of a tok event
+                    _stream_pages(gid, out)
                     continue
                 rec = per_gid.setdefault(
                     gid, {"toks": [], "fin": False, "reason": None})

@@ -32,6 +32,25 @@
   the worker's ``reload_weights``) or ``"retire"`` — giving zero-drop
   rolling weight updates across the fleet.
 
+ISSUE 15 adds **disaggregated prefill/decode** (``roles=`` on the
+fleet): requests on a split fleet place in two stages — a prefill
+worker computes the prompt's KV pages and streams them back as
+CRC-framed ``kvpage`` events plus a ``kvdone`` carrying the first
+sampled token, then the router ships the verified pages to a decode
+worker (session affinity pins there — that is where the prefix cache
+lives) which imports them and decodes with zero prefill work. The
+robustness contract: every handoff is fenced by a handoff id (a zombie
+prefill worker cannot double-deliver), corrupt frames void the WHOLE
+transfer and re-drive the prefill under a bounded retry budget (typed
+:class:`~..errors.KVTransferError` past it — never decoded-on-garbage),
+a prefill worker dying mid-transfer discards its partial pages
+atomically and fails over to a healthy prefill peer
+(``fleet_handoff_failovers_total``), decode-worker death rides the
+PR-12 replay (deadline carried unchanged), a stalled transfer channel
+pauses new prefills so the bounded admission queue sheds typed, and a
+fleet with NO healthy prefill worker degrades to colocated prefill on
+the decode side with a one-shot warning.
+
 The router is single-threaded by design: all state mutates inside
 :meth:`step` (the pump), mirroring ``LLMEngine.step``. ``submit`` +
 ``join``/``step`` + ``result`` is the whole client API.
@@ -41,6 +60,7 @@ from __future__ import annotations
 
 import itertools
 import time
+import warnings
 from collections import deque
 
 import numpy as np
@@ -48,7 +68,8 @@ import numpy as np
 from ....observability import metrics as _obs_metrics
 from ....utils import fault_injection as _fi
 from ..errors import (EngineClosedError, FleetOverloadedError,
-                      RequestTimeoutError)
+                      KVTransferError, RequestTimeoutError)
+from .framing import decode_frame, join_frames
 from .supervisor import ReplicaSupervisor
 
 __all__ = ["Router", "FleetRequest"]
@@ -71,19 +92,66 @@ _G_QUEUE = _obs_metrics.gauge(
 _G_DRAINING = _obs_metrics.gauge(
     "fleet_replicas_draining",
     "replicas currently draining (no new placements)")
+# disaggregated prefill/decode handoff (ISSUE 15)
+_M_KV_PAGES = _obs_metrics.counter(
+    "fleet_kv_pages_transferred_total",
+    "CRC-valid KV-page frames received from prefill workers (corrupt "
+    "frames are not counted — they void the whole handoff)")
+_M_KV_RETRIES = _obs_metrics.counter(
+    "fleet_kv_transfer_retries_total",
+    "KV handoffs re-driven after a transient transfer failure (corrupt "
+    "frame, failed delivery) — bounded by max_kv_retries, past which the "
+    "request fails with a typed KVTransferError")
+_M_HANDOFFS = _obs_metrics.counter(
+    "fleet_prefill_handoffs_total",
+    "completed prefill->decode KV-page handoffs (CRC-verified pages plus "
+    "the first sampled token accepted by the router)")
+_M_FAILOVERS = _obs_metrics.counter(
+    "fleet_handoff_failovers_total",
+    "handoffs abandoned because a worker died mid-transfer, with the "
+    "prefill re-dispatched elsewhere (partial pages discarded "
+    "atomically)")
 
-QUEUED, PLACED, DONE, FAILED = "queued", "placed", "done", "failed"
+QUEUED, PREFILLING, PLACED, DONE, FAILED = (
+    "queued", "prefilling", "placed", "done", "failed")
+
+
+class _IdleBackoff:
+    """Exponential idle backoff for the router's wait loops (ISSUE 15
+    satellite): replaces the hardcoded 5 ms busy-polls that burned a
+    core on every large idle fleet. ``idle()`` sleeps the current delay
+    and doubles it toward ``ceiling``; any progress ``reset()``\\ s to
+    ``floor``, so a busy fleet stays responsive while an idle one backs
+    off to sleeping ~ceiling seconds per probe."""
+
+    __slots__ = ("floor", "ceiling", "_delay")
+
+    def __init__(self, floor=0.0005, ceiling=0.05):
+        self.floor = float(floor)
+        self.ceiling = max(float(ceiling), float(floor))
+        self._delay = self.floor
+
+    def reset(self):
+        self._delay = self.floor
+
+    def idle(self):
+        time.sleep(self._delay)
+        self._delay = min(self.ceiling, self._delay * 2)
 
 
 class FleetRequest:
     """Router-side record of one request: the original prompt/sampling
     (the redispatch replay source), emitted tokens so far, the absolute
-    deadline, and the current assignment (replica + generation)."""
+    deadline, and the current assignment (replica + generation). On a
+    role-split fleet it also carries the handoff state: ``hid`` (the
+    handoff generation — stale frames from a zombie prefill worker are
+    dropped by id), the frame buffer of an in-flight transfer, and the
+    CRC-verified ``pages`` awaiting decode placement."""
 
     __slots__ = ("gid", "prompt", "max_new", "eos", "deadline", "session",
                  "state", "replica", "generation", "emitted", "error",
                  "finish_reason", "t_submit", "t_first", "t_done",
-                 "redispatches")
+                 "redispatches", "hid", "kv_retries", "frames", "pages")
 
     def __init__(self, gid, prompt, max_new, eos, deadline, session):
         self.gid = gid
@@ -102,6 +170,14 @@ class FleetRequest:
         self.t_first = None
         self.t_done = None
         self.redispatches = 0
+        self.hid = 0
+        self.kv_retries = 0
+        # in-flight transfer buffer: seq -> (raw chunk, encoded data,
+        # declared crc) — the raw bytes feed the whole-payload CRC at
+        # kvdone; the already-encoded+verified form forwards verbatim
+        # to the decode worker (no re-encode, no re-CRC)
+        self.frames: dict[int, tuple] = {}
+        self.pages = None  # {"frames": [(data_b64, crc)], "crc", "count"}
 
     @property
     def finished(self):
@@ -125,7 +201,9 @@ class Router:
                  engine_kwargs=None, ckpt_root=None, max_queue=64,
                  max_inflight_per_replica=None, session_affinity=True,
                  hang_timeout_s=0.0, max_restarts=3, log_dir=None,
-                 env_extra=None, wait_ready=True):
+                 env_extra=None, wait_ready=True, roles=None,
+                 max_kv_retries=3, max_pending_handoffs=8,
+                 idle_backoff=(0.0005, 0.05)):
         self._name = f"fleet#{next(Router._ids)}"
         engine_kwargs = dict(engine_kwargs or {})
         if supervisor is None:
@@ -137,7 +215,8 @@ class Router:
                 {"artifact": artifact, "engine": engine_kwargs,
                  "ckpt_root": ckpt_root},
                 hang_timeout_s=hang_timeout_s, max_restarts=max_restarts,
-                log_dir=log_dir, env_extra=env_extra, instance=self._name)
+                log_dir=log_dir, env_extra=env_extra, instance=self._name,
+                roles=roles)
             if wait_ready:
                 try:
                     supervisor.wait_ready()
@@ -151,6 +230,17 @@ class Router:
             max_inflight_per_replica
             or 2 * int(engine_kwargs.get("max_batch_size", 4) or 4))
         self.session_affinity = bool(session_affinity)
+        # disaggregated handoff knobs (ISSUE 15): the transfer retry
+        # budget (the utils.retry idiom — re-drive on transient failure,
+        # typed KVTransferError past the budget) and the backpressure
+        # bound on concurrently buffered handoffs (a stalled transfer
+        # channel pauses NEW prefill placements; the bounded admission
+        # queue then sheds with a typed error — never silent growth)
+        self.max_kv_retries = int(max_kv_retries)
+        self.max_pending_handoffs = int(max_pending_handoffs)
+        # idle-backoff floor/ceiling for join/drain/stats wait loops
+        self.idle_backoff = (float(idle_backoff[0]), float(idle_backoff[1]))
+        self._degraded_warned = False
         self._reqs: dict[int, FleetRequest] = {}
         self._queue: deque[FleetRequest] = deque()
         self._inflight: dict[int, set] = {
@@ -162,7 +252,8 @@ class Router:
         self.reloads: list[tuple] = []  # (replica_id, checkpoint step)
         self._gids = itertools.count(1)
         self._closed = False
-        for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS):
+        for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _M_KV_PAGES,
+                  _M_KV_RETRIES, _M_HANDOFFS, _M_FAILOVERS):
             m.inc(0, instance=self._name)
         _G_QUEUE.set(0, instance=self._name)
         _G_DRAINING.set(0, instance=self._name)
@@ -232,18 +323,26 @@ class Router:
         drill picks its SIGKILL victim by load)."""
         return sorted(self._inflight.get(replica_id, ()))
 
-    def join(self, timeout=None, poll_s=0.005):
-        """Pump :meth:`step` until every submitted request finished."""
+    def join(self, timeout=None, poll_s=None):
+        """Pump :meth:`step` until every submitted request finished.
+        Idle ticks back off exponentially (``idle_backoff``
+        floor→ceiling) instead of busy-polling — an idle fleet sleeps,
+        it does not burn a core. ``poll_s`` (legacy) pins a fixed poll
+        interval instead."""
         deadline = (time.time() + float(timeout)
                     if timeout is not None else None)
+        backoff = (_IdleBackoff(poll_s, poll_s) if poll_s is not None
+                   else _IdleBackoff(*self.idle_backoff))
         while self.pending():
             progressed = self.step()
             if deadline is not None and time.time() > deadline:
                 raise TimeoutError(
                     f"fleet join timed out with {len(self.pending())} "
                     "requests unfinished")
-            if not progressed:
-                time.sleep(poll_s)
+            if progressed:
+                backoff.reset()
+            else:
+                backoff.idle()
 
     # ------------------------------------------------------------------
     # the pump
@@ -294,6 +393,14 @@ class Router:
             if (req.state != PLACED or req.replica != replica_id
                     or ev.get("gen") != req.generation):
                 return
+            # first tokens from the decode worker ack the handed-off
+            # pages arrived intact — the router's buffered copy can go
+            # and the transfer retry budget re-arms (NOT at kvdone: a
+            # decode side that keeps rejecting deliveries must still be
+            # able to exhaust the budget into a typed KVTransferError)
+            if ev.get("toks"):
+                req.pages = None
+                req.kv_retries = 0
             for tok in ev.get("toks", ()):
                 if req.t_first is None:
                     req.t_first = time.perf_counter()
@@ -310,12 +417,24 @@ class Router:
                     req.state = DONE
                     req.finish_reason = reason
                     req.t_done = time.perf_counter()
+        elif kind == "kvpage":
+            self._handle_kvpage(replica_id, ev)
+        elif kind == "kvdone":
+            self._handle_kvdone(replica_id, ev)
         elif kind == "load":
             self._load[replica_id] = ev
         elif kind == "err":
             req = self._reqs.get(ev.get("gid"))
             if req is not None and not req.finished:
                 self._inflight[replica_id].discard(req.gid)
+                if ev.get("kind") == "KVTransferError":
+                    # the decode worker rejected the handed-off pages
+                    # (corrupt/incomplete buffer): transient — re-drive
+                    # the prefill under the transfer retry budget
+                    self._kv_transfer_failed(
+                        req, f"decode replica {replica_id} rejected the "
+                             f"pages: {ev.get('msg')}")
+                    return
                 self._fail(req, RuntimeError(
                     f"replica {replica_id} rejected request {req.gid}: "
                     f"{ev.get('kind')}: {ev.get('msg')}"), "error")
@@ -333,23 +452,157 @@ class Router:
         req.error = error
         req.finish_reason = reason
         req.t_done = time.perf_counter()
+        req.frames = {}
+        req.pages = None
         if isinstance(error, RequestTimeoutError):
             _M_TIMEOUTS.inc(instance=self._name)
+
+    # -- disaggregated KV-page handoff (ISSUE 15) ------------------------
+    def _handoff_current(self, replica_id, ev):
+        """The in-flight handoff this frame/done event belongs to, or
+        None when it is stale: wrong state, wrong replica, or a
+        superseded handoff id — a zombie prefill worker re-delivering
+        pages for an already re-driven transfer is dropped by id, so it
+        can never double-deliver into the replayed stream."""
+        req = self._reqs.get(ev.get("gid"))
+        if (req is None or req.finished or req.state != PREFILLING
+                or req.replica != replica_id
+                or ev.get("hid") != req.hid):
+            return None
+        return req
+
+    def _handle_kvpage(self, replica_id, ev):
+        req = self._handoff_current(replica_id, ev)
+        if req is None:
+            return
+        chunk = decode_frame(ev)
+        if chunk is None:
+            # corrupt frame: the WHOLE handoff is void — the prefill is
+            # re-driven rather than ever decoded-on-garbage
+            self._kv_transfer_failed(
+                req, f"corrupt page frame {ev.get('seq')} from replica "
+                     f"{replica_id}")
+            return
+        # keep the raw bytes (whole-payload CRC at kvdone) beside the
+        # already-encoded data+crc (verified: crc == crc32(chunk)),
+        # which forward verbatim to the decode worker
+        req.frames[int(ev.get("seq", 0))] = (chunk, ev.get("data"),
+                                             ev.get("crc"))
+        _M_KV_PAGES.inc(instance=self._name)
+
+    def _handle_kvdone(self, replica_id, ev):
+        req = self._handoff_current(replica_id, ev)
+        if req is None:
+            return
+        self._inflight[replica_id].discard(req.gid)
+        if ev.get("fin") and ev.get("first_tok") is None:
+            # prefill-side typed end before a first token existed
+            # (deadline expired inside the prefill worker)
+            req.frames = {}
+            reason = ev.get("reason") or "error"
+            if reason == "timeout":
+                self._fail(req, RequestTimeoutError(
+                    f"request {req.gid} hit its deadline during prefill "
+                    f"on replica {replica_id}", rid=req.gid,
+                    deadline=req.deadline), reason)
+            else:
+                self._fail(req, RuntimeError(
+                    f"request {req.gid} ended during prefill on replica "
+                    f"{replica_id}: {reason}"), reason)
+            return
+        total = int(ev.get("frames", 0))
+        frames, req.frames = req.frames, {}
+        blob, why = join_frames({i: c for i, (c, _, _) in frames.items()},
+                                total, ev.get("crc"))
+        if why is not None:
+            self._kv_transfer_failed(
+                req, f"{why} (from replica {replica_id})")
+            return
+        tok = int(ev["first_tok"])
+        if req.t_first is None:
+            req.t_first = time.perf_counter()
+        req.emitted.append(tok)
+        _M_HANDOFFS.inc(instance=self._name)
+        if ev.get("fin") or req.remaining <= 0:
+            # the first token already finished the request: no decode
+            # stage, no pages to ship
+            req.state = DONE
+            req.finish_reason = ev.get("reason") or "length"
+            req.t_done = time.perf_counter()
+            return
+        # stage 2 pending: verified pages queue (front — oldest work)
+        # for decode placement. Only the already-encoded frames are
+        # kept — they forward verbatim, no re-encode.
+        req.pages = {"frames": [(frames[i][1], frames[i][2])
+                                for i in range(total)],
+                     "crc": int(ev.get("crc", 0)), "count": total}
+        req.state = QUEUED
+        req.replica = None
+        self._queue.appendleft(req)
+
+    def _kv_transfer_failed(self, req, why, failover=False):
+        """Void a handoff atomically — partial frames and buffered pages
+        dropped, handoff id bumped so a zombie's stale deliveries miss —
+        and re-drive the prefill elsewhere. Transient failures (corrupt
+        frames, rejected deliveries) charge the transfer retry budget
+        and fail with a typed :class:`KVTransferError` past it; worker
+        deaths (``failover=True``) are counted as handoff failovers and
+        governed by the supervisor's restart budget instead. The next
+        prefill dispatch assigns a fresh handoff id; until then the
+        QUEUED state alone fences stale deliveries."""
+        req.frames = {}
+        req.pages = None
+        if req.state == PREFILLING and req.replica is not None:
+            self._inflight.get(req.replica, set()).discard(req.gid)
+        if failover:
+            _M_FAILOVERS.inc(instance=self._name)
+            _M_REDISPATCH.inc(instance=self._name)
+            req.redispatches += 1
+        else:
+            req.kv_retries += 1
+            if req.kv_retries > self.max_kv_retries:
+                self._fail(req, KVTransferError(
+                    f"request {req.gid}: KV-page handoff failed "
+                    f"({why}); transfer retry budget "
+                    f"({self.max_kv_retries}) exhausted",
+                    gid=req.gid, retries=req.kv_retries), "kv_transfer")
+                return
+            _M_KV_RETRIES.inc(instance=self._name)
+        req.state = QUEUED
+        req.replica = None
+        self._queue.appendleft(req)
 
     # -- death recovery --------------------------------------------------
     def _recover_replica(self, replica_id):
         """Requeue (at the FRONT, preserving age order) every in-flight
         request of a dead replica for replay elsewhere. The replay
         prompt is prompt + emitted-so-far; greedy determinism makes the
-        resumed stream bit-identical to an undisturbed one."""
+        resumed stream bit-identical to an undisturbed one. A handoff
+        the dead replica was mid-transfer on is discarded atomically and
+        the prefill re-driven (counted as a handoff failover)."""
         gids = sorted(self._inflight.get(replica_id, ()))
         self._inflight[replica_id] = set()
         self._load.pop(replica_id, None)
         # a dying replica cancels any drain it was serving
         self._draining.pop(replica_id, None)
+        # session pins at the dead replica are stale either way: the
+        # respawn rejoins with a COLD prefix cache, so steering the next
+        # session request at the slot buys nothing and used to aim at a
+        # corpse during the restart window (ISSUE 15 satellite)
+        if self._sessions:
+            self._sessions = {k: v for k, v in self._sessions.items()
+                              if v != replica_id}
         for gid in reversed(gids):
             req = self._reqs.get(gid)
             if req is None or req.finished:
+                continue
+            if req.state == PREFILLING:
+                # prefill worker died mid-transfer: partial pages are
+                # dropped atomically, the prefill re-drives elsewhere —
+                # decode streams of other requests never hiccup
+                self._kv_transfer_failed(
+                    req, f"prefill replica {replica_id} died "
+                         "mid-transfer", failover=True)
                 continue
             if req.remaining <= 0:
                 # everything was emitted; only the fin event was lost
@@ -360,6 +613,10 @@ class Router:
             req.state = QUEUED
             req.replica = None
             req.redispatches += 1
+            # emitted moved past the handed-off pages: the replay
+            # re-drives prefill from prompt+emitted, not stale pages
+            req.frames = {}
+            req.pages = None
             self._queue.appendleft(req)
             _M_REDISPATCH.inc(instance=self._name)
 
@@ -374,9 +631,11 @@ class Router:
                     self._queue.remove(req)
                 except ValueError:
                     pass
-            elif req.state == PLACED:
+            elif req.state in (PLACED, PREFILLING):
                 # free the replica's blocks; its own engine-side deadline
-                # check races with this cancel — both are idempotent
+                # check races with this cancel — both are idempotent.
+                # A mid-transfer handoff's partial pages die with the
+                # request (_fail drops frames + pages).
                 h = self._handle(req.replica)
                 if h is not None:
                     h.send({"op": "cancel", "gid": req.gid,
@@ -400,17 +659,39 @@ class Router:
                 and len(self._inflight[h.id])
                 < self.max_inflight_per_replica)
 
-    def _pick_replica(self, req):
-        if self.session_affinity and req.session is not None:
-            rid = self._sessions.get(req.session)
-            if rid is not None:
-                h = self._handle(rid)
-                if h is not None and self._placeable(h):
-                    return h
+    # -- roles (ISSUE 15): prefill workers take stage-1 work only --------
+    def _role(self, h):
+        return getattr(h, "role", None) or "both"
+
+    @property
+    def split(self):
+        """True when the fleet has dedicated prefill workers
+        (role-disaggregated serving)."""
+        return any(self._role(h) == "prefill"
+                   for h in self.supervisor.handles)
+
+    def _pending_handoffs(self):
+        """Requests whose pages are buffered at the router (transfer in
+        flight or awaiting decode placement) — the backpressure bound.
+        Scans only the in-flight sets and the queue (both bounded), not
+        the full request table: finished-but-unreleased requests on a
+        long-lived server must not slow placement down."""
+        n = 0
+        for gids in self._inflight.values():
+            for gid in gids:
+                r = self._reqs.get(gid)
+                if (r is not None and not r.finished
+                        and (r.state == PREFILLING
+                             or r.pages is not None)):
+                    n += 1
+        for r in self._queue:
+            if r.pages is not None:
+                n += 1
+        return n
+
+    def _least_loaded(self, candidates):
         best, best_score = None, None
-        for h in self.supervisor.handles:
-            if not self._placeable(h):
-                continue
+        for h in candidates:
             load = self._load.get(h.id, {})
             score = (len(self._inflight[h.id]),
                      float(load.get("kv", 0.0))
@@ -419,56 +700,212 @@ class Router:
                 best, best_score = h, score
         return best
 
+    def _pick_replica(self, req):
+        """Decode-capable placement (any non-prefill role): session
+        affinity first — the pin lives on the replica whose prefix
+        cache is warm, i.e. the DECODE replica on a split fleet — then
+        least-loaded."""
+        if self.session_affinity and req.session is not None:
+            rid = self._sessions.get(req.session)
+            if rid is not None:
+                h = self._handle(rid)
+                if (h is not None and self._role(h) != "prefill"
+                        and self._placeable(h)):
+                    return h
+        return self._least_loaded(
+            h for h in self.supervisor.handles
+            if self._role(h) != "prefill" and self._placeable(h))
+
+    def _pick_prefill_replica(self):
+        return self._least_loaded(
+            h for h in self.supervisor.handles
+            if self._role(h) == "prefill" and self._placeable(h))
+
+    def _any_prefill_healthy(self):
+        return any(h.alive and not h.retired
+                   for h in self.supervisor.handles
+                   if self._role(h) == "prefill")
+
+    # -- dispatch helpers -----------------------------------------------
+    def _replay_prompt(self, req):
+        """Original prompt + everything already emitted — the greedy
+        continuation from here is bit-identical."""
+        return np.concatenate(
+            [req.prompt, np.asarray(req.emitted, np.int32)]).tolist()
+
+    def _send_checked(self, h, payload):
+        try:
+            _fi.fire("serve.dispatch")
+        except Exception:
+            return False
+        return h.send(payload)
+
+    def _dispatch_failed(self, req):
+        """Requeue after a failed dispatch (dead pipe or injected
+        fault): the bumped generation invalidates the half-delivered
+        copy even if it arrived."""
+        req.state = QUEUED
+        req.replica = None
+        req.redispatches += 1
+        self._queue.appendleft(req)
+        _M_REDISPATCH.inc(instance=self._name)
+
+    def _note_session(self, req, h):
+        if self.session_affinity and req.session is not None:
+            # LRU-bounded: one entry per session key forever would
+            # grow without bound on a long-lived server (the replica
+            # worker bounds its gid bookkeeping the same way)
+            self._sessions.pop(req.session, None)
+            self._sessions[req.session] = h.id
+            while len(self._sessions) > self.MAX_SESSIONS:
+                self._sessions.pop(next(iter(self._sessions)))
+
+    def _dispatch_submit(self, req, h):
+        """Colocated dispatch: the replica prefills AND decodes."""
+        self._queue.remove(req)
+        req.generation += 1
+        req.replica = h.id
+        req.state = PLACED
+        payload = {
+            "op": "submit", "gid": req.gid, "gen": req.generation,
+            "prompt": self._replay_prompt(req),
+            "max_new": req.remaining, "eos": req.eos,
+            "deadline": req.deadline,
+        }
+        if not self._send_checked(h, payload):
+            self._dispatch_failed(req)
+            return False
+        self._inflight[h.id].add(req.gid)
+        self._note_session(req, h)
+        return True
+
+    def _dispatch_prefill(self, req, h):
+        """Stage 1: the prefill worker computes the pages and streams
+        them back as CRC-framed kvpage events. A fresh handoff id fences
+        the transfer — frames from any earlier assignment are void."""
+        self._queue.remove(req)
+        req.generation += 1
+        req.hid += 1
+        req.replica = h.id
+        req.state = PREFILLING
+        req.frames = {}
+        payload = {
+            "op": "prefill", "gid": req.gid, "gen": req.generation,
+            "hid": req.hid, "prompt": self._replay_prompt(req),
+            "max_new": req.remaining, "eos": req.eos,
+            "deadline": req.deadline,
+        }
+        if not self._send_checked(h, payload):
+            self._dispatch_failed(req)
+            return False
+        self._inflight[h.id].add(req.gid)
+        return True
+
+    def _dispatch_pages(self, req, h):
+        """Stage 2: ship the CRC-verified pages down to the decode
+        worker, then the submit that imports them. The decode prompt is
+        prompt + emitted (exactly the prefill's first token at this
+        point), the budget the remainder, and the deadline THE deadline
+        — carried unchanged across the handoff."""
+        self._queue.remove(req)
+        req.generation += 1
+        frames = req.pages["frames"]
+        ok = True
+        for seq, (data, crc) in enumerate(frames):
+            # forwarded VERBATIM: the encoded form and CRC are the ones
+            # the prefill worker produced and the router verified
+            ok = h.send({"op": "kvpage", "gid": req.gid, "seq": seq,
+                         "total": len(frames), "crc": crc, "data": data})
+            if not ok:
+                break
+        if ok:
+            ok = self._send_checked(h, {
+                "op": "submit_pages", "gid": req.gid,
+                "gen": req.generation,
+                "prompt": self._replay_prompt(req),
+                "max_new": req.remaining, "eos": req.eos,
+                "deadline": req.deadline, "frames": len(frames),
+                "crc": req.pages["crc"],
+            })
+        if not ok:
+            # dead pipe: the verified pages stay buffered — the retry
+            # ships the SAME pages to another decode replica next tick
+            # (emitted has not advanced, so they are still exact)
+            self._dispatch_failed(req)
+            return False
+        req.replica = h.id
+        req.state = PLACED
+        self._inflight[h.id].add(req.gid)
+        self._note_session(req, h)
+        return True
+
+    def _place_stage2_behind_head(self):
+        """Place pages-verified requests sitting BEHIND a
+        backpressure-blocked stage-1 head. Stage-2 dispatch only ever
+        DRAINS the transfer buffer, so letting it overtake cannot starve
+        the head — it is what unblocks it. Without this, a stage-1
+        replay requeued in front of a pages-ready request deadlocks the
+        whole queue: the head waits on the pending-handoff count that
+        only the request behind it can reduce."""
+        placed = 0
+        for req in [r for r in self._queue if r.pages is not None]:
+            h = self._pick_replica(req)
+            if h is None or not self._dispatch_pages(req, h):
+                break
+            placed += 1
+        return placed
+
     def _place(self):
         placed = 0
+        split = self.split
         while self._queue:
             req = self._queue[0]
+            if split and req.pages is not None:
+                # stage 2: pages verified, awaiting a decode worker
+                h = self._pick_replica(req)
+                if h is None or not self._dispatch_pages(req, h):
+                    break
+                placed += 1
+                continue
+            if split:
+                # stage 1: prefill placement. Backpressure: when the
+                # transfer channel stalls (handoffs pile up buffered),
+                # PAUSE new prefills — requests stay queued, and the
+                # bounded admission queue sheds with a typed error
+                # instead of growing silently.
+                if self._pending_handoffs() >= self.max_pending_handoffs:
+                    placed += self._place_stage2_behind_head()
+                    break
+                h = self._pick_prefill_replica()
+                if h is None and not self._any_prefill_healthy():
+                    # no healthy prefill worker at all: degrade
+                    # gracefully to colocated prefill on the decode
+                    # side, once-warned — serving beats stalling
+                    h = self._pick_replica(req)
+                    if h is None:
+                        break
+                    if not self._degraded_warned:
+                        self._degraded_warned = True
+                        warnings.warn(
+                            f"{self._name}: no healthy prefill worker; "
+                            "degrading to colocated prefill on decode "
+                            "replicas until one rejoins",
+                            RuntimeWarning)
+                    if not self._dispatch_submit(req, h):
+                        break
+                    placed += 1
+                    continue
+                if h is None or not self._dispatch_prefill(req, h):
+                    break
+                placed += 1
+                continue
+            # colocated fleet: the PR-12 path
             h = self._pick_replica(req)
-            if h is None:
+            if h is None or not self._dispatch_submit(req, h):
+                # one retry per tick on a failed dispatch; if the pipe
+                # is really dead the supervisor's next check() reports
+                # the death and the replica leaves the placeable set
                 break
-            self._queue.popleft()
-            req.generation += 1
-            req.replica = h.id
-            req.state = PLACED
-            payload = {
-                "op": "submit", "gid": req.gid, "gen": req.generation,
-                # replay source: original prompt + everything already
-                # emitted — the greedy continuation is bit-identical
-                "prompt": np.concatenate(
-                    [req.prompt,
-                     np.asarray(req.emitted, np.int32)]).tolist(),
-                "max_new": req.remaining, "eos": req.eos,
-                "deadline": req.deadline,
-            }
-            ok = True
-            try:
-                _fi.fire("serve.dispatch")
-            except Exception:
-                ok = False
-            if ok:
-                ok = h.send(payload)
-            if not ok:
-                # dispatch failed (dead pipe or injected fault): replay
-                # elsewhere; the bumped generation invalidates this copy
-                # even if it half-arrived
-                req.state = QUEUED
-                req.replica = None
-                req.redispatches += 1
-                self._queue.appendleft(req)
-                _M_REDISPATCH.inc(instance=self._name)
-                # one retry per tick; if the pipe is really dead the
-                # supervisor's next check() reports the death and the
-                # replica leaves the placeable set
-                break
-            self._inflight[h.id].add(req.gid)
-            if self.session_affinity and req.session is not None:
-                # LRU-bounded: one entry per session key forever would
-                # grow without bound on a long-lived server (the replica
-                # worker bounds its gid bookkeeping the same way)
-                self._sessions.pop(req.session, None)
-                self._sessions[req.session] = h.id
-                while len(self._sessions) > self.MAX_SESSIONS:
-                    self._sessions.pop(next(iter(self._sessions)))
             placed += 1
         return placed
 
@@ -501,9 +938,12 @@ class Router:
         _G_DRAINING.set(len(self._draining), instance=self._name)
         if wait:
             deadline = time.time() + float(timeout)
+            backoff = _IdleBackoff(*self.idle_backoff)
             while replica_id in self._draining:
-                if not self.step():
-                    time.sleep(0.005)
+                if self.step():
+                    backoff.reset()
+                else:
+                    backoff.idle()
                 if time.time() > deadline:
                     raise TimeoutError(
                         f"drain of replica {replica_id} timed out")
@@ -553,6 +993,12 @@ class Router:
             "queue_depth": _G_QUEUE.value(instance=inst),
             "replicas_draining": _G_DRAINING.value(instance=inst),
             "drains_completed": self.drains_completed,
+            # disaggregated handoff (ISSUE 15)
+            "kv_pages_transferred": int(_M_KV_PAGES.value(instance=inst)),
+            "kv_transfer_retries": int(
+                _M_KV_RETRIES.value(instance=inst)),
+            "prefill_handoffs": int(_M_HANDOFFS.value(instance=inst)),
+            "handoff_failovers": int(_M_FAILOVERS.value(instance=inst)),
         }
 
     def ttft_seconds(self):
@@ -560,6 +1006,14 @@ class Router:
         that produced at least one token) — the drill's p99 source."""
         return [r.t_first - r.t_submit for r in self._reqs.values()
                 if r.t_first is not None]
+
+    def reset_replica_metrics(self):
+        """Ask every live replica to reset its engine-owned metric
+        series (the bench window discipline: warm-phase latency
+        observations must not pollute a timed window's percentiles)."""
+        for h in self.supervisor.handles:
+            if h.alive and not h.retired:
+                h.send({"op": "reset_metrics"})
 
     def replica_stats(self, replica_id, timeout=10.0):
         """Synchronous ``stats`` RPC to one replica (allocator cleanliness
@@ -570,16 +1024,21 @@ class Router:
         if h is None or not h.send({"op": "stats"}):
             return None
         deadline = time.time() + timeout
+        backoff = _IdleBackoff(*self.idle_backoff)
         while time.time() < deadline:
             stats = None
-            for ev in h.events():
+            evs = h.events()
+            for ev in evs:
                 if ev.get("e") == "stats" and stats is None:
                     stats = ev
                 else:
                     self._handle_event(h, ev)
             if stats is not None:
                 return stats
-            time.sleep(0.005)
+            if evs:
+                backoff.reset()
+            else:
+                backoff.idle()
         return None
 
     def close(self):
@@ -588,7 +1047,8 @@ class Router:
         self._closed = True
         self.supervisor.shutdown()
         for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _G_QUEUE,
-                  _G_DRAINING):
+                  _G_DRAINING, _M_KV_PAGES, _M_KV_RETRIES, _M_HANDOFFS,
+                  _M_FAILOVERS):
             m.remove(instance=self._name)
 
     def __enter__(self):

@@ -196,9 +196,27 @@ class ReplicaSupervisor:
 
     def __init__(self, n_replicas, config, *, hang_timeout_s=0.0,
                  max_restarts=3, term_grace_s=5.0, boot_grace_s=120.0,
-                 log_dir=None, env_extra=None, instance="fleet"):
+                 log_dir=None, env_extra=None, instance="fleet",
+                 roles=None):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
+        # role-disaggregated serving (ISSUE 15): each slot is "prefill",
+        # "decode" or "both" (the colocated default). The role is part of
+        # the SLOT, not the incarnation — a restarted replica respawns
+        # with the same role, so a crash can never silently turn a
+        # prefill worker into a decode worker.
+        if roles is not None:
+            roles = [str(r) for r in roles]
+            if len(roles) != int(n_replicas):
+                raise ValueError(
+                    f"roles has {len(roles)} entries for {n_replicas} "
+                    "replicas")
+            bad = [r for r in roles if r not in ("prefill", "decode",
+                                                 "both")]
+            if bad:
+                raise ValueError(f"unknown replica roles {bad}; expected "
+                                 "'prefill', 'decode' or 'both'")
+        self._roles = roles
         self.instance = instance
         self.hang_timeout_s = float(hang_timeout_s or 0.0)
         self.term_grace_s = float(term_grace_s)
@@ -238,11 +256,20 @@ class ReplicaSupervisor:
         self._note_liveness()
 
     # -- lifecycle -------------------------------------------------------
+    def role(self, i):
+        """The slot's serving role ("both" when undeclared)."""
+        return self._roles[i] if self._roles else "both"
+
     def _spawn(self, i, incarnation):
         log_path = (os.path.join(self.log_dir, f"replica.{i}.log")
                     if self.log_dir else None)
-        return ReplicaHandle(i, self._config, env=self._env,
-                             log_path=log_path, incarnation=incarnation)
+        config = self._config
+        if self._roles is not None:
+            config = dict(config, role=self._roles[i])
+        h = ReplicaHandle(i, config, env=self._env,
+                          log_path=log_path, incarnation=incarnation)
+        h.role = self.role(i)
+        return h
 
     def wait_ready(self, timeout=180.0):
         """Block until every live replica reported ``ready`` (engine

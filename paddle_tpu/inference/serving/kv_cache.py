@@ -59,11 +59,23 @@ deterministic and bit-reproducible across every write path, which a
 block-scalar scale (write-order-dependent rescaling) cannot guarantee.
 Block identity, refcounts, prefix hashes and COW never touch payload
 dtype, so sharing/eviction/speculation compose unchanged.
+
+ISSUE 15 adds **page export/import** for disaggregated prefill/decode
+serving: ``export_request_pages`` gathers one request's pool blocks
+(codes AND scale rows for int8 pools) into host arrays, and
+``import_request_pages`` writes such a payload into another pool's
+blocks — the prefill→decode KV handoff. Because per-row quantization is
+a pure function of the row, an imported page is byte-identical to the
+page local prefill would have written, so the handoff preserves greedy
+determinism by construction. ``pack_kv_pages``/``unpack_kv_pages``
+serialize the payload for the transfer channel (the fleet frames the
+bytes with CRCs; corruption is the CHANNEL's problem, detected there).
 """
 
 from __future__ import annotations
 
 import hashlib
+import io
 from collections import OrderedDict
 
 import jax
@@ -71,7 +83,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "KV_QMAX",
-           "quantize_kv_rows", "kv_pool_bytes_per_block"]
+           "quantize_kv_rows", "kv_pool_bytes_per_block",
+           "pack_kv_pages", "unpack_kv_pages"]
 
 # symmetric int8: codes in [-127, 127], scale = absmax/127 per row.
 # -128 is deliberately unused so the scheme stays symmetric (dequant is
@@ -401,3 +414,135 @@ class PagedKVCache:
         if self.quantized:
             self.k_scale = [s.at[dst].set(s[src]) for s in self.k_scale]
             self.v_scale = [s.at[dst].set(s[src]) for s in self.v_scale]
+
+    # -- disaggregated prefill/decode page handoff (ISSUE 15) -----------
+    def export_request_pages(self, blocks, covered):
+        """Gather the pool content of ``blocks`` (one request's pages, in
+        table order) into host arrays: ``{"k": [L, n, block, Hkv, D],
+        "v": ..., covered, block_size, kv_dtype}``, plus
+        ``k_scale``/``v_scale`` ``[L, n, block, Hkv]`` rows for int8
+        pools (codes without their scales are not a page). ``covered``
+        records how many leading tokens the pages actually hold — the
+        tail block may be partial; its trailing rows are whatever the
+        pool holds and are masked by context lengths on the other side,
+        exactly as they are here."""
+        idx = np.asarray(blocks, np.int32)
+        out = {
+            "covered": int(covered),
+            "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+            "k": np.stack([np.asarray(kp[idx]) for kp in self.k]),
+            "v": np.stack([np.asarray(vp[idx]) for vp in self.v]),
+        }
+        if self.quantized:
+            out["k_scale"] = np.stack(
+                [np.asarray(s[idx]) for s in self.k_scale])
+            out["v_scale"] = np.stack(
+                [np.asarray(s[idx]) for s in self.v_scale])
+        return out
+
+    def validate_request_pages(self, pages):
+        """Typed geometry validation of an import payload WITHOUT
+        mutating anything: dtype/block-size match, payload shapes fit
+        this pool, and — on quantized pools — the scale rows exist and
+        fit too. The decode engine calls this at admission (before any
+        blocks are allocated); :meth:`import_request_pages` calls it
+        again before writing, so a bad payload can never leave the pool
+        half-imported. Returns the number of payload blocks."""
+        if pages.get("kv_dtype") != self.kv_dtype:
+            raise ValueError(
+                f"imported pages carry kv_dtype={pages.get('kv_dtype')!r} "
+                f"but this pool stores {self.kv_dtype!r}")
+        if int(pages.get("block_size", -1)) != self.block_size:
+            raise ValueError(
+                f"imported pages use block_size={pages.get('block_size')} "
+                f"but this pool uses {self.block_size}")
+        k, v = pages["k"], pages["v"]
+        want = (len(self.k),) + self.k[0].shape[1:]
+        if k.shape[:1] + k.shape[2:] != want or k.shape != v.shape:
+            raise ValueError(
+                f"imported page shape {k.shape} does not fit this pool "
+                f"(layers+block geometry {want})")
+        n = k.shape[1]
+        if self.quantized:
+            swant = want[:-1]
+            for nm in ("k_scale", "v_scale"):
+                s = pages.get(nm)
+                if s is None:
+                    raise ValueError(
+                        f"int8 pages are missing their {nm} rows — "
+                        "codes without scales are not a page")
+                if (s.shape[:1] + s.shape[2:] != swant
+                        or s.shape[1] != n):
+                    raise ValueError(
+                        f"imported {nm} shape {s.shape} does not fit "
+                        f"this pool (layers+block geometry {swant}, "
+                        f"{n} payload blocks)")
+        return n
+
+    def import_request_pages(self, blocks, pages):
+        """Write an :meth:`export_request_pages` payload into ``blocks``
+        of THIS pool (host-triggered, like :meth:`copy_block` — not
+        inside a compiled step). ``blocks`` may be longer than the
+        payload (admission also allocates room for the next token);
+        only the payload's blocks are written. Raises ``ValueError`` on
+        any pool-geometry mismatch BEFORE any pool array moves —
+        importing pages of the wrong shape/dtype would decode garbage
+        silently, and a mid-write failure would be worse."""
+        n = self.validate_request_pages(pages)
+        if n > len(blocks):
+            raise ValueError(
+                f"payload holds {n} blocks but only {len(blocks)} were "
+                "allocated for the import")
+        k, v = pages["k"], pages["v"]
+        idx = jnp.asarray(np.asarray(blocks[:n], np.int32))
+        self.k = [kp.at[idx].set(jnp.asarray(k[i], kp.dtype))
+                  for i, kp in enumerate(self.k)]
+        self.v = [vp.at[idx].set(jnp.asarray(v[i], vp.dtype))
+                  for i, vp in enumerate(self.v)]
+        if self.quantized:
+            ks, vs = pages["k_scale"], pages["v_scale"]
+            self.k_scale = [s.at[idx].set(jnp.asarray(ks[i], s.dtype))
+                            for i, s in enumerate(self.k_scale)]
+            self.v_scale = [s.at[idx].set(jnp.asarray(vs[i], s.dtype))
+                            for i, s in enumerate(self.v_scale)]
+
+
+def pack_kv_pages(pages):
+    """Serialize an ``export_request_pages`` payload to bytes (npz,
+    pickle-free) for the fleet's CRC-framed transfer channel."""
+    buf = io.BytesIO()
+    arrays = {k: v for k, v in pages.items()
+              if isinstance(v, np.ndarray)}
+    arrays["covered"] = np.int64(pages["covered"])
+    arrays["block_size"] = np.int64(pages["block_size"])
+    arrays["kv_dtype"] = np.frombuffer(
+        (pages["kv_dtype"] or "").encode(), np.uint8)
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def unpack_kv_pages(data):
+    """Inverse of :func:`pack_kv_pages`. Raises ``ValueError`` on a
+    payload that does not parse as the page format — the caller treats
+    that as a corrupt transfer (the CRC framing should have caught it
+    first)."""
+    try:
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            out = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ValueError(f"undecodable KV page payload: {e}") from e
+    for key in ("covered", "block_size", "kv_dtype", "k", "v"):
+        if key not in out:
+            raise ValueError(f"KV page payload missing field {key!r}")
+    out["covered"] = int(out["covered"])
+    out["block_size"] = int(out["block_size"])
+    dt = bytes(out["kv_dtype"]).decode() or None
+    out["kv_dtype"] = dt
+    if dt == "int8":
+        for key in ("k_scale", "v_scale"):
+            if key not in out:
+                raise ValueError(
+                    f"int8 KV page payload missing field {key!r} — "
+                    "codes without scales are not a page")
+    return out

@@ -131,6 +131,10 @@ class Request:
         self.prefilling = False
         # speculative decoding: tokens materialized in the DRAFT pool
         self.draft_cached = 0
+        # disaggregated handoff (ISSUE 15): pages computed by a prefill
+        # worker, imported at admission instead of prefilling. Cleared
+        # after the one-time import — an eviction re-prefills normally.
+        self.preloaded = None
         self.admit_seq = -1               # admission order (eviction policy)
         self.evictions = 0
         self._rng = (np.random.RandomState(self.sampling.seed)
@@ -250,7 +254,11 @@ class Scheduler:
         while (len(picked) < self.max_prefills_per_step and self.waiting
                and self._free_slot() is not None):
             req = self.waiting[0]
-            if self.prefix_cache is not None:
+            # preloaded (disaggregated-handoff) requests charge full
+            # blocks and skip prefix matching: their pages arrive by
+            # import, not by sharing — the engine registers the imported
+            # full blocks afterwards so LATER admissions can share them
+            if self.prefix_cache is not None and req.preloaded is None:
                 matched, mtok = self.prefix_cache.match(req.tokens)
             else:
                 matched, mtok = [], 0
@@ -269,9 +277,20 @@ class Scheduler:
             self.waiting.popleft()
             slot = self._free_slot()
             req.blocks = list(matched) + blocks
-            req.num_cached = mtok          # prefix tokens already in-pool
-            req.draft_cached = mtok        # mirrored draft pool (spec)
-            req.prefilling = True
+            if req.preloaded is not None:
+                # decode-ready immediately: pages cover every token but
+                # the last one (whose KV the first decode step writes);
+                # the engine imports the payload into req.blocks before
+                # this step's decode runs. The draft pool (speculative
+                # decoding) was NOT transferred — its catch-up loop
+                # re-derives the prompt positions deterministically.
+                req.num_cached = int(req.preloaded["covered"])
+                req.draft_cached = 0
+                req.prefilling = False
+            else:
+                req.num_cached = mtok      # prefix tokens already in-pool
+                req.draft_cached = mtok    # mirrored draft pool (spec)
+                req.prefilling = True
             req.prefill_upto = req.num_tokens
             req.state = RUNNING
             req.admit_seq = next(self._admit_seq)
@@ -441,6 +460,7 @@ class Scheduler:
             except ValueError:
                 pass
         req.prefilling = False
+        req.preloaded = None  # never-imported handoff pages die here
         req.abort_reason = reason
         req.state = FINISHED
 

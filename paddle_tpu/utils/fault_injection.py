@@ -58,6 +58,19 @@ Sites wired into the framework:
   is treated as corrupt and must be QUARANTINED (skipped under the
   per-epoch skip budget, counted in io_records_quarantined_total) —
   never retried, never silently dropped past the budget.
+- ``serve.prefill_crash`` — disaggregated prefill worker, fired between
+  KV-page frame emissions (boolean site): the worker SIGKILLs itself
+  MID-TRANSFER — the router must discard the partial pages atomically
+  and re-drive the prefill on a healthy prefill worker
+  (fleet_handoff_failovers_total), with decode streams of other
+  requests never hiccuping.
+- ``serve.kv_transfer_corrupt`` — disaggregated prefill worker, fired
+  per KV-page frame (boolean site): the frame's payload bytes are
+  corrupted AFTER its CRC was computed, so the router's CRC check must
+  catch the mismatch and re-drive the prefill under the transfer retry
+  budget (fleet_kv_transfer_retries_total) instead of decoding on
+  garbage; past the budget the request fails with a typed
+  KVTransferError.
 
 Arming a site is scoped and seeded::
 
@@ -86,7 +99,8 @@ SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "io.prefetch", "proc.kill", "hb.write", "train.stall",
          "train.spike", "serve.replica_crash", "serve.replica_hang",
          "serve.dispatch", "io.stream.open", "io.stream.read",
-         "io.stream.corrupt")
+         "io.stream.corrupt", "serve.prefill_crash",
+         "serve.kv_transfer_corrupt")
 
 
 class InjectedFault(OSError):

@@ -700,21 +700,27 @@ def fleet_sizing(tiny):
 
 
 def run_fleet(artifact, stream, *, n_replicas, engine_kwargs,
-              warm_stream=None, log_dir=None):
+              warm_stream=None, log_dir=None, roles=None):
     """One timed window through a real replica fleet (ISSUE 12):
     ``n_replicas`` worker processes behind the Router, requests admitted
     on the stream's arrival clock. ``warm_stream`` is replayed first so
-    every replica's prefill/decode graphs are compiled before timing."""
+    every replica's prefill/decode graphs are compiled before timing
+    (engine-owned metrics are reset afterwards — the window discipline).
+    ``roles`` (ISSUE 15) splits the fleet into dedicated prefill/decode
+    workers; decode-worker ITL percentiles are collected per replica
+    from the stats RPC, so the disagg A/B compares exactly the latency
+    the handoff is supposed to protect."""
     from paddle_tpu.inference.serving.fleet import Router
 
     fleet = Router(artifact=artifact, n_replicas=n_replicas,
                    engine_kwargs=engine_kwargs, log_dir=log_dir,
-                   max_queue=1_000_000)
+                   max_queue=1_000_000, roles=roles)
     try:
         if warm_stream is not None:
             for r in warm_stream:
                 fleet.submit(r.prompt, max_new=r.max_new)
             fleet.join(timeout=600)
+            fleet.reset_replica_metrics()
         gids = []
         i = 0
         t0 = time.perf_counter()
@@ -734,6 +740,18 @@ def run_fleet(artifact, stream, *, n_replicas, engine_kwargs,
         wall = time.perf_counter() - t0
         outs = [fleet.result(g) for g in gids]
         fm = fleet.metrics()
+        # decode-worker ITL: engine-owned histograms read per replica;
+        # on a split fleet only decode-capable replicas decode, on a
+        # colocated fleet every replica does
+        decode_itl = []
+        for h in fleet.supervisor.handles:
+            if not h.alive or h.retired:
+                continue
+            if roles is not None and roles[h.id] == "prefill":
+                continue
+            s = fleet.replica_stats(h.id)
+            if s and s.get("itl_p99_ms") is not None:
+                decode_itl.append(float(s["itl_p99_ms"]))
     finally:
         fleet.close()
     gen_tokens = sum(r.max_new for r in stream)
@@ -741,7 +759,11 @@ def run_fleet(artifact, stream, *, n_replicas, engine_kwargs,
                 tokens_per_sec=round(gen_tokens / wall, 1),
                 gen_tokens=gen_tokens, n_replicas=n_replicas,
                 redispatches=fm["redispatches"],
-                requests_shed=fm["requests_shed"])
+                requests_shed=fm["requests_shed"],
+                prefill_handoffs=fm["prefill_handoffs"],
+                kv_transfer_retries=fm["kv_transfer_retries"],
+                decode_itl_p99_ms=(round(max(decode_itl), 2)
+                                   if decode_itl else None))
 
 
 def run_fleet_ab(tiny=True, seed=0, fleet=3):
@@ -800,11 +822,118 @@ def run_fleet_ab(tiny=True, seed=0, fleet=3):
     )
 
 
+def disagg_sizing(tiny):
+    """Long-prompt mix over a replica fleet (ISSUE 15): a background of
+    short decode-heavy requests with long prompts landing mid-stream —
+    the workload whose colocated prefills stall every in-flight token
+    stream, and exactly what shipping prefill to dedicated workers
+    protects. The deeper/wider tiny makes chunk compute dominate RPC
+    overhead (the fleet_sizing trick)."""
+    import dataclasses as _dc
+
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        cfg = _dc.replace(llama_tiny(), hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=4,
+                          max_position_embeddings=1024)
+        stream = dict(n=12, rate=300.0, min_prompt=4, max_prompt=12,
+                      min_new=24, max_new=40)
+        long_prompts = dict(every=3, length=384)
+        engine = dict(num_blocks=256, block_size=8, max_batch_size=4,
+                      max_prefills_per_step=1)
+    else:
+        cfg = llama_small()
+        stream = dict(n=32, rate=150.0, min_prompt=16, max_prompt=64,
+                      min_new=48, max_new=96)
+        long_prompts = dict(every=4, length=1024)
+        engine = dict(num_blocks=512, block_size=16, max_batch_size=4,
+                      max_prefills_per_step=1)
+    return cfg, stream, long_prompts, engine
+
+
+def run_disagg_ab(tiny=True, seed=0, fleet=3):
+    """Disaggregated prefill/decode A/B (ISSUE 15 acceptance): ONE
+    seeded long-prompt mix through a colocated ``fleet``-replica fleet
+    and a role-split fleet of the SAME size (1 prefill + the rest
+    decode) — both real subprocess fleets behind the same Router/RPC
+    path, both bit-exact against an in-process engine reference. The
+    headline number is DECODE-worker ITL p99 (engine-owned histograms):
+    colocated replicas stall their decode batches for every long
+    prefill, while split decode workers receive finished KV pages and
+    never prefill — so the disagg arm's ITL p99 must come in at or
+    under the colocated arm's."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              save_llama_artifact)
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, stream_kwargs, long_prompts, engine_kwargs = disagg_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = long_prompt_stream(cfg, stream_kwargs, long_prompts,
+                                seed=seed)
+    n = max(int(fleet), 2)
+    # warm with an n-times larger stream so EVERY replica sees every
+    # prefill bucket: least-loaded placement spreads warm requests
+    # nearly evenly, and a bucket compile landing inside the timed
+    # window would charge ~10s of XLA time to one arm's ITL p99
+    warm = long_prompt_stream(cfg, dict(stream_kwargs,
+                                        n=stream_kwargs["n"] * n),
+                              long_prompts, seed=seed + 1)
+    roles = ["prefill"] + ["decode"] * (n - 1)
+    tmp = tempfile.mkdtemp(prefix="bench_disagg.")
+    try:
+        artifact = os.path.join(tmp, "model")
+        save_llama_artifact(model, artifact)
+        eng = LLMEngine(model, ingest_async=False, **engine_kwargs)
+        try:
+            rids = [eng.add_request(
+                r.prompt, SamplingParams(max_new_tokens=r.max_new))
+                for r in stream]
+            for _ in eng.stream():
+                pass
+            refs = [eng.output_tokens(r) for r in rids]
+        finally:
+            eng.close()
+        colocated = run_fleet(artifact, stream, n_replicas=n,
+                              engine_kwargs=engine_kwargs,
+                              warm_stream=warm)
+        disagg = run_fleet(artifact, stream, n_replicas=n,
+                           engine_kwargs=engine_kwargs,
+                           warm_stream=warm, roles=roles)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    bit_exact = (_bit_exact(refs, colocated["outputs"])
+                 and _bit_exact(refs, disagg["outputs"]))
+    co_itl = colocated["decode_itl_p99_ms"]
+    dg_itl = disagg["decode_itl_p99_ms"]
+    return dict(
+        colocated={k: v for k, v in colocated.items() if k != "outputs"},
+        disagg={k: v for k, v in disagg.items() if k != "outputs"},
+        itl_p99_ratio=(round(dg_itl / co_itl, 3)
+                       if co_itl and dg_itl else None),
+        tokens_per_sec_ratio=round(
+            disagg["tokens_per_sec"]
+            / max(colocated["tokens_per_sec"], 1e-9), 3),
+        n_replicas=n,
+        roles=roles,
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+        long_prompt_len=long_prompts["length"],
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="poisson",
                     choices=["poisson", "shared-prefix", "chunked", "spec",
-                             "fleet", "quantized"])
+                             "fleet", "quantized", "disagg"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
@@ -858,6 +987,13 @@ def main():
         if not res["deterministic"]:
             sys.exit("FAIL: int8-KV greedy decode was not deterministic "
                      "run-to-run")
+        return
+    if args.workload == "disagg":
+        res = run_disagg_ab(tiny=tiny, seed=args.seed, fleet=args.fleet)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: disaggregated fleet outputs diverge from the "
+                     "in-process engine greedy reference")
         return
 
     cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)

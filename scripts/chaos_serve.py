@@ -46,9 +46,23 @@ processes over one shared model artifact + checkpoint root):
   IDENTICAL token ids — asserted against an undisturbed quantized
   single-engine baseline, like the fp32 kill drill asserts against its
   fp32 baseline.
+- ``disagg``: the ISSUE-15 storm over a ROLE-SPLIT fleet (2 prefill +
+  2 decode workers): one prefill worker SIGKILLs itself MID-TRANSFER
+  (fault site ``serve.prefill_crash``, fired between KV-page frames,
+  with tiny frames forced so every handoff spans several) AND one
+  decode worker wedges mid-stream (``serve.replica_hang``). The router
+  must discard the partial pages atomically, re-drive the prefill on
+  the surviving prefill worker (``fleet_handoff_failovers_total`` > 0),
+  and replay the hung decode worker's requests through a fresh
+  two-stage handoff — every output bit-identical to a COLOCATED
+  single-engine baseline, allocators clean on every replica. A second
+  burst arms ``serve.kv_transfer_corrupt`` (frames corrupted after
+  their CRC was computed): the router's CRC check must catch it and
+  re-drive under the transfer retry budget
+  (``fleet_kv_transfer_retries_total`` > 0), still bit-exact.
 
-``--drill all`` (the default) runs kill, hang, drain, shed, quant in
-order.
+``--drill all`` (the default) runs kill, hang, drain, shed, quant,
+disagg in order.
 Wired into the slow tier of tests/test_serving.py, the chaos_train.py
 discipline applied to serving. Everything runs on CPU
 (JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
@@ -487,19 +501,109 @@ def drill_quant(out, model, n):
         fleet.close()
 
 
+def drill_disagg(out, model, n):
+    """ISSUE 15 acceptance: prefill-worker SIGKILL mid-transfer + decode
+    worker hang mid-stream over a role-split fleet, all outputs
+    bit-identical to a COLOCATED single-engine baseline; then a
+    corrupt-transfer burst that must complete through the retry budget.
+    """
+    import json as _json
+
+    n_prefill = 2
+    n_decode = max(2, n - n_prefill)
+    roles = ["prefill"] * n_prefill + ["decode"] * n_decode
+    total = len(roles)
+    stream = request_stream(_cfg(model))
+    baseline = baseline_outputs(model, stream)
+    # tiny frames force multi-frame transfers on the tiny model, so the
+    # mid-transfer kill genuinely interrupts a handoff; replica 0
+    # (prefill) dies between frames, the LAST replica (decode) wedges
+    env = {"PADDLE_KV_FRAME_BYTES": "2048",
+           "CHAOS_SERVE_SITES": _json.dumps([
+               {"site": "serve.prefill_crash", "replica": 0,
+                "after": 11},
+               {"site": "serve.replica_hang", "replica": total - 1,
+                "after": 12},
+           ])}
+    fleet = _fleet(out, total, roles=roles, hang_timeout_s=3.0,
+                   env_extra=env)
+    try:
+        gids, shed, wall = run_burst(fleet, stream)
+        wait_all_ready(fleet)
+        check(not shed, f"no request shed: {shed}")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done == len(stream),
+              f"completed == submitted ({done}/{len(stream)}): the "
+              "disaggregated fleet dropped nothing")
+        m = fleet.metrics()
+        check(m["prefill_handoffs"] >= 1 and
+              m["kv_pages_transferred"] >= 1,
+              f"KV pages flowed prefill->decode "
+              f"({m['prefill_handoffs']} handoffs, "
+              f"{m['kv_pages_transferred']} frames)")
+        check(m["handoff_failovers"] >= 1,
+              f"the mid-transfer SIGKILL was recovered by re-driving "
+              f"the prefill elsewhere ({m['handoff_failovers']} "
+              "failovers, partial pages discarded atomically)")
+        check(m["replica_restarts"] >= 2,
+              f"supervisor restarted the crashed prefill worker AND the "
+              f"hung decode worker ({m['replica_restarts']} restarts)")
+        vals = read_liveness(out)
+        check(any(v < total for v in vals) and vals and vals[-1] == total,
+              f"liveness dipped and recovered (transitions: {vals})")
+        for h in fleet.supervisor.handles:
+            s = fleet.replica_stats(h.id)
+            check(s is not None and s.get("role") == roles[h.id],
+                  f"replica {h.id} reports role={roles[h.id]} after "
+                  "restart (role survives respawn)")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+    # corrupt-transfer burst (fresh fleet, clean incarnations): frames
+    # corrupted AFTER their CRC was computed must be caught by the
+    # router and re-driven under the retry budget — never decoded
+    stream2 = request_stream(_cfg(model), seed=1)
+    baseline2 = baseline_outputs(model, stream2)
+    out2 = os.path.join(out, "corrupt")
+    os.makedirs(out2, exist_ok=True)
+    env2 = {"PADDLE_KV_FRAME_BYTES": "2048",
+            "CHAOS_SERVE_SITES": _json.dumps([
+                {"site": "serve.kv_transfer_corrupt", "replica": 0,
+                 "after": 7, "max_fires": 2},
+            ])}
+    fleet = _fleet(out, total, roles=roles, env_extra=env2,
+                   log_dir=out2)
+    try:
+        gids, shed, wall = run_burst(fleet, stream2)
+        check(not shed, f"no request shed in the corrupt burst: {shed}")
+        done = assert_complete_bitexact(fleet, gids, baseline2)
+        check(done == len(stream2),
+              "corrupt burst: completed == submitted")
+        m = fleet.metrics()
+        check(m["kv_transfer_retries"] >= 1,
+              f"corrupt frames were caught by CRC and the prefill "
+              f"re-driven ({m['kv_transfer_retries']} transfer retries, "
+              "zero garbage decoded)")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
 def _cfg(model):
     return model.config
 
 
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
-          "shed": drill_shed, "quant": drill_quant}
+          "shed": drill_shed, "quant": drill_quant,
+          "disagg": drill_disagg}
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--drill", default="all",
                     choices=["kill", "hang", "drain", "shed", "quant",
-                             "all"])
+                             "disagg", "all"])
     ap.add_argument("--fleet", type=int, default=3)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
@@ -508,7 +612,7 @@ def main(argv=None):
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_serve.")
     print(f"[chaos] serving fleet drill, scratch: {out_root}, "
           f"fleet={args.fleet}")
-    drills = (["kill", "hang", "drain", "shed", "quant"]
+    drills = (["kill", "hang", "drain", "shed", "quant", "disagg"]
               if args.drill == "all" else [args.drill])
     model = None
     for name in drills:
