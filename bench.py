@@ -722,6 +722,48 @@ def bench_serving(on_tpu):
                          "concurrent-capacity acceptance, held exactly "
                          "by the regression tripwire",
     })
+    # KV-tiering A/B (ISSUE 16): one seeded multi-session stream whose
+    # prefix working set exceeds the device pool, replayed through a
+    # never-evicted reference, a recompute-eviction arm (tier off) and a
+    # host-RAM-tiered arm. The tracked line is the tiered arm's
+    # EFFECTIVE tokens/s; the >=1.5x-vs-recompute acceptance and greedy
+    # bit-exactness across all arms (incl. the int8-KV replay) are
+    # asserted — tiering moves pages, never math.
+    tr = bsv.run_tiering_ab(tiny=not on_tpu)
+    assert tr["bit_exact"], \
+        "tiered/recompute arm diverged from the never-evicted greedy " \
+        "reference"
+    assert tr["int8_bit_exact"], \
+        "int8 tiered arm diverged from the int8 never-evicted reference"
+    _emit({
+        "metric": "serving_tiering_tokens_per_sec" if on_tpu
+                  else "serving_cpu_tiering_tokens_per_sec",
+        "value": tr["tiered"]["effective_tokens_per_sec"],
+        "unit": "tokens/s (prompt+generated)",
+        "vs_baseline": None,
+        "effective_tokens_per_sec_recompute":
+            tr["recompute"]["effective_tokens_per_sec"],
+        "effective_tokens_per_sec_resident":
+            tr["resident"]["effective_tokens_per_sec"],
+        "tiering_speedup": tr["speedup"],
+        "int8_tiering_speedup": tr["int8_speedup"],
+        "kv_spills": tr["kv_spills"],
+        "kv_revives": tr["kv_revives"],
+        "bit_exact": tr["bit_exact"],
+        "int8_bit_exact": tr["int8_bit_exact"],
+        "num_requests": tr["num_requests"],
+        "n_sessions": tr["n_sessions"],
+        "prefix_len": tr["prefix_len"],
+        "pool_blocks": tr["pool_blocks"],
+        "host_blocks": tr["host_blocks"],
+        "baseline_note": "one seeded multi-session stream (working set "
+                         "> device pool) through never-evicted vs "
+                         "recompute-eviction vs host-RAM-tiered pools; "
+                         "effective tokens/s counts revived prefix "
+                         "tokens as served; greedy outputs bit-exact "
+                         "across arms in both the fp32 and int8-KV "
+                         "replays",
+    })
     # fleet scaling A/B (ISSUE 12): 1-replica vs N-replica subprocess
     # fleets behind the same Router/RPC path, so the tracked line is pure
     # replica parallelism — the ROADMAP item 1 tokens/s-scaling evidence,

@@ -13,9 +13,13 @@ from .errors import (  # noqa: F401
     ReplicaCrashLoopError, RequestTimeoutError,
 )
 from .kv_cache import (  # noqa: F401
-    BlockAllocator, KV_QMAX, PagedKVCache, PrefixCache,
-    kv_pool_bytes_per_block, pack_kv_pages, quantize_kv_rows,
-    unpack_kv_pages,
+    BlockAllocator, HostKVTier, KV_QMAX, PagedKVCache, PageSnapshot,
+    PrefixCache, kv_pool_bytes_per_block, pack_kv_pages,
+    quantize_kv_rows, unpack_kv_pages,
+)
+from .prefix_store import (  # noqa: F401
+    PrefixStoreMismatch, load_prefix_store, pool_geometry,
+    save_prefix_store, weights_fingerprint,
 )
 from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
 from .paged_attention import (  # noqa: F401
@@ -37,6 +41,9 @@ __all__ = [
     "quantize_state_dict", "dequantize_state_dict", "KV_QMAX",
     "quantize_kv_rows", "kv_pool_bytes_per_block", "pack_kv_pages",
     "unpack_kv_pages",
+    "HostKVTier", "PageSnapshot", "PrefixStoreMismatch",
+    "weights_fingerprint", "pool_geometry", "save_prefix_store",
+    "load_prefix_store",
     "fleet", "RequestTimeoutError", "FleetOverloadedError",
     "EngineClosedError", "ReplicaCrashLoopError", "KVTransferError",
 ]

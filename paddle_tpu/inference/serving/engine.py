@@ -55,7 +55,14 @@ import numpy as np
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
 from .errors import EngineClosedError, RequestTimeoutError
-from .kv_cache import PagedKVCache, PrefixCache
+from .kv_cache import (PagedKVCache, PrefixCache, HostKVTier,
+                       _G_HOST_BLOCKS, _H_REVIVE_MS, _H_SPILL_MS,
+                       _M_HOST_EVICT, _M_REVIVES, _M_REVIVE_BYTES,
+                       _M_SPILLS, _M_SPILL_BYTES)
+from .prefix_store import (PrefixStoreMismatch, load_prefix_store,
+                           pool_geometry, save_prefix_store,
+                           weights_fingerprint, _M_STORE_LOADED,
+                           _M_STORE_REJECTED, _M_STORE_SAVED)
 from .scheduler import (Request, SamplingParams, Scheduler,
                         _M_ADMITTED, _M_COW, _M_EVICTIONS, _M_FINISHED,
                         _M_PREFIX_REUSED, _M_QUEUED_EXH)
@@ -127,7 +134,12 @@ _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
                     _M_PREFILL_CHUNKS, _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED,
                     _M_TOKENS, _M_DEADLINE, _M_KV_SAVED, _H_TTFT, _H_ITL,
                     _G_SPEC_RATIO, _G_KV_UTIL, _G_OCCUPANCY,
-                    _G_QUANT_BLOCKS)
+                    _G_QUANT_BLOCKS,
+                    # KV tiering + prefix store (ISSUE 16)
+                    _M_SPILLS, _M_REVIVES, _M_SPILL_BYTES, _M_REVIVE_BYTES,
+                    _M_HOST_EVICT, _G_HOST_BLOCKS, _H_SPILL_MS,
+                    _H_REVIVE_MS, _M_STORE_SAVED, _M_STORE_LOADED,
+                    _M_STORE_REJECTED)
 
 
 @dataclasses.dataclass
@@ -247,7 +259,9 @@ class LLMEngine:
                  max_prefills_per_step=1, ingest_async=True, plan=None,
                  enable_prefix_cache=False, max_prefill_tokens_per_step=None,
                  draft_model=None, spec_tokens=2, kv_dtype=None,
-                 prefill_only=False):
+                 prefill_only=False, kv_host_blocks=0,
+                 prefix_store_path=None, prefix_store_autosave_chains=None,
+                 fuse_draft_catchup=True):
         from ...models.llama import LlamaForCausalLM
 
         if not isinstance(model, LlamaForCausalLM):
@@ -313,10 +327,48 @@ class LLMEngine:
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         n = next(LLMEngine._instance_ids)
         self._name = f"llm_engine#{n}"
+        # KV tiering (ISSUE 16): a host-RAM page tier behind the device
+        # pool. Preempted decode-ready requests and reclaimed prefix
+        # blocks spill to it instead of being recomputed; revival is
+        # import_request_pages — bit-exact by construction.
+        kv_host_blocks = int(kv_host_blocks)
+        if kv_host_blocks < 0:
+            raise ValueError("kv_host_blocks must be >= 0")
+        self.kv_tier = (HostKVTier(self.cache, kv_host_blocks,
+                                   instance=self._name)
+                        if kv_host_blocks > 0 else None)
+        if self.kv_tier is not None and self.prefix_cache is not None:
+            self.prefix_cache.on_spill = self.kv_tier.spill_blocks
+        # persistent prefix store (ISSUE 16): hash chains survive process
+        # death as a CRC-framed shard; boot re-imports them into the host
+        # tier so the next matching prompt revives instead of re-prefills.
+        if prefix_store_path is not None:
+            if self.prefix_cache is None:
+                raise ValueError(
+                    "prefix_store_path requires enable_prefix_cache=True: "
+                    "the store persists prefix hash chains")
+            if self.kv_tier is None:
+                raise ValueError(
+                    "prefix_store_path requires kv_host_blocks > 0: "
+                    "loaded entries land in the host tier until a "
+                    "matching request revives them")
+        self._store_path = prefix_store_path
+        if prefix_store_autosave_chains is not None:
+            prefix_store_autosave_chains = int(prefix_store_autosave_chains)
+            if prefix_store_autosave_chains < 1:
+                raise ValueError(
+                    "prefix_store_autosave_chains must be >= 1")
+            if prefix_store_path is None:
+                raise ValueError("prefix_store_autosave_chains without "
+                                 "prefix_store_path saves nowhere")
+        self._store_autosave = prefix_store_autosave_chains
+        self._store_fingerprint = None
+        self._store_saved_chains = -1  # force the first autosave crossing
         self.scheduler = Scheduler(self.cache.allocator, block_size,
                                    max_batch_size, max_prefills_per_step,
                                    instance=self._name,
-                                   prefix_cache=self.prefix_cache)
+                                   prefix_cache=self.prefix_cache,
+                                   kv_tier=self.kv_tier)
         if self.cache.quantized:
             _M_KV_SAVED.inc(self._kv_bytes_saved, instance=self._name)
             _G_QUANT_BLOCKS.set(0, instance=self._name)
@@ -382,9 +434,90 @@ class LLMEngine:
         self._tables_dev = None
         self._requests: dict[int, Request] = {}
         self._closed = False
+        # fused ragged draft catch-up (ISSUE 16 perf satellite): one
+        # fori_loop graph per power-of-two feed-length bucket instead of
+        # F sequential dispatches of the single-token draft decode.
+        self._fuse_catchup = bool(fuse_draft_catchup)
+        self._catchup_jits = {}
+        if self.kv_tier is not None:
+            # publish the tier series at zero so metrics() and dashboards
+            # see them from boot, not from the first spill
+            for m in (_M_SPILLS, _M_REVIVES, _M_SPILL_BYTES,
+                      _M_REVIVE_BYTES, _M_HOST_EVICT):
+                m.inc(0, instance=self._name)
+            _G_HOST_BLOCKS.set(0, instance=self._name)
+        self._store_geometry = None
+        if self._store_path is not None:
+            self._store_fingerprint = weights_fingerprint(model)
+            self._store_geometry = pool_geometry(self.cache, self.config)
+            self._load_prefix_store()
         self._ingest = (_IngestThread(self._stage_request, self._name)
                         if ingest_async else None)
         self.stats_extra = {"steps": 0, "prefills": 0, "tokens_out": 0}
+
+    # ------------------------------------------------------------------
+    # persistent prefix store (ISSUE 16)
+    # ------------------------------------------------------------------
+    def _prefix_store_entries(self):
+        """Chain entries worth persisting: every device-registered chain
+        (exported from the pool) plus every host-tier-resident chain a
+        prior boot loaded or a reclaim demoted — deduped by hash, device
+        copy wins (it is the one requests are actively sharing)."""
+        entries = {}
+        for h, b in self.prefix_cache.registered_chains():
+            entries[h] = self.cache.export_request_pages([b],
+                                                         self.block_size)
+        for h, pages in self.kv_tier.prefix_items():
+            entries.setdefault(h, pages)
+        return list(entries.items())
+
+    def save_prefix_store(self):
+        """Serialize the current prefix chains to ``prefix_store_path``
+        (atomic publish; the previous store stays intact on any failure).
+        Returns the number of entries written."""
+        if self._store_path is None:
+            raise ValueError(f"{self._name} has no prefix_store_path")
+        entries = self._prefix_store_entries()
+        save_prefix_store(self._store_path, entries,
+                          fingerprint=self._store_fingerprint,
+                          geometry=self._store_geometry,
+                          instance=self._name)
+        self._store_saved_chains = len(self.prefix_cache)
+        return len(entries)
+
+    def _load_prefix_store(self):
+        """Import the on-disk store into the host tier; any mismatch
+        (CRC, fingerprint, geometry) degrades to a clean cold start."""
+        try:
+            entries = load_prefix_store(
+                self._store_path, fingerprint=self._store_fingerprint,
+                geometry=self._store_geometry, instance=self._name)
+        except PrefixStoreMismatch as e:
+            warnings.warn(f"{self._name}: rejecting prefix store: {e}; "
+                          "cold-starting the prefix cache", RuntimeWarning)
+            return 0
+        if entries is None:
+            return 0
+        loaded = 0
+        for h, pages in entries:
+            if self.kv_tier.put_prefix_payload(h, pages):
+                loaded += 1
+        return loaded
+
+    def _maybe_autosave_store(self):
+        if self._store_path is None or self._store_autosave is None:
+            return
+        grown = len(self.prefix_cache) - max(self._store_saved_chains, 0)
+        if (grown >= self._store_autosave
+                or self._store_saved_chains < 0 and len(self.prefix_cache)):
+            try:
+                self.save_prefix_store()
+            except OSError as e:
+                # saving is an optimisation; the serving loop never dies
+                # for it (the previous store on disk stays intact)
+                warnings.warn(f"{self._name}: prefix store autosave "
+                              f"failed: {e}", RuntimeWarning)
+                self._store_saved_chains = len(self.prefix_cache)
 
     def _ensure_open(self):
         if self._closed:
@@ -563,7 +696,19 @@ class LLMEngine:
         re-prefills through the normal staged path."""
         pages = req.preloaded
         req.preloaded = None
+        revived = req.revived_from_tier
+        req.revived_from_tier = False
+        t0 = time.perf_counter()
         self.cache.import_request_pages(req.blocks, pages)
+        if revived:
+            # tier revival (ISSUE 16): the session came back from host
+            # RAM instead of re-prefilling
+            _M_REVIVES.inc(instance=self._name)
+            _M_REVIVE_BYTES.inc(
+                sum(int(v.nbytes) for v in pages.values()
+                    if isinstance(v, np.ndarray)), instance=self._name)
+            _H_REVIVE_MS.observe((time.perf_counter() - t0) * 1e3,
+                                 instance=self._name)
         if self.prefix_cache is not None:
             # sound because imported pages are byte-identical to local
             # prefill output (per-row quantization is pure)
@@ -769,6 +914,102 @@ class LLMEngine:
 
         return chunk_pure
 
+    def _make_decode_core(self, model):
+        """The traced one-token decode body, shared verbatim between the
+        plain decode executable and the fused catch-up loop (ISSUE 16):
+        the fused path must run the IDENTICAL op sequence per step or
+        draft proposals — and therefore acceptance counts — would drift
+        between modes. Assumes params are already swapped in and the
+        caller is inside ``trace_guard``."""
+        from ...core.tensor import Tensor
+
+        block_size = self.block_size
+        _head = self._head_fn(model)
+        _arr = self._arr
+
+        def core(ids, positions, tables, k_pools, v_pools, ks_in, vs_in):
+            import jax
+            import jax.numpy as jnp
+
+            from ...ops import manipulation as M
+            from .kv_cache import quantize_kv_rows
+            from .paged_attention import paged_decode_attention
+
+            quantized = ks_in[0] is not None if ks_in else False
+            bsz = ids.shape[0]
+            x = model.llama.embed_tokens(Tensor._wrap(ids))
+            cos_t = _arr(model.llama.rope_cos)
+            sin_t = _arr(model.llama.rope_sin)
+            # batched rope at per-request positions
+            c = cos_t[positions][:, None, None, :]
+            sn = sin_t[positions][:, None, None, :]
+            new_k, new_v, new_ks, new_vs = [], [], [], []
+            for layer, kp, vp, ksc, vsc in zip(model.llama.layers,
+                                               k_pools, v_pools,
+                                               ks_in, vs_in):
+                attn = layer.self_attn
+                h = layer.input_layernorm(x)
+                q = M.reshape(attn.q_proj(h),
+                              [bsz, 1, attn.num_heads, attn.head_dim])
+                k = M.reshape(attn.k_proj(h),
+                              [bsz, 1, attn.num_kv_heads,
+                               attn.head_dim])
+                v = M.reshape(attn.v_proj(h),
+                              [bsz, 1, attn.num_kv_heads,
+                               attn.head_dim])
+
+                def rope(t):
+                    a = _arr(t)
+                    d2 = a.shape[-1] // 2
+                    a1, a2 = a[..., :d2], a[..., d2:]
+                    cc = c.astype(a.dtype)
+                    ss = sn.astype(a.dtype)
+                    return jnp.concatenate(
+                        [a1 * cc - a2 * ss, a2 * cc + a1 * ss], -1)
+
+                qa, ka, va = rope(q), rope(k), _arr(v)
+                blk = tables[jnp.arange(bsz),
+                             positions // block_size]
+                off = positions % block_size
+                if quantized:
+                    qk, sk = quantize_kv_rows(ka)   # [B,1,Hkv,D]
+                    qv, sv = quantize_kv_rows(va)
+                for i in range(bsz):
+                    if quantized:
+                        kp = jax.lax.dynamic_update_slice(
+                            kp, qk[i:i + 1], (blk[i], off[i], 0, 0))
+                        vp = jax.lax.dynamic_update_slice(
+                            vp, qv[i:i + 1], (blk[i], off[i], 0, 0))
+                        ksc = jax.lax.dynamic_update_slice(
+                            ksc, sk[i:i + 1], (blk[i], off[i], 0))
+                        vsc = jax.lax.dynamic_update_slice(
+                            vsc, sv[i:i + 1], (blk[i], off[i], 0))
+                    else:
+                        kp = jax.lax.dynamic_update_slice(
+                            kp, ka[i:i + 1].astype(kp.dtype),
+                            (blk[i], off[i], 0, 0))
+                        vp = jax.lax.dynamic_update_slice(
+                            vp, va[i:i + 1].astype(vp.dtype),
+                            (blk[i], off[i], 0, 0))
+                out = paged_decode_attention(
+                    qa, kp, vp, tables, positions + 1,
+                    scale=1.0 / math.sqrt(attn.head_dim),
+                    k_scale=ksc, v_scale=vsc)
+                attn_out = attn.o_proj(
+                    M.reshape(Tensor._wrap(out), [bsz, 1, -1]))
+                x = x + attn_out
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+                new_k.append(kp)
+                new_v.append(vp)
+                if quantized:
+                    new_ks.append(ksc)
+                    new_vs.append(vsc)
+            h = model.llama.norm(x)
+            logits = _head(h[:, -1:])
+            return _arr(logits)[:, 0], new_k, new_v, new_ks, new_vs
+
+        return core
+
     def _make_decode_fn(self, model, params):
         """Pure one-token decode over ``model``: ``(param_arrays,
         ids [B, 1], positions [B], tables [B, P], k_pools, v_pools,
@@ -777,20 +1018,48 @@ class LLMEngine:
         ragged lengths. Quantized caches quantize the written row and
         store its per-head scale beside the codes (ISSUE 14)."""
         from ...core import state as _state
-        from ...core.tensor import Tensor
 
-        block_size = self.block_size
-        _head = self._head_fn(model)
-        _arr = self._arr
+        core = self._make_decode_core(model)
 
         def decode_pure(param_arrays, ids, positions, tables,
                         k_pools, v_pools, k_scales, v_scales):
-            import jax
-            import jax.numpy as jnp
+            quantized = len(k_scales) > 0
+            ks_in = k_scales if quantized else [None] * len(k_pools)
+            vs_in = v_scales if quantized else [None] * len(v_pools)
+            old = [p._data for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                with _state.trace_guard():
+                    logits, new_k, new_v, new_ks, new_vs = core(
+                        ids, positions, tables, k_pools, v_pools,
+                        ks_in, vs_in)
+            finally:
+                for p, a in zip(params, old):
+                    p._data = a
+            return logits, new_k, new_v, new_ks, new_vs
 
-            from ...ops import manipulation as M
-            from .kv_cache import quantize_kv_rows
-            from .paged_attention import paged_decode_attention
+        return decode_pure
+
+    def _make_catchup_fn(self, model, params):
+        """Fused ragged draft catch-up (ISSUE 16 perf satellite): one
+        ``fori_loop`` graph that replays ``F`` feed tokens through the
+        shared decode core — ``(param_arrays, ids [B, F],
+        positions [B, F], tables, pools...) -> (last logits [B, V],
+        pools...)`` — replacing ``F`` sequential dispatches of the
+        single-token draft decode with ONE. Graph size is O(layers),
+        independent of ``F``, so the doubling-ladder buckets stay cheap
+        to compile. Rows shorter than ``F`` left-pad by repeating their
+        first (token, position) feed: rewriting the same token at the
+        same position is a deterministic no-op, so padded replays are
+        bit-identical to the unfused loop."""
+        from ...core import state as _state
+
+        core = self._make_decode_core(model)
+
+        def catchup_pure(param_arrays, ids, positions, tables,
+                         k_pools, v_pools, k_scales, v_scales):
+            import jax
 
             quantized = len(k_scales) > 0
             ks_in = k_scales if quantized else [None] * len(k_pools)
@@ -800,82 +1069,38 @@ class LLMEngine:
                 for p, a in zip(params, param_arrays):
                     p._data = a
                 with _state.trace_guard():
-                    bsz = ids.shape[0]
-                    x = model.llama.embed_tokens(Tensor._wrap(ids))
-                    cos_t = _arr(model.llama.rope_cos)
-                    sin_t = _arr(model.llama.rope_sin)
-                    # batched rope at per-request positions
-                    c = cos_t[positions][:, None, None, :]
-                    sn = sin_t[positions][:, None, None, :]
-                    new_k, new_v, new_ks, new_vs = [], [], [], []
-                    for layer, kp, vp, ksc, vsc in zip(model.llama.layers,
-                                                       k_pools, v_pools,
-                                                       ks_in, vs_in):
-                        attn = layer.self_attn
-                        h = layer.input_layernorm(x)
-                        q = M.reshape(attn.q_proj(h),
-                                      [bsz, 1, attn.num_heads, attn.head_dim])
-                        k = M.reshape(attn.k_proj(h),
-                                      [bsz, 1, attn.num_kv_heads,
-                                       attn.head_dim])
-                        v = M.reshape(attn.v_proj(h),
-                                      [bsz, 1, attn.num_kv_heads,
-                                       attn.head_dim])
+                    def one(t, kps, vps, kss, vss):
+                        ids_t = jax.lax.dynamic_slice_in_dim(
+                            ids, t, 1, axis=1)
+                        pos_t = jax.lax.dynamic_slice_in_dim(
+                            positions, t, 1, axis=1)[:, 0]
+                        return core(ids_t, pos_t, tables, kps, vps,
+                                    kss, vss)
 
-                        def rope(t):
-                            a = _arr(t)
-                            d2 = a.shape[-1] // 2
-                            a1, a2 = a[..., :d2], a[..., d2:]
-                            cc = c.astype(a.dtype)
-                            ss = sn.astype(a.dtype)
-                            return jnp.concatenate(
-                                [a1 * cc - a2 * ss, a2 * cc + a1 * ss], -1)
+                    # step 0 outside the loop fixes the carry avals
+                    lg, kps, vps, kss, vss = one(0, k_pools, v_pools,
+                                                 ks_in, vs_in)
+                    if not quantized:
+                        kss, vss = [], []
 
-                        qa, ka, va = rope(q), rope(k), _arr(v)
-                        blk = tables[jnp.arange(bsz),
-                                     positions // block_size]
-                        off = positions % block_size
-                        if quantized:
-                            qk, sk = quantize_kv_rows(ka)   # [B,1,Hkv,D]
-                            qv, sv = quantize_kv_rows(va)
-                        for i in range(bsz):
-                            if quantized:
-                                kp = jax.lax.dynamic_update_slice(
-                                    kp, qk[i:i + 1], (blk[i], off[i], 0, 0))
-                                vp = jax.lax.dynamic_update_slice(
-                                    vp, qv[i:i + 1], (blk[i], off[i], 0, 0))
-                                ksc = jax.lax.dynamic_update_slice(
-                                    ksc, sk[i:i + 1], (blk[i], off[i], 0))
-                                vsc = jax.lax.dynamic_update_slice(
-                                    vsc, sv[i:i + 1], (blk[i], off[i], 0))
-                            else:
-                                kp = jax.lax.dynamic_update_slice(
-                                    kp, ka[i:i + 1].astype(kp.dtype),
-                                    (blk[i], off[i], 0, 0))
-                                vp = jax.lax.dynamic_update_slice(
-                                    vp, va[i:i + 1].astype(vp.dtype),
-                                    (blk[i], off[i], 0, 0))
-                        out = paged_decode_attention(
-                            qa, kp, vp, tables, positions + 1,
-                            scale=1.0 / math.sqrt(attn.head_dim),
-                            k_scale=ksc, v_scale=vsc)
-                        attn_out = attn.o_proj(
-                            M.reshape(Tensor._wrap(out), [bsz, 1, -1]))
-                        x = x + attn_out
-                        x = x + layer.mlp(layer.post_attention_layernorm(x))
-                        new_k.append(kp)
-                        new_v.append(vp)
-                        if quantized:
-                            new_ks.append(ksc)
-                            new_vs.append(vsc)
-                    h = model.llama.norm(x)
-                    logits = _head(h[:, -1:])
+                    def body(t, carry):
+                        kps, vps, kss, vss, _ = carry
+                        lg, kps, vps, kss, vss = one(
+                            t, kps, vps,
+                            kss if quantized else [None] * len(kps),
+                            vss if quantized else [None] * len(vps))
+                        if not quantized:
+                            kss, vss = [], []
+                        return (kps, vps, kss, vss, lg)
+
+                    kps, vps, kss, vss, lg = jax.lax.fori_loop(
+                        1, ids.shape[1], body, (kps, vps, kss, vss, lg))
             finally:
                 for p, a in zip(params, old):
                     p._data = a
-            return _arr(logits)[:, 0], new_k, new_v, new_ks, new_vs
+            return lg, kps, vps, kss, vss
 
-        return decode_pure
+        return catchup_pure
 
     def _make_verify_fn(self, model, params):
         """Pure speculative verify over ``model``: ``(param_arrays,
@@ -1033,6 +1258,21 @@ class LLMEngine:
                 self._make_verify_fn(self.model, self._params), self._plan,
                 name=self._verify_name, donate_argnums=(5, 6, 7, 8))
 
+    def _catchup_jit(self, F):
+        """The fused catch-up executable for feed-length bucket ``F``
+        (compiled on first use per rung; the fori_loop body makes each
+        rung's graph O(layers), so the ladder stays cheap)."""
+        jit = self._catchup_jits.get(F)
+        if jit is None:
+            from ...distributed.plan import compile_step_with_plan
+            jit = compile_step_with_plan(
+                self._make_catchup_fn(self.draft_model,
+                                      self._draft_params),
+                self._plan, name=f"{self._draft_decode_name}_catchup{F}",
+                donate_argnums=(4, 5, 6, 7))
+            self._catchup_jits[F] = jit
+        return jit
+
     # ------------------------------------------------------------------
     # the scheduler tick
     # ------------------------------------------------------------------
@@ -1178,9 +1418,11 @@ class LLMEngine:
                 args={"rid": req.rid, "engine": self._name,
                       "evictions": req.evictions})
             if req.preloaded is not None:
-                # disaggregated handoff: imported pages land in the
-                # freshly allocated blocks before this step decodes
+                # disaggregated handoff OR tier revival: imported pages
+                # land in the freshly allocated blocks before this step
+                # decodes
                 self._adopt_preloaded(req)
+        self._drain_revives()
 
         # -- chunked prefill (budgeted; interleaves with decode below) ---
         for req, start, take in sched.prefill_work(
@@ -1218,8 +1460,60 @@ class LLMEngine:
                 for i, req in ready:
                     req.num_cached += 1
                     outputs.extend(self._emit(req, logits[i]))
+        self._maybe_autosave_store()
         self._update_gauges()
         return outputs
+
+    def _drain_revives(self):
+        """Land this step's host-tier prefix hits (queued by the
+        scheduler's ``match_with_tier``) in their freshly allocated
+        blocks and publish the chain identities so the NEXT admission
+        shares them device-side. A hash that vanished from the tier
+        between match and drain (LRU pressure from a same-step spill)
+        degrades to prefilling that span — and everything after it, since
+        a chain with a hole is no chain."""
+        sched = self.scheduler
+        if not sched.pending_revive:
+            return
+        # gather each request's revivable span first, then land it as ONE
+        # batched import: a functional pool update copies the whole pool,
+        # so importing block-by-block would cost O(span * pool) instead
+        # of O(pool)
+        spans = {}  # rid -> (req, [(block, h, pages), ...])
+        dead = set()  # rids whose chain broke mid-revive
+        for req, block, h in sched.pending_revive:
+            idx = req.blocks.index(block)
+            if req.rid in dead or req.finished:
+                req.num_cached = min(req.num_cached, idx * self.block_size)
+                self.kv_tier.pop_prefix(h)  # unreachable behind the hole
+                continue
+            pages = self.kv_tier.pop_prefix(h)
+            if pages is None:
+                dead.add(req.rid)
+                req.num_cached = min(req.num_cached, idx * self.block_size)
+                continue
+            spans.setdefault(req.rid, (req, []))[1].append((block, h,
+                                                            pages))
+        sched.pending_revive.clear()
+        for req, parts in spans.values():
+            t0 = time.perf_counter()
+            blocks = [b for b, _, _ in parts]
+            merged = dict(parts[0][2])
+            merged["covered"] = len(parts) * self.block_size
+            if len(parts) > 1:
+                for key in ("k", "v", "k_scale", "v_scale"):
+                    if key in merged:
+                        merged[key] = np.concatenate(
+                            [p[key] for _, _, p in parts], axis=1)
+            self.cache.import_request_pages(blocks, merged)
+            for b, h, _ in parts:
+                self.prefix_cache.adopt(b, h)
+            nbytes = sum(int(v.nbytes) for v in merged.values()
+                         if isinstance(v, np.ndarray))
+            _M_REVIVES.inc(len(parts), instance=self._name)
+            _M_REVIVE_BYTES.inc(nbytes, instance=self._name)
+            _H_REVIVE_MS.observe((time.perf_counter() - t0) * 1e3,
+                                 instance=self._name)
 
     def _update_gauges(self):
         # utilization gauges: free-list arithmetic the host already holds
@@ -1250,25 +1544,48 @@ class LLMEngine:
             fs = list(range(lo, r.num_tokens))
             feeds[r.rid] = fs
             F = max(F, len(fs))
-        for rid, fs in feeds.items():
-            # left-pad by repeating the first feed: re-writing the same
-            # token at the same position is a deterministic no-op, so the
-            # ragged catch-up runs as F uniform batched steps
-            feeds[rid] = [fs[0]] * (F - len(fs)) + fs
-        logits = None
-        for t in range(F):
-            ids = np.zeros((B, 1), np.int32)
-            pos = np.zeros(B, np.int32)
+        if self._fuse_catchup and F > 1:
+            # fused catch-up (ISSUE 16 perf satellite): bucket F up to
+            # the next power of two — the extra left-pad steps rewrite
+            # the first feed in place, a deterministic no-op — and replay
+            # the whole ragged window in ONE fori_loop dispatch instead
+            # of F sequential single-token dispatches
+            Fb = 1 << (F - 1).bit_length()
+            for rid, fs in feeds.items():
+                feeds[rid] = [fs[0]] * (Fb - len(fs)) + fs
+            ids = np.zeros((B, Fb), np.int32)
+            pos = np.zeros((B, Fb), np.int32)
             for i, r in ready:
-                j = feeds[r.rid][t]
-                ids[i, 0] = toks[r.rid][j]
-                pos[i] = j
+                for t, j in enumerate(feeds[r.rid]):
+                    ids[i, t] = toks[r.rid][j]
+                    pos[i, t] = j
             dc = self.draft_cache
             (logits, dc.k, dc.v, dc.k_scale, dc.v_scale) = \
-                self._draft_decode_jit(
+                self._catchup_jit(Fb)(
                     [p._data for p in self._draft_params],
                     jnp.asarray(ids), jnp.asarray(pos), tables,
                     dc.k, dc.v, dc.k_scale, dc.v_scale)
+        else:
+            for rid, fs in feeds.items():
+                # left-pad by repeating the first feed: re-writing the
+                # same token at the same position is a deterministic
+                # no-op, so the ragged catch-up runs as F uniform batched
+                # steps
+                feeds[rid] = [fs[0]] * (F - len(fs)) + fs
+            logits = None
+            for t in range(F):
+                ids = np.zeros((B, 1), np.int32)
+                pos = np.zeros(B, np.int32)
+                for i, r in ready:
+                    j = feeds[r.rid][t]
+                    ids[i, 0] = toks[r.rid][j]
+                    pos[i] = j
+                dc = self.draft_cache
+                (logits, dc.k, dc.v, dc.k_scale, dc.v_scale) = \
+                    self._draft_decode_jit(
+                        [p._data for p in self._draft_params],
+                        jnp.asarray(ids), jnp.asarray(pos), tables,
+                        dc.k, dc.v, dc.k_scale, dc.v_scale)
         prev = np.asarray(logits)
         drafts = np.zeros((B, K), np.int32)
         for kstep in range(K):
@@ -1454,12 +1771,25 @@ class LLMEngine:
         back to ``latest_valid_step()``), a checkpoint step directory, or
         a state-dict file path. Returns the restored step (or None)."""
         try:
-            return self._reload_weights_impl(source)
+            step = self._reload_weights_impl(source)
         finally:
             if self._plan is not None:
                 # restored host arrays must go back to the plan's layouts
                 # or the next step would recompile for replicated inputs
                 self._plan.apply_to_model(self.model)
+        if self._store_path is not None:
+            fp = weights_fingerprint(self.model)
+            if fp != self._store_fingerprint:
+                # different weights: every cached chain (device-registered,
+                # host-resident, on disk) would decode garbage — drop them
+                # all, then try the store again in case a shard for the NEW
+                # fingerprint was published by a peer or a prior run
+                self.prefix_cache.invalidate()
+                self.kv_tier.drop_prefixes()
+                self._store_fingerprint = fp
+                self._store_saved_chains = -1
+                self._load_prefix_store()
+        return step
 
     def _reload_weights_impl(self, source):
         from ...distributed.checkpoint import load_state_dict
@@ -1541,6 +1871,21 @@ class LLMEngine:
             "quantized_blocks_in_use": (
                 int(_G_QUANT_BLOCKS.value(instance=inst))
                 if self.cache.quantized else None),
+            # KV tiering + prefix store (ISSUE 16) — zeros when the tier
+            # is off so consumers never need to key-guard
+            "kv_spills": int(_M_SPILLS.value(instance=inst)),
+            "kv_revives": int(_M_REVIVES.value(instance=inst)),
+            "kv_spill_bytes": int(_M_SPILL_BYTES.value(instance=inst)),
+            "kv_revive_bytes": int(_M_REVIVE_BYTES.value(instance=inst)),
+            "kv_host_evictions": int(_M_HOST_EVICT.value(instance=inst)),
+            "kv_host_blocks": int(_G_HOST_BLOCKS.value(instance=inst)),
+            "kv_spill_ms": _H_SPILL_MS.summary(instance=inst),
+            "kv_revive_ms": _H_REVIVE_MS.summary(instance=inst),
+            "prefix_store_saved": int(_M_STORE_SAVED.value(instance=inst)),
+            "prefix_store_loaded": int(
+                _M_STORE_LOADED.value(instance=inst)),
+            "prefix_store_rejected": int(
+                _M_STORE_REJECTED.value(instance=inst)),
         }
 
     def reset_metrics(self):
@@ -1555,6 +1900,10 @@ class LLMEngine:
             # not window activity — republish it so a benchmark window
             # reset doesn't erase the capacity accounting
             _M_KV_SAVED.inc(self._kv_bytes_saved, instance=self._name)
+        if self.kv_tier is not None and not self._closed:
+            # host occupancy is current state, not window activity
+            _G_HOST_BLOCKS.set(self.kv_tier.host_blocks_in_use,
+                               instance=self._name)
 
     def reset_block_high_water(self):
         """Re-anchor the allocator's high-water mark at the current
@@ -1576,6 +1925,15 @@ class LLMEngine:
         ingest thread."""
         if self._closed:
             return
+        if self._store_path is not None:
+            # persist the warm prefix chains BEFORE teardown frees their
+            # blocks; a failed save keeps the previous store intact and
+            # never blocks the close
+            try:
+                self.save_prefix_store()
+            except OSError as e:
+                warnings.warn(f"{self._name}: prefix store save on close "
+                              f"failed: {e}", RuntimeWarning)
         self._closed = True
         if self._ingest is not None:
             self._ingest.close()
@@ -1587,6 +1945,8 @@ class LLMEngine:
         for req in list(self.scheduler.waiting):
             self.scheduler.abort(req, "closed")
         self._requests.clear()
+        if self.kv_tier is not None:
+            self.kv_tier.close()
         self.reset_metrics()
         if self._was_training:
             self.model.train()

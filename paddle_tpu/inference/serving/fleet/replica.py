@@ -142,9 +142,17 @@ def replica_worker_main():
 
     model = load_llama_artifact(cfg["artifact"])
     role = cfg.get("role") or "both"
+    engine_kw = dict(cfg.get("engine") or {})
+    if engine_kw.get("prefix_store_path"):
+        # each replica persists its own prefix-store shard — a literal
+        # shared path would have every worker clobbering one store file
+        # at close(), so the fleet API takes a ``{replica}`` template
+        engine_kw["prefix_store_path"] = str(
+            engine_kw["prefix_store_path"]).replace(
+                "{replica}", str(replica_id))
     eng = LLMEngine(model, ingest_async=False,
                     prefill_only=(role == "prefill"),
-                    **cfg.get("engine") or {})
+                    **engine_kw)
     reloaded = None
     root = cfg.get("ckpt_root")
     if root:

@@ -70,21 +70,81 @@ page local prefill would have written, so the handoff preserves greedy
 determinism by construction. ``pack_kv_pages``/``unpack_kv_pages``
 serialize the payload for the transfer channel (the fleet frames the
 bytes with CRCs; corruption is the CHANNEL's problem, detected there).
+
+ISSUE 16 adds the **host-RAM tier** (:class:`HostKVTier`): when the
+device free list dries up, cold pages — a preempted request's blocks, or
+a refcount-0 registered block being reclaimed out of the reusable pool —
+are snapshotted (:meth:`PagedKVCache.snapshot_request_pages`, a zero-copy
+device-side gather) and drained to host numpy arrays on a transfer
+thread (the ``DevicePrefetcher`` idiom: async D2H that never blocks the
+step loop, dies once and degrades to synchronous conversion). The tier
+is budget-bounded (``max_host_blocks``) with its own LRU, so host RAM is
+a sized cache, not a leak. Revival is ``import_request_pages`` instead
+of re-prefill — bit-exact by construction (PR 15) — and spilled prefix
+blocks keep their chain hashes as tier keys, so
+:meth:`PrefixCache.match_with_tier` extends a device chain walk into the
+host tier and the scheduler revives host-resident prefixes on admission.
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import queue
+import threading
+import time
+import warnings
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "KV_QMAX",
+from ...observability import metrics as _obs_metrics
+from ...utils import fault_injection as _fi
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "HostKVTier",
+           "PageSnapshot", "KV_QMAX",
            "quantize_kv_rows", "kv_pool_bytes_per_block",
            "pack_kv_pages", "unpack_kv_pages"]
+
+# KV tiering observability (ISSUE 16): spills/revives are counted per
+# EVENT (one preempted request's page set, or one reclaimed prefix
+# block); bytes counters carry the volume, the gauge tracks host-tier
+# residency, and the histograms time the actual transfers (D2H
+# materialization on spill, pool import on revive). Instance-labeled by
+# engine, like every serving metric.
+_M_SPILLS = _obs_metrics.counter(
+    "serving_kv_spills_total",
+    "KV page-spill events into the host tier (one per preempted request "
+    "or per reclaimed prefix block)")
+_M_REVIVES = _obs_metrics.counter(
+    "serving_kv_revives_total",
+    "KV revive events out of the host tier (import_request_pages instead "
+    "of re-prefill: one per revived request or prefix block)")
+_M_SPILL_BYTES = _obs_metrics.counter(
+    "serving_kv_spill_bytes_total",
+    "bytes moved device->host by KV tier spills (codes + scale sidecars "
+    "for int8 pools)")
+_M_REVIVE_BYTES = _obs_metrics.counter(
+    "serving_kv_revive_bytes_total",
+    "bytes moved host->device by KV tier revivals")
+_M_HOST_EVICT = _obs_metrics.counter(
+    "serving_kv_host_evictions_total",
+    "entries LRU-dropped from the host tier to fit its block budget "
+    "(the spilled content is recomputable; dropping costs a re-prefill, "
+    "never correctness)")
+_G_HOST_BLOCKS = _obs_metrics.gauge(
+    "serving_kv_host_blocks",
+    "KV blocks currently resident in the host-RAM tier")
+_H_SPILL_MS = _obs_metrics.histogram(
+    "serving_kv_spill_ms",
+    "device->host materialization latency per spill event",
+    buckets=_obs_metrics.DEFAULT_MS_BUCKETS)
+_H_REVIVE_MS = _obs_metrics.histogram(
+    "serving_kv_revive_ms",
+    "host->device import latency per revive event",
+    buckets=_obs_metrics.DEFAULT_MS_BUCKETS)
 
 # symmetric int8: codes in [-127, 127], scale = absmax/127 per row.
 # -128 is deliberately unused so the scheme stays symmetric (dequant is
@@ -188,16 +248,20 @@ class BlockAllocator:
         ``on_reclaim``."""
         if n > self.num_free:
             return None
-        ids = []
+        ids, reclaimed = [], []
         for _ in range(n):
             if self._free:
                 b = self._free.pop()
             else:
                 b, _ = self._reusable.popitem(last=False)  # LRU reclaim
-                if self.on_reclaim is not None:
-                    self.on_reclaim(b)
+                reclaimed.append(b)
             self._ref[b] = 1
             ids.append(b)
+        if reclaimed and self.on_reclaim is not None:
+            # one notification for the whole wave: the ISSUE-16 spill
+            # path turns each wave into ONE device gather + ONE queued
+            # D2H, so reclaim cost is per-allocate, not per-block
+            self.on_reclaim(reclaimed)
         self.high_water = max(self.high_water, len(self._ref))
         return ids
 
@@ -262,7 +326,15 @@ class PrefixCache:
         self.block_size = int(block_size)
         self._by_hash = {}      # chain hash (bytes) -> block id
         self._block_hash = {}   # block id -> chain hash
-        allocator.on_reclaim = self._forget
+        # ISSUE 16: optional spill hook ``on_spill(pairs)`` taking a
+        # batch of ``(block_id, chain_hash)`` pairs (set by the engine
+        # when a HostKVTier is attached). Reclaiming reusable blocks out
+        # of the device pool offers their content to the host tier
+        # BEFORE the identities are forgotten — a reclaim becomes a
+        # demotion, not a loss. A divergent-write ``forget`` never
+        # spills: that content no longer matches its published hash.
+        self.on_spill = None
+        allocator.on_reclaim = self._reclaim
         allocator.cache_probe = self
 
     def __len__(self):
@@ -313,11 +385,82 @@ class PrefixCache:
                 self._block_hash[blocks[i]] = h
             parent = h
 
+    def match_with_tier(self, tokens, tier):
+        """:meth:`match`, extended into the host tier (ISSUE 16): after
+        the device chain walk stops, keep hashing chunks and probing
+        ``tier`` for host-resident continuations of the SAME chain.
+        Returns ``(block_ids, device_covered, host_hashes)`` — the host
+        hashes cover the chunks immediately after ``device_covered``;
+        the caller allocates fresh blocks for them and revives their
+        pages via ``import_request_pages``. The combined coverage obeys
+        the same proper-prefix cap as :meth:`match`."""
+        tokens = np.asarray(tokens)
+        bs = self.block_size
+        max_chunks = max((len(tokens) - 1) // bs, 0)
+        blocks, parent = [], b""
+        host = []
+        i = 0
+        while i < max_chunks:
+            h = self._chunk_hash(parent, tokens[i * bs:(i + 1) * bs])
+            b = self._by_hash.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+            parent = h
+            i += 1
+        while tier is not None and i < max_chunks:
+            h = self._chunk_hash(parent, tokens[i * bs:(i + 1) * bs])
+            if not tier.has_prefix(h):
+                break
+            host.append(h)
+            parent = h
+            i += 1
+        return blocks, len(blocks) * bs, host
+
+    def adopt(self, block_id, chain_hash):
+        """Publish a revived block under its KNOWN chain hash (host-tier
+        or prefix-store revival: the pages just imported are
+        byte-identical to what the chain's original writer produced, so
+        the identity transfers with them — no token rehash needed).
+        First writer wins, exactly like :meth:`register`."""
+        if chain_hash in self._by_hash or block_id in self._block_hash:
+            return
+        self._by_hash[chain_hash] = block_id
+        self._block_hash[block_id] = chain_hash
+
+    def registered_chains(self):
+        """Snapshot of ``(chain_hash, block_id)`` pairs currently
+        published — the prefix store serializes these (plus the host
+        tier's entries) on save."""
+        return list(self._by_hash.items())
+
+    def invalidate(self):
+        """Drop EVERY cached identity (``reload_weights`` with a
+        different weight fingerprint: pool content no longer corresponds
+        to any chain under the new model). Blocks parked in the
+        allocator's reusable pool stay parked — with their hashes gone
+        they recycle as plain free blocks and are never spilled."""
+        self._by_hash.clear()
+        self._block_hash.clear()
+
     def forget(self, block_id):
         """Drop a block's cached identity (divergent write to a
         refcount-1 registered block — its content no longer matches the
         published hash)."""
         self._forget(block_id)
+
+    def _reclaim(self, block_ids):
+        """Allocator ``on_reclaim`` hook: a WAVE of reusable blocks is
+        being handed to new owners. Offer their (still intact) content
+        to the host tier in one batch — one device gather and one queued
+        D2H for the whole wave — then forget the device identities."""
+        if self.on_spill is not None:
+            pairs = [(b, self._block_hash[b]) for b in block_ids
+                     if b in self._block_hash]
+            if pairs:
+                self.on_spill(pairs)
+        for b in block_ids:
+            self._forget(b)
 
     def _forget(self, block_id):
         h = self._block_hash.pop(block_id, None)
@@ -426,20 +569,18 @@ class PagedKVCache:
         tail block may be partial; its trailing rows are whatever the
         pool holds and are masked by context lengths on the other side,
         exactly as they are here."""
-        idx = np.asarray(blocks, np.int32)
-        out = {
-            "covered": int(covered),
-            "block_size": self.block_size,
-            "kv_dtype": self.kv_dtype,
-            "k": np.stack([np.asarray(kp[idx]) for kp in self.k]),
-            "v": np.stack([np.asarray(vp[idx]) for vp in self.v]),
-        }
-        if self.quantized:
-            out["k_scale"] = np.stack(
-                [np.asarray(s[idx]) for s in self.k_scale])
-            out["v_scale"] = np.stack(
-                [np.asarray(s[idx]) for s in self.v_scale])
-        return out
+        return self.snapshot_request_pages(blocks, covered).materialize()
+
+    def snapshot_request_pages(self, blocks, covered):
+        """Device-side capture of ``blocks`` for the host tier (ISSUE
+        16): the per-layer gathers are DISPATCHED now — against the pool
+        arrays as they are at this instant, which jax's immutability
+        makes safe no matter how soon the allocator hands the blocks to
+        a new owner — but the D2H transfer is deferred to
+        :meth:`PageSnapshot.materialize` (normally run on the tier's
+        transfer thread). The materialized payload is exactly an
+        :meth:`export_request_pages` dict."""
+        return PageSnapshot(self, blocks, covered)
 
     def validate_request_pages(self, pages):
         """Typed geometry validation of an import payload WITHOUT
@@ -506,6 +647,332 @@ class PagedKVCache:
                             for i, s in enumerate(self.k_scale)]
             self.v_scale = [s.at[idx].set(jnp.asarray(vs[i], s.dtype))
                             for i, s in enumerate(self.v_scale)]
+
+
+# One compiled gather for a whole spill: every pool array of a capture
+# (all layers' k, v and — on int8 pools — scales) goes through a single
+# jitted dispatch instead of one eager fancy-index per array. jit's own
+# aval cache keys on (pool count, shapes, dtypes, index length), so the
+# same callable serves every pool geometry; spilling under device-pressure
+# is pure dispatch overhead and this turns ~8 slow eager gathers per
+# spill into one fast-path call.
+_POOL_GATHER = jax.jit(lambda pools, idx: [p[idx] for p in pools])
+
+
+class PageSnapshot:
+    """Lazily-materialized page capture (see
+    :meth:`PagedKVCache.snapshot_request_pages`). ``materialize`` is
+    idempotent and thread-safe: the transfer thread races the consumer
+    only for who PAYS the D2H, never for what the payload contains."""
+
+    def __init__(self, cache, blocks, covered):
+        idx = np.asarray(blocks, np.int32)
+        self.nblocks = len(blocks)
+        self.covered = int(covered)
+        self._meta = {"covered": int(covered),
+                      "block_size": cache.block_size,
+                      "kv_dtype": cache.kv_dtype}
+        # gathers dispatch against the CURRENT pool bindings; results are
+        # device arrays the pool can no longer mutate
+        groups = [("k", cache.k), ("v", cache.v)]
+        if cache.quantized:
+            groups += [("k_scale", cache.k_scale),
+                       ("v_scale", cache.v_scale)]
+        flat = _POOL_GATHER([p for _, g in groups for p in g],
+                            jnp.asarray(idx))
+        self._parts, off = {}, 0
+        for name, g in groups:
+            self._parts[name] = flat[off:off + len(g)]
+            off += len(g)
+        self._pages = None
+        self._lock = threading.Lock()
+        # set by the tier: called exactly once, under the snapshot lock,
+        # with (nbytes, ms) when the D2H actually runs — whichever of the
+        # transfer thread / a consumer gets there first
+        self.on_materialized = None
+
+    @property
+    def ready(self):
+        return self._pages is not None
+
+    def materialize(self):
+        """Host payload dict (``export_request_pages`` format); first
+        caller pays the D2H and the spill byte/latency telemetry is
+        recorded exactly once."""
+        with self._lock:
+            if self._pages is None:
+                t0 = time.perf_counter()
+                pages = dict(self._meta)
+                for name, parts in self._parts.items():
+                    pages[name] = np.stack(
+                        [np.asarray(p) for p in parts])
+                nbytes = sum(a.nbytes for a in pages.values()
+                             if isinstance(a, np.ndarray))
+                self._pages = pages
+                self._parts = None  # release device refs
+                if self.on_materialized is not None:
+                    self.on_materialized(
+                        nbytes, (time.perf_counter() - t0) * 1e3)
+            return self._pages
+
+    def view(self, i):
+        """Single-block view into this capture (batched prefix spill:
+        one snapshot serves a whole reclaim wave; each chain hash keys a
+        view of its own block)."""
+        return _SnapshotView(self, i)
+
+
+class _SnapshotView:
+    """One block of a batched :class:`PageSnapshot` — same ``nblocks``/
+    ``materialize`` surface the tier stores, backed by the shared parent
+    capture (the wave pays one gather and one D2H, not one per block)."""
+
+    def __init__(self, snap, i):
+        self._snap = snap
+        self._i = int(i)
+        self.nblocks = 1
+        self.covered = snap._meta["block_size"]
+
+    def materialize(self):
+        pages = self._snap.materialize()
+        i = self._i
+        out = {k: (v[:, i:i + 1] if isinstance(v, np.ndarray) else v)
+               for k, v in pages.items()}
+        out["covered"] = self.covered
+        return out
+
+
+class HostKVTier:
+    """Bounded host-RAM tier over a :class:`PagedKVCache` (ISSUE 16).
+
+    Two kinds of entries share one LRU under one block budget:
+
+    * ``("req", rid)`` — a preempted request's full page set, spilled by
+      the scheduler at eviction and revived (``import_request_pages``)
+      on re-admission instead of re-prefilling;
+    * ``("prefix", chain_hash)`` — a single refcount-0 registered block
+      demoted when the allocator reclaimed it, keyed by the SAME chain
+      hash it had on device so :meth:`PrefixCache.match_with_tier` can
+      extend a chain walk into host RAM. Prefix-store boot entries land
+      here too.
+
+    ``max_host_blocks`` bounds total resident blocks; ``put`` evicts
+    oldest entries to fit (spilled content is recomputable — dropping an
+    entry costs a re-prefill, never correctness). D2H materialization
+    runs on a transfer thread (``DevicePrefetcher`` idiom: dies once,
+    warns once, degrades to synchronous conversion on access); every
+    access path calls ``materialize()`` itself, so correctness never
+    depends on the thread having run.
+    """
+
+    def __init__(self, cache, max_host_blocks, instance=None,
+                 async_transfer=True):
+        if max_host_blocks < 1:
+            raise ValueError(
+                f"max_host_blocks must be >= 1, got {max_host_blocks}")
+        self.cache = cache
+        self.max_host_blocks = int(max_host_blocks)
+        self.instance = instance
+        self._entries = OrderedDict()   # key -> PageSnapshot | dict
+        self._blocks_used = 0
+        self._lock = threading.RLock()
+        self._q: queue.Queue = queue.Queue()
+        self._thread = None
+        if async_transfer:
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"{instance or 'kv-tier'}-spill")
+            self._thread.start()
+        _G_HOST_BLOCKS.set(0, instance=self.instance)
+
+    # -- transfer thread ------------------------------------------------
+    def _worker(self):
+        while True:
+            snap = self._q.get()
+            if snap is None:
+                return
+            try:
+                snap.materialize()
+            except BaseException as e:  # degrade: consumers materialize
+                warnings.warn(
+                    f"HostKVTier transfer thread died ({e!r}); degrading "
+                    "to synchronous spill materialization", RuntimeWarning)
+                return
+
+    def close(self):
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        with self._lock:
+            self._entries.clear()
+            self._blocks_used = 0
+        _G_HOST_BLOCKS.set(0, instance=self.instance)
+
+    # -- internals ------------------------------------------------------
+    def _entry_blocks(self, entry):
+        return (int(entry["k"].shape[1]) if isinstance(entry, dict)
+                else entry.nblocks)
+
+    def _gauge(self):
+        _G_HOST_BLOCKS.set(self._blocks_used, instance=self.instance)
+
+    def _put(self, key, entry, nblocks):
+        """Insert under the budget, LRU-evicting other entries to fit.
+        Returns False (no state change) when the entry alone exceeds the
+        whole budget."""
+        if nblocks > self.max_host_blocks:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._blocks_used -= self._entry_blocks(old)
+            while (self._blocks_used + nblocks > self.max_host_blocks
+                   and self._entries):
+                _, victim = self._entries.popitem(last=False)
+                self._blocks_used -= self._entry_blocks(victim)
+                _M_HOST_EVICT.inc(instance=self.instance)
+            self._entries[key] = entry
+            self._blocks_used += nblocks
+            self._gauge()
+        return True
+
+    def _get(self, key, pop):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            if pop:
+                self._entries.pop(key)
+                self._blocks_used -= self._entry_blocks(entry)
+            else:
+                self._entries.move_to_end(key)
+            self._gauge()
+        if isinstance(entry, dict):
+            return entry
+        return entry.materialize()
+
+    def _spill(self, key, blocks, covered):
+        """Shared spill path: fire the fault site (failure degrades to
+        recompute-eviction — the caller just proceeds as if no tier were
+        attached), snapshot, insert, queue the async D2H."""
+        try:
+            _fi.fire("serve.kv_spill")
+        except Exception:
+            return False
+        snap = self.cache.snapshot_request_pages(blocks, covered)
+        snap.on_materialized = lambda nbytes, ms: (
+            _M_SPILL_BYTES.inc(nbytes, instance=self.instance),
+            _H_SPILL_MS.observe(ms, instance=self.instance))
+        if not self._put(key, snap, snap.nblocks):
+            return False
+        _M_SPILLS.inc(instance=self.instance)
+        if self._thread is not None:
+            self._q.put(snap)
+        return True
+
+    # -- preempted-request entries (scheduler-facing) -------------------
+    def spill_request(self, rid, blocks, covered):
+        """Spill one preempted request's pages under ``("req", rid)``;
+        the caller frees the device blocks right after (the snapshot's
+        gathers already dispatched)."""
+        n = -(-int(covered) // self.cache.block_size)
+        return self._spill(("req", int(rid)), list(blocks)[:n], covered)
+
+    def peek_request(self, rid):
+        """Materialized payload for a spilled request (MRU-touched, NOT
+        removed — removal happens at :meth:`drop_request` once admission
+        actually succeeds), or None if the tier LRU dropped it."""
+        return self._get(("req", int(rid)), pop=False)
+
+    def drop_request(self, rid):
+        with self._lock:
+            entry = self._entries.pop(("req", int(rid)), None)
+            if entry is not None:
+                self._blocks_used -= self._entry_blocks(entry)
+                self._gauge()
+
+    # -- prefix-block entries -------------------------------------------
+    def spill_blocks(self, pairs):
+        """Demote a reclaim WAVE of registered blocks — ``(block_id,
+        chain_hash)`` pairs — in one batch: one fault-site fire, one
+        device gather, one queued D2H for the whole wave; each chain
+        hash keys a single-block view of the shared capture. Wired as
+        ``PrefixCache.on_spill``."""
+        if not pairs:
+            return
+        try:
+            _fi.fire("serve.kv_spill")
+        except Exception:
+            return
+        blocks = [b for b, _ in pairs]
+        snap = self.cache.snapshot_request_pages(
+            blocks, len(blocks) * self.cache.block_size)
+        snap.on_materialized = lambda nbytes, ms: (
+            _M_SPILL_BYTES.inc(nbytes, instance=self.instance),
+            _H_SPILL_MS.observe(ms, instance=self.instance))
+        put_any = False
+        for i, (_, h) in enumerate(pairs):
+            if self._put(("prefix", bytes(h)), snap.view(i), 1):
+                put_any = True
+                _M_SPILLS.inc(instance=self.instance)
+        if put_any and self._thread is not None:
+            self._q.put(snap)
+
+    def spill_block(self, block_id, chain_hash):
+        """Demote one reclaimed registered block (its chain hash is the
+        tier key); single-pair form of :meth:`spill_blocks`."""
+        self.spill_blocks([(block_id, chain_hash)])
+
+    def has_prefix(self, chain_hash):
+        with self._lock:
+            key = ("prefix", bytes(chain_hash))
+            if key not in self._entries:
+                return False
+            self._entries.move_to_end(key)
+            return True
+
+    def pop_prefix(self, chain_hash):
+        """Materialized single-block payload for a host-resident chain
+        link (removed: the block is being revived into the device pool,
+        where it is re-registered under the same hash)."""
+        return self._get(("prefix", bytes(chain_hash)), pop=True)
+
+    def put_prefix_payload(self, chain_hash, pages):
+        """Insert an already-materialized single-block payload (prefix
+        store boot path)."""
+        return self._put(("prefix", bytes(chain_hash)), pages,
+                         int(pages["k"].shape[1]))
+
+    def prefix_items(self):
+        """Materialized ``(chain_hash, payload)`` pairs currently
+        resident (for the prefix store's save pass; entries stay put)."""
+        with self._lock:
+            keys = [k for k in self._entries if k[0] == "prefix"]
+        out = []
+        for key in keys:
+            pages = self._get(key, pop=False)
+            if pages is not None:
+                out.append((key[1], pages))
+        return out
+
+    def drop_prefixes(self):
+        """Drop every prefix entry (weight fingerprint changed: host
+        content no longer matches any chain under the new weights)."""
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == "prefix"]:
+                entry = self._entries.pop(key)
+                self._blocks_used -= self._entry_blocks(entry)
+            self._gauge()
+
+    @property
+    def host_blocks_in_use(self):
+        with self._lock:
+            return self._blocks_used
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
 
 
 def pack_kv_pages(pages):

@@ -1,0 +1,190 @@
+"""Crash-safe on-disk prefix store (ISSUE 16 tentpole, part b).
+
+A PR-12 rolling update drains an engine, reloads weights, and until now
+restarted the prefix cache stone-cold: every cross-request prompt prefix
+had to be re-prefilled from scratch. This module persists the
+:class:`~.kv_cache.PrefixCache` hash-chain — chain hash → one block's
+page payload — as a CRC-framed ``*.pdstream`` shard (the PR-13 container
+format, written with the PR-13 atomic-write discipline: tmp → fsync →
+rename, so a killed writer can never publish a torn store) and re-imports
+it at engine boot / ``reload_weights``, landing the entries in the
+host-RAM tier where the first matching request revives them via
+``import_request_pages`` — a warm restart instead of a cold one.
+
+Wrong pages are worse than no pages, so the load path is gated three
+ways, each degrading to a CLEAN COLD START (typed
+:class:`PrefixStoreMismatch`, counted in
+``serving_prefix_store_rejected_total``), never a partial import:
+
+* **CRC / framing** — any torn frame, bad magic, or checksum mismatch
+  surfaces as the stream layer's ``StreamCorruptionError``;
+* **weight fingerprint** — :func:`weights_fingerprint` digests every
+  parameter's name/shape/dtype/bytes; KV pages are a pure function of
+  the weights and the tokens, so pages written under different weights
+  would decode fluent garbage. The fingerprint in the store header must
+  match the serving model exactly;
+* **pool geometry** — block size, KV dtype, layer count and head
+  geometry must match: a page of the wrong shape cannot land in the
+  pool (``validate_request_pages`` would throw block-by-block; the
+  header check rejects the whole store up front instead).
+
+The save path sits behind the ``serve.store_write`` fault site, armed by
+``chaos_serve.py --drill warmstore`` in the killed-mid-save window.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from ...observability import metrics as _obs_metrics
+from ...utils.retry import atomic_write
+from ...io.streaming import (MAGIC, StreamCorruptionError, _FRAME,
+                             read_stream_shard)
+from .kv_cache import pack_kv_pages, unpack_kv_pages
+
+import zlib
+
+__all__ = ["PrefixStoreMismatch", "weights_fingerprint", "pool_geometry",
+           "save_prefix_store", "load_prefix_store", "STORE_VERSION"]
+
+STORE_VERSION = 1
+
+_M_STORE_SAVED = _obs_metrics.counter(
+    "serving_prefix_store_saved_total",
+    "prefix-chain entries serialized to the on-disk prefix store")
+_M_STORE_LOADED = _obs_metrics.counter(
+    "serving_prefix_store_loaded_total",
+    "prefix-chain entries re-imported from the on-disk prefix store "
+    "into the host tier at engine boot / reload_weights")
+_M_STORE_REJECTED = _obs_metrics.counter(
+    "serving_prefix_store_rejected_total",
+    "prefix-store files rejected whole (CRC/framing corruption, weight-"
+    "fingerprint mismatch, or pool-geometry mismatch) — the engine "
+    "cold-starts cleanly instead of importing wrong pages")
+
+
+class PrefixStoreMismatch(RuntimeError):
+    """The store on disk cannot be trusted for THIS engine: corrupt
+    framing, a different weight fingerprint, or a different pool
+    geometry. The caller degrades to a cold start — never a partial or
+    wrong import."""
+
+
+def weights_fingerprint(model):
+    """Order-independent digest of every parameter (name, shape, dtype,
+    bytes). KV pages are a deterministic function of weights + tokens,
+    so two models with the same fingerprint produce byte-identical
+    pages for the same chain — the gate that makes re-importing stored
+    pages sound."""
+    h = hashlib.sha1()
+    for name, val in sorted(model.state_dict().items()):
+        arr = np.ascontiguousarray(
+            np.asarray(val.numpy() if hasattr(val, "numpy") else val))
+        h.update(name.encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def pool_geometry(cache, config):
+    """The geometry tuple a stored page must match to land in ``cache``
+    (mirrors what ``validate_request_pages`` would check page-by-page)."""
+    return {
+        "block_size": cache.block_size,
+        "kv_dtype": cache.kv_dtype,
+        "layers": len(cache.k),
+        "kv_heads": int(config.num_key_value_heads),
+        "head_dim": int(config.head_dim),
+    }
+
+
+def save_prefix_store(path, entries, *, fingerprint, geometry,
+                      instance=None):
+    """Atomically publish ``entries`` — ``(chain_hash bytes, pages
+    dict)`` pairs — as one CRC-framed shard at ``path``. Record 0 is the
+    JSON header (version, fingerprint, geometry, entry count); each
+    following record is ``chain_hash ‖ pack_kv_pages(pages)``. The
+    ``serve.store_write`` fault site sits between the payload hitting
+    the tmp file and the atomic rename: a failure (or a SIGKILL) there
+    leaves the PREVIOUS store intact and never publishes a torn one.
+    Returns the number of entries written."""
+    entries = list(entries)
+    header = json.dumps({
+        "version": STORE_VERSION,
+        "fingerprint": fingerprint,
+        "geometry": geometry,
+        "entries": len(entries),
+    }, sort_keys=True).encode()
+
+    def body(f):
+        f.write(MAGIC)
+        for rec in [header] + [h + pack_kv_pages(p) for h, p in entries]:
+            f.write(_FRAME.pack(len(rec), zlib.crc32(rec)))
+            f.write(rec)
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    atomic_write(path, body, fire_site="serve.store_write")
+    _M_STORE_SAVED.inc(len(entries), instance=instance)
+    return len(entries)
+
+
+def load_prefix_store(path, *, fingerprint, geometry, instance=None):
+    """Entries of the store at ``path`` as ``(chain_hash, pages)``
+    pairs, or ``None`` when no store exists (a first boot, not an
+    error). Raises :class:`PrefixStoreMismatch` — counting the file in
+    ``serving_prefix_store_rejected_total`` — on CRC/framing corruption,
+    version/fingerprint/geometry mismatch, or an entry count that does
+    not match the header (a self-consistency belt on top of per-frame
+    CRCs)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        try:
+            recs = read_stream_shard(path, decode_fn=bytes)
+        except StreamCorruptionError as e:
+            raise PrefixStoreMismatch(f"corrupt prefix store: {e}") from e
+        if not recs:
+            raise PrefixStoreMismatch(f"{path}: empty store (no header)")
+        try:
+            header = json.loads(recs[0])
+        except ValueError as e:
+            raise PrefixStoreMismatch(
+                f"{path}: undecodable store header: {e}") from e
+        if header.get("version") != STORE_VERSION:
+            raise PrefixStoreMismatch(
+                f"{path}: store version {header.get('version')!r}, "
+                f"this engine speaks {STORE_VERSION}")
+        if header.get("fingerprint") != fingerprint:
+            raise PrefixStoreMismatch(
+                f"{path}: weight fingerprint mismatch (store "
+                f"{str(header.get('fingerprint'))[:12]}…, model "
+                f"{fingerprint[:12]}…) — pages from other weights "
+                "would decode garbage")
+        if header.get("geometry") != geometry:
+            raise PrefixStoreMismatch(
+                f"{path}: pool geometry mismatch (store "
+                f"{header.get('geometry')}, engine {geometry})")
+        if header.get("entries") != len(recs) - 1:
+            raise PrefixStoreMismatch(
+                f"{path}: header promises {header.get('entries')} "
+                f"entries, shard holds {len(recs) - 1}")
+        out = []
+        for rec in recs[1:]:
+            if len(rec) <= 20:
+                raise PrefixStoreMismatch(
+                    f"{path}: truncated store entry")
+            try:
+                out.append((rec[:20], unpack_kv_pages(rec[20:])))
+            except ValueError as e:
+                raise PrefixStoreMismatch(
+                    f"{path}: undecodable page payload: {e}") from e
+    except PrefixStoreMismatch:
+        _M_STORE_REJECTED.inc(instance=instance)
+        raise
+    _M_STORE_LOADED.inc(len(out), instance=instance)
+    return out

@@ -135,6 +135,13 @@ class Request:
         # worker, imported at admission instead of prefilling. Cleared
         # after the one-time import — an eviction re-prefills normally.
         self.preloaded = None
+        # KV tiering (ISSUE 16): non-None while this request's pages sit
+        # in the host tier (set at spill-eviction, keyed by rid);
+        # ``revived_from_tier`` marks an admission whose ``preloaded``
+        # payload came back FROM the tier, so the engine can count the
+        # revive (and its bytes/latency) separately from fleet handoffs.
+        self.spill_key = None
+        self.revived_from_tier = False
         self.admit_seq = -1               # admission order (eviction policy)
         self.evictions = 0
         self._rng = (np.random.RandomState(self.sampling.seed)
@@ -193,7 +200,8 @@ class Scheduler:
     _ids = itertools.count(1)
 
     def __init__(self, allocator, block_size, max_batch_size,
-                 max_prefills_per_step=1, instance=None, prefix_cache=None):
+                 max_prefills_per_step=1, instance=None, prefix_cache=None,
+                 kv_tier=None):
         self.allocator = allocator
         self.block_size = int(block_size)
         self.slots: list[Request | None] = [None] * int(max_batch_size)
@@ -202,6 +210,15 @@ class Scheduler:
         self._admit_seq = itertools.count()
         self.instance = instance or f"scheduler#{next(Scheduler._ids)}"
         self.prefix_cache = prefix_cache
+        # host-RAM tier (ISSUE 16, a kv_cache.HostKVTier): eviction spills
+        # decode-ready requests' pages instead of dropping them, admission
+        # revives spilled requests / host-resident prefix chains by page
+        # import instead of re-prefill. None keeps recompute preemption.
+        self.kv_tier = kv_tier
+        # (req, block_id, chain_hash) host-prefix revivals the engine must
+        # import+adopt before this step's prefill work (drained like
+        # pending_cow)
+        self.pending_revive: list[tuple] = []
         # block-table mutation counter: the engine invalidates its cached
         # device table array on change, so steady-state decode does ZERO
         # table H2D (ISSUE 11 satellite)
@@ -254,12 +271,29 @@ class Scheduler:
         while (len(picked) < self.max_prefills_per_step and self.waiting
                and self._free_slot() is not None):
             req = self.waiting[0]
+            # a spill-evicted request revives from the host tier: its
+            # payload becomes a ``preloaded`` import, exactly the
+            # disaggregated-handoff shape. A tier that LRU-dropped the
+            # entry under budget pressure degrades to plain re-prefill.
+            if req.spill_key is not None and self.kv_tier is not None:
+                payload = self.kv_tier.peek_request(req.spill_key)
+                if payload is not None:
+                    req.preloaded = payload
+                    req.revived_from_tier = True
+                else:
+                    req.spill_key = None
             # preloaded (disaggregated-handoff) requests charge full
             # blocks and skip prefix matching: their pages arrive by
             # import, not by sharing — the engine registers the imported
             # full blocks afterwards so LATER admissions can share them
+            host_hits = []
             if self.prefix_cache is not None and req.preloaded is None:
-                matched, mtok = self.prefix_cache.match(req.tokens)
+                if self.kv_tier is not None:
+                    matched, mtok, host_hits = (
+                        self.prefix_cache.match_with_tier(
+                            req.tokens, self.kv_tier))
+                else:
+                    matched, mtok = self.prefix_cache.match(req.tokens)
             else:
                 matched, mtok = [], 0
             need = -(-(req.num_tokens + 1) // self.block_size) - len(matched)
@@ -287,9 +321,21 @@ class Scheduler:
                 req.num_cached = int(req.preloaded["covered"])
                 req.draft_cached = 0
                 req.prefilling = False
+                if req.revived_from_tier:
+                    self.kv_tier.drop_request(req.spill_key)
+                    req.spill_key = None
             else:
-                req.num_cached = mtok      # prefix tokens already in-pool
-                req.draft_cached = mtok    # mirrored draft pool (spec)
+                # host-resident chain links continue the device match:
+                # queue their page imports (drained by the engine before
+                # prefill work) and start num_cached past them — the
+                # blocks that would otherwise be re-prefilled arrive by
+                # host->device copy instead. The draft pool (speculative
+                # decoding) only mirrors the DEVICE match; the catch-up
+                # loop re-derives the revived span deterministically.
+                for j, h in enumerate(host_hits):
+                    self.pending_revive.append((req, blocks[j], h))
+                req.num_cached = mtok + len(host_hits) * self.block_size
+                req.draft_cached = mtok
                 req.prefilling = True
             req.prefill_upto = req.num_tokens
             req.state = RUNNING
@@ -422,6 +468,19 @@ class Scheduler:
 
     def _evict(self, req):
         slot = self.slots.index(req)
+        # KV tiering (ISSUE 16): spill a decode-ready victim's pages to
+        # the host tier BEFORE the blocks free — the snapshot's gathers
+        # dispatch against the still-bound pool arrays, so freeing (and
+        # even re-writing) the blocks afterwards cannot corrupt the
+        # spilled copy. Mid-prefill victims are not spilled (their pages
+        # are incomplete); a failed/over-budget spill degrades to the
+        # plain recompute preemption below.
+        if (self.kv_tier is not None and not req.prefilling
+                and req.num_cached > 0
+                and req.num_cached == req.num_tokens - 1):
+            if self.kv_tier.spill_request(req.rid, req.blocks,
+                                          req.num_cached):
+                req.spill_key = req.rid
         self.allocator.free(req.blocks)
         req.blocks = []
         req.num_cached = 0
@@ -461,6 +520,9 @@ class Scheduler:
                 pass
         req.prefilling = False
         req.preloaded = None  # never-imported handoff pages die here
+        if req.spill_key is not None and self.kv_tier is not None:
+            self.kv_tier.drop_request(req.spill_key)  # host pages too
+            req.spill_key = None
         req.abort_reason = reason
         req.state = FINISHED
 

@@ -71,6 +71,18 @@ Sites wired into the framework:
   budget (fleet_kv_transfer_retries_total) instead of decoding on
   garbage; past the budget the request fails with a typed
   KVTransferError.
+- ``serve.kv_spill`` — HostKVTier spill capture, fired as a cold page
+  set (preempted request or reclaimed prefix block) is snapshotted for
+  the host-RAM tier: spilling is an *optimisation*, so the failure must
+  degrade to plain recompute-eviction (the request re-prefills on
+  re-admission; the block identity is simply forgotten) — never crash
+  the engine, never leave a half-registered host entry.
+- ``serve.store_write`` — persistent prefix-store save, fired after the
+  CRC-framed shard payload hits the tmp file but before the atomic
+  rename (the "killed mid-store-write" window): a previously published
+  store must stay intact byte-for-byte and a torn shard must never
+  become visible; boot after the failure recovers warm from the old
+  store or cold-starts cleanly.
 
 Arming a site is scoped and seeded::
 
@@ -100,7 +112,8 @@ SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "train.spike", "serve.replica_crash", "serve.replica_hang",
          "serve.dispatch", "io.stream.open", "io.stream.read",
          "io.stream.corrupt", "serve.prefill_crash",
-         "serve.kv_transfer_corrupt")
+         "serve.kv_transfer_corrupt", "serve.kv_spill",
+         "serve.store_write")
 
 
 class InjectedFault(OSError):

@@ -234,6 +234,10 @@ def run_engine(model, stream, engine=None, **engine_kwargs):
                 spec_accept_ratio=(round(em["spec_accept_ratio"], 4)
                                    if em["spec_accept_ratio"] is not None
                                    else None),
+                kv_spills=em["kv_spills"],
+                kv_revives=em["kv_revives"],
+                kv_host_evictions=em["kv_host_evictions"],
+                prefix_store_loaded=em["prefix_store_loaded"],
                 ttft_p50_ms=_r(em["ttft_ms"]["p50"]),
                 ttft_p99_ms=_r(em["ttft_ms"]["p99"]),
                 itl_p50_ms=_r(em["itl_ms"]["p50"]),
@@ -515,7 +519,16 @@ def run_spec_ab(tiny=True, seed=0, spec_tokens=3, draft="self"):
     be bit-exact — speculation changes WHEN tokens are produced, never
     WHICH. ``draft='self'`` uses the target model as its own draft
     (accept ratio 1.0 — the machinery's upper bound; a production draft
-    is a distilled smaller llama, which only changes the ratio)."""
+    is a distilled smaller llama, which only changes the ratio).
+
+    ISSUE 16 adds a third arm: the SAME speculative engine with the
+    fused ragged catch-up disabled (``fuse_draft_catchup=False`` — the
+    pre-16 per-token dispatch loop). Its outputs and acceptance counts
+    must be bit-identical to the fused arm (``fused_bit_exact``);
+    ``catchup_fused_speedup`` is fused/unfused tokens/s. With
+    ``draft='self'`` every proposal is accepted and the catch-up window
+    stays at one token, so the speedup only shows with a real
+    (divergent) draft — ``draft='tiny'``."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM
 
@@ -536,21 +549,36 @@ def run_spec_ab(tiny=True, seed=0, spec_tokens=3, draft="self"):
     stream = request_stream(cfg, seed=seed, **stream_kwargs)
     warm = request_stream(cfg, seed=seed + 1, **stream_kwargs)
     res = {}
-    for arm, dm in (("plain", None), ("spec", draft_model)):
+    for arm, dm, fused in (("plain", None, True),
+                           ("spec", draft_model, True),
+                           ("spec_unfused", draft_model, False)):
         kw = dict(engine_kwargs)
         if dm is not None:
-            kw.update(draft_model=dm, spec_tokens=spec_tokens)
+            kw.update(draft_model=dm, spec_tokens=spec_tokens,
+                      fuse_draft_catchup=fused)
         eng = _warm_engine(model, warm, **kw)
         try:
             res[arm] = run_engine(model, stream, engine=eng)
         finally:
             eng.close()
     bit_exact = _bit_exact(res["plain"]["outputs"], res["spec"]["outputs"])
+    # the fused catch-up must change WHEN draft rows are written, never
+    # WHAT: identical outputs AND identical acceptance behaviour
+    fused_bit_exact = (
+        _bit_exact(res["spec"]["outputs"], res["spec_unfused"]["outputs"])
+        and res["spec"]["spec_accept_ratio"]
+        == res["spec_unfused"]["spec_accept_ratio"])
     return dict(
         plain={k: v for k, v in res["plain"].items() if k != "outputs"},
         spec={k: v for k, v in res["spec"].items() if k != "outputs"},
+        spec_unfused={k: v for k, v in res["spec_unfused"].items()
+                      if k != "outputs"},
         speedup=round(res["spec"]["tokens_per_sec"]
                       / res["plain"]["tokens_per_sec"], 3),
+        catchup_fused_speedup=round(
+            res["spec"]["tokens_per_sec"]
+            / max(res["spec_unfused"]["tokens_per_sec"], 1e-9), 3),
+        fused_bit_exact=bool(fused_bit_exact),
         spec_accept_ratio=res["spec"]["spec_accept_ratio"],
         spec_tokens=spec_tokens,
         draft=draft,
@@ -671,6 +699,172 @@ def run_quantized_ab(tiny=True, seed=0, repeat=1):
             / max(res["fp32"]["tokens_per_sec"], 1e-9), 3),
         repeats=max(int(repeat), 1),
         num_requests=len(stream),
+    )
+
+
+def tiering_sizing(tiny):
+    """Sizing for the KV-tiering A/B (ISSUE 16): the live SESSION WORKING
+    SET — distinct long per-session prefixes revisited round-robin — is
+    deliberately larger than the device pool, so by the time a session
+    comes back its prefix blocks have been reclaimed. The recompute arm
+    re-prefills them from scratch; the tiered arm revives them from host
+    RAM. The deeper/wider tiny makes prefill COMPUTE (what revival
+    avoids) dominate dispatch overhead — the shared-prefix-sizing
+    trick."""
+    import dataclasses as _dc
+
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        cfg = _dc.replace(llama_tiny(), hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=4,
+                          max_position_embeddings=1024)
+        sessions = dict(n_sessions=6, visits=2, rate=400.0,
+                        prefix_len=512, min_suffix=2, max_suffix=6,
+                        min_new=1, max_new=2)
+        # 6 sessions x 32 prefix blocks = 192 blocks of working set
+        # against a 72-block pool (holds ~2 sessions): every round-2
+        # visit finds its prefix reclaimed. At 512 prefix tokens the
+        # recompute arm re-pays a real prefill; the tiered arm pays a
+        # host->device page copy
+        engine = dict(num_blocks=72, block_size=16, max_batch_size=2,
+                      max_prefills_per_step=1)
+        host_blocks = 512
+        resident_blocks = 512
+    else:
+        cfg = llama_small()
+        sessions = dict(n_sessions=8, visits=2, rate=200.0,
+                        prefix_len=512, min_suffix=16, max_suffix=48,
+                        min_new=8, max_new=16)
+        engine = dict(num_blocks=192, block_size=16, max_batch_size=2,
+                      max_prefills_per_step=1)
+        host_blocks = 1024
+        resident_blocks = 1024
+    return cfg, sessions, engine, host_blocks, resident_blocks
+
+
+def session_stream(cfg, *, n_sessions, visits, rate, prefix_len,
+                   min_suffix, max_suffix, min_new, max_new, seed=0,
+                   prefix_seed=None):
+    """Seeded multi-session stream: ``n_sessions`` distinct long
+    prefixes (per-session conversation state), revisited round-robin
+    ``visits`` times with a fresh short suffix per visit — the
+    more-live-sessions-than-HBM shape KV tiering targets."""
+    rng = np.random.RandomState(seed)
+    prng = np.random.RandomState(
+        seed + 101 if prefix_seed is None else prefix_seed)
+    prefixes = [prng.randint(0, cfg.vocab_size, prefix_len).astype(np.int32)
+                for _ in range(n_sessions)]
+    arrivals = np.cumsum(
+        rng.exponential(1.0 / rate, size=n_sessions * visits))
+    out, i = [], 0
+    for _ in range(visits):
+        for s in range(n_sessions):
+            slen = int(rng.randint(min_suffix, max_suffix + 1))
+            suffix = rng.randint(0, cfg.vocab_size, slen).astype(np.int32)
+            out.append(_Req(float(arrivals[i]),
+                            np.concatenate([prefixes[s], suffix]),
+                            int(rng.randint(min_new, max_new + 1))))
+            i += 1
+    return out
+
+
+def run_tiering_ab(tiny=True, seed=0, repeat=1):
+    """KV-tiering A/B (ISSUE 16 acceptance): ONE seeded multi-session
+    stream whose working set exceeds the device pool, through three arms
+    over the same weights:
+
+      resident   an oversized pool that never evicts — the bit-exact
+                 greedy reference
+      recompute  the small pool with the tier OFF: a reclaimed prefix is
+                 gone, every revisit re-prefills it (the pre-16 story)
+      tiered     the SAME small pool with ``kv_host_blocks``: reclaimed
+                 prefixes spill to host RAM and revisits revive them via
+                 ``import_request_pages``
+
+    All arms must be bit-exact (tiering moves pages, never math); the
+    headline is tiered/recompute EFFECTIVE (prompt+generated) tokens/s —
+    revived prefix tokens are served without recomputing them. The int8
+    variant replays the same A/B over int8-KV pools (its own reference;
+    int8 vs fp32 token ids may legitimately differ) proving the tier
+    composes with quantized pools. ``repeat`` is min-of-N per arm."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, sess_kwargs, engine_kwargs, host_blocks, resident_blocks = \
+        tiering_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = session_stream(cfg, seed=seed, **sess_kwargs)
+    warm = session_stream(cfg, seed=seed + 1, prefix_seed=seed + 202,
+                          **sess_kwargs)
+    arms = {
+        "resident": dict(engine_kwargs, num_blocks=resident_blocks),
+        "recompute": dict(engine_kwargs),
+        "tiered": dict(engine_kwargs, kv_host_blocks=host_blocks),
+    }
+    engines, runs = {}, {a: [] for a in arms}
+    try:
+        for arm, kw in arms.items():
+            engines[arm] = _warm_engine(model, warm,
+                                        enable_prefix_cache=True, **kw)
+        for _ in range(max(int(repeat), 1)):
+            for arm in arms:
+                runs[arm].append(
+                    run_engine(model, stream, engine=engines[arm]))
+    finally:
+        for eng in engines.values():
+            eng.close()
+    bit_exact = all(
+        _bit_exact(runs["resident"][0]["outputs"], r["outputs"])
+        for rs in runs.values() for r in rs)
+    res = {arm: max(rs, key=lambda r: r["effective_tokens_per_sec"])
+           for arm, rs in runs.items()}
+
+    # int8 variant: same stream, int8 pools in all three roles — its own
+    # never-evicted reference (int8 vs fp32 ids can differ; int8 arms
+    # must agree with EACH OTHER)
+    engines8, runs8 = {}, {a: [] for a in arms}
+    try:
+        for arm, kw in arms.items():
+            engines8[arm] = _warm_engine(model, warm,
+                                         enable_prefix_cache=True,
+                                         kv_dtype="int8", **kw)
+        for arm in arms:
+            runs8[arm].append(
+                run_engine(model, stream, engine=engines8[arm]))
+    finally:
+        for eng in engines8.values():
+            eng.close()
+    int8_bit_exact = all(
+        _bit_exact(runs8["resident"][0]["outputs"], r["outputs"])
+        for rs in runs8.values() for r in rs)
+
+    return dict(
+        resident={k: v for k, v in res["resident"].items()
+                  if k != "outputs"},
+        recompute={k: v for k, v in res["recompute"].items()
+                   if k != "outputs"},
+        tiered={k: v for k, v in res["tiered"].items()
+                if k != "outputs"},
+        speedup=round(res["tiered"]["effective_tokens_per_sec"]
+                      / res["recompute"]["effective_tokens_per_sec"], 3),
+        int8_speedup=round(
+            runs8["tiered"][0]["effective_tokens_per_sec"]
+            / runs8["recompute"][0]["effective_tokens_per_sec"], 3),
+        kv_spills=res["tiered"]["kv_spills"],
+        kv_revives=res["tiered"]["kv_revives"],
+        bit_exact=bool(bit_exact),
+        int8_bit_exact=bool(int8_bit_exact),
+        repeats=max(int(repeat), 1),
+        num_requests=len(stream),
+        n_sessions=sess_kwargs["n_sessions"],
+        visits=sess_kwargs["visits"],
+        prefix_len=sess_kwargs["prefix_len"],
+        pool_blocks=engine_kwargs["num_blocks"],
+        host_blocks=host_blocks,
     )
 
 
@@ -933,7 +1127,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="poisson",
                     choices=["poisson", "shared-prefix", "chunked", "spec",
-                             "fleet", "quantized", "disagg"])
+                             "fleet", "quantized", "disagg", "tiering"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
@@ -973,6 +1167,19 @@ def main():
         print(json.dumps(res, indent=2))
         if not res["bit_exact"]:
             sys.exit("FAIL: speculative arm diverges from plain greedy")
+        if not res["fused_bit_exact"]:
+            sys.exit("FAIL: fused draft catch-up diverges from the "
+                     "sequential catch-up loop")
+        return
+    if args.workload == "tiering":
+        res = run_tiering_ab(tiny=tiny, seed=args.seed)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: tiered/recompute arms diverge from the "
+                     "never-evicted greedy reference")
+        if not res["int8_bit_exact"]:
+            sys.exit("FAIL: int8 tiered arm diverges from its "
+                     "never-evicted int8 reference")
         return
     if args.workload == "fleet":
         res = run_fleet_ab(tiny=tiny, seed=args.seed, fleet=args.fleet)

@@ -61,8 +61,19 @@ processes over one shared model artifact + checkpoint root):
   re-drive under the transfer retry budget
   (``fleet_kv_transfer_retries_total`` > 0), still bit-exact.
 
+- ``warmstore``: the ISSUE-16 persistent-prefix-store drill
+  (single-engine — no fleet). A cold engine serves a session-revisit
+  stream and publishes the prefix store at ``close()``; a warm boot
+  must re-import it (``prefix_store_loaded`` > 0), REVIVE the shared
+  prefixes instead of re-prefilling (``kv_revives`` > 0) and produce
+  bit-identical outputs. Crash arms: a victim process SIGKILLed from
+  inside the armed ``serve.store_write`` window must never publish a
+  torn store (the previous bytes survive exactly and still load); a
+  corrupt store byte and a weight-fingerprint mismatch must each be
+  rejected WHOLE and degrade to a clean, still-bit-exact cold start.
+
 ``--drill all`` (the default) runs kill, hang, drain, shed, quant,
-disagg in order.
+disagg, warmstore in order.
 Wired into the slow tier of tests/test_serving.py, the chaos_train.py
 discipline applied to serving. Everything runs on CPU
 (JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
@@ -590,20 +601,154 @@ def drill_disagg(out, model, n):
         fleet.close()
 
 
+_VICTIM_SRC = r'''
+import os, sys, numpy as np
+sys.path.insert(0, sys.argv[1])
+from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                          load_llama_artifact)
+from paddle_tpu.utils import fault_injection as fi
+
+class Kill9(OSError):
+    """SIGKILLs the process from inside the armed serve.store_write
+    window — data written to the tmp file, nothing published yet."""
+    def __init__(self, *a):
+        os.kill(os.getpid(), 9)
+
+model = load_llama_artifact(sys.argv[2])
+rng = np.random.RandomState(66)
+prefix = rng.randint(0, model.config.vocab_size, 12).astype(np.int32)
+prompts = [np.concatenate([prefix, rng.randint(
+    0, model.config.vocab_size, s).astype(np.int32)]) for s in (4, 6)]
+eng = LLMEngine(model, num_blocks=24, block_size=4, max_batch_size=3,
+                enable_prefix_cache=True, kv_host_blocks=64,
+                prefix_store_path=sys.argv[3])
+eng.generate(prompts, SamplingParams(max_new_tokens=4))
+with fi.inject("serve.store_write", exc=Kill9):
+    eng.save_prefix_store()       # dies HERE, mid-write
+raise SystemExit("unreachable: the armed save did not kill us")
+'''
+
+
+def drill_warmstore(out, model, n):
+    """ISSUE 16 acceptance: the persistent prefix store across engine
+    restarts. A cold engine serves a session-revisit stream and
+    publishes the store at close(); a warm engine re-imports it and
+    REVIVES prefixes instead of re-prefilling, bit-exact. Then the
+    crash arms: a victim process SIGKILLed from inside the
+    ``serve.store_write`` window must never publish a torn store (the
+    previous bytes survive exactly); a corrupt store and a
+    weight-fingerprint mismatch must each cold-start CLEAN — wrong
+    pages are never imported."""
+    import subprocess
+
+    from paddle_tpu.inference.serving import LLMEngine, SamplingParams
+
+    cfg = _cfg(model)
+    rng = np.random.RandomState(66)
+    prefix = rng.randint(0, cfg.vocab_size, 12).astype(np.int32)
+
+    def wave(suffixes, seed):
+        r = np.random.RandomState(seed)
+        return [np.concatenate([prefix, r.randint(
+            0, cfg.vocab_size, s).astype(np.int32)]) for s in suffixes]
+
+    waves = [wave((4, 6, 5), 1),
+             [rng.randint(0, cfg.vocab_size, 40).astype(np.int32)],
+             wave((3, 7), 2)]
+    store = os.path.join(out, "prefix.pdstream")
+    kw = dict(num_blocks=14, block_size=4, max_batch_size=3,
+              enable_prefix_cache=True, kv_host_blocks=64,
+              prefix_store_path=store)
+
+    def serve(**extra):
+        outs = []
+        with LLMEngine(model, **dict(kw, **extra)) as eng:
+            boot = eng.metrics()
+            for w in waves:
+                outs.extend(eng.generate(
+                    w, SamplingParams(max_new_tokens=6)))
+            return outs, boot, eng.metrics()
+
+    cold, boot0, _ = serve()
+    check(boot0["prefix_store_loaded"] == 0,
+          "first boot found no store (clean cold start)")
+    check(os.path.exists(store), "close() published the prefix store")
+    good = open(store, "rb").read()
+
+    warm, boot1, em1 = serve()
+    check(boot1["prefix_store_loaded"] > 0,
+          f"warm boot re-imported {int(boot1['prefix_store_loaded'])} "
+          "stored chains")
+    check(em1["kv_revives"] > 0,
+          f"stored chains were REVIVED, not re-prefilled "
+          f"({int(em1['kv_revives'])} revives)")
+    check(all(np.array_equal(a, b) for a, b in zip(warm, cold)),
+          "warm-restart outputs bit-identical to the cold run")
+
+    # SIGKILL from inside the store-write window: tmp data written,
+    # rename not reached — the PREVIOUS store must survive exactly
+    victim = os.path.join(out, "victim.py")
+    with open(victim, "w") as f:
+        f.write(_VICTIM_SRC)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, victim, REPO, os.path.join(out, "model"), store],
+        env=env, capture_output=True, text=True, timeout=300)
+    check(r.returncode == -signal.SIGKILL,
+          f"victim died by SIGKILL mid-store-write (rc={r.returncode})")
+    check(open(store, "rb").read() == good,
+          "previous store intact byte-for-byte (no torn publish)")
+    _, boot2, _ = serve()
+    check(boot2["prefix_store_loaded"] > 0,
+          "store still loads after the crashed writer")
+
+    # corrupt store: rejected WHOLE, clean cold start, still bit-exact
+    blob = bytearray(good)
+    blob[len(blob) // 2] ^= 0xFF
+    with open(store, "wb") as f:
+        f.write(bytes(blob))
+    got3, boot3, _ = serve()
+    check(boot3["prefix_store_loaded"] == 0 and
+          boot3["prefix_store_rejected"] >= 1,
+          "corrupt store rejected whole (nothing partially imported)")
+    check(all(np.array_equal(a, b) for a, b in zip(got3, cold)),
+          "cold start after rejection still bit-exact")
+    with open(store, "wb") as f:
+        f.write(good)
+
+    # fingerprint mismatch: same store, DIFFERENT weights — pages from
+    # other weights would decode garbage; must cold-start clean
+    import copy
+
+    m2 = copy.deepcopy(model)
+    sd = m2.state_dict()
+    _, val = next(iter(sd.items()))
+    val.set_value(val.numpy() + 0.25)
+    with LLMEngine(m2, **kw) as eng:
+        boot4 = eng.metrics()
+        outs4 = eng.generate(waves[0], SamplingParams(max_new_tokens=4))
+        check(boot4["prefix_store_loaded"] == 0 and
+              boot4["prefix_store_rejected"] >= 1,
+              "weight-fingerprint mismatch rejected the store")
+        check(len(outs4) == len(waves[0]),
+              "mismatched-store engine still serves (clean cold start)")
+
+
 def _cfg(model):
     return model.config
 
 
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
           "shed": drill_shed, "quant": drill_quant,
-          "disagg": drill_disagg}
+          "disagg": drill_disagg, "warmstore": drill_warmstore}
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--drill", default="all",
                     choices=["kill", "hang", "drain", "shed", "quant",
-                             "disagg", "all"])
+                             "disagg", "warmstore", "all"])
     ap.add_argument("--fleet", type=int, default=3)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
@@ -612,7 +757,8 @@ def main(argv=None):
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_serve.")
     print(f"[chaos] serving fleet drill, scratch: {out_root}, "
           f"fleet={args.fleet}")
-    drills = (["kill", "hang", "drain", "shed", "quant", "disagg"]
+    drills = (["kill", "hang", "drain", "shed", "quant", "disagg",
+               "warmstore"]
               if args.drill == "all" else [args.drill])
     model = None
     for name in drills:

@@ -1,0 +1,465 @@
+"""KV-cache tiering + persistent prefix store tests (ISSUE 16): host-RAM
+tier round-trips (fp32 + int8), spilled shared blocks keeping refcounts
+and chain identity across demotion/revival, tier-pressure LRU ordering,
+bit-exact revival vs a never-evicted reference, the ``serve.kv_spill``
+degrade path, and the crash-safe ``*.pdstream`` prefix store
+(save/load/corrupt/fingerprint-mismatch, warm engine restarts, the
+``serve.store_write`` injection window)."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (
+    BlockAllocator, HostKVTier, LLMEngine, PagedKVCache, PrefixCache,
+    PrefixStoreMismatch, SamplingParams, load_prefix_store, pool_geometry,
+    save_prefix_store, weights_fingerprint,
+)
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.utils import fault_injection as fi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg():
+    from paddle_tpu.models import llama_tiny
+
+    return llama_tiny()
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(7)
+    m = LlamaForCausalLM(tiny_cfg())
+    m.eval()
+    return m
+
+
+def shared_prompts(cfg, prefix_len, suffix_lens, seed=0):
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, cfg.vocab_size, prefix_len).astype(np.int32)
+    return [np.concatenate([prefix, rng.randint(
+        0, cfg.vocab_size, s).astype(np.int32)]) for s in suffix_lens]
+
+
+def unique_prompts(cfg, lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, n).astype(np.int32)
+            for n in lengths]
+
+
+def _pool(num_blocks=8, block_size=4, kv_dtype=None, fill_seed=None):
+    """A PagedKVCache (+ its allocator + a PrefixCache) with optionally
+    deterministic non-zero pool content, so exported pages are
+    distinguishable from the zero-initialized pool."""
+    import jax.numpy as jnp
+
+    cache = PagedKVCache(tiny_cfg(), num_blocks, block_size,
+                         kv_dtype=kv_dtype)
+    prefix = PrefixCache(cache.allocator, block_size)
+    if fill_seed is not None:
+        rng = np.random.RandomState(fill_seed)
+        def fill(pools, scale=1.0):
+            return [jnp.asarray(
+                (rng.standard_normal(np.shape(p)) * scale).astype(
+                    np.asarray(p).dtype)) for p in pools]
+        cache.k = fill(cache.k, 20.0 if kv_dtype == "int8" else 1.0)
+        cache.v = fill(cache.v, 20.0 if kv_dtype == "int8" else 1.0)
+        if cache.quantized:
+            cache.k_scale = fill(cache.k_scale)
+            cache.v_scale = fill(cache.v_scale)
+    return cache, prefix
+
+
+# ---------------------------------------------------------------------------
+# host tier unit behavior
+# ---------------------------------------------------------------------------
+
+class TestHostKVTier:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_spill_pop_round_trip(self, kv_dtype):
+        # a spilled block's payload must round-trip bit-exactly through
+        # host RAM — including the int8 code + scale sidecar layout
+        cache, prefix = _pool(kv_dtype=kv_dtype, fill_seed=3)
+        tier = HostKVTier(cache, 16, async_transfer=False)
+        want = cache.export_request_pages([2, 5], 2 * cache.block_size)
+        tier.spill_blocks([(2, b"h" * 20), (5, b"g" * 20)])
+        got = tier.pop_prefix(b"h" * 20)
+        for key in ("k", "v") + (("k_scale", "v_scale")
+                                 if kv_dtype == "int8" else ()):
+            np.testing.assert_array_equal(got[key], want[key][:, :1])
+        got2 = tier.pop_prefix(b"g" * 20)
+        np.testing.assert_array_equal(got2["k"], want["k"][:, 1:2])
+        assert tier.pop_prefix(b"h" * 20) is None  # pop removes
+        tier.close()
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_import_round_trip_restores_pool(self, kv_dtype):
+        # spill from one pool, import into ANOTHER (zeroed) pool: the
+        # destination blocks must hold the source bytes exactly
+        src, _ = _pool(kv_dtype=kv_dtype, fill_seed=11)
+        dst, _ = _pool(kv_dtype=kv_dtype)
+        tier = HostKVTier(src, 16, async_transfer=False)
+        tier.spill_blocks([(3, b"x" * 20)])
+        pages = tier.pop_prefix(b"x" * 20)
+        dst.import_request_pages([6], pages)
+        got = dst.export_request_pages([6], dst.block_size)
+        want = src.export_request_pages([3], src.block_size)
+        for key in ("k", "v") + (("k_scale", "v_scale")
+                                 if kv_dtype == "int8" else ()):
+            np.testing.assert_array_equal(got[key], want[key])
+        tier.close()
+
+    def test_lru_eviction_order_under_pressure(self):
+        # budget of 2 blocks, three single-block spills: the OLDEST
+        # unreferenced entry is evicted; a has_prefix touch refreshes LRU
+        cache, _ = _pool(fill_seed=1)
+        tier = HostKVTier(cache, 2, async_transfer=False)
+        before = obs_metrics.REGISTRY.get(
+            "serving_kv_host_evictions_total")
+        base = before.value(instance=None) if before else 0.0
+        tier.spill_blocks([(1, b"a" * 20)])
+        tier.spill_blocks([(2, b"b" * 20)])
+        assert tier.has_prefix(b"a" * 20)       # touch: a becomes MRU
+        tier.spill_blocks([(3, b"c" * 20)])     # evicts b, NOT a
+        assert tier.has_prefix(b"a" * 20)
+        assert not tier.has_prefix(b"b" * 20)
+        assert tier.has_prefix(b"c" * 20)
+        assert tier.host_blocks_in_use == 2
+        after = obs_metrics.REGISTRY.get(
+            "serving_kv_host_evictions_total").value(instance=None)
+        assert after >= base + 1
+        tier.close()
+
+    def test_oversized_entry_rejected_whole(self):
+        cache, _ = _pool(fill_seed=2)
+        tier = HostKVTier(cache, 1, async_transfer=False)
+        ok = tier.spill_request(0, [1, 2, 3], 3 * cache.block_size)
+        assert not ok                       # 3 blocks > 1-block budget
+        assert tier.host_blocks_in_use == 0
+        tier.close()
+
+    def test_kv_spill_fault_site_degrades_to_no_spill(self):
+        # an armed serve.kv_spill site makes spills fail CLOSED: nothing
+        # lands in the tier, the caller proceeds as if tierless
+        cache, _ = _pool(fill_seed=4)
+        tier = HostKVTier(cache, 16, async_transfer=False)
+        with fi.inject("serve.kv_spill") as inj:
+            tier.spill_blocks([(1, b"a" * 20)])
+            assert not tier.spill_request(7, [2], cache.block_size)
+        assert inj.fires == 2
+        assert not tier.has_prefix(b"a" * 20)
+        assert tier.peek_request(7) is None
+        assert tier.host_blocks_in_use == 0
+        tier.close()
+
+
+# ---------------------------------------------------------------------------
+# spilled shared blocks: refcounts + chain identity across demote/revive
+# ---------------------------------------------------------------------------
+
+class TestSharedBlockIdentity:
+    def test_spill_preserves_chain_and_refcounts_on_revival(self):
+        cache, prefix = _pool(num_blocks=6, block_size=4, fill_seed=9)
+        alloc = cache.allocator
+        tier = HostKVTier(cache, 16, async_transfer=False)
+        prefix.on_spill = tier.spill_blocks
+
+        # 9 tokens: two FULL registrable blocks plus the one position
+        # the proper-prefix match cap always leaves to prefill
+        tokens = np.arange(1, 10, dtype=np.int32)
+        blocks = alloc.allocate(2)
+        prefix.register(tokens, blocks, 8)
+        chain_hashes = [prefix._block_hash[b] for b in blocks]
+        payload_before = cache.export_request_pages(blocks, 8)
+        alloc.free(blocks)                  # refcount 0 -> reusable park
+
+        # exhaust the pool: the reclaim wave demotes BOTH registered
+        # blocks to the tier under their chain hashes in one batch
+        grabbed = alloc.allocate(alloc.num_free)
+        for h in chain_hashes:
+            assert tier.has_prefix(h)
+        dev_blocks, covered, host = prefix.match_with_tier(tokens, tier)
+        assert dev_blocks == [] and covered == 0
+        assert host == chain_hashes          # identity survived demotion
+
+        # revive: fresh blocks, imported payload, adopt under the SAME
+        # hashes — then a second sharer acquires them
+        alloc.free(grabbed[:2])
+        revived = alloc.allocate(2)
+        for nb, h in zip(revived, host):
+            pages = tier.pop_prefix(h)
+            cache.import_request_pages([nb], pages)
+            prefix.adopt(nb, h)
+        dev2, cov2, host2 = prefix.match_with_tier(tokens, tier)
+        assert dev2 == revived and cov2 == 8 and host2 == []
+        alloc.acquire(revived)   # a second sharer joins the reviver
+        assert all(alloc.ref(b) == 2 for b in revived)
+        payload_after = cache.export_request_pages(revived, 8)
+        np.testing.assert_array_equal(payload_before["k"],
+                                      payload_after["k"])
+        np.testing.assert_array_equal(payload_before["v"],
+                                      payload_after["v"])
+        tier.close()
+
+
+# ---------------------------------------------------------------------------
+# engine-level: revival is bit-exact vs a never-evicted reference
+# ---------------------------------------------------------------------------
+
+def _waves(cfg, seed=21):
+    """Two shared-prefix waves separated by a long unique 'flusher'
+    prompt that forces the small pool to reclaim the wave-1 prefix
+    blocks; wave 2 then revives them from the host tier."""
+    wave1 = shared_prompts(cfg, 12, [4, 6, 5], seed=seed)
+    flusher = unique_prompts(cfg, [40], seed=seed + 1)
+    wave2 = shared_prompts(cfg, 12, [3, 7], seed=seed)
+    return [wave1, flusher, wave2]
+
+
+class TestTieredEngineBitExact:
+    def _run(self, model, waves, n_new=6, **kw):
+        outs, em = [], None
+        with LLMEngine(model, block_size=4, max_batch_size=3,
+                       enable_prefix_cache=True, **kw) as eng:
+            for wave in waves:
+                outs.extend(eng.generate(
+                    wave, SamplingParams(max_new_tokens=n_new)))
+            em = eng.metrics()
+        return outs, em
+
+    def test_prefix_revival_bit_exact_vs_never_evicted(self, model):
+        waves = _waves(model.config)
+        # reference arm: pool big enough that nothing is ever reclaimed
+        refs, rm = self._run(model, waves, num_blocks=96)
+        assert rm["kv_spills"] == 0
+        got, em = self._run(model, waves, num_blocks=14, kv_host_blocks=64)
+        assert em["kv_spills"] > 0, "pool pressure never spilled"
+        assert em["kv_revives"] > 0, "no revisit revived from host"
+        assert em["kv_spill_bytes"] > 0 and em["kv_revive_bytes"] > 0
+        assert em["kv_host_evictions"] == 0  # budget was ample
+        for a, b in zip(got, refs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_prefix_revival_bit_exact_int8(self, model):
+        # int8-KV variant: its own int8 never-evicted reference (int8 vs
+        # fp32 ids may legitimately differ; int8 arms must agree)
+        waves = _waves(model.config, seed=33)
+        refs, _ = self._run(model, waves, num_blocks=96, kv_dtype="int8")
+        got, em = self._run(model, waves, num_blocks=14, kv_host_blocks=64,
+                            kv_dtype="int8")
+        assert em["kv_spills"] > 0 and em["kv_revives"] > 0
+        for a, b in zip(got, refs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_preempted_request_revived_without_reprefill(self, model):
+        # decode-pressure eviction: the victim's pages spill to host and
+        # re-admission imports them instead of re-prefilling
+        cfg = model.config
+        prompts = unique_prompts(cfg, [8, 8, 8], seed=5)
+        refs = [model.generate(paddle.to_tensor(p[None]),
+                               max_new_tokens=20).numpy()[0]
+                for p in prompts]
+        with LLMEngine(model, num_blocks=5, block_size=8, max_batch_size=2,
+                       kv_host_blocks=32) as eng:
+            outs = eng.generate(prompts, SamplingParams(max_new_tokens=20))
+            em = eng.metrics()
+            stats = eng.stats()
+        assert stats["evictions"] >= 1
+        assert em["kv_spills"] >= 1 and em["kv_revives"] >= 1
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+
+    def test_kv_spill_injection_degrades_to_recompute(self, model):
+        # with serve.kv_spill armed the tier never receives pages —
+        # behavior must degrade to plain recompute-eviction, bit-exact
+        waves = _waves(model.config, seed=44)
+        refs, _ = self._run(model, waves, num_blocks=96)
+        with fi.inject("serve.kv_spill"):
+            got, em = self._run(model, waves, num_blocks=14,
+                                kv_host_blocks=64)
+        assert em["kv_spills"] == 0 and em["kv_revives"] == 0
+        for a, b in zip(got, refs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_tier_metric_names_registered(self, model):
+        # the telemetry contract: every ISSUE-16 series name is live in
+        # the registry once an engine with a tier has run
+        waves = _waves(model.config, seed=55)
+        self._run(model, waves, num_blocks=14, kv_host_blocks=64)
+        for name in ("serving_kv_spills_total", "serving_kv_revives_total",
+                     "serving_kv_spill_bytes_total",
+                     "serving_kv_revive_bytes_total",
+                     "serving_kv_host_evictions_total",
+                     "serving_kv_host_blocks",
+                     "serving_kv_spill_ms", "serving_kv_revive_ms",
+                     "serving_prefix_store_saved_total",
+                     "serving_prefix_store_loaded_total",
+                     "serving_prefix_store_rejected_total"):
+            assert obs_metrics.REGISTRY.get(name) is not None, name
+
+
+# ---------------------------------------------------------------------------
+# persistent prefix store
+# ---------------------------------------------------------------------------
+
+class TestPrefixStore:
+    def _entries(self, kv_dtype=None, n=3, seed=17):
+        cache, _ = _pool(kv_dtype=kv_dtype, fill_seed=seed)
+        return [(bytes([i]) * 20,
+                 cache.export_request_pages([i + 1], cache.block_size))
+                for i in range(n)]
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_save_load_round_trip(self, tmp_path, kv_dtype):
+        path = str(tmp_path / "prefix.pdstream")
+        entries = self._entries(kv_dtype)
+        n = save_prefix_store(path, entries, fingerprint="fp",
+                              geometry={"block_size": 4})
+        assert n == len(entries)
+        got = load_prefix_store(path, fingerprint="fp",
+                                geometry={"block_size": 4})
+        assert [h for h, _ in got] == [h for h, _ in entries]
+        for (_, a), (_, b) in zip(got, entries):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+
+    def test_missing_store_is_a_clean_first_boot(self, tmp_path):
+        assert load_prefix_store(str(tmp_path / "none.pdstream"),
+                                 fingerprint="fp", geometry={}) is None
+
+    def test_corrupt_store_rejected_whole(self, tmp_path):
+        path = str(tmp_path / "prefix.pdstream")
+        save_prefix_store(path, self._entries(), fingerprint="fp",
+                          geometry={})
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        rej = obs_metrics.REGISTRY.get(
+            "serving_prefix_store_rejected_total").value(instance=None)
+        with pytest.raises(PrefixStoreMismatch):
+            load_prefix_store(path, fingerprint="fp", geometry={})
+        assert obs_metrics.REGISTRY.get(
+            "serving_prefix_store_rejected_total").value(
+                instance=None) >= rej + 1
+
+    def test_fingerprint_and_geometry_gates(self, tmp_path):
+        path = str(tmp_path / "prefix.pdstream")
+        save_prefix_store(path, self._entries(), fingerprint="fp",
+                          geometry={"block_size": 4})
+        with pytest.raises(PrefixStoreMismatch):
+            load_prefix_store(path, fingerprint="OTHER",
+                              geometry={"block_size": 4})
+        with pytest.raises(PrefixStoreMismatch):
+            load_prefix_store(path, fingerprint="fp",
+                              geometry={"block_size": 8})
+
+    def test_store_write_failure_preserves_previous_store(self, tmp_path):
+        # the serve.store_write site sits between tmp-file payload and
+        # atomic rename: a failure there never publishes a torn store
+        path = str(tmp_path / "prefix.pdstream")
+        save_prefix_store(path, self._entries(n=2), fingerprint="fp",
+                          geometry={})
+        before = open(path, "rb").read()
+        with fi.inject("serve.store_write") as inj:
+            with pytest.raises(OSError):
+                save_prefix_store(path, self._entries(n=3),
+                                  fingerprint="fp", geometry={})
+        assert inj.fires == 1
+        assert open(path, "rb").read() == before
+        assert load_prefix_store(path, fingerprint="fp",
+                                 geometry={}) is not None
+
+    def test_weights_fingerprint_tracks_weights(self, model):
+        import copy
+
+        fp1 = weights_fingerprint(model)
+        assert fp1 == weights_fingerprint(model)  # deterministic
+        m2 = copy.deepcopy(model)
+        name, val = next(iter(m2.state_dict().items()))
+        val.set_value(val.numpy() + 1.0)
+        assert weights_fingerprint(m2) != fp1
+
+
+class TestWarmRestart:
+    def test_engine_warm_restart_bit_exact(self, model, tmp_path):
+        path = str(tmp_path / "prefix.pdstream")
+        waves = _waves(model.config, seed=66)
+        kw = dict(num_blocks=14, block_size=4, max_batch_size=3,
+                  enable_prefix_cache=True, kv_host_blocks=64,
+                  prefix_store_path=path)
+        # cold boot: serve, then close() publishes the store
+        with LLMEngine(model, **kw) as eng:
+            cold = [o for w in waves for o in eng.generate(
+                w, SamplingParams(max_new_tokens=6))]
+        assert os.path.exists(path)
+        # warm boot: chains land in the tier and the same stream
+        # revives them instead of re-prefilling — outputs identical
+        with LLMEngine(model, **kw) as eng:
+            em0 = eng.metrics()
+            assert em0["prefix_store_loaded"] > 0
+            warm = [o for w in waves for o in eng.generate(
+                w, SamplingParams(max_new_tokens=6))]
+            em = eng.metrics()
+        assert em["kv_revives"] > 0
+        for a, b in zip(warm, cold):
+            np.testing.assert_array_equal(a, b)
+
+    def test_store_save_failure_at_close_is_contained(self, model,
+                                                      tmp_path):
+        path = str(tmp_path / "prefix.pdstream")
+        waves = _waves(model.config, seed=77)
+        kw = dict(num_blocks=14, block_size=4, max_batch_size=3,
+                  enable_prefix_cache=True, kv_host_blocks=64,
+                  prefix_store_path=path)
+        with fi.inject("serve.store_write"):
+            with pytest.warns(RuntimeWarning):
+                with LLMEngine(model, **kw) as eng:
+                    eng.generate(waves[0],
+                                 SamplingParams(max_new_tokens=4))
+        assert not os.path.exists(path)  # nothing torn was published
+
+    def test_reload_weights_with_new_fingerprint_cold_starts(
+            self, model, tmp_path):
+        import copy
+
+        from paddle_tpu.inference.serving import save_llama_artifact
+
+        path = str(tmp_path / "prefix.pdstream")
+        waves = _waves(model.config, seed=88)
+        kw = dict(num_blocks=14, block_size=4, max_batch_size=3,
+                  enable_prefix_cache=True, kv_host_blocks=64,
+                  prefix_store_path=path)
+        with LLMEngine(model, **kw) as eng:
+            for w in waves:
+                eng.generate(w, SamplingParams(max_new_tokens=4))
+        m2 = copy.deepcopy(model)
+        sd = m2.state_dict()
+        name, val = next(iter(sd.items()))
+        val.set_value(val.numpy() + 0.25)
+        art = str(tmp_path / "model2")
+        save_llama_artifact(m2, art)
+        # reload under the ORIGINAL model: new fingerprint, stale store
+        m3 = copy.deepcopy(model)
+        with LLMEngine(m3, **kw) as eng:
+            assert eng.metrics()["prefix_store_loaded"] > 0
+            eng.reload_weights(art)
+            # old-fingerprint pages were dropped (the on-disk store no
+            # longer matches the new fingerprint) — no stale chains
+            # survive in the host tier
+            assert eng.kv_tier.host_blocks_in_use == 0
+            assert len(eng.prefix_cache) == 0
+
+    def test_store_requires_prefix_cache_and_tier(self, model, tmp_path):
+        path = str(tmp_path / "prefix.pdstream")
+        with pytest.raises(ValueError):
+            LLMEngine(model, enable_prefix_cache=True,
+                      prefix_store_path=path)  # no tier
+        with pytest.raises(ValueError):
+            LLMEngine(model, kv_host_blocks=8,
+                      prefix_store_path=path)  # no prefix cache
