@@ -845,6 +845,40 @@ def bench_serving(on_tpu):
                          "metrics reset); outputs bit-exact vs the "
                          "in-process CPU engine",
     })
+    # multi-tenant QoS A/B (ISSUE 17): the SAME interactive stream runs
+    # uncontended and under a batch-tier flood + abuser burst on one
+    # engine with tenants configured. The tracked line is the contended
+    # latency-tier p99 TTFT; the uncontended reference, the ratio and
+    # the abuser's quota-paced throughput ride the line as fields, and
+    # interactive outputs must be bit-exact across arms (QoS changes
+    # WHEN work runs, never WHICH tokens).
+    qs = bsv.run_qos_ab(tiny=not on_tpu)
+    assert qs["bit_exact"], \
+        "contended interactive outputs diverged from the uncontended run"
+    _emit({
+        "metric": "serving_qos_lat_ttft_p99_ms" if on_tpu
+                  else "serving_cpu_qos_lat_ttft_p99_ms",
+        "value": qs["contended"]["lat_ttft_p99_ms"], "unit": "ms",
+        "vs_baseline": None,
+        "lat_ttft_p99_ms_uncontended":
+            qs["uncontended"]["lat_ttft_p99_ms"],
+        "lat_ttft_p99_ratio": qs["lat_ttft_p99_ratio"],
+        "abuser_tokens_per_sec":
+            qs["contended"]["abuser_tokens_per_sec"],
+        "abuser_quota_tokens_per_sec":
+            qs["contended"]["abuser_quota_tokens_per_sec"],
+        "quota_throttled": qs["contended"]["quota_throttled"],
+        "batch_yields": qs["contended"]["batch_yields"],
+        "tenant_tokens": qs["contended"]["tenant_tokens"],
+        "bit_exact": qs["bit_exact"],
+        "num_requests": qs["num_requests"],
+        "baseline_note": "one warmed engine, tenants configured "
+                         "(interactive w=4, batch tier, abuser behind a "
+                         "token-rate bucket); latency-tier TTFT is "
+                         "bench-timed per tenant (the engine histogram "
+                         "deliberately carries no tenant label); "
+                         "interactive outputs bit-exact across arms",
+    })
 
 
 def make_llama(on_tpu):

@@ -9,8 +9,9 @@ and the ``LLMEngine`` front-end (``engine``). See DESIGN_DECISIONS.md
 """
 
 from .errors import (  # noqa: F401
-    EngineClosedError, FleetOverloadedError, KVTransferError,
-    ReplicaCrashLoopError, RequestTimeoutError,
+    DeadlineInfeasibleError, EngineClosedError, FleetOverloadedError,
+    KVTransferError, ReplicaCrashLoopError, RequestTimeoutError,
+    TenantQuotaExceededError,
 )
 from .kv_cache import (  # noqa: F401
     BlockAllocator, HostKVTier, KV_QMAX, PagedKVCache, PageSnapshot,
@@ -21,7 +22,10 @@ from .prefix_store import (  # noqa: F401
     PrefixStoreMismatch, load_prefix_store, pool_geometry,
     save_prefix_store, weights_fingerprint,
 )
-from .scheduler import Request, SamplingParams, Scheduler  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Request, SamplingParams, Scheduler, TenantQuota, TIER_BATCH,
+    TIER_LATENCY,
+)
 from .paged_attention import (  # noqa: F401
     paged_decode_attention, paged_multiquery_attention,
 )
@@ -46,4 +50,6 @@ __all__ = [
     "load_prefix_store",
     "fleet", "RequestTimeoutError", "FleetOverloadedError",
     "EngineClosedError", "ReplicaCrashLoopError", "KVTransferError",
+    "TenantQuota", "TIER_LATENCY", "TIER_BATCH",
+    "TenantQuotaExceededError", "DeadlineInfeasibleError",
 ]

@@ -64,8 +64,9 @@ from .prefix_store import (PrefixStoreMismatch, load_prefix_store,
                            weights_fingerprint, _M_STORE_LOADED,
                            _M_STORE_REJECTED, _M_STORE_SAVED)
 from .scheduler import (Request, SamplingParams, Scheduler,
-                        _M_ADMITTED, _M_COW, _M_EVICTIONS, _M_FINISHED,
-                        _M_PREFIX_REUSED, _M_QUEUED_EXH)
+                        _M_ADMITTED, _M_BATCH_YIELD, _M_COW, _M_EVICTIONS,
+                        _M_FINISHED, _M_PREFIX_REUSED, _M_QUEUED_EXH,
+                        _M_TENANT_TOKENS, _M_THROTTLED)
 
 __all__ = ["LLMEngine", "StepOutput", "save_llama_artifact",
            "load_llama_artifact", "load_llama_state_dict",
@@ -139,7 +140,11 @@ _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
                     _M_SPILLS, _M_REVIVES, _M_SPILL_BYTES, _M_REVIVE_BYTES,
                     _M_HOST_EVICT, _G_HOST_BLOCKS, _H_SPILL_MS,
                     _H_REVIVE_MS, _M_STORE_SAVED, _M_STORE_LOADED,
-                    _M_STORE_REJECTED)
+                    _M_STORE_REJECTED,
+                    # multi-tenant QoS (ISSUE 17); _M_TENANT_TOKENS is
+                    # tenant-labeled, so metrics()/reset_metrics() handle
+                    # it separately (exact-match remove can't reach it)
+                    _M_THROTTLED, _M_BATCH_YIELD)
 
 
 @dataclasses.dataclass
@@ -552,7 +557,7 @@ class LLMEngine:
         req._staged = (jax.device_put(ids), bucket, len(toks))
 
     def add_request(self, prompt_ids, sampling: SamplingParams | None = None,
-                    arrival_t=None, deadline=None):
+                    arrival_t=None, deadline=None, tenant=None, tier=None):
         """Enqueue a prompt; returns the request id. Never blocks on pool
         exhaustion — the request queues until blocks free up.
 
@@ -562,7 +567,10 @@ class LLMEngine:
         registered, staged, or any allocator/scheduler state moves — and
         a deadline expiring later aborts the request at the next step
         (blocks freed, slot recycled, stream finished with reason
-        ``"timeout"``)."""
+        ``"timeout"``).
+
+        ``tenant``/``tier`` (ISSUE 17) attach a QoS identity — defaults
+        (``"default"``/latency) keep the exact pre-QoS FIFO behavior."""
         self._ensure_open()
         if deadline is not None and time.time() >= float(deadline):
             raise RequestTimeoutError(
@@ -570,7 +578,7 @@ class LLMEngine:
                 f"(now={time.time():.3f}); request rejected before any "
                 "block allocation", deadline=deadline)
         req = Request(prompt_ids, sampling, arrival_t=arrival_t,
-                      deadline=deadline)
+                      deadline=deadline, tenant=tenant, tier=tier)
         self._check_admissible(req)
         # observability clock zero: TTFT and the queued span both measure
         # from the moment the engine accepted the request
@@ -582,6 +590,35 @@ class LLMEngine:
             self._stage_request(req)
             self.scheduler.waiting.append(req)
         return req.rid
+
+    def configure_tenant(self, name, *, weight=1.0, rate_tokens_per_s=None,
+                         window_s=1.0, host_blocks=None,
+                         prefix_blocks=None):
+        """Declare one tenant's QoS envelope (ISSUE 17) in one call:
+        fair-share ``weight`` and leaky-bucket token-rate quota land in
+        the scheduler, ``host_blocks`` caps its resident host-tier pages
+        (requires a KV tier), and ``prefix_blocks`` caps how many
+        device-pool prefix blocks it may keep published (over-share
+        demotes its own oldest to the host tier, never other tenants').
+        Unconfigured tenants serve at weight 1 with no quota — QoS stays
+        fully off until the first call."""
+        self._ensure_open()
+        st = self.scheduler.configure_tenant(
+            name, weight=weight, rate_tokens_per_s=rate_tokens_per_s,
+            window_s=window_s)
+        if host_blocks is not None:
+            if self.kv_tier is None:
+                raise ValueError(
+                    "host_blocks needs a host tier; construct the engine "
+                    "with kv_host_blocks=")
+            self.kv_tier.set_tenant_share(name, host_blocks)
+        if prefix_blocks is not None:
+            if self.prefix_cache is None:
+                raise ValueError(
+                    "prefix_blocks needs prefix sharing; construct the "
+                    "engine with enable_prefix_cache=True")
+            self.prefix_cache.set_tenant_share(name, prefix_blocks)
+        return st
 
     def _check_admissible(self, req):
         """Admission validation shared by ``add_request`` and
@@ -637,7 +674,7 @@ class LLMEngine:
 
     def add_request_with_pages(self, prompt_ids, pages,
                                sampling: SamplingParams | None = None,
-                               deadline=None):
+                               deadline=None, tenant=None, tier=None):
         """Admit a request whose prompt KV pages were computed by a
         prefill worker (the decode side of the disaggregated handoff):
         ``prompt_ids`` is the original prompt PLUS the first token the
@@ -662,7 +699,8 @@ class LLMEngine:
                 f"deadline {deadline} already expired at admission "
                 f"(now={time.time():.3f}); imported pages rejected before "
                 "any block allocation", deadline=deadline)
-        req = Request(prompt_ids, sampling, deadline=deadline)
+        req = Request(prompt_ids, sampling, deadline=deadline,
+                      tenant=tenant, tier=tier)
         covered = int(pages["covered"])
         if covered != len(req.prompt) - 1:
             raise ValueError(
@@ -713,7 +751,7 @@ class LLMEngine:
             # sound because imported pages are byte-identical to local
             # prefill output (per-row quantization is pure)
             self.prefix_cache.register(req.tokens, req.blocks,
-                                       req.num_cached)
+                                       req.num_cached, tenant=req.tenant)
         req.t_decode_start = time.perf_counter_ns()
         _obs_trace.add_complete(
             "request.import", getattr(req, "_t_admit", req.t_queue_start),
@@ -1355,12 +1393,15 @@ class LLMEngine:
             req.draft_cached = start + take
         req.num_cached = start + take
         _M_PREFILL_CHUNKS.inc(instance=self._name)
+        # QoS accounting (ISSUE 17): prefill work charges the tenant's
+        # quota/vtime as it is SERVED, chunk by chunk
+        self.scheduler.note_served(req, take)
         if self.prefix_cache is not None:
             # publish the identity of every full block now materialized so
             # later admissions (and this request's own re-prefill after an
             # eviction) can share them
             self.prefix_cache.register(req.tokens, req.blocks,
-                                       req.num_cached)
+                                       req.num_cached, tenant=req.tenant)
         if req.num_cached >= req.prefill_upto:
             req.prefilling = False
             self.stats_extra["prefills"] += 1
@@ -1482,8 +1523,15 @@ class LLMEngine:
         spans = {}  # rid -> (req, [(block, h, pages), ...])
         dead = set()  # rids whose chain broke mid-revive
         for req, block, h in sched.pending_revive:
+            if req.finished:
+                # aborted between match and drain (deadline expiry): its
+                # blocks are already freed, so indexing them would throw.
+                # ``Scheduler.abort`` purges these entries and their tier
+                # pins itself; this is belt-and-braces for direct aborts.
+                self.kv_tier.pop_prefix(h)
+                continue
             idx = req.blocks.index(block)
-            if req.rid in dead or req.finished:
+            if req.rid in dead:
                 req.num_cached = min(req.num_cached, idx * self.block_size)
                 self.kv_tier.pop_prefix(h)  # unreachable behind the hole
                 continue
@@ -1507,7 +1555,7 @@ class LLMEngine:
                             [p[key] for _, _, p in parts], axis=1)
             self.cache.import_request_pages(blocks, merged)
             for b, h, _ in parts:
-                self.prefix_cache.adopt(b, h)
+                self.prefix_cache.adopt(b, h, tenant=req.tenant)
             nbytes = sum(int(v.nbytes) for v in merged.values()
                          if isinstance(v, np.ndarray))
             _M_REVIVES.inc(len(parts), instance=self._name)
@@ -1689,6 +1737,9 @@ class LLMEngine:
         finish bookkeeping. Returns [StepOutput]."""
         req.output_tokens.append(int(tok))
         self.stats_extra["tokens_out"] += 1
+        # QoS accounting (ISSUE 17): each emitted token charges the
+        # tenant's quota and advances its fair-queueing virtual time
+        self.scheduler.note_served(req, 1)
         # latency observation at the emission point — the host just
         # fetched logits/verify results anyway, so the clock read is free
         now = time.perf_counter_ns()
@@ -1886,7 +1937,33 @@ class LLMEngine:
                 _M_STORE_LOADED.value(instance=inst)),
             "prefix_store_rejected": int(
                 _M_STORE_REJECTED.value(instance=inst)),
+            # multi-tenant QoS (ISSUE 17) — zeros when QoS is unused
+            "quota_throttled": int(_M_THROTTLED.value(instance=inst)),
+            "batch_yields": int(_M_BATCH_YIELD.value(instance=inst)),
+            "tenant_tokens": self._tenant_token_counts(),
         }
+
+    def _remove_tenant_series(self):
+        """Remove THIS instance's tenant-labeled series. The extra
+        ``tenant`` label means the plain ``remove(instance=)`` sweep in
+        ``reset_metrics``/``close`` cannot reach them — iterate the live
+        label sets instead."""
+        for labels in list(_M_TENANT_TOKENS.labels()):
+            d = dict(labels)
+            if d.get("instance") == self._name:
+                _M_TENANT_TOKENS.remove(**d)
+
+    def _tenant_token_counts(self):
+        """Per-tenant served-token counts for THIS instance — iterated
+        from live label sets because the ``tenant`` label is only known
+        at serve time, not declaration time."""
+        out = {}
+        for labels in _M_TENANT_TOKENS.labels():
+            d = dict(labels)
+            if d.get("instance") == self._name:
+                out[d.get("tenant", "default")] = int(
+                    _M_TENANT_TOKENS.value(**d))
+        return out
 
     def reset_metrics(self):
         """Drop THIS instance's registry series (latency histograms and
@@ -1895,6 +1972,7 @@ class LLMEngine:
         the reported percentiles; a production engine has no reason to."""
         for m in _SERVING_METRICS:
             m.remove(instance=self._name)
+        self._remove_tenant_series()
         if self.cache.quantized and not self._closed:
             # bytes saved is a construction-time constant of THIS pool,
             # not window activity — republish it so a benchmark window

@@ -20,6 +20,20 @@ of flapping forever.
   failed deliveries were re-driven (the prefill re-runs elsewhere, never
   decoded-on-garbage) until the budget ran out — the streaming
   ``StreamReadError`` idiom applied to the transfer channel.
+* :class:`TenantQuotaExceededError` — a tenant blew through its
+  token-rate quota at the router (ISSUE 17): hard rejection with a
+  ``retry_after_s`` hint so the abuser backs off instead of hammering.
+* :class:`DeadlineInfeasibleError` — SLO-aware placement (ISSUE 17)
+  determined the deadline cannot be met (estimated queue wait + prefill
+  cost exceed the remaining budget); subclasses
+  :class:`RequestTimeoutError` so existing expiry handling catches it,
+  but fires BEFORE any work is admitted.
+
+Backoff contract (ISSUE 17): every load-rejection error
+(:class:`FleetOverloadedError`, :class:`TenantQuotaExceededError`,
+:class:`DeadlineInfeasibleError`) carries a machine-readable
+``retry_after_s`` estimated from the current queue drain rate, so
+clients retry politely instead of contributing to the overload.
 """
 
 from __future__ import annotations
@@ -28,7 +42,8 @@ from ...distributed.launch.controllers.collective import CrashLoopError
 
 __all__ = ["RequestTimeoutError", "FleetOverloadedError",
            "EngineClosedError", "ReplicaCrashLoopError",
-           "KVTransferError"]
+           "KVTransferError", "TenantQuotaExceededError",
+           "DeadlineInfeasibleError"]
 
 
 class RequestTimeoutError(TimeoutError):
@@ -46,11 +61,41 @@ class FleetOverloadedError(RuntimeError):
     """The fleet's bounded admission queue is full — the request was shed
     at submit time (load shedding: a typed error now beats an unbounded
     queue that times everyone out later). ``queue_depth`` records the
-    bound that was hit."""
+    bound that was hit; ``retry_after_s`` estimates when capacity should
+    free up (from the queue drain rate), or None when unknown."""
 
-    def __init__(self, msg, queue_depth=None):
+    def __init__(self, msg, queue_depth=None, retry_after_s=None):
         super().__init__(msg)
         self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+
+
+class TenantQuotaExceededError(RuntimeError):
+    """One tenant exhausted its token-rate quota (ISSUE 17) — the
+    request was rejected at submit so the quota bounds the ABUSER's
+    throughput, not everyone's. ``tenant`` names the offender;
+    ``retry_after_s`` says when the leaky bucket drains enough to admit
+    again (machine-readable, so well-behaved clients back off)."""
+
+    def __init__(self, msg, tenant=None, retry_after_s=None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineInfeasibleError(RequestTimeoutError):
+    """SLO-aware placement rejection (ISSUE 17): the estimated queue
+    wait plus prefill cost already exceed the request's remaining
+    deadline budget, so admitting it would only burn decode slots on
+    work guaranteed to expire mid-stream. Subclasses
+    :class:`RequestTimeoutError` — callers that handle expiry handle
+    this too — but is raised BEFORE any allocator state moves.
+    ``retry_after_s`` estimates when the queue drains enough for the
+    same deadline budget to become feasible."""
+
+    def __init__(self, msg, rid=None, deadline=None, retry_after_s=None):
+        super().__init__(msg, rid=rid, deadline=deadline)
+        self.retry_after_s = retry_after_s
 
 
 class EngineClosedError(RuntimeError):

@@ -245,7 +245,8 @@ def replica_worker_main():
                     np.asarray(cmd["prompt"], np.int32),
                     SamplingParams(max_new_tokens=int(cmd["max_new"]),
                                    eos_token_id=cmd.get("eos")),
-                    deadline=cmd.get("deadline"))
+                    deadline=cmd.get("deadline"),
+                    tenant=cmd.get("tenant"), tier=cmd.get("tier"))
             except RequestTimeoutError:
                 _emit({"e": "tok", "gid": gid, "gen": cmd.get("gen", 0),
                        "toks": [], "fin": True, "reason": "timeout"})
@@ -266,7 +267,8 @@ def replica_worker_main():
                     np.asarray(cmd["prompt"], np.int32),
                     SamplingParams(max_new_tokens=int(cmd["max_new"]),
                                    eos_token_id=cmd.get("eos")),
-                    deadline=cmd.get("deadline"))
+                    deadline=cmd.get("deadline"),
+                    tenant=cmd.get("tenant"), tier=cmd.get("tier"))
             except RequestTimeoutError:
                 _emit({"e": "kvdone", "gid": gid,
                        "hid": cmd.get("hid", 0), "first_tok": None,
@@ -318,7 +320,8 @@ def replica_worker_main():
                     np.asarray(cmd["prompt"], np.int32), pages,
                     SamplingParams(max_new_tokens=int(cmd["max_new"]),
                                    eos_token_id=cmd.get("eos")),
-                    deadline=cmd.get("deadline"))
+                    deadline=cmd.get("deadline"),
+                    tenant=cmd.get("tenant"), tier=cmd.get("tier"))
             except RequestTimeoutError:
                 # expired between prefill completion and decode
                 # admission: imported pages dropped, typed end
@@ -361,7 +364,26 @@ def replica_worker_main():
                    # the comparison is engine-measured, not bench-timed
                    "itl_p50_ms": m["itl_ms"]["p50"],
                    "itl_p99_ms": m["itl_ms"]["p99"],
-                   "ttft_p99_ms": m["ttft_ms"]["p99"]})
+                   "ttft_p99_ms": m["ttft_ms"]["p99"],
+                   # per-replica QoS counters (ISSUE 17): the qos drill
+                   # and bench sum these fleet-wide to prove batch-tier
+                   # work YIELDED slots rather than being dropped
+                   "quota_throttled": s["quota_throttled"],
+                   "batch_yields": s["batch_yields"]})
+        elif op == "configure_tenant":
+            # QoS envelope push (ISSUE 17): idempotent — the router
+            # re-sends the full set to every new incarnation. Cache
+            # shares only apply where the subsystem exists; a fleet
+            # without tiering/prefix-sharing serves the tenant without
+            # those caps rather than erroring the whole config.
+            eng.configure_tenant(
+                cmd["tenant"], weight=cmd.get("weight", 1.0),
+                rate_tokens_per_s=cmd.get("rate"),
+                window_s=cmd.get("window", 1.0),
+                host_blocks=(cmd.get("host_blocks")
+                             if eng.kv_tier is not None else None),
+                prefix_blocks=(cmd.get("prefix_blocks")
+                               if eng.prefix_cache is not None else None))
         elif op == "reset_metrics":
             # window discipline (bench): warm-phase latency observations
             # must not pollute the timed window's percentiles

@@ -51,6 +51,24 @@ pauses new prefills so the bounded admission queue sheds typed, and a
 fleet with NO healthy prefill worker degrades to colocated prefill on
 the decode side with a one-shot warning.
 
+ISSUE 17 adds the **multi-tenant QoS front door**: requests may carry a
+``tenant=`` identity and a ``tier=`` (latency | batch). A tenant
+declared via :meth:`Router.configure_tenant` gets a hard leaky-bucket
+admission quota at the router (token demand charged at submit; over it,
+a typed :class:`~..errors.TenantQuotaExceededError` with a
+machine-readable ``retry_after_s``) and its weight/quota/cache shares
+are pushed down to every replica engine (re-pushed to respawns and
+autoscaled newcomers), where weighted-fair scheduling paces the served
+tokens. ``slo_admission=True`` arms deadline-feasibility at placement:
+a request whose deadline budget is already smaller than the estimated
+queue wait plus prefill cost is rejected with a typed
+:class:`~..errors.DeadlineInfeasibleError` (plus ``retry_after_s``)
+instead of being admitted to expire mid-decode. ``enable_autoscale``
+turns on the supervisor's autoscale tick inside :meth:`step` — scale-up
+spawns a replica, scale-down rides :meth:`drain` (``then="retire"``)
+so shrinking the fleet drops zero requests. All of it off by default:
+untagged traffic on an unconfigured router behaves exactly as before.
+
 The router is single-threaded by design: all state mutates inside
 :meth:`step` (the pump), mirroring ``LLMEngine.step``. ``submit`` +
 ``join``/``step`` + ``result`` is the whole client API.
@@ -59,6 +77,8 @@ The router is single-threaded by design: all state mutates inside
 from __future__ import annotations
 
 import itertools
+import os
+import signal
 import time
 import warnings
 from collections import deque
@@ -67,8 +87,10 @@ import numpy as np
 
 from ....observability import metrics as _obs_metrics
 from ....utils import fault_injection as _fi
-from ..errors import (EngineClosedError, FleetOverloadedError,
-                      KVTransferError, RequestTimeoutError)
+from ..errors import (DeadlineInfeasibleError, EngineClosedError,
+                      FleetOverloadedError, KVTransferError,
+                      RequestTimeoutError, TenantQuotaExceededError)
+from ..scheduler import TIER_BATCH, TIER_LATENCY, TenantQuota
 from .framing import decode_frame, join_frames
 from .supervisor import ReplicaSupervisor
 
@@ -111,6 +133,16 @@ _M_FAILOVERS = _obs_metrics.counter(
     "handoffs abandoned because a worker died mid-transfer, with the "
     "prefill re-dispatched elsewhere (partial pages discarded "
     "atomically)")
+# multi-tenant QoS front door (ISSUE 17)
+_M_QUOTA_REJECTED = _obs_metrics.counter(
+    "fleet_quota_rejections_total",
+    "requests rejected at submit with TenantQuotaExceededError because "
+    "their tenant's leaky-bucket admission quota was exhausted")
+_M_INFEASIBLE = _obs_metrics.counter(
+    "fleet_deadline_infeasible_total",
+    "requests rejected at submit by the SLO feasibility check "
+    "(estimated queue wait + prefill cost already exceed the deadline "
+    "budget)")
 
 QUEUED, PREFILLING, PLACED, DONE, FAILED = (
     "queued", "prefilling", "placed", "done", "failed")
@@ -151,15 +183,23 @@ class FleetRequest:
     __slots__ = ("gid", "prompt", "max_new", "eos", "deadline", "session",
                  "state", "replica", "generation", "emitted", "error",
                  "finish_reason", "t_submit", "t_first", "t_done",
-                 "redispatches", "hid", "kv_retries", "frames", "pages")
+                 "redispatches", "hid", "kv_retries", "frames", "pages",
+                 "tenant", "tier")
 
-    def __init__(self, gid, prompt, max_new, eos, deadline, session):
+    def __init__(self, gid, prompt, max_new, eos, deadline, session,
+                 tenant=None, tier=None):
         self.gid = gid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.eos = eos
         self.deadline = deadline
         self.session = session
+        self.tenant = str(tenant) if tenant else "default"
+        tier = tier or TIER_LATENCY
+        if tier not in (TIER_LATENCY, TIER_BATCH):
+            raise ValueError(f"unknown tier {tier!r}; expected "
+                             f"{TIER_LATENCY!r} or {TIER_BATCH!r}")
+        self.tier = tier
         self.state = QUEUED
         self.replica = None
         self.generation = 0
@@ -203,7 +243,7 @@ class Router:
                  hang_timeout_s=0.0, max_restarts=3, log_dir=None,
                  env_extra=None, wait_ready=True, roles=None,
                  max_kv_retries=3, max_pending_handoffs=8,
-                 idle_backoff=(0.0005, 0.05)):
+                 idle_backoff=(0.0005, 0.05), slo_admission=False):
         self._name = f"fleet#{next(Router._ids)}"
         engine_kwargs = dict(engine_kwargs or {})
         if supervisor is None:
@@ -252,8 +292,26 @@ class Router:
         self.reloads: list[tuple] = []  # (replica_id, checkpoint step)
         self._gids = itertools.count(1)
         self._closed = False
+        # multi-tenant QoS (ISSUE 17): tenant envelopes declared via
+        # configure_tenant — router-side hard quota + the config pushed
+        # down to every replica incarnation (tracked per (id, inc) so
+        # respawns and autoscaled newcomers get it too)
+        self._tenants: dict[str, dict] = {}
+        self._tenant_quota: dict[str, TenantQuota] = {}
+        self._cfg_sent: set[tuple] = set()
+        # SLO-aware admission: recent completion times feed the queue
+        # drain-rate estimate (retry_after_s hints + feasibility); the
+        # TTFT EMA estimates the prefill cost of a new request
+        self.slo_admission = bool(slo_admission)
+        self._done_times: deque[float] = deque(maxlen=256)
+        self._ttft_ema = None
+        # fleet autoscaling: armed by enable_autoscale, ticked in step()
+        self._autoscale = None
+        self.scale_ups = 0
+        self.scale_downs = 0
         for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _M_KV_PAGES,
-                  _M_KV_RETRIES, _M_HANDOFFS, _M_FAILOVERS):
+                  _M_KV_RETRIES, _M_HANDOFFS, _M_FAILOVERS,
+                  _M_QUOTA_REJECTED, _M_INFEASIBLE):
             m.inc(0, instance=self._name)
         _G_QUEUE.set(0, instance=self._name)
         _G_DRAINING.set(0, instance=self._name)
@@ -262,11 +320,16 @@ class Router:
     # client API
     # ------------------------------------------------------------------
     def submit(self, prompt, *, max_new=32, eos=None, deadline_s=None,
-               session=None):
+               session=None, tenant=None, tier=None):
         """Admit a request; returns its fleet-wide id. Raises
-        :class:`RequestTimeoutError` when the deadline is already spent
-        and :class:`FleetOverloadedError` when the bounded queue is full
-        — in both cases NOTHING was queued or placed."""
+        :class:`RequestTimeoutError` when the deadline is already spent,
+        :class:`TenantQuotaExceededError` when the tenant's admission
+        quota is exhausted, :class:`DeadlineInfeasibleError` when the
+        SLO feasibility check (``slo_admission=True``) says the deadline
+        cannot be met, and :class:`FleetOverloadedError` when the
+        bounded queue is full — in every case NOTHING was queued or
+        placed, and every load rejection carries a machine-readable
+        ``retry_after_s``."""
         if self._closed:
             raise EngineClosedError(f"{self._name} is closed")
         deadline = (time.time() + float(deadline_s)
@@ -276,18 +339,206 @@ class Router:
             raise RequestTimeoutError(
                 f"deadline_s={deadline_s} already expired at admission",
                 deadline=deadline)
+        req = FleetRequest(next(self._gids), prompt, max_new, eos,
+                           deadline, session, tenant=tenant, tier=tier)
+        try:
+            # chaos hook: an armed tenant-flood site makes THIS submit
+            # behave as if the fleet were drowning — the typed overload
+            # path (retry_after_s included) fires without needing a real
+            # thousand-request flood in the test
+            _fi.fire("serve.tenant_flood")
+        except Exception:
+            _M_SHED.inc(instance=self._name)
+            raise FleetOverloadedError(
+                f"injected tenant flood: request from tenant "
+                f"{req.tenant!r} shed",
+                queue_depth=len(self._queue),
+                retry_after_s=self._retry_after(len(self._queue) + 1))
+        quota = self._tenant_quota.get(req.tenant)
+        if quota is not None and not quota.admissible():
+            _M_QUOTA_REJECTED.inc(instance=self._name)
+            raise TenantQuotaExceededError(
+                f"tenant {req.tenant!r} exhausted its admission quota; "
+                "back off instead of hammering the router",
+                tenant=req.tenant, retry_after_s=quota.retry_after())
+        if (self.slo_admission and deadline_s is not None
+                and req.tier == TIER_LATENCY):
+            est = self._estimate_service_start()
+            if est is not None and float(deadline_s) < est:
+                _M_INFEASIBLE.inc(instance=self._name)
+                raise DeadlineInfeasibleError(
+                    f"deadline_s={deadline_s} cannot be met: estimated "
+                    f"queue wait + prefill cost is {est:.3f}s; rejecting "
+                    "at placement instead of expiring mid-decode",
+                    deadline=deadline,
+                    retry_after_s=max(0.05, est - float(deadline_s)))
         if len(self._queue) >= self.max_queue:
             _M_SHED.inc(instance=self._name)
             raise FleetOverloadedError(
                 f"admission queue full ({self.max_queue} requests "
                 "waiting); shedding instead of queuing unboundedly",
-                queue_depth=len(self._queue))
-        req = FleetRequest(next(self._gids), prompt, max_new, eos,
-                           deadline, session)
+                queue_depth=len(self._queue),
+                retry_after_s=self._retry_after(len(self._queue)))
+        if quota is not None:
+            # charge the bucket only once every rejection gate passed —
+            # shed/infeasible requests must not burn quota
+            quota.note(len(req.prompt) + req.max_new)
         self._reqs[req.gid] = req
         self._queue.append(req)
         _G_QUEUE.set(len(self._queue), instance=self._name)
         return req.gid
+
+    # -- QoS estimation helpers (ISSUE 17) ------------------------------
+    _RATE_WINDOW_S = 5.0
+
+    def _drain_rate(self):
+        """Recent completion rate (requests/s) over the rate window —
+        the denominator of every retry_after_s hint."""
+        now = time.time()
+        n = sum(1 for t in self._done_times
+                if now - t <= self._RATE_WINDOW_S)
+        return n / self._RATE_WINDOW_S
+
+    def _retry_after(self, n_ahead):
+        """Seconds until ~``n_ahead`` queued requests should have
+        drained at the observed completion rate (1.0s floor default
+        when the rate is still unknown)."""
+        rate = self._drain_rate()
+        if rate <= 0.0:
+            return 1.0
+        return max(0.05, float(n_ahead) / rate)
+
+    def _estimate_service_start(self):
+        """Estimated submit→first-token latency for a request admitted
+        NOW: queue wait at the observed drain rate plus the TTFT EMA.
+        None (= admit; never guess-reject) before any completion
+        history exists."""
+        if self._ttft_ema is None:
+            return None
+        wait = 0.0
+        rate = self._drain_rate()
+        if rate > 0.0 and self._queue:
+            wait = len(self._queue) / rate
+        return wait + self._ttft_ema
+
+    def _note_done(self, req):
+        """Completion bookkeeping shared by every terminal transition:
+        feeds the drain-rate window and the TTFT EMA."""
+        self._done_times.append(time.time())
+        if req.t_first is not None and req.t_submit is not None:
+            dt = req.t_first - req.t_submit
+            self._ttft_ema = (dt if self._ttft_ema is None
+                              else 0.8 * self._ttft_ema + 0.2 * dt)
+
+    # -- tenant configuration (ISSUE 17) --------------------------------
+    def configure_tenant(self, name, *, weight=1.0, rate_tokens_per_s=None,
+                         window_s=1.0, host_blocks=None,
+                         prefix_blocks=None):
+        """Declare one tenant's QoS envelope fleet-wide: the router
+        enforces a HARD admission quota (token demand — prompt +
+        max_new — charged at submit against the leaky bucket; over it,
+        submits raise :class:`TenantQuotaExceededError`), and the full
+        envelope (weight, quota, cache shares) is pushed to every
+        replica engine, where weighted-fair scheduling paces SERVED
+        tokens. The push is tracked per replica incarnation, so respawns
+        and autoscaled newcomers are configured automatically at the
+        next :meth:`step`."""
+        name = str(name)
+        if not name:
+            raise ValueError("tenant name must be non-empty")
+        cfg = {"weight": float(weight), "window": float(window_s)}
+        if rate_tokens_per_s is not None:
+            cfg["rate"] = float(rate_tokens_per_s)
+            self._tenant_quota[name] = TenantQuota(
+                float(rate_tokens_per_s), window_s=float(window_s))
+        else:
+            self._tenant_quota.pop(name, None)
+        if host_blocks is not None:
+            cfg["host_blocks"] = int(host_blocks)
+        if prefix_blocks is not None:
+            cfg["prefix_blocks"] = int(prefix_blocks)
+        self._tenants[name] = cfg
+        # force a full re-push: config is idempotent on the replica side
+        self._cfg_sent.clear()
+
+    def _push_tenant_config(self):
+        """Send the declared tenant envelopes to every live replica
+        incarnation that has not received them yet (fresh boots,
+        respawns after a crash, autoscaled newcomers)."""
+        for h in self.supervisor.handles:
+            if not (h.ready and h.alive and not h.retired):
+                continue
+            key = (h.id, h.incarnation)
+            if key in self._cfg_sent:
+                continue
+            ok = True
+            for name, cfg in self._tenants.items():
+                ok = h.send({"op": "configure_tenant", "tenant": name,
+                             **cfg}) and ok
+            if ok:
+                self._cfg_sent.add(key)
+
+    # -- fleet autoscaling (ISSUE 17) -----------------------------------
+    def enable_autoscale(self, min_replicas, max_replicas, **kw):
+        """Arm the supervisor's autoscale tick inside :meth:`step`:
+        queue pressure grows the fleet one replica at a time, calm
+        shrinks it by draining the highest slot (``then="retire"`` — the
+        PR-12 zero-drop path). ``kw`` forwards watermarks / cooldown /
+        scale-event budget to :meth:`ReplicaSupervisor.autoscale`;
+        disable again with :meth:`disable_autoscale`."""
+        min_replicas, max_replicas = int(min_replicas), int(max_replicas)
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min ({min_replicas}) <= max ({max_replicas})")
+        self._autoscale = {"min": min_replicas, "max": max_replicas,
+                           "kw": dict(kw)}
+
+    def disable_autoscale(self):
+        self._autoscale = None
+
+    def _mean_occupancy(self):
+        """Mean decode-slot occupancy over live replicas' self-reported
+        load gauges (replicas that never reported count as 0 — a booting
+        replica is idle capacity, and treating it as busy would wedge
+        scale-down forever)."""
+        occ, n = 0.0, 0
+        for h in self.supervisor.handles:
+            if h.retired:
+                continue
+            n += 1
+            occ += float(self._load.get(h.id, {}).get("occ", 0.0))
+        return occ / n if n else 0.0
+
+    def _autoscale_tick(self):
+        cfg = self._autoscale
+        decision = self.supervisor.autoscale(
+            cfg["min"], cfg["max"], queue_depth=len(self._queue),
+            occupancy=self._mean_occupancy(), **cfg["kw"])
+        if decision is None:
+            return
+        action, rid = decision
+        if action == "up":
+            # the supervisor already spawned it — give it an in-flight
+            # set so placement/recovery bookkeeping treats it as any
+            # other slot (tenant config follows via _push_tenant_config)
+            self._inflight.setdefault(rid, set())
+            self.scale_ups += 1
+            return
+        # scale-down: zero-drop by construction — drain first, retire
+        # only once the slot's in-flight set empties
+        if rid in self._draining:
+            return
+        self.scale_downs += 1
+        self.drain(rid, then="retire")
+        try:
+            # chaos hook (serve.scale_down_kill): SIGKILL the draining
+            # replica mid-drain — its in-flight requests must redispatch
+            # and still drop zero requests
+            _fi.fire("serve.scale_down_kill")
+        except Exception:
+            h = self._handle(rid)
+            if h is not None and h.proc.poll() is None:
+                os.kill(h.pid, signal.SIGKILL)
 
     def request(self, gid):
         return self._reqs[gid]
@@ -369,6 +620,12 @@ class Router:
             self._recover_replica(death["replica"])
         # 3. deadlines (queued + placed)
         self._expire_deadlines()
+        # 3b. QoS config push + autoscale tick (ISSUE 17) — both no-ops
+        #     unless armed
+        if self._tenants:
+            self._push_tenant_config()
+        if self._autoscale is not None:
+            self._autoscale_tick()
         # 4. placement
         progressed += self._place()
         # 5. drains
@@ -417,6 +674,7 @@ class Router:
                     req.state = DONE
                     req.finish_reason = reason
                     req.t_done = time.perf_counter()
+                    self._note_done(req)
         elif kind == "kvpage":
             self._handle_kvpage(replica_id, ev)
         elif kind == "kvdone":
@@ -454,6 +712,7 @@ class Router:
         req.t_done = time.perf_counter()
         req.frames = {}
         req.pages = None
+        self._note_done(req)
         if isinstance(error, RequestTimeoutError):
             _M_TIMEOUTS.inc(instance=self._name)
 
@@ -529,6 +788,7 @@ class Router:
             req.state = DONE
             req.finish_reason = ev.get("reason") or "length"
             req.t_done = time.perf_counter()
+            self._note_done(req)
             return
         # stage 2 pending: verified pages queue (front — oldest work)
         # for decode placement. Only the already-encoded frames are
@@ -609,6 +869,7 @@ class Router:
                 req.state = DONE
                 req.finish_reason = "length"
                 req.t_done = time.perf_counter()
+                self._note_done(req)
                 continue
             req.state = QUEUED
             req.replica = None
@@ -770,7 +1031,8 @@ class Router:
             "op": "submit", "gid": req.gid, "gen": req.generation,
             "prompt": self._replay_prompt(req),
             "max_new": req.remaining, "eos": req.eos,
-            "deadline": req.deadline,
+            "deadline": req.deadline, "tenant": req.tenant,
+            "tier": req.tier,
         }
         if not self._send_checked(h, payload):
             self._dispatch_failed(req)
@@ -793,7 +1055,8 @@ class Router:
             "op": "prefill", "gid": req.gid, "gen": req.generation,
             "hid": req.hid, "prompt": self._replay_prompt(req),
             "max_new": req.remaining, "eos": req.eos,
-            "deadline": req.deadline,
+            "deadline": req.deadline, "tenant": req.tenant,
+            "tier": req.tier,
         }
         if not self._send_checked(h, payload):
             self._dispatch_failed(req)
@@ -825,7 +1088,8 @@ class Router:
                 "prompt": self._replay_prompt(req),
                 "max_new": req.remaining, "eos": req.eos,
                 "deadline": req.deadline, "frames": len(frames),
-                "crc": req.pages["crc"],
+                "crc": req.pages["crc"], "tenant": req.tenant,
+                "tier": req.tier,
             })
         if not ok:
             # dead pipe: the verified pages stay buffered — the retry
@@ -999,6 +1263,13 @@ class Router:
                 _M_KV_RETRIES.value(instance=inst)),
             "prefill_handoffs": int(_M_HANDOFFS.value(instance=inst)),
             "handoff_failovers": int(_M_FAILOVERS.value(instance=inst)),
+            # multi-tenant QoS + autoscale (ISSUE 17)
+            "quota_rejections": int(
+                _M_QUOTA_REJECTED.value(instance=inst)),
+            "deadline_infeasible": int(
+                _M_INFEASIBLE.value(instance=inst)),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
         }
 
     def ttft_seconds(self):
@@ -1048,7 +1319,7 @@ class Router:
         self.supervisor.shutdown()
         for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _G_QUEUE,
                   _G_DRAINING, _M_KV_PAGES, _M_KV_RETRIES, _M_HANDOFFS,
-                  _M_FAILOVERS):
+                  _M_FAILOVERS, _M_QUOTA_REJECTED, _M_INFEASIBLE):
             m.remove(instance=self._name)
 
     def __enter__(self):

@@ -28,6 +28,17 @@ The supervisor only manages processes; request-level recovery
 job — ``check()`` hands it the death events WITH the dying process's
 final token events (drained to EOF first), so tokens emitted before the
 crash are never lost and never double-counted.
+
+ISSUE 17 adds **fleet autoscaling**: :meth:`ReplicaSupervisor.autoscale`
+is a pure decision tick driven by the router's ``fleet_queue_depth`` and
+occupancy gauges — sustained pressure above the high watermark grows the
+fleet by one slot (:meth:`add_replica`), calm below the low watermark
+nominates the highest live slot for the caller to drain-then-retire
+(riding the PR-12 zero-drop drain; the supervisor never kills a slot
+that may hold in-flight work). Hysteresis (distinct watermarks + a
+cooldown between events) and a leaky-bucket scale-event budget (the
+:class:`RestartBudget` machinery again) keep flapping load from
+crash-looping the fleet through churn.
 """
 
 from __future__ import annotations
@@ -40,6 +51,7 @@ import sys
 import tempfile
 import threading
 import time
+import warnings
 
 from ....distributed.launch import heartbeat as _hb
 from ....distributed.launch.controllers.collective import RestartBudget
@@ -59,6 +71,14 @@ _G_LIVE = _obs_metrics.gauge(
 _M_RESTARTS = _obs_metrics.counter(
     "fleet_replica_restarts_total",
     "replica respawns performed by the supervisor (crash or hang)")
+_M_SCALE_UP = _obs_metrics.counter(
+    "fleet_scale_up_total",
+    "replicas added by autoscale (queue pressure above the high "
+    "watermark past the cooldown)")
+_M_SCALE_DOWN = _obs_metrics.counter(
+    "fleet_scale_down_total",
+    "replicas nominated for drain-then-retire by autoscale (fleet calm "
+    "below the low watermark past the cooldown)")
 
 # repo root (five levels up: fleet/serving/inference/paddle_tpu/<repo>)
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -248,11 +268,17 @@ class ReplicaSupervisor:
         # the router's single-threaded pump (a synchronous backoff sleep
         # would freeze token events, placements and the redispatch the
         # death just triggered, for every healthy replica too)
+        self._max_restarts = int(max_restarts)
         self._budgets = [RestartBudget(max_restarts, sleep=lambda s: None)
                          for _ in range(int(n_replicas))]
         self._pending_respawn: dict[int, float] = {}
         self.handles = [self._spawn(i, 0) for i in range(int(n_replicas))]
         self._last_live = None
+        # autoscale state (ISSUE 17): budget created lazily at the first
+        # autoscale() tick (its shape is a caller decision)
+        self._scale_budget = None
+        self._last_scale_t = None
+        self._scale_warned = False
         self._note_liveness()
 
     # -- lifecycle -------------------------------------------------------
@@ -307,6 +333,98 @@ class ReplicaSupervisor:
                 h.close()
         _G_LIVE.remove(instance=self.instance)
         _M_RESTARTS.remove(instance=self.instance)
+        _M_SCALE_UP.remove(instance=self.instance)
+        _M_SCALE_DOWN.remove(instance=self.instance)
+
+    # -- fleet autoscaling (ISSUE 17) -----------------------------------
+    @property
+    def n_active(self):
+        """Slots not retired (live, booting, or pending respawn) — the
+        fleet size autoscale reasons about."""
+        return sum(1 for h in self.handles if not h.retired)
+
+    def add_replica(self, role="both"):
+        """Grow the fleet by one slot (the autoscale-up action). The new
+        slot appends at the end — slot id == handles index stays true for
+        every existing slot — with a fresh restart budget and incarnation
+        0. Returns the new slot id."""
+        i = len(self.handles)
+        if self._roles is not None:
+            role = str(role)
+            if role not in ("prefill", "decode", "both"):
+                raise ValueError(f"unknown replica role {role!r}")
+            self._roles.append(role)
+        self._budgets.append(
+            RestartBudget(self._max_restarts, sleep=lambda s: None))
+        self.handles.append(self._spawn(i, 0))
+        _M_SCALE_UP.inc(instance=self.instance)
+        self._note_liveness()
+        return i
+
+    def autoscale(self, min_replicas, max_replicas, *, queue_depth,
+                  occupancy, high_water=0.75, low_water=0.25,
+                  cooldown_s=5.0, max_events=8, window_s=60.0, now=None):
+        """One autoscale decision tick, driven by the router's gauges:
+        ``queue_depth`` (requests waiting at the router) and
+        ``occupancy`` (mean decode-slot occupancy across live replicas,
+        0..1).
+
+        * **Up** — work is queued AND the fleet is busy (``occupancy >=
+          high_water``) with room to grow: spawn one replica
+          (:meth:`add_replica`) and return ``("up", new_id)``.
+        * **Down** — nothing queued AND the fleet is idle (``occupancy
+          <= low_water``) above the floor: return ``("down",
+          victim_id)`` nominating the highest live slot; the CALLER
+          drains it (zero-drop) and calls :meth:`retire` — the
+          supervisor never kills a slot that may hold in-flight work.
+        * Otherwise (or inside the hysteresis band / cooldown / an
+          exhausted scale-event budget) return ``None``.
+
+        Hysteresis is the gap between the watermarks plus ``cooldown_s``
+        between events; the leaky-bucket scale-event budget
+        (``max_events`` per rolling ``window_s``, fixed at the first
+        tick) stops flapping load from churning replicas forever — past
+        it, autoscale goes quiet (one warning) instead of crash-looping
+        the fleet."""
+        min_replicas, max_replicas = int(min_replicas), int(max_replicas)
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min ({min_replicas}) <= max ({max_replicas})")
+        if not low_water < high_water:
+            raise ValueError(
+                f"need low_water ({low_water}) < high_water "
+                f"({high_water}) — the gap IS the hysteresis band")
+        now = time.time() if now is None else now
+        n = self.n_active
+        want_up = (queue_depth > 0 and occupancy >= high_water
+                   and n < max_replicas)
+        want_down = (queue_depth == 0 and occupancy <= low_water
+                     and n > min_replicas)
+        if not (want_up or want_down):
+            return None
+        if (self._last_scale_t is not None
+                and now - self._last_scale_t < cooldown_s):
+            return None
+        if self._scale_budget is None:
+            self._scale_budget = RestartBudget(
+                int(max_events), window_s=float(window_s),
+                sleep=lambda s: None)
+        if not self._scale_budget.try_acquire():
+            if not self._scale_warned:
+                self._scale_warned = True
+                warnings.warn(
+                    f"{self.instance}: scale-event budget exhausted "
+                    f"({self._scale_budget.max_restarts} per "
+                    f"{self._scale_budget.window_s:.0f}s); autoscale "
+                    "pausing — flapping load, widen the watermarks",
+                    RuntimeWarning)
+            return None
+        self._last_scale_t = now
+        if want_up:
+            return ("up", self.add_replica())
+        victim = max(h.id for h in self.handles if not h.retired)
+        _M_SCALE_DOWN.inc(instance=self.instance)
+        return ("down", victim)
 
     # -- the watchdog tick ----------------------------------------------
     def _hung(self, h, beats, now):

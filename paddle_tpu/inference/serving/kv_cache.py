@@ -265,6 +265,15 @@ class BlockAllocator:
         self.high_water = max(self.high_water, len(self._ref))
         return ids
 
+    def unpark(self, block_id):
+        """Move a parked reusable block back to the plain free list —
+        its cached identity was retracted (per-tenant share enforcement,
+        ISSUE 17), so it is no longer worth reclaim bookkeeping. A block
+        that is live or already free is left alone."""
+        if block_id in self._reusable:
+            del self._reusable[block_id]
+            self._free.append(block_id)
+
     def acquire(self, ids):
         """Share existing blocks: incref each id, reviving reusable
         (refcount-0 cached) blocks. Raises on ids that are neither live
@@ -336,9 +345,52 @@ class PrefixCache:
         self.on_spill = None
         allocator.on_reclaim = self._reclaim
         allocator.cache_probe = self
+        # per-tenant accounting (ISSUE 17): how many registered blocks
+        # each tenant has published, oldest-first, plus optional shares.
+        # A tenant over its share demotes ITS OWN oldest identities to
+        # the host tier (and unparks the blocks) — it can grow the warm
+        # set only up to its budget, never by evicting another tenant's
+        # published blocks past theirs.
+        self._block_tenant = {}     # block id -> tenant name
+        self._tenant_lru = {}       # tenant -> OrderedDict[block id, None]
+        self._tenant_share = {}     # tenant -> max registered blocks
 
     def __len__(self):
         return len(self._by_hash)
+
+    def set_tenant_share(self, name, max_blocks):
+        """Cap tenant ``name`` at ``max_blocks`` registered (published)
+        blocks; ``None`` removes the cap."""
+        if max_blocks is None:
+            self._tenant_share.pop(str(name), None)
+        else:
+            if int(max_blocks) < 1:
+                raise ValueError(
+                    f"tenant prefix share must be >= 1, got {max_blocks}")
+            self._tenant_share[str(name)] = int(max_blocks)
+
+    def tenant_blocks(self, name):
+        """Registered blocks currently attributed to tenant ``name``."""
+        return len(self._tenant_lru.get(str(name), ()))
+
+    def _tag(self, block_id, tenant):
+        if tenant is None:
+            return
+        self._block_tenant[block_id] = tenant
+        self._tenant_lru.setdefault(tenant, OrderedDict())[block_id] = None
+
+    def _enforce_share(self, tenant):
+        share = self._tenant_share.get(tenant)
+        if share is None:
+            return
+        lru = self._tenant_lru.get(tenant)
+        while lru and len(lru) > share:
+            b = next(iter(lru))  # tenant's oldest published block
+            h = self._block_hash.get(b)
+            if self.on_spill is not None and h is not None:
+                self.on_spill([(b, h)], [tenant])  # demote, don't lose
+            self._forget(b)
+            self.allocator.unpark(b)
 
     def registered(self, block_id):
         return block_id in self._block_hash
@@ -366,24 +418,31 @@ class PrefixCache:
             parent = h
         return blocks, len(blocks) * bs
 
-    def register(self, tokens, blocks, upto):
+    def register(self, tokens, blocks, upto, tenant=None):
         """Publish the identity of every FULL block among ``blocks`` whose
         tokens (``tokens[:upto]``) are materialized in the pool. First
         writer wins: a chain hash already mapping to a (different) block
         keeps its mapping and the duplicate block simply stays private;
         a block already registered under another chain is never re-keyed.
+        Newly published blocks are attributed to ``tenant`` (ISSUE 17);
+        a tenant over its share demotes its own oldest identities.
         """
         tokens = np.asarray(tokens)
         bs = self.block_size
         n_chunks = min(int(upto) // bs, len(blocks))
         parent = b""
+        tagged = False
         for i in range(n_chunks):
             h = self._chunk_hash(parent, tokens[i * bs:(i + 1) * bs])
             cur = self._by_hash.get(h)
             if cur is None and blocks[i] not in self._block_hash:
                 self._by_hash[h] = blocks[i]
                 self._block_hash[blocks[i]] = h
+                self._tag(blocks[i], tenant)
+                tagged = True
             parent = h
+        if tagged and tenant is not None:
+            self._enforce_share(tenant)
 
     def match_with_tier(self, tokens, tier):
         """:meth:`match`, extended into the host tier (ISSUE 16): after
@@ -417,7 +476,7 @@ class PrefixCache:
             i += 1
         return blocks, len(blocks) * bs, host
 
-    def adopt(self, block_id, chain_hash):
+    def adopt(self, block_id, chain_hash, tenant=None):
         """Publish a revived block under its KNOWN chain hash (host-tier
         or prefix-store revival: the pages just imported are
         byte-identical to what the chain's original writer produced, so
@@ -427,6 +486,9 @@ class PrefixCache:
             return
         self._by_hash[chain_hash] = block_id
         self._block_hash[block_id] = chain_hash
+        self._tag(block_id, tenant)
+        if tenant is not None:
+            self._enforce_share(tenant)
 
     def registered_chains(self):
         """Snapshot of ``(chain_hash, block_id)`` pairs currently
@@ -442,6 +504,8 @@ class PrefixCache:
         they recycle as plain free blocks and are never spilled."""
         self._by_hash.clear()
         self._block_hash.clear()
+        self._block_tenant.clear()
+        self._tenant_lru.clear()
 
     def forget(self, block_id):
         """Drop a block's cached identity (divergent write to a
@@ -458,7 +522,8 @@ class PrefixCache:
             pairs = [(b, self._block_hash[b]) for b in block_ids
                      if b in self._block_hash]
             if pairs:
-                self.on_spill(pairs)
+                tenants = [self._block_tenant.get(b) for b, _ in pairs]
+                self.on_spill(pairs, tenants)
         for b in block_ids:
             self._forget(b)
 
@@ -466,6 +531,11 @@ class PrefixCache:
         h = self._block_hash.pop(block_id, None)
         if h is not None:
             self._by_hash.pop(h, None)
+        t = self._block_tenant.pop(block_id, None)
+        if t is not None:
+            lru = self._tenant_lru.get(t)
+            if lru is not None:
+                lru.pop(block_id, None)
 
 
 class PagedKVCache:
@@ -775,6 +845,9 @@ class HostKVTier:
         self.instance = instance
         self._entries = OrderedDict()   # key -> PageSnapshot | dict
         self._blocks_used = 0
+        self._tenant_of = {}            # key -> tenant name (tagged only)
+        self._tenant_blocks = {}        # tenant -> resident block count
+        self._tenant_share = {}         # tenant -> max resident blocks
         self._lock = threading.RLock()
         self._q: queue.Queue = queue.Queue()
         self._thread = None
@@ -807,6 +880,8 @@ class HostKVTier:
         with self._lock:
             self._entries.clear()
             self._blocks_used = 0
+            self._tenant_of.clear()
+            self._tenant_blocks.clear()
         _G_HOST_BLOCKS.set(0, instance=self.instance)
 
     # -- internals ------------------------------------------------------
@@ -817,23 +892,74 @@ class HostKVTier:
     def _gauge(self):
         _G_HOST_BLOCKS.set(self._blocks_used, instance=self.instance)
 
-    def _put(self, key, entry, nblocks):
+    def set_tenant_share(self, name, max_blocks):
+        """Cap one tenant's RESIDENT host blocks (ISSUE 17). Over-share
+        inserts evict that tenant's own oldest entries first, so a flood
+        of spills from one tenant cannot push other tenants' warm pages
+        out of the shared LRU. ``None`` removes the cap."""
+        name = str(name)
+        with self._lock:
+            if max_blocks is None:
+                self._tenant_share.pop(name, None)
+                return
+            if max_blocks < 1:
+                raise ValueError(
+                    f"tenant share must be >= 1 block, got {max_blocks}")
+            self._tenant_share[name] = int(max_blocks)
+
+    def _account(self, key, nblocks, tenant):
+        self._blocks_used += nblocks
+        if tenant is not None:
+            self._tenant_of[key] = tenant
+            self._tenant_blocks[tenant] = (
+                self._tenant_blocks.get(tenant, 0) + nblocks)
+
+    def _unaccount(self, key, entry):
+        n = self._entry_blocks(entry)
+        self._blocks_used -= n
+        t = self._tenant_of.pop(key, None)
+        if t is not None:
+            left = self._tenant_blocks.get(t, 0) - n
+            if left > 0:
+                self._tenant_blocks[t] = left
+            else:
+                self._tenant_blocks.pop(t, None)
+
+    def _put(self, key, entry, nblocks, tenant=None):
         """Insert under the budget, LRU-evicting other entries to fit.
-        Returns False (no state change) when the entry alone exceeds the
-        whole budget."""
+        A tagged tenant over its share evicts ITS OWN oldest entries
+        first before touching the shared LRU. Returns False (no state
+        change) when the entry alone exceeds the whole budget or the
+        tenant's share."""
         if nblocks > self.max_host_blocks:
             return False
+        tenant = str(tenant) if tenant is not None else None
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
-                self._blocks_used -= self._entry_blocks(old)
+                self._unaccount(key, old)
+            share = (self._tenant_share.get(tenant)
+                     if tenant is not None else None)
+            if share is not None:
+                if nblocks > share:
+                    return False
+                while (self._tenant_blocks.get(tenant, 0) + nblocks
+                       > share):
+                    victim_key = next(
+                        (k for k in self._entries
+                         if self._tenant_of.get(k) == tenant), None)
+                    if victim_key is None:
+                        break
+                    victim = self._entries.pop(victim_key)
+                    self._unaccount(victim_key, victim)
+                    _M_HOST_EVICT.inc(instance=self.instance)
             while (self._blocks_used + nblocks > self.max_host_blocks
                    and self._entries):
-                _, victim = self._entries.popitem(last=False)
-                self._blocks_used -= self._entry_blocks(victim)
+                victim_key, victim = self._entries.popitem(last=False)
+                self._unaccount(victim_key, victim)
                 _M_HOST_EVICT.inc(instance=self.instance)
             self._entries[key] = entry
-            self._blocks_used += nblocks
+            self._account(key, nblocks, tenant)
             self._gauge()
         return True
 
@@ -844,7 +970,7 @@ class HostKVTier:
                 return None
             if pop:
                 self._entries.pop(key)
-                self._blocks_used -= self._entry_blocks(entry)
+                self._unaccount(key, entry)
             else:
                 self._entries.move_to_end(key)
             self._gauge()
@@ -852,7 +978,7 @@ class HostKVTier:
             return entry
         return entry.materialize()
 
-    def _spill(self, key, blocks, covered):
+    def _spill(self, key, blocks, covered, tenant=None):
         """Shared spill path: fire the fault site (failure degrades to
         recompute-eviction — the caller just proceeds as if no tier were
         attached), snapshot, insert, queue the async D2H."""
@@ -864,7 +990,7 @@ class HostKVTier:
         snap.on_materialized = lambda nbytes, ms: (
             _M_SPILL_BYTES.inc(nbytes, instance=self.instance),
             _H_SPILL_MS.observe(ms, instance=self.instance))
-        if not self._put(key, snap, snap.nblocks):
+        if not self._put(key, snap, snap.nblocks, tenant=tenant):
             return False
         _M_SPILLS.inc(instance=self.instance)
         if self._thread is not None:
@@ -872,12 +998,13 @@ class HostKVTier:
         return True
 
     # -- preempted-request entries (scheduler-facing) -------------------
-    def spill_request(self, rid, blocks, covered):
+    def spill_request(self, rid, blocks, covered, tenant=None):
         """Spill one preempted request's pages under ``("req", rid)``;
         the caller frees the device blocks right after (the snapshot's
         gathers already dispatched)."""
         n = -(-int(covered) // self.cache.block_size)
-        return self._spill(("req", int(rid)), list(blocks)[:n], covered)
+        return self._spill(("req", int(rid)), list(blocks)[:n], covered,
+                           tenant=tenant)
 
     def peek_request(self, rid):
         """Materialized payload for a spilled request (MRU-touched, NOT
@@ -887,17 +1014,20 @@ class HostKVTier:
 
     def drop_request(self, rid):
         with self._lock:
-            entry = self._entries.pop(("req", int(rid)), None)
+            key = ("req", int(rid))
+            entry = self._entries.pop(key, None)
             if entry is not None:
-                self._blocks_used -= self._entry_blocks(entry)
+                self._unaccount(key, entry)
                 self._gauge()
 
     # -- prefix-block entries -------------------------------------------
-    def spill_blocks(self, pairs):
+    def spill_blocks(self, pairs, tenants=None):
         """Demote a reclaim WAVE of registered blocks — ``(block_id,
         chain_hash)`` pairs — in one batch: one fault-site fire, one
         device gather, one queued D2H for the whole wave; each chain
-        hash keys a single-block view of the shared capture. Wired as
+        hash keys a single-block view of the shared capture. ``tenants``
+        (parallel to ``pairs``, entries may be None) tags each demoted
+        block for per-tenant share accounting. Wired as
         ``PrefixCache.on_spill``."""
         if not pairs:
             return
@@ -913,16 +1043,18 @@ class HostKVTier:
             _H_SPILL_MS.observe(ms, instance=self.instance))
         put_any = False
         for i, (_, h) in enumerate(pairs):
-            if self._put(("prefix", bytes(h)), snap.view(i), 1):
+            tenant = tenants[i] if tenants is not None else None
+            if self._put(("prefix", bytes(h)), snap.view(i), 1,
+                         tenant=tenant):
                 put_any = True
                 _M_SPILLS.inc(instance=self.instance)
         if put_any and self._thread is not None:
             self._q.put(snap)
 
-    def spill_block(self, block_id, chain_hash):
+    def spill_block(self, block_id, chain_hash, tenant=None):
         """Demote one reclaimed registered block (its chain hash is the
         tier key); single-pair form of :meth:`spill_blocks`."""
-        self.spill_blocks([(block_id, chain_hash)])
+        self.spill_blocks([(block_id, chain_hash)], [tenant])
 
     def has_prefix(self, chain_hash):
         with self._lock:
@@ -938,11 +1070,11 @@ class HostKVTier:
         where it is re-registered under the same hash)."""
         return self._get(("prefix", bytes(chain_hash)), pop=True)
 
-    def put_prefix_payload(self, chain_hash, pages):
+    def put_prefix_payload(self, chain_hash, pages, tenant=None):
         """Insert an already-materialized single-block payload (prefix
         store boot path)."""
         return self._put(("prefix", bytes(chain_hash)), pages,
-                         int(pages["k"].shape[1]))
+                         int(pages["k"].shape[1]), tenant=tenant)
 
     def prefix_items(self):
         """Materialized ``(chain_hash, payload)`` pairs currently
@@ -962,13 +1094,18 @@ class HostKVTier:
         with self._lock:
             for key in [k for k in self._entries if k[0] == "prefix"]:
                 entry = self._entries.pop(key)
-                self._blocks_used -= self._entry_blocks(entry)
+                self._unaccount(key, entry)
             self._gauge()
 
     @property
     def host_blocks_in_use(self):
         with self._lock:
             return self._blocks_used
+
+    def tenant_blocks_in_use(self, name):
+        """Resident host blocks currently accounted to one tenant."""
+        with self._lock:
+            return self._tenant_blocks.get(str(name), 0)
 
     def __len__(self):
         with self._lock:

@@ -41,6 +41,24 @@ Token-granularity admission into a fixed set of decode slots:
 eviction, finish, COW, trim): the engine caches the device block-table
 array against it, so steady-state decode re-uploads nothing (ISSUE 11
 satellite).
+
+**Multi-tenant QoS (ISSUE 17).** Requests carry a ``tenant=`` identity
+and a ``tier`` (``latency`` | ``batch``). Once any tenant is configured
+(:meth:`Scheduler.configure_tenant`) or non-default traffic is queued,
+admission switches from strict FIFO to weighted-fair queuing: the
+latency tier strictly outranks the batch tier, and within a tier the
+backlogged tenant with the lowest *virtual time* (served tokens /
+weight) admits next — its own requests still in FIFO order, so each
+request's token outputs stay bit-identical to an undisturbed run (QoS
+moves *when* work runs, never *which* tokens). Per-tenant token-rate
+quotas (:class:`TenantQuota`, the launcher's ``RestartBudget`` leaky
+bucket over served tokens) DEFER an over-quota tenant's admissions
+instead of shedding them. Batch-tier requests *yield* decode slots
+under latency pressure: they are preempted through the normal eviction
+path — which spills decode-ready pages to the ISSUE-16 host tier, so
+revival is a page import, not a re-prefill — and re-admit when the
+pressure drops. Pure-default traffic never touches any of this: the
+FIFO admission order of PR 7 is preserved exactly.
 """
 
 from __future__ import annotations
@@ -54,7 +72,8 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 
-__all__ = ["SamplingParams", "Request", "Scheduler"]
+__all__ = ["SamplingParams", "Request", "Scheduler", "TenantQuota",
+           "TIER_LATENCY", "TIER_BATCH"]
 
 # engine-owned admission/eviction counters (ISSUE 10 satellite): the
 # registry — labeled by the owning engine/scheduler instance — is the
@@ -77,8 +96,105 @@ _M_PREFIX_REUSED = _obs_metrics.counter(
 _M_COW = _obs_metrics.counter(
     "serving_cow_copies_total",
     "copy-on-write block copies (divergent write to a shared block)")
+# multi-tenant QoS (ISSUE 17)
+_M_THROTTLED = _obs_metrics.counter(
+    "serving_quota_throttled_total",
+    "admission passes that deferred every waiting tenant on its token-"
+    "rate quota (deferred, never shed)")
+_M_BATCH_YIELD = _obs_metrics.counter(
+    "serving_batch_yields_total",
+    "batch-tier requests preempted (spilled to the host tier when "
+    "decode-ready) so latency-tier work could take their slot")
+_M_TENANT_TOKENS = _obs_metrics.counter(
+    "serving_tenant_tokens_total",
+    "tokens served per tenant (prefill chunks + decode emissions); the "
+    "tenant label is bounded to configured tenant names plus 'default'")
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+TIER_LATENCY, TIER_BATCH = "latency", "batch"
+
+
+class TenantQuota:
+    """Per-tenant token-rate quota: a rolling-window leaky bucket over
+    SERVED tokens, mirroring the launcher's ``RestartBudget`` (events
+    pruned past the window, injectable clock so tests never sleep).
+
+    ``rate_tokens_per_s * window_s`` tokens may be served per rolling
+    ``window_s`` window. The scheduler charges tokens as they are served
+    (prefill chunks and decode emissions) and gates *admission* on the
+    bucket: an over-quota tenant's waiting requests are deferred — never
+    shed — until enough history ages out. One in-flight request may
+    overshoot the limit; throttling mid-decode would hold a decode slot
+    idle, the one thing a fixed-slot engine can never afford.
+    :meth:`retry_after` estimates the wait, the machine-readable backoff
+    hint the router's typed quota rejection carries (ISSUE 17 satellite).
+    """
+
+    def __init__(self, rate_tokens_per_s, window_s=1.0,
+                 clock=time.monotonic):
+        self.rate = float(rate_tokens_per_s)
+        if self.rate <= 0:
+            raise ValueError(
+                f"rate_tokens_per_s must be > 0, got {rate_tokens_per_s}")
+        self.window_s = float(window_s)
+        self.limit = self.rate * self.window_s
+        self._clock = clock
+        self._events: deque[tuple[float, float]] = deque()
+        self._used = 0.0
+
+    def _prune(self, now):
+        ev = self._events
+        while ev and now - ev[0][0] > self.window_s:
+            self._used -= ev.popleft()[1]
+
+    @property
+    def used(self):
+        """Tokens served inside the current rolling window."""
+        self._prune(self._clock())
+        return self._used
+
+    def admissible(self):
+        return self.used < self.limit
+
+    def note(self, n):
+        """Charge ``n`` served tokens to the window."""
+        now = self._clock()
+        self._prune(now)
+        self._events.append((now, float(n)))
+        self._used += float(n)
+
+    def retry_after(self):
+        """Seconds until the bucket re-admits (0.0 while admissible)."""
+        now = self._clock()
+        self._prune(now)
+        if self._used < self.limit:
+            return 0.0
+        over = self._used - self.limit
+        expired = 0.0
+        for t, n in self._events:
+            expired += n
+            if expired > over:
+                return max(0.0, t + self.window_s - now)
+        return self.window_s
+
+
+class _TenantState:
+    """Scheduler-side per-tenant accounting: the WFQ virtual time plus
+    the optional rate quota. ``configured`` marks tenants registered via
+    ``configure_tenant`` — only their names appear as metric label
+    values (the cardinality bound); ad-hoc tenant names are served under
+    default weight and labeled ``default``."""
+
+    __slots__ = ("name", "weight", "quota", "served_tokens", "vtime",
+                 "configured")
+
+    def __init__(self, name, weight=1.0, quota=None, vtime=0.0):
+        self.name = str(name)
+        self.weight = float(weight)
+        self.quota = quota
+        self.served_tokens = 0
+        self.vtime = float(vtime)
+        self.configured = False
 
 
 @dataclasses.dataclass
@@ -98,13 +214,24 @@ class Request:
     _ids = itertools.count(1)
 
     def __init__(self, prompt_ids, sampling: SamplingParams | None = None,
-                 rid=None, arrival_t=None, deadline=None):
+                 rid=None, arrival_t=None, deadline=None, tenant=None,
+                 tier=None):
         self.rid = rid if rid is not None else next(Request._ids)
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise ValueError("empty prompt")
         self.sampling = sampling or SamplingParams()
         self.arrival_t = arrival_t
+        # multi-tenant QoS (ISSUE 17): who this request bills to and how
+        # urgent it is. ``latency`` requests hold their decode slots;
+        # ``batch`` requests admit behind latency work and yield their
+        # slots under pressure (spill to the host tier, revive later).
+        self.tenant = str(tenant) if tenant else "default"
+        tier = tier or TIER_LATENCY
+        if tier not in (TIER_LATENCY, TIER_BATCH):
+            raise ValueError(f"unknown tier {tier!r}; expected "
+                             f"{TIER_LATENCY!r} or {TIER_BATCH!r}")
+        self.tier = tier
         # absolute wall-clock deadline (time.time() seconds, ISSUE 12):
         # the engine checks it at admission and at every step; expiry
         # aborts the request with a typed RequestTimeoutError finish
@@ -226,10 +353,17 @@ class Scheduler:
         # (src, dst) device page copies the engine must run before the
         # next pool write — queued by the COW guard, drained by step()
         self.pending_cow: list[tuple[int, int]] = []
+        # multi-tenant QoS (ISSUE 17): per-tenant WFQ/quota state, lazily
+        # created per tenant name. The weighted-fair admission path arms
+        # itself only once a tenant is configured or non-default traffic
+        # is queued — pure-default traffic keeps the exact FIFO order.
+        self.tenants: dict[str, _TenantState] = {}
+        self._qos_configured = False
         # pre-touch the series so stats reads zeros before any event
         for m in (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
-                  _M_PREFIX_REUSED, _M_COW):
+                  _M_PREFIX_REUSED, _M_COW, _M_THROTTLED, _M_BATCH_YIELD):
             m.inc(0, instance=self.instance)
+        _M_TENANT_TOKENS.inc(0, instance=self.instance, tenant="default")
 
     @property
     def stats(self):
@@ -243,7 +377,115 @@ class Scheduler:
             "prefix_blocks_reused": int(
                 _M_PREFIX_REUSED.value(instance=inst)),
             "cow_copies": int(_M_COW.value(instance=inst)),
+            "quota_throttled": int(_M_THROTTLED.value(instance=inst)),
+            "batch_yields": int(_M_BATCH_YIELD.value(instance=inst)),
         }
+
+    # -- multi-tenant QoS (ISSUE 17) -------------------------------------
+    def configure_tenant(self, name, *, weight=1.0, rate_tokens_per_s=None,
+                         window_s=1.0, clock=time.monotonic):
+        """Register (or refresh) tenant ``name``: its weighted-fair
+        ``weight`` — the share of admission it gets while backlogged
+        against other tenants — and an optional :class:`TenantQuota`
+        token-rate quota. The first configured tenant arms the QoS
+        admission path; until then admission is plain FIFO."""
+        if float(weight) <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {weight}")
+        st = self._tenant(name)
+        st.weight = float(weight)
+        st.quota = (TenantQuota(rate_tokens_per_s, window_s, clock=clock)
+                    if rate_tokens_per_s else None)
+        st.configured = True
+        self._qos_configured = True
+        return st
+
+    def _tenant(self, name):
+        st = self.tenants.get(name)
+        if st is None:
+            # a tenant joining late starts at the LOWEST live virtual
+            # time, not 0 — otherwise it would monopolize admission
+            # until it "caught up" with tenants that served all along
+            vt = min((s.vtime for s in self.tenants.values()), default=0.0)
+            st = self.tenants[name] = _TenantState(name, vtime=vt)
+        return st
+
+    def _qos_active(self):
+        return self._qos_configured or any(
+            r.tier != TIER_LATENCY or r.tenant != "default"
+            for r in self.waiting)
+
+    def note_served(self, req, n):
+        """Charge ``n`` served tokens (a prefill chunk or a decode
+        emission) to the request's tenant: advances its WFQ virtual time
+        by ``n / weight``, feeds its rate quota, and the per-tenant
+        token counter. Called by the engine on the serving hot path —
+        host-side arithmetic only."""
+        if n <= 0:
+            return
+        st = self._tenant(req.tenant)
+        st.served_tokens += int(n)
+        st.vtime += n / st.weight
+        if st.quota is not None:
+            st.quota.note(n)
+        _M_TENANT_TOKENS.inc(
+            n, instance=self.instance,
+            tenant=st.name if st.configured else "default")
+
+    def _admissible(self, st):
+        return st.quota is None or st.quota.admissible()
+
+    def _next_admission(self):
+        """QoS admission choice: the ``waiting`` position to admit next,
+        or ``None`` when every waiting request's tenant is quota-
+        deferred. The latency tier strictly outranks batch; within a
+        tier, the tenant with the lowest virtual time wins and its
+        EARLIEST queued request goes — per-tenant order stays FIFO, so
+        a tenant's own requests admit in submission order regardless of
+        what the other tenants do. Over-quota tenants are skipped
+        (deferred, never shed)."""
+        throttled = False
+        for tier in (TIER_LATENCY, TIER_BATCH):
+            best = None
+            seen = set()
+            for pos, req in enumerate(self.waiting):
+                if req.tier != tier or req.tenant in seen:
+                    continue
+                seen.add(req.tenant)
+                st = self._tenant(req.tenant)
+                if not self._admissible(st):
+                    throttled = True
+                    continue
+                if best is None or (st.vtime, pos) < best:
+                    best = (st.vtime, pos)
+            if best is not None:
+                return best[1]
+        if throttled:
+            _M_THROTTLED.inc(instance=self.instance)
+        return None
+
+    def _yield_batch_slot(self):
+        """Batch-tier yield (ISSUE 17): the slots are full and a
+        latency-tier request is waiting admissibly — preempt the most
+        recently admitted batch-tier running request through the normal
+        eviction path (which spills decode-ready pages to the host
+        tier, so revival is a page import, not a re-prefill). Returns
+        True when a slot was freed."""
+        wants_latency = any(
+            r.tier == TIER_LATENCY and self._admissible(self._tenant(
+                r.tenant))
+            for r in self.waiting)
+        if not wants_latency:
+            return False
+        batch = [r for r in self.running if r.tier == TIER_BATCH]
+        if not batch:
+            return False
+        # prefer decode-ready victims: their pages spill (mid-prefill
+        # pages are incomplete and degrade to recompute preemption)
+        ready = [r for r in batch if not r.prefilling]
+        victim = max(ready or batch, key=lambda r: r.admit_seq)
+        self._evict(victim)
+        _M_BATCH_YIELD.inc(instance=self.instance)
+        return True
 
     # -- queries ---------------------------------------------------------
     @property
@@ -264,13 +506,25 @@ class Scheduler:
         """Waiting requests to admit THIS step: pops up to
         ``max_prefills_per_step`` requests that fit (a free slot + blocks
         for prompt-and-first-token, charging only blocks the prefix cache
-        cannot supply). A head-of-queue request that does not fit stays
-        queued — FIFO, no overtaking — and the engine simply decodes with
-        what is running."""
+        cannot supply). A chosen request that does not fit stays queued —
+        no overtaking within the step — and the engine simply decodes
+        with what is running. Default traffic picks the FIFO head; with
+        QoS active the weighted-fair ``_next_admission`` chooses, and a
+        full slot set may first make room by preempting batch-tier work
+        (``_yield_batch_slot``)."""
         picked = []
-        while (len(picked) < self.max_prefills_per_step and self.waiting
-               and self._free_slot() is not None):
-            req = self.waiting[0]
+        while len(picked) < self.max_prefills_per_step and self.waiting:
+            qos = self._qos_active()
+            if self._free_slot() is None:
+                # batch-tier yield (ISSUE 17): under latency pressure a
+                # full slot set preempts batch work to the host tier
+                # instead of queueing latency requests behind it
+                if not (qos and self._yield_batch_slot()):
+                    break
+            pos = self._next_admission() if qos else 0
+            if pos is None:
+                break  # every waiting tenant is quota-deferred
+            req = self.waiting[pos]
             # a spill-evicted request revives from the host tier: its
             # payload becomes a ``preloaded`` import, exactly the
             # disaggregated-handoff shape. A tier that LRU-dropped the
@@ -308,7 +562,7 @@ class Scheduler:
                     self.allocator.free(matched)
                 _M_QUEUED_EXH.inc(instance=self.instance)
                 break
-            self.waiting.popleft()
+            del self.waiting[pos]
             slot = self._free_slot()
             req.blocks = list(matched) + blocks
             if req.preloaded is not None:
@@ -388,10 +642,17 @@ class Scheduler:
             got = self.allocator.allocate(1)
             if got is not None:
                 return got[0]
-            victim = max((r for r in self.running if r is not req),
-                         key=lambda r: r.admit_seq, default=None)
+            peers = [r for r in self.running if r is not req]
+            # batch-tier peers yield first (ISSUE 17): growing latency
+            # work never preempts a latency peer while batch work still
+            # occupies slots
+            batch = [r for r in peers if r.tier == TIER_BATCH]
+            victim = max(batch or peers, key=lambda r: r.admit_seq,
+                         default=None)
             if victim is None:
                 victim = req  # alone and out of memory: preempt self
+            if victim.tier == TIER_BATCH and req.tier == TIER_LATENCY:
+                _M_BATCH_YIELD.inc(instance=self.instance)
             self._evict(victim)
             evicted.append(victim)
             if victim is req:
@@ -479,7 +740,8 @@ class Scheduler:
                 and req.num_cached > 0
                 and req.num_cached == req.num_tokens - 1):
             if self.kv_tier.spill_request(req.rid, req.blocks,
-                                          req.num_cached):
+                                          req.num_cached,
+                                          tenant=req.tenant):
                 req.spill_key = req.rid
         self.allocator.free(req.blocks)
         req.blocks = []
@@ -506,8 +768,29 @@ class Scheduler:
         the distinction)."""
         if req.state == FINISHED:
             return
+        # early termination must also unwind queued device-page work that
+        # references the dying request (ISSUE 17 satellite): a pending
+        # host-tier revive would index into the emptied block list, and a
+        # pending COW copy would write into a freed (possibly re-
+        # allocated) destination block.
+        if self.pending_revive:
+            mine = [t for t in self.pending_revive if t[0] is req]
+            if mine:
+                self.pending_revive = [t for t in self.pending_revive
+                                       if t[0] is not req]
+                for _, _, h in mine:
+                    # the chain's host pages were pinned for this
+                    # admission; drop them the way the engine's dead-
+                    # request drain path does — a payload nobody will
+                    # import must not sit in the host tier forever
+                    if self.kv_tier is not None:
+                        self.kv_tier.pop_prefix(h)
         if req.state == RUNNING:
             slot = self.slots.index(req)
+            if self.pending_cow and req.blocks:
+                dying = set(req.blocks)
+                self.pending_cow = [(s, d) for s, d in self.pending_cow
+                                    if d not in dying]
             if req.blocks:
                 self.allocator.free(req.blocks)
             req.blocks = []

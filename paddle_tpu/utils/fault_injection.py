@@ -83,6 +83,16 @@ Sites wired into the framework:
   store must stay intact byte-for-byte and a torn shard must never
   become visible; boot after the failure recovers warm from the old
   store or cold-starts cleanly.
+- ``serve.tenant_flood`` — Router admission, fired per submit: the fleet
+  behaves as if a tenant flood has saturated the queue, so the submit is
+  shed with a typed FleetOverloadedError carrying a machine-readable
+  ``retry_after_s`` hint — well-behaved clients back off instead of
+  hammering an overloaded fleet.
+- ``serve.scale_down_kill`` — Router autoscale tick (boolean site), fired
+  as a scale-down decision starts draining the victim replica: the
+  replica is SIGKILLed MID-DRAIN, so its still-queued requests must ride
+  the normal crash-redispatch path to healthy peers — scale-down remains
+  zero-drop even when the retiring replica dies uncleanly.
 
 Arming a site is scoped and seeded::
 
@@ -113,7 +123,8 @@ SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "serve.dispatch", "io.stream.open", "io.stream.read",
          "io.stream.corrupt", "serve.prefill_crash",
          "serve.kv_transfer_corrupt", "serve.kv_spill",
-         "serve.store_write")
+         "serve.store_write", "serve.tenant_flood",
+         "serve.scale_down_kill")
 
 
 class InjectedFault(OSError):

@@ -1123,11 +1123,173 @@ def run_disagg_ab(tiny=True, seed=0, fleet=3):
     )
 
 
+def qos_sizing(tiny):
+    """Three-tenant mix over ONE engine (ISSUE 17): an interactive
+    latency-tier stream, a batch-tier flood sized to fill every decode
+    slot with long generations, and an abuser bursting a demand several
+    times its token-rate quota. The contended arm must keep the
+    interactive TTFT close to the uncontended reference while the
+    scheduler paces the abuser at its bucket rate."""
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        cfg = llama_tiny()
+        lat = dict(n=16, rate=150.0, min_prompt=4, max_prompt=24,
+                   min_new=12, max_new=24)
+        bat = dict(n=8, rate=1e6, min_prompt=4, max_prompt=16,
+                   min_new=24, max_new=40)
+        abu = dict(n=10, rate=1e6, min_prompt=4, max_prompt=12,
+                   min_new=8, max_new=12)
+        engine = dict(num_blocks=160, block_size=8, max_batch_size=8,
+                      max_prefills_per_step=2)
+        abuser_rate = 60.0
+    else:
+        cfg = llama_small()
+        lat = dict(n=48, rate=100.0, min_prompt=16, max_prompt=128,
+                   min_new=32, max_new=64)
+        bat = dict(n=8, rate=1e6, min_prompt=16, max_prompt=64,
+                   min_new=64, max_new=128)
+        abu = dict(n=24, rate=1e6, min_prompt=16, max_prompt=64,
+                   min_new=16, max_new=32)
+        engine = dict(num_blocks=512, block_size=16, max_batch_size=8)
+        abuser_rate = 200.0
+    return cfg, lat, bat, abu, engine, abuser_rate
+
+
+def _run_qos_arm(eng, jobs):
+    """One timed window of tenant/tier-attributed jobs through a warmed
+    engine. Per-tenant TTFT is bench-timed (first token seen minus
+    arrival) because the engine's TTFT histogram carries no ``tenant``
+    label — the cardinality bound is deliberate; scheduler-side QoS
+    counters (throttles, yields, per-tenant served tokens) are
+    engine-owned, read from the metrics registry after the window."""
+    from paddle_tpu.inference.serving import SamplingParams
+
+    eng.reset_metrics()
+    jobs = sorted(jobs, key=lambda j: j["arrival"])
+    owner = {}
+    first_t, finish_t = {}, {}
+    i = 0
+    t0 = time.perf_counter()
+    while i < len(jobs) or eng.has_work():
+        now = time.perf_counter() - t0
+        while i < len(jobs) and jobs[i]["arrival"] <= now:
+            j = jobs[i]
+            rid = eng.add_request(
+                j["req"].prompt,
+                SamplingParams(max_new_tokens=j["req"].max_new),
+                tenant=j["tenant"], tier=j["tier"])
+            owner[rid] = j
+            i += 1
+        if not eng.has_work():
+            time.sleep(max(0.0, jobs[i]["arrival"] - now))
+            continue
+        for out in eng.step():
+            t = time.perf_counter() - t0
+            if out.rid not in first_t:
+                first_t[out.rid] = t
+            if out.finished:
+                finish_t[out.rid] = t
+    wall = time.perf_counter() - t0
+    outs = {rid: eng.output_tokens(rid) for rid in owner}
+    em = eng.metrics()
+    stats = eng.stats()
+
+    def bucket_ttfts(bucket):
+        return [first_t[rid] - j["req"].arrival
+                for rid, j in owner.items() if j["bucket"] == bucket]
+
+    def bucket_span(bucket):
+        arr = [(j["req"].arrival, finish_t[rid], j["req"].max_new)
+               for rid, j in owner.items() if j["bucket"] == bucket]
+        if not arr:
+            return 0.0, 0
+        return (max(f for _, f, _ in arr) - min(a for a, _, _ in arr),
+                sum(g for _, _, g in arr))
+    return dict(owner=owner, outputs=outs, wall_s=round(wall, 4),
+                ttfts={b: bucket_ttfts(b) for b in ("lat", "bat", "abu")},
+                spans={b: bucket_span(b) for b in ("lat", "bat", "abu")},
+                quota_throttled=stats["quota_throttled"],
+                batch_yields=stats["batch_yields"],
+                tenant_tokens=em["tenant_tokens"])
+
+
+def run_qos_ab(tiny=True, seed=0):
+    """Multi-tenant QoS A/B (ISSUE 17): the SAME interactive stream runs
+    once uncontended and once under a batch flood + abuser burst, on one
+    warmed engine with tenants configured. Reports contended vs
+    uncontended latency-tier TTFT percentiles and the abuser's achieved
+    throughput against its quota; the interactive outputs of both arms
+    must be bit-identical (QoS changes WHEN work runs, never WHICH
+    tokens)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import TIER_BATCH
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, lat_kw, bat_kw, abu_kw, engine_kwargs, abuser_rate = \
+        qos_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    lat = request_stream(cfg, seed=seed, **lat_kw)
+    bat = request_stream(cfg, seed=seed + 1, **bat_kw)
+    abu = request_stream(cfg, seed=seed + 2, **abu_kw)
+
+    def jobs_from(stream, tenant, tier, bucket):
+        return [dict(arrival=r.arrival, req=r, tenant=tenant, tier=tier,
+                     bucket=bucket) for r in stream]
+
+    eng = warm_arms(model, lat + bat + abu, **engine_kwargs)
+    try:
+        eng.configure_tenant("interactive", weight=4.0)
+        eng.configure_tenant("batchjobs", weight=1.0)
+        eng.configure_tenant("abuser", rate_tokens_per_s=abuser_rate)
+        un = _run_qos_arm(
+            eng, jobs_from(lat, "interactive", None, "lat"))
+        co = _run_qos_arm(
+            eng, jobs_from(bat, "batchjobs", TIER_BATCH, "bat")
+            + jobs_from(abu, "abuser", None, "abu")
+            + jobs_from(lat, "interactive", None, "lat"))
+    finally:
+        eng.close()
+
+    def lat_outputs(arm):
+        ordered = sorted((rid for rid, j in arm["owner"].items()
+                          if j["bucket"] == "lat"),
+                         key=lambda rid: arm["owner"][rid]["req"].arrival)
+        return [arm["outputs"][rid] for rid in ordered]
+
+    bit_exact = _bit_exact(lat_outputs(un), lat_outputs(co))
+    abu_span, abu_tokens = co["spans"]["abu"]
+    abu_rate = round(abu_tokens / abu_span, 1) if abu_span else None
+    u99 = _latency_stats(un["ttfts"]["lat"])
+    c99 = _latency_stats(co["ttfts"]["lat"])
+    return dict(
+        uncontended=dict(wall_s=un["wall_s"],
+                         lat_ttft_p50_ms=u99["p50_ms"],
+                         lat_ttft_p99_ms=u99["p99_ms"]),
+        contended=dict(wall_s=co["wall_s"],
+                       lat_ttft_p50_ms=c99["p50_ms"],
+                       lat_ttft_p99_ms=c99["p99_ms"],
+                       abuser_tokens_per_sec=abu_rate,
+                       abuser_quota_tokens_per_sec=abuser_rate,
+                       quota_throttled=co["quota_throttled"],
+                       batch_yields=co["batch_yields"],
+                       tenant_tokens=co["tenant_tokens"]),
+        lat_ttft_p99_ratio=round(c99["p99_ms"] / u99["p99_ms"], 3)
+        if u99["p99_ms"] else None,
+        bit_exact=bool(bit_exact),
+        num_requests=len(lat) + len(bat) + len(abu),
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="poisson",
                     choices=["poisson", "shared-prefix", "chunked", "spec",
-                             "fleet", "quantized", "disagg", "tiering"])
+                             "fleet", "quantized", "disagg", "tiering",
+                             "qos"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
@@ -1201,6 +1363,14 @@ def main():
         if not res["bit_exact"]:
             sys.exit("FAIL: disaggregated fleet outputs diverge from the "
                      "in-process engine greedy reference")
+        return
+    if args.workload == "qos":
+        res = run_qos_ab(tiny=tiny, seed=args.seed)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: contended interactive outputs diverge from "
+                     "the uncontended run — QoS must only change WHEN "
+                     "work runs, never WHICH tokens")
         return
 
     cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)

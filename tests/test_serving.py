@@ -1645,11 +1645,15 @@ def _chaos_env():
 
 @pytest.mark.slow
 class TestChaosServeDrill:
-    @pytest.mark.parametrize("drill", ["kill", "hang", "drain"])
+    @pytest.mark.parametrize("drill", ["kill", "hang", "drain", "qos"])
     def test_drill(self, drill, tmp_path):
         """ISSUE 12 acceptance: scripts/chaos_serve.py --drill kill runs
         the storm (one replica SIGKILLed AND one hung mid-burst with
         fleet >= 3); hang and drain exercise their paths in isolation.
+        qos (ISSUE 17) floods the fleet with batch + over-quota traffic
+        and asserts the latency tier holds p99 TTFT, the abuser is
+        rate-limited typed, batch work yields-not-drops, and a
+        mid-flood scale-down (draining replica SIGKILLed) drops zero.
         Every drill asserts bit-exact outputs vs an undisturbed baseline,
         typed-error accounting, liveness dip+recovery and clean
         allocators — see the script for the full checklist."""
