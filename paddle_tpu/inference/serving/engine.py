@@ -125,6 +125,19 @@ _G_QUANT_BLOCKS = _obs_metrics.gauge(
     "int8-quantized KV pool blocks held by live requests after the last "
     "step (0 series absent on unquantized engines) — the occupancy the "
     "halved block memory buys")
+# device-resident decode (ISSUE 18): how often the decode loop blocks on
+# a device->host fetch and how many bytes it pulls. Host-side sampling
+# fetches [B, V] f32 logits per emitted token; in-graph sampling fetches
+# [B] int32 tokens; a fused k-step window fetches [B, k] int32 once.
+_M_HOST_SYNCS = _obs_metrics.counter(
+    "serving_host_syncs_total",
+    "blocking device->host fetches made by the decode loop (logits or "
+    "sampled tokens); one per decode round-trip, prefill fetches excluded")
+_M_FETCH_BYTES = _obs_metrics.counter(
+    "serving_decode_fetch_bytes_total",
+    "bytes fetched device->host by the decode loop: B*V*4 per step under "
+    "host-side sampling, B*4 per step with in-graph sampling, B*k*4 per "
+    "fused k-step decode window")
 
 # the ONE list of every serving metric handle an engine instance owns —
 # metrics() and reset_metrics() both iterate it, so a new metric cannot
@@ -144,7 +157,9 @@ _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
                     # multi-tenant QoS (ISSUE 17); _M_TENANT_TOKENS is
                     # tenant-labeled, so metrics()/reset_metrics() handle
                     # it separately (exact-match remove can't reach it)
-                    _M_THROTTLED, _M_BATCH_YIELD)
+                    _M_THROTTLED, _M_BATCH_YIELD,
+                    # device-resident decode (ISSUE 18)
+                    _M_HOST_SYNCS, _M_FETCH_BYTES)
 
 
 @dataclasses.dataclass
@@ -266,8 +281,9 @@ class LLMEngine:
                  draft_model=None, spec_tokens=2, kv_dtype=None,
                  prefill_only=False, kv_host_blocks=0,
                  prefix_store_path=None, prefix_store_autosave_chains=None,
-                 fuse_draft_catchup=True):
-        from ...models.llama import LlamaForCausalLM
+                 fuse_draft_catchup=True, decode_steps_per_sync=1,
+                 in_graph_sampling=None, capture_logits=False):
+        from ...models.llama import LlamaForCausalLM, sample_next_tokens
 
         if not isinstance(model, LlamaForCausalLM):
             raise TypeError("LLMEngine serves LlamaForCausalLM models; got "
@@ -432,6 +448,47 @@ class LLMEngine:
             self._draft_prefill_jit = None
             self._draft_decode_jit = None
             self._verify_jit = None
+        # device-resident decode (ISSUE 18): in-graph greedy sampling
+        # shrinks the per-step fetch from [B, V] f32 logits to [B] int32
+        # tokens; fused windows (decode_steps_per_sync=k) run k decode
+        # iterations inside one fori_loop graph and fetch [B, k] tokens
+        # per host round-trip. k=1 with in_graph_sampling unset keeps the
+        # pre-ISSUE-18 host-sampling path byte-identical.
+        k = int(decode_steps_per_sync)
+        if k < 1:
+            raise ValueError(
+                f"decode_steps_per_sync must be >= 1, got {k}")
+        if k > 1 and draft_model is not None:
+            raise ValueError(
+                "decode_steps_per_sync > 1 and speculative decoding are "
+                "mutually exclusive: the verify window already batches "
+                "device work and samples in-graph")
+        if in_graph_sampling is None:
+            in_graph_sampling = k > 1
+        in_graph_sampling = bool(in_graph_sampling)
+        if k > 1 and not in_graph_sampling:
+            raise ValueError(
+                "decode_steps_per_sync > 1 requires in_graph_sampling: a "
+                "fused window cannot round-trip logits to the host "
+                "between its iterations")
+        if in_graph_sampling and draft_model is not None:
+            raise ValueError(
+                "in_graph_sampling applies to the plain decode path; the "
+                "speculative verify step already samples in-graph")
+        if capture_logits and in_graph_sampling:
+            raise ValueError(
+                "capture_logits=True requires host-side sampling "
+                "(in_graph_sampling=False, decode_steps_per_sync=1): "
+                "device-resident decode never fetches the logits rows")
+        self._decode_window = k
+        self._in_graph = in_graph_sampling
+        self.capture_logits = bool(capture_logits)
+        self._window_name = f"llm_engine_decode_window#{n}"
+        self._window_jit = None
+        self._warned_do_sample = False
+        # hoisted from _emit (ISSUE 18 satellite): one import at
+        # construction instead of one per emitted token
+        self._sample_next_tokens = sample_next_tokens
         # device block-table cache (ISSUE 11 satellite): rebuilt only when
         # the scheduler's table version moves, so steady-state decode does
         # ZERO table H2D
@@ -958,14 +1015,22 @@ class LLMEngine:
         the fused path must run the IDENTICAL op sequence per step or
         draft proposals — and therefore acceptance counts — would drift
         between modes. Assumes params are already swapped in and the
-        caller is inside ``trace_guard``."""
+        caller is inside ``trace_guard``.
+
+        ``active`` (jnp [B] bool, optional) is the fused decode window's
+        EOS-freeze mask (ISSUE 18): rows marked inactive have their K/V
+        write redirected to the reserved null block 0 at offset 0 — the
+        same scratch target empty slots already write through their
+        all-zero table rows — so a finished row can ride out the rest of
+        the window without corrupting live pages."""
         from ...core.tensor import Tensor
 
         block_size = self.block_size
         _head = self._head_fn(model)
         _arr = self._arr
 
-        def core(ids, positions, tables, k_pools, v_pools, ks_in, vs_in):
+        def core(ids, positions, tables, k_pools, v_pools, ks_in, vs_in,
+                 active=None):
             import jax
             import jax.numpy as jnp
 
@@ -1009,6 +1074,11 @@ class LLMEngine:
                 blk = tables[jnp.arange(bsz),
                              positions // block_size]
                 off = positions % block_size
+                if active is not None:
+                    # EOS-freeze: park frozen rows' writes on the null
+                    # block (reserved, never allocated to a request)
+                    blk = jnp.where(active, blk, 0)
+                    off = jnp.where(active, off, 0)
                 if quantized:
                     qk, sk = quantize_kv_rows(ka)   # [B,1,Hkv,D]
                     qv, sv = quantize_kv_rows(va)
@@ -1078,6 +1148,96 @@ class LLMEngine:
             return logits, new_k, new_v, new_ks, new_vs
 
         return decode_pure
+
+    def _make_window_fn(self, model, params, window):
+        """Fused k-step decode window (ISSUE 18 tentpole): ``(param_arrays,
+        ids [B, 1], positions [B], active [B] bool, budget [B] int32,
+        eos_ids [B] int32, tables [B, P], k_pools, v_pools, k_scales,
+        v_scales) -> (tokens [B, window] int32, pools, scale pools)``.
+
+        A ``fori_loop`` body runs one full decode iteration — paged
+        attention, KV write at the advanced position, in-graph greedy
+        argmax — then advances each ACTIVE row's position/input token and
+        freezes rows that emitted their ``eos_ids`` entry or exhausted
+        their per-row ``budget`` (min(window, tokens remaining), computed
+        host-side). Frozen rows write to null block 0 via the decode
+        core's ``active`` mask and their token column repeats the frozen
+        input id, which the host-side emitter ignores. The graph compiles
+        ONCE per (B, window): every input shape is fixed, and the loop
+        body reuses the SAME traced core as the per-step path, so greedy
+        outputs are bit-identical to k sequential per-step decodes."""
+        from ...core import state as _state
+        from ...models.llama import greedy_tokens_in_graph
+
+        core = self._make_decode_core(model)
+
+        def window_pure(param_arrays, ids, positions, active, budget,
+                        eos_ids, tables, k_pools, v_pools, k_scales,
+                        v_scales):
+            import jax
+            import jax.numpy as jnp
+
+            quantized = len(k_scales) > 0
+            ks_in = k_scales if quantized else [None] * len(k_pools)
+            vs_in = v_scales if quantized else [None] * len(v_pools)
+            old = [p._data for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                with _state.trace_guard():
+                    def one(t, ids, positions, active, budget, toks,
+                            kps, vps, kss, vss):
+                        lg, kps, vps, kss, vss = core(
+                            ids, positions, tables, kps, vps, kss, vss,
+                            active=active)
+                        nxt = greedy_tokens_in_graph(lg)
+                        # frozen rows repeat their input id; the emitter
+                        # never reads past a row's budget anyway
+                        emitted = jnp.where(active, nxt, ids[:, 0])
+                        toks = jax.lax.dynamic_update_slice(
+                            toks, emitted[:, None], (0, t))
+                        stepped = active.astype(jnp.int32)
+                        positions = positions + stepped
+                        budget = budget - stepped
+                        done = (emitted == eos_ids) | (budget <= 0)
+                        active = active & ~done
+                        ids = emitted[:, None]
+                        return (ids, positions, active, budget, toks,
+                                kps, vps, kss, vss)
+
+                    toks0 = jnp.zeros((ids.shape[0], window), jnp.int32)
+                    # step 0 outside the loop fixes the carry avals
+                    (ids_c, pos_c, act_c, bud_c, toks, kps, vps, kss,
+                     vss) = one(0, ids, positions, active, budget, toks0,
+                                k_pools, v_pools, ks_in, vs_in)
+                    if not quantized:
+                        kss, vss = [], []
+
+                    def body(t, carry):
+                        (ids_c, pos_c, act_c, bud_c, toks, kps, vps,
+                         kss, vss) = carry
+                        (ids_c, pos_c, act_c, bud_c, toks, kps, vps,
+                         kss, vss) = one(
+                            t, ids_c, pos_c, act_c, bud_c, toks, kps,
+                            vps,
+                            kss if quantized else [None] * len(kps),
+                            vss if quantized else [None] * len(vps))
+                        if not quantized:
+                            kss, vss = [], []
+                        return (ids_c, pos_c, act_c, bud_c, toks, kps,
+                                vps, kss, vss)
+
+                    (ids_c, pos_c, act_c, bud_c, toks, kps, vps, kss,
+                     vss) = jax.lax.fori_loop(
+                        1, window, body,
+                        (ids_c, pos_c, act_c, bud_c, toks, kps, vps,
+                         kss, vss))
+            finally:
+                for p, a in zip(params, old):
+                    p._data = a
+            return toks, kps, vps, kss, vss
+
+        return window_pure
 
     def _make_catchup_fn(self, model, params):
         """Fused ragged draft catch-up (ISSUE 16 perf satellite): one
@@ -1283,6 +1443,12 @@ class LLMEngine:
         self._decode_jit = compile_step_with_plan(
             self._make_decode_fn(self.model, self._params), self._plan,
             name=self._decode_name, donate_argnums=(4, 5, 6, 7))
+        if self._in_graph:
+            self._window_jit = compile_step_with_plan(
+                self._make_window_fn(self.model, self._params,
+                                     self._decode_window),
+                self._plan, name=self._window_name,
+                donate_argnums=(7, 8, 9, 10))
         if self.draft_model is not None:
             self._draft_prefill_jit = compile_step_with_plan(
                 self._make_chunk_fn(self.draft_model, self._draft_params),
@@ -1477,14 +1643,28 @@ class LLMEngine:
             return outputs
 
         # -- decode ------------------------------------------------------
-        sched.ensure_decode_room(extra=self._spec_k)
+        sched.ensure_decode_room(
+            extra=self._spec_k,
+            extra_for=(self._window_extra if self._decode_window > 1
+                       else None))
         self._drain_cow()
         ready = [(i, r) for i, r in enumerate(sched.slots)
                  if r is not None and not r.prefilling]
         if ready:
+            sampled = any(r.sampling.do_sample for _, r in ready)
             if self._spec_k:
                 self._spec_step(ready, outputs)
+            elif self._in_graph and not sampled:
+                self._window_step(ready, outputs)
             else:
+                if self._in_graph and not self._warned_do_sample:
+                    self._warned_do_sample = True
+                    warnings.warn(
+                        f"{self._name}: do_sample=True requests keep the "
+                        "host sampling path (per-request numpy RNG); "
+                        "device-resident decode degrades to per-step "
+                        "host sampling while any is in the batch",
+                        RuntimeWarning)
                 B = self.max_batch_size
                 ids = np.zeros((B, 1), np.int32)
                 positions = np.zeros(B, np.int32)
@@ -1498,12 +1678,57 @@ class LLMEngine:
                         jnp.asarray(positions), self._tables(),
                         c.k, c.v, c.k_scale, c.v_scale)
                 logits = np.asarray(logits)
+                _M_HOST_SYNCS.inc(instance=self._name)
+                _M_FETCH_BYTES.inc(logits.nbytes, instance=self._name)
                 for i, req in ready:
                     req.num_cached += 1
                     outputs.extend(self._emit(req, logits[i]))
         self._maybe_autosave_store()
         self._update_gauges()
         return outputs
+
+    def _window_extra(self, req):
+        """Lookahead positions ``ensure_decode_room`` must reserve for
+        ``req`` before a fused window: the window writes at most
+        ``min(k, tokens remaining)`` new positions, the first of which
+        the base room check already covers."""
+        remaining = req.sampling.max_new_tokens - len(req.output_tokens)
+        return max(min(self._decode_window, remaining) - 1, 0)
+
+    def _window_step(self, ready, outputs):
+        """Device-resident decode for all decode-ready slots (ISSUE 18):
+        one fused ``decode_steps_per_sync``-step dispatch, one ``[B, k]``
+        int32 token fetch, then batched host-side emission. Greedy only —
+        ``step`` routes batches containing ``do_sample`` requests to the
+        per-step host path."""
+        import jax.numpy as jnp
+
+        B, k = self.max_batch_size, self._decode_window
+        ids = np.zeros((B, 1), np.int32)
+        positions = np.zeros(B, np.int32)
+        active = np.zeros(B, np.bool_)
+        budget = np.zeros(B, np.int32)
+        eos_ids = np.full(B, -1, np.int32)
+        for i, req in ready:
+            ids[i, 0] = req.last_token
+            positions[i] = req.num_cached
+            active[i] = True
+            remaining = (req.sampling.max_new_tokens
+                         - len(req.output_tokens))
+            budget[i] = min(k, remaining)
+            if req.sampling.eos_token_id is not None:
+                eos_ids[i] = req.sampling.eos_token_id
+        c = self.cache
+        (toks, c.k, c.v, c.k_scale, c.v_scale) = self._window_jit(
+            [p._data for p in self._params], jnp.asarray(ids),
+            jnp.asarray(positions), jnp.asarray(active),
+            jnp.asarray(budget), jnp.asarray(eos_ids), self._tables(),
+            c.k, c.v, c.k_scale, c.v_scale)
+        toks = np.asarray(toks)
+        _M_HOST_SYNCS.inc(instance=self._name)
+        _M_FETCH_BYTES.inc(toks.nbytes, instance=self._name)
+        for i, req in ready:
+            self._emit_window(req, toks[i], outputs)
 
     def _drain_revives(self):
         """Land this step's host-tier prefix hits (queued by the
@@ -1635,6 +1860,8 @@ class LLMEngine:
                         jnp.asarray(ids), jnp.asarray(pos), tables,
                         dc.k, dc.v, dc.k_scale, dc.v_scale)
         prev = np.asarray(logits)
+        _M_HOST_SYNCS.inc(instance=self._name)
+        _M_FETCH_BYTES.inc(prev.nbytes, instance=self._name)
         drafts = np.zeros((B, K), np.int32)
         for kstep in range(K):
             for i, r in ready:
@@ -1652,6 +1879,8 @@ class LLMEngine:
                         jnp.asarray(ids), jnp.asarray(pos), tables,
                         dc.k, dc.v, dc.k_scale, dc.v_scale)
                 prev = np.asarray(prev)
+                _M_HOST_SYNCS.inc(instance=self._name)
+                _M_FETCH_BYTES.inc(prev.nbytes, instance=self._name)
         for _, r in ready:
             # positions 0 .. num_tokens+K-2 now hold draft K/V
             r.draft_cached = r.num_tokens + K - 1
@@ -1684,6 +1913,9 @@ class LLMEngine:
             c.k, c.v, c.k_scale, c.v_scale)
         counts = np.asarray(counts)
         nxt = np.asarray(nxt)
+        _M_HOST_SYNCS.inc(instance=self._name)
+        _M_FETCH_BYTES.inc(counts.nbytes + nxt.nbytes,
+                           instance=self._name)
         accepted = 0
         for i, r in ready:
             a = int(counts[i])
@@ -1718,18 +1950,74 @@ class LLMEngine:
     def _emit(self, req, row):
         """Sample the next token for ``req`` from logits ``row`` [V] and
         commit it. Returns [StepOutput]."""
-        from ...models.llama import sample_next_tokens
-
         s = req.sampling
-        # last sampled-from logits row, kept for the quantization
-        # tolerance tests (bounded logit delta vs the fp32 engine) and as
-        # a logprobs hook; [V] f32, overwritten per emission, dropped
-        # with the request at release()
-        req.last_logits = np.asarray(row)
-        tok = int(sample_next_tokens(
+        if self.capture_logits:
+            # last sampled-from logits row, kept for the quantization
+            # tolerance tests (bounded logit delta vs the fp32 engine) and
+            # as a logprobs hook; [V] f32, overwritten per emission,
+            # dropped with the request at release(). Opt-in (ISSUE 18):
+            # the copy is a [V] f32 D2H pinned per live request.
+            req.last_logits = np.asarray(row)
+        tok = int(self._sample_next_tokens(
             row[None], do_sample=s.do_sample, temperature=s.temperature,
             top_k=s.top_k, top_p=s.top_p, rng=req._rng)[0])
         return self._emit_token(req, tok)
+
+    def _emit_window(self, req, toks, outputs):
+        """Commit one fused window's tokens for ``req`` (``toks`` is the
+        request's ``[k]`` int32 row from the window fetch) in a single
+        batched pass: the accept scan mirrors the in-graph EOS-freeze
+        (stop after eos or the max_new_tokens budget), QoS charges ONCE
+        for the whole window, and the single window-boundary clock read
+        is spread over the accepted tokens as m observations of Δt/m so
+        ITL percentiles stay per-token comparable (see DESIGN_DECISIONS
+        "Device-resident decode"). Appends StepOutputs to ``outputs``."""
+        s = req.sampling
+        accepted = []
+        for t in toks:
+            accepted.append(int(t))
+            if len(req.output_tokens) + len(accepted) >= s.max_new_tokens:
+                break
+            if s.eos_token_id is not None and int(t) == s.eos_token_id:
+                break
+        m = len(accepted)
+        req.output_tokens.extend(accepted)
+        req.num_cached += m
+        self.stats_extra["tokens_out"] += m
+        # QoS accounting (ISSUE 17): the tenant's quota/vtime charge moves
+        # to the window boundary — one charge of m tokens
+        self.scheduler.note_served(req, m)
+        now = time.perf_counter_ns()
+        _M_TOKENS.inc(m, instance=self._name)
+        spread = m
+        if req.t_first_token is None:
+            # first emission happens in decode only for imported requests
+            # (disagg handoff / tier revival); TTFT lands on the first
+            # token, ITL on the rest
+            req.t_first_token = now
+            if req.t_submit is not None:
+                _H_TTFT.observe((now - req.t_submit) / 1e6,
+                                instance=self._name)
+            spread = m - 1
+        if spread > 0 and req.t_last_token is not None:
+            dt_ms = (now - req.t_last_token) / 1e6 / spread
+            for _ in range(spread):
+                _H_ITL.observe(dt_ms, instance=self._name)
+        req.t_last_token = now
+        done = req.should_finish()
+        if done:
+            self.scheduler.finish(req)
+            start = req.t_decode_start or req.t_first_token or now
+            _obs_trace.add_complete(
+                "request.decode", start, now, cat="request", tid=req.rid,
+                args={"rid": req.rid, "engine": self._name,
+                      "tokens": len(req.output_tokens),
+                      "finish_reason": req.finish_reason()})
+        for j, tok in enumerate(accepted):
+            last = j == m - 1
+            outputs.append(StepOutput(
+                req.rid, int(tok), done and last,
+                req.finish_reason() if done and last else None))
 
     def _emit_token(self, req, tok):
         """Commit one already-chosen token (sampled host-side, or accepted
@@ -1941,6 +2229,10 @@ class LLMEngine:
             "quota_throttled": int(_M_THROTTLED.value(instance=inst)),
             "batch_yields": int(_M_BATCH_YIELD.value(instance=inst)),
             "tenant_tokens": self._tenant_token_counts(),
+            # device-resident decode (ISSUE 18): decode-loop round-trips
+            # and the bytes they pulled (prefill fetches excluded)
+            "host_syncs": int(_M_HOST_SYNCS.value(instance=inst)),
+            "decode_fetch_bytes": int(_M_FETCH_BYTES.value(instance=inst)),
         }
 
     def _remove_tenant_series(self):

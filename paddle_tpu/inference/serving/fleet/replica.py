@@ -166,6 +166,23 @@ def replica_worker_main():
             reloaded = eng.reload_weights(mgr)
     hb_dir = cfg.get("hb_dir")
     hb.write(step=0, dir=hb_dir, rank=replica_id)
+
+    # In-graph/window engines (decode_steps_per_sync > 1) warm their
+    # decode executable BEFORE reporting ready: the first-call compile of
+    # a fused k-step window can outlast the hang watchdog — especially
+    # with every replica compiling at once — and a replica must never
+    # look wedged for unavoidable one-time work. Boot time is covered by
+    # the supervisor's boot grace, not the heartbeat. Default engines
+    # keep the lazy first-call compile (pre-window boot behavior).
+    if getattr(eng, "_in_graph", False) and role != "prefill":
+        wid = eng.add_request(np.zeros(4, dtype=np.int64),
+                              SamplingParams(max_new_tokens=2))
+        while not any(o.rid == wid and o.finished for o in eng.step()):
+            pass
+        eng.release(wid)
+        eng.reset_metrics()
+        eng.reset_block_high_water()
+
     _emit({"e": "ready", "replica": replica_id, "role": role,
            "incarnation": int(os.environ.get(ENV_INCARNATION, "0") or 0),
            "reloaded_step": reloaded})

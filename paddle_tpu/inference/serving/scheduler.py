@@ -271,6 +271,10 @@ class Request:
         self.revived_from_tier = False
         self.admit_seq = -1               # admission order (eviction policy)
         self.evictions = 0
+        # last-position logits row, stashed by the engine only when it was
+        # built with ``capture_logits=True`` (ISSUE 18: the copy is a [V]
+        # f32 D2H per emission, so it is opt-in); None otherwise
+        self.last_logits = None
         self._rng = (np.random.RandomState(self.sampling.seed)
                      if self.sampling.do_sample else None)
 
@@ -658,10 +662,14 @@ class Scheduler:
             if victim is req:
                 return None
 
-    def ensure_decode_room(self, extra=0):
+    def ensure_decode_room(self, extra=0, extra_for=None):
         """Grow every running request that is about to write past its last
         block; ``extra`` reserves additional lookahead positions (the
-        speculative verify window writes ``k+1`` tokens at once). On
+        speculative verify window writes ``k+1`` tokens at once).
+        ``extra_for`` — a ``Request -> int`` callable — overrides ``extra``
+        per request: fused decode windows (ISSUE 18) reserve
+        ``min(k, tokens_remaining) - 1`` positions so a request one token
+        from its budget cap never grows a block it will not write. On
         exhaustion, evict the most-recently-admitted running request (free
         its blocks, re-queue at the FRONT) and retry — token-granularity
         eviction. Divergent-write targets that are shared get a private
@@ -673,7 +681,8 @@ class Scheduler:
                 continue
             # mid-prefill requests already own blocks for prompt+1 tokens
             # (charged at admission) and take no speculative lookahead
-            lookahead = 0 if req.prefilling else int(extra)
+            lookahead = 0 if req.prefilling else int(
+                extra_for(req) if extra_for is not None else extra)
             # the decode step writes ONE token at position len(tokens)-1
             # (plus ``lookahead`` speculative positions), so capacity
             # len(tokens)+lookahead is exactly enough — demanding more

@@ -31,7 +31,8 @@ from ..nn.layer.norm import RMSNorm
 from ..ops import creation, manipulation as M, math as ops_math
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "StaticKVCache",
-           "sample_next_tokens", "llama_tiny", "llama_small", "llama_125m",
+           "sample_next_tokens", "greedy_tokens_in_graph",
+           "llama_tiny", "llama_small", "llama_125m",
            "llama_1b", "llama_7b", "llama_13b"]
 
 
@@ -206,6 +207,20 @@ def sample_next_tokens(last, *, do_sample=False, temperature=1.0, top_k=None,
         probs /= probs.sum(-1, keepdims=True)
     return np.array([rng.choice(probs.shape[1], p=probs[i])
                      for i in range(last.shape[0])])
+
+
+def greedy_tokens_in_graph(last):
+    """In-graph greedy companion to :func:`sample_next_tokens`: argmax over
+    the last axis of logits ``last`` (jnp [B, V] f32), returned as int32.
+
+    Bit-identical to the host path: ``sample_next_tokens`` casts f32 logits
+    to float64 before ``np.argmax`` — the cast is exact and monotone, so the
+    winning index (first occurrence on ties, same rule as ``jnp.argmax``)
+    cannot change. Used by the serving engine's device-resident decode so
+    the per-step fetch is ``[B]`` int32 instead of ``[B, V]`` f32."""
+    import jax.numpy as jnp
+
+    return jnp.argmax(last, axis=-1).astype(jnp.int32)
 
 
 class LlamaAttention(Layer):

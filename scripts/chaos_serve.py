@@ -112,6 +112,28 @@ ENGINE_KW = dict(num_blocks=64, block_size=8, max_batch_size=4,
                  max_prefills_per_step=2)
 
 
+def _window_k():
+    return int(ENGINE_KW.get("decode_steps_per_sync", 1))
+
+
+def _hang_after_steps():
+    """Busy-tick count before the armed replica wedges. Calibrated in
+    TOKENS (12) for one-token engine steps; a fused decode window emits
+    k tokens per step, so the trigger scales down to keep the wedge
+    landing mid-burst instead of after the work is done."""
+    return max(3, 12 // _window_k())
+
+
+def _hang_timeout_s():
+    """Watchdog staleness bound. Calibrated (3s) for one-token engine
+    steps; a k-step fused window multiplies the legitimate worst-case
+    gap between heartbeats — both the serve-loop beat cadence and the
+    one-time window compile — so the bound scales with k. On a one-core
+    runner an unscaled bound cascades: one hang verdict respawns a
+    replica whose re-warmup starves the others past the bound in turn."""
+    return 3.0 * _window_k()
+
+
 def check(cond, msg):
     if not cond:
         raise AssertionError(msg)
@@ -276,8 +298,8 @@ def drill_kill(out, model, n, hang_too=True):
     if arm_hang:
         env = {"CHAOS_SERVE_SITE": "serve.replica_hang",
                "CHAOS_SERVE_REPLICA": str(n - 1),
-               "CHAOS_SERVE_AFTER_STEPS": "12"}
-    fleet = _fleet(out, n, hang_timeout_s=3.0, env_extra=env)
+               "CHAOS_SERVE_AFTER_STEPS": str(_hang_after_steps())}
+    fleet = _fleet(out, n, hang_timeout_s=_hang_timeout_s(), env_extra=env)
     try:
         victim = {}
 
@@ -288,6 +310,10 @@ def drill_kill(out, model, n, hang_too=True):
             # the redispatch path is guaranteed to be exercised.
             cand = [h for h in fl.supervisor.handles
                     if h.alive and (not arm_hang or h.id != n - 1)]
+            if not cand:
+                # every candidate is mid-respawn (watchdog churn under
+                # contention) — retry once somebody is back up and busy
+                return False
             h = max(cand, key=lambda h: len(fl.inflight(h.id)))
             if not fl.inflight(h.id):
                 return False
@@ -345,8 +371,8 @@ def drill_hang(out, model, n):
     baseline = baseline_outputs(model, stream)
     env = {"CHAOS_SERVE_SITE": "serve.replica_hang",
            "CHAOS_SERVE_REPLICA": str(n - 1),
-           "CHAOS_SERVE_AFTER_STEPS": "12"}
-    fleet = _fleet(out, n, hang_timeout_s=3.0, env_extra=env)
+           "CHAOS_SERVE_AFTER_STEPS": str(_hang_after_steps())}
+    fleet = _fleet(out, n, hang_timeout_s=_hang_timeout_s(), env_extra=env)
     try:
         gids, shed, wall = run_burst(fleet, stream)
         wait_all_ready(fleet)
@@ -486,7 +512,7 @@ def drill_quant(out, model, n):
         1, model=model_q)
     stream = request_stream(_cfg(model_q))
     baseline = baseline_outputs(model_q, stream, engine_kw=engine_kw)
-    fleet = _fleet(out, n, engine_kw=engine_kw, hang_timeout_s=3.0)
+    fleet = _fleet(out, n, engine_kw=engine_kw, hang_timeout_s=_hang_timeout_s())
     try:
         victim = {}
 
@@ -548,7 +574,7 @@ def drill_disagg(out, model, n):
                {"site": "serve.replica_hang", "replica": total - 1,
                 "after": 12},
            ])}
-    fleet = _fleet(out, total, roles=roles, hang_timeout_s=3.0,
+    fleet = _fleet(out, total, roles=roles, hang_timeout_s=_hang_timeout_s(),
                    env_extra=env)
     try:
         gids, shed, wall = run_burst(fleet, stream)
@@ -990,8 +1016,16 @@ def main(argv=None):
                     choices=["kill", "hang", "drain", "shed", "quant",
                              "disagg", "warmstore", "qos", "all"])
     ap.add_argument("--fleet", type=int, default=3)
+    ap.add_argument("--decode-window", type=int, default=1,
+                    help="decode_steps_per_sync for every engine (baseline "
+                    "AND fleet replicas): >1 proves redispatch replay is "
+                    "window-agnostic (ISSUE 18)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.decode_window > 1:
+        # threaded through the ONE shared kwargs dict so the single-engine
+        # baseline and the replicas stay the same engine configuration
+        ENGINE_KW["decode_steps_per_sync"] = args.decode_window
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     out_root = args.out or tempfile.mkdtemp(prefix="chaos_serve.")

@@ -22,7 +22,11 @@ predate it). Rounds are compared WITHIN a platform: the CPU-smoke
 trajectory (r06+, cpu metric names like ``serving_cpu_engine_…``)
 anchors and guards its own history without reading the TPU rounds'
 metrics as "vanished", and vice versa — each platform's LATEST round is
-checked against that platform's prior rounds.
+checked against that platform's prior rounds. Platforms named ``cpu*``
+use the looser ``CPU_SMOKE_RATIO`` round-over-round floor (ISSUE 18):
+shared-host guest-visible speed swings ~25-30% between sessions, so the
+absolute cpu numbers only witness catastrophic regressions — the strict
+cpu gates are the within-round A/B ratios and bit-exact asserts.
 
 **Multichip strategy-parity tripwire** (ISSUE 8 satellite): the LATEST
 ``MULTICHIP_r*.json`` artifact's dryrun lines are checked too. Since the
@@ -100,11 +104,31 @@ def default_floors():
         return {}
 
 
+# Shared-host CPU smoke rounds (ISSUE 18 re-anchor): the guest-visible
+# host speed swings ~25-30% on minute-to-hour timescales — measured on an
+# IDLE single-core guest with identical code, each workload in its own
+# subprocess: bert_tiny fine-tune 8962 vs 6219 tok/s (0.69x) forty
+# minutes apart, resnet18 16.8 vs 13.4 img/s (0.80x) within the hour.
+# A 0.95 floor against one prior point estimate false-fails UNCHANGED
+# code on such a host. 0.70 still catches the catastrophic regressions
+# absolute CPU numbers can witness; the strict cpu tripwires are the
+# within-round A/B ratios (speedups, capacity ratios, bit-exact gates,
+# compile counts), which are hardware-relative and stable across
+# host-speed swings. Dedicated-chip platforms keep the 0.95 bound.
+CPU_SMOKE_RATIO = 0.70
+
+
+def _platform_ratio(plat, ratio):
+    return min(ratio, CPU_SMOKE_RATIO) if plat.startswith("cpu") else ratio
+
+
 def check(rounds, ratio=0.95, floors=None):
     """Failure strings across platforms: each platform's latest round is
     checked against that platform's prior rounds (empty == all clear).
     Records without a ``platform`` stamp group under "tpu", so synthetic
-    single-platform histories behave exactly as before."""
+    single-platform histories behave exactly as before. Platforms whose
+    name starts with "cpu" use :data:`CPU_SMOKE_RATIO` when it is below
+    ``ratio`` (shared-host variance, see above)."""
     if not rounds:
         return ["FAIL: no BENCH_r*.json artifacts found"]
     by_platform = {}
@@ -115,8 +139,9 @@ def check(rounds, ratio=0.95, floors=None):
                 metric] = rec
     failures = []
     for plat in sorted(by_platform):
-        failures += _check_one_platform(by_platform[plat], ratio=ratio,
-                                        floors=floors)
+        failures += _check_one_platform(
+            by_platform[plat], ratio=_platform_ratio(plat, ratio),
+            floors=floors)
     return failures
 
 
@@ -324,7 +349,8 @@ def main(argv=None):
         if not failures:
             n = len(rounds.get(latest, {})) if rounds else 0
             print(f"OK: round {latest}, {n} metrics within "
-                  f"{args.ratio}x of prior round and above MFU floors; "
+                  f"{args.ratio}x of prior round ({CPU_SMOKE_RATIO}x on "
+                  f"cpu* platforms) and above MFU floors; "
                   f"multichip r{mc_latest}, {mc_anchored} anchored "
                   f"strategy lines within "
                   f"{args.multichip_tol:.0%} of baseline")

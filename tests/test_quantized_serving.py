@@ -354,7 +354,7 @@ class TestQuantizedEngine:
         ref = model(paddle.to_tensor(p[None])).numpy()[0, -1]
         with LLMEngine(model, num_blocks=64, block_size=8,
                        max_batch_size=2, kv_dtype="int8",
-                       ingest_async=False) as eng:
+                       ingest_async=False, capture_logits=True) as eng:
             rid = eng.add_request(p, SamplingParams(max_new_tokens=1))
             for _ in eng.stream():
                 pass

@@ -1682,6 +1682,25 @@ class TestChaosServeDrill:
         assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
         assert "SERVE DRILL PASSED" in r.stdout
 
+    def test_drill_kill_windowed(self, tmp_path):
+        """ISSUE 18: the kill storm with fused decode windows (k=4) on
+        every engine — baseline AND replicas — proves redispatch replay
+        is window-agnostic: the router replays prompt + already-emitted
+        tokens on a survivor and the windowed engine reproduces the
+        bit-identical continuation."""
+        import subprocess
+        import sys as _sys
+
+        r = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "scripts",
+                                           "chaos_serve.py"),
+             "--drill", "kill", "--fleet", "3", "--decode-window", "4",
+             "--out", str(tmp_path)],
+            env=_chaos_env(), cwd=REPO, capture_output=True, text=True,
+            timeout=560)
+        assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+        assert "SERVE DRILL PASSED" in r.stdout
+
 
 @pytest.mark.slow
 class TestFleetScaling:
