@@ -858,6 +858,44 @@ def bench_serving(on_tpu):
                          "outputs bit-exact vs the in-process CPU "
                          "engine",
     })
+    # model-parallel fleet A/B (ISSUE 19): a llama whose fp32 weights +
+    # KV pool exceed the per-device byte budget — unservable on any
+    # single-device replica — runs on tp=2 replica GROUPS (one Router
+    # slot = two coordinated worker processes over jax.distributed),
+    # against the largest ladder config that does fit one device on the
+    # same device count. The tracked line is the sharded arm's tokens/s:
+    # fleet-scale serving of a model that does not fit one device. CPU
+    # subprocess for the same backend reasons as the fleet line.
+    r = subprocess.run(
+        [_sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts", "bench_serving.py"),
+         "--workload", "tpfleet", "--tiny"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, f"tpfleet A/B failed: {r.stderr[-2000:]}"
+    tpf = _json.loads(r.stdout)
+    assert tpf["bit_exact"], \
+        "tp-sharded fleet diverged from the in-process engine reference"
+    _emit({
+        "metric": "serving_cpu_tpfleet_tokens_per_sec",
+        "value": tpf["sharded"]["tokens_per_sec"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "tokens_per_sec_single_device_config":
+            tpf["single"]["tokens_per_sec"],
+        "tp": tpf["tp"],
+        "n_groups": tpf["n_groups"],
+        "n_devices": tpf["n_devices"],
+        "device_budget_bytes": tpf["device_budget_bytes"],
+        "big_model_device_bytes": tpf["big_model_device_bytes"],
+        "big_model_shard_bytes": tpf["big_model_shard_bytes"],
+        "bit_exact": tpf["bit_exact"],
+        "num_requests": tpf["num_requests"],
+        "baseline_note": "one seeded burst through 2 tp=2 replica "
+                         "groups serving a llama whose weights + KV "
+                         "pool exceed the per-device budget, vs the "
+                         "largest single-device config that fits on "
+                         "the same device count; each arm bit-exact "
+                         "vs its in-process CPU engine reference",
+    })
     # disaggregated prefill/decode A/B (ISSUE 15): colocated vs
     # role-split fleets of the SAME size on the long-prompt mix. The
     # tracked line is the split arm's tokens/s; the headline contract —

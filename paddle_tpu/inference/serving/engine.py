@@ -299,6 +299,40 @@ class LLMEngine:
         self._plan = plan
         if plan is not None:
             plan.apply_to_model(model)
+        # multi-process plan (ISSUE 19): the plan's mesh spans jax
+        # processes (one engine rank per process, SPMD lockstep — the
+        # fleet's tp replica groups). Host-side control flow stays
+        # identical on every rank; device arrays the engine feeds its
+        # compiled steps must live REPLICATED on the global mesh (_g),
+        # outputs are pinned replicated (_build_jits), and host fetches
+        # read the locally addressable shard (_fetch).
+        self._mp = False
+        if plan is not None and plan.mesh.devices.size > 1:
+            import jax
+            pi = jax.process_index()
+            self._mp = any(d.process_index != pi
+                           for d in plan.mesh.devices.flat)
+        if self._mp:
+            # features whose data path fetches pool pages to the host
+            # (or runs a second model) are incompatible with a
+            # process-spanning mesh; fail at construction, not mid-burst
+            for flag, why in (
+                    (int(kv_host_blocks) > 0,
+                     "kv_host_blocks > 0 (host KV tier spills pool "
+                     "pages to host RAM)"),
+                    (prefix_store_path is not None,
+                     "prefix_store_path (the store exports pool "
+                     "pages)"),
+                    (draft_model is not None,
+                     "draft_model (speculative decoding)"),
+                    (bool(prefill_only),
+                     "prefill_only (disaggregated handoff exports "
+                     "pool pages)")):
+                if flag:
+                    raise ValueError(
+                        f"a plan whose mesh spans multiple processes "
+                        f"does not support {why}; run these features "
+                        "on single-process engines")
         self.config = model.config
         was_training = model.training
         model.eval()
@@ -331,6 +365,8 @@ class LLMEngine:
         self.kv_dtype = kv_dtype
         self.cache = PagedKVCache(self.config, num_blocks, block_size,
                                   dtype=dtype, kv_dtype=kv_dtype)
+        if self._mp:
+            self._globalize_cache(self.cache)
         self._kv_bytes_saved = self.cache.bytes_saved_vs_unquantized(
             self.config)
         # prefix sharing (ISSUE 11): content-hashed block identity over the
@@ -589,6 +625,44 @@ class LLMEngine:
                 "blocks and removed this instance's metric series)")
 
     # ------------------------------------------------------------------
+    # multi-process placement helpers (ISSUE 19)
+    # ------------------------------------------------------------------
+    def _g(self, x):
+        """Device placement for a step input: on a single-process mesh
+        this is plain ``jnp.asarray`` (byte-identical to the pre-group
+        engine); on a process-spanning mesh the value is committed
+        REPLICATED over the plan's global mesh — every rank passes the
+        same host value (SPMD lockstep), so the commit is collective-free
+        and keeps jit from refusing to mix local and global arrays."""
+        if not self._mp:
+            import jax.numpy as jnp
+            return jnp.asarray(x)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(
+            np.asarray(x), NamedSharding(self._plan.mesh,
+                                         PartitionSpec()))
+
+    def _fetch(self, arr):
+        """Host fetch of a step output. Outputs on a process-spanning
+        mesh are pinned replicated (``_build_jits``), so every rank reads
+        the SAME value from its locally addressable shard —
+        ``np.asarray`` on the global array itself would raise (it spans
+        non-addressable devices)."""
+        if not self._mp:
+            return np.asarray(arr)
+        return np.asarray(arr.addressable_data(0))
+
+    def _globalize_cache(self, cache):
+        """Re-commit freshly zeroed pool arrays (created on the local
+        default device) replicated over the global mesh so the compiled
+        steps can donate and rebind them."""
+        cache.k = [self._g(x) for x in cache.k]
+        cache.v = [self._g(x) for x in cache.v]
+        cache.k_scale = [self._g(x) for x in cache.k_scale]
+        cache.v_scale = [self._g(x) for x in cache.v_scale]
+
+    # ------------------------------------------------------------------
     # request lifecycle
     # ------------------------------------------------------------------
     def _bucket_for(self, n):
@@ -611,7 +685,8 @@ class LLMEngine:
         spec = BucketSpec({1: (bucket,)})
         ids, _ = np_pad_to_bucket(toks[None].astype(np.int32), spec,
                                   lengths={1: len(toks)})
-        req._staged = (jax.device_put(ids), bucket, len(toks))
+        ids_dev = self._g(ids) if self._mp else jax.device_put(ids)
+        req._staged = (ids_dev, bucket, len(toks))
 
     def add_request(self, prompt_ids, sampling: SamplingParams | None = None,
                     arrival_t=None, deadline=None, tenant=None, tier=None):
@@ -719,6 +794,11 @@ class LLMEngine:
         pools. The request must have finished prefill (decode-ready) —
         exporting a half-prefilled request would hand off pages the
         first token was never sampled from."""
+        if self._mp:
+            raise ValueError(
+                "export_kv_pages is not supported on a plan whose mesh "
+                "spans multiple processes: pool pages cannot be fetched "
+                "to one host (sharded disagg handoff is future work)")
         req = self._requests[rid]
         if req.finished or req.prefilling or req.num_cached < 1:
             raise ValueError(
@@ -1435,20 +1515,33 @@ class LLMEngine:
     def _build_jits(self):
         from ...distributed.plan import compile_step_with_plan
 
+        # process-spanning mesh: pin EVERY output replicated (a single
+        # PartitionSpec leaf is a prefix pytree covering all outputs).
+        # Logits/tokens must be replicated so every rank's host fetch
+        # reads the same value from its addressable shard; pools ride
+        # along replicated, which costs an allgather on the sharded
+        # attention writes but keeps the engine's rebind/donate contract
+        # rank-agnostic.
+        mp_out = None
+        if self._mp:
+            from jax.sharding import PartitionSpec
+            mp_out = PartitionSpec()
         # scale pools donate beside the payload pools (empty pytrees on
         # the fp path — a zero-leaf donation is a no-op)
         self._prefill_jit = compile_step_with_plan(
             self._make_chunk_fn(self.model, self._params), self._plan,
-            name=self._prefill_name, donate_argnums=(5, 6, 7, 8))
+            name=self._prefill_name, donate_argnums=(5, 6, 7, 8),
+            out_specs=mp_out)
         self._decode_jit = compile_step_with_plan(
             self._make_decode_fn(self.model, self._params), self._plan,
-            name=self._decode_name, donate_argnums=(4, 5, 6, 7))
+            name=self._decode_name, donate_argnums=(4, 5, 6, 7),
+            out_specs=mp_out)
         if self._in_graph:
             self._window_jit = compile_step_with_plan(
                 self._make_window_fn(self.model, self._params,
                                      self._decode_window),
                 self._plan, name=self._window_name,
-                donate_argnums=(7, 8, 9, 10))
+                donate_argnums=(7, 8, 9, 10), out_specs=mp_out)
         if self.draft_model is not None:
             self._draft_prefill_jit = compile_step_with_plan(
                 self._make_chunk_fn(self.draft_model, self._draft_params),
@@ -1495,7 +1588,10 @@ class LLMEngine:
         if key != self._tables_version:
             lists = [(r.blocks if ok else [])
                      for ok, r in zip(mask, sched.slots)]
-            self._tables_dev = self.cache.table_array(lists, self.max_pages)
+            tbl = self.cache.table_array(lists, self.max_pages)
+            if self._mp:
+                tbl = self._g(np.asarray(tbl))
+            self._tables_dev = tbl
             self._tables_version = key
         return self._tables_dev
 
@@ -1539,12 +1635,18 @@ class LLMEngine:
         tables_row = np.zeros(self.max_pages, np.int32)
         nblk = min(len(req.blocks), self.max_pages)
         tables_row[:nblk] = req.blocks[:nblk]
-        tables_dev = jnp.asarray(tables_row)
+        tables_dev = self._g(tables_row)
+        start_a, upto_a = np.int32(start), np.int32(start + take)
+        if self._mp:
+            # scalars too: a host scalar beside global-mesh arrays would
+            # make jit refuse the mixed-device call (ids_chunk is a view
+            # of the staged ids, already replicated on the global mesh)
+            start_a, upto_a = self._g(start_a), self._g(upto_a)
         cache = self.cache
         (logits, cache.k, cache.v, cache.k_scale, cache.v_scale) = \
             self._prefill_jit(
                 [p._data for p in self._params], ids_chunk,
-                np.int32(start), np.int32(start + take), tables_dev,
+                start_a, upto_a, tables_dev,
                 cache.k, cache.v, cache.k_scale, cache.v_scale)
         if self.draft_model is not None:
             # mirror every target chunk into the draft pools: the draft
@@ -1574,7 +1676,7 @@ class LLMEngine:
             _M_PREFILLS.inc(instance=self._name)
             # the _emit below fetches logits (the existing sync point);
             # the prefill span closes right after it
-            outputs.extend(self._emit(req, np.asarray(logits)[0]))
+            outputs.extend(self._emit(req, self._fetch(logits)[0]))
             req.t_decode_start = time.perf_counter_ns()
             _obs_trace.add_complete(
                 "request.prefill",
@@ -1674,10 +1776,10 @@ class LLMEngine:
                 c = self.cache
                 (logits, c.k, c.v, c.k_scale, c.v_scale) = \
                     self._decode_jit(
-                        [p._data for p in self._params], jnp.asarray(ids),
-                        jnp.asarray(positions), self._tables(),
+                        [p._data for p in self._params], self._g(ids),
+                        self._g(positions), self._tables(),
                         c.k, c.v, c.k_scale, c.v_scale)
-                logits = np.asarray(logits)
+                logits = self._fetch(logits)
                 _M_HOST_SYNCS.inc(instance=self._name)
                 _M_FETCH_BYTES.inc(logits.nbytes, instance=self._name)
                 for i, req in ready:
@@ -1720,11 +1822,11 @@ class LLMEngine:
                 eos_ids[i] = req.sampling.eos_token_id
         c = self.cache
         (toks, c.k, c.v, c.k_scale, c.v_scale) = self._window_jit(
-            [p._data for p in self._params], jnp.asarray(ids),
-            jnp.asarray(positions), jnp.asarray(active),
-            jnp.asarray(budget), jnp.asarray(eos_ids), self._tables(),
+            [p._data for p in self._params], self._g(ids),
+            self._g(positions), self._g(active),
+            self._g(budget), self._g(eos_ids), self._tables(),
             c.k, c.v, c.k_scale, c.v_scale)
-        toks = np.asarray(toks)
+        toks = self._fetch(toks)
         _M_HOST_SYNCS.inc(instance=self._name)
         _M_FETCH_BYTES.inc(toks.nbytes, instance=self._name)
         for i, req in ready:
@@ -2142,6 +2244,14 @@ class LLMEngine:
                 raise FileNotFoundError(
                     "reload_weights: no committed checkpoint in "
                     f"{source.root}")
+            if self._plan is not None:
+                # group rejoin gate (ISSUE 19): a checkpoint recorded
+                # under a DIFFERENT sharding plan must not be committed
+                # to this engine's layouts — raise PlanMismatchError
+                # (typed) instead of silently serving re-sharded weights
+                # the rest of the fleet does not have
+                CheckpointManager._check_plan(
+                    source.plan_fingerprint(step), self._plan, step)
             load_state_dict(self.model.state_dict(), source.step_dir(step))
             return step
         import os

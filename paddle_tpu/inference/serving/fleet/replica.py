@@ -79,14 +79,66 @@ import time
 import zlib
 
 from .framing import decode_frame, encode_frame, join_frames, split_frames
-from .supervisor import ENV_CONFIG, ENV_ID, ENV_INCARNATION
+from .supervisor import (ENV_CONFIG, ENV_COORD_PORT, ENV_GROUP_RANK,
+                         ENV_GROUP_SIZE, ENV_ID, ENV_INCARNATION)
 
 __all__ = ["replica_worker_main"]
 
+# non-zero group ranks run the SAME engine in SPMD lockstep but own no
+# RPC stream — rank 0 is the one mouth of the group, so everyone else's
+# protocol emissions are suppressed (their stdout is a log file)
+_SILENT = [False]
+
 
 def _emit(obj):
+    if _SILENT[0]:
+        return
     sys.stdout.write(json.dumps(obj) + "\n")
     sys.stdout.flush()
+
+
+class _GroupChannel:
+    """Rank-0 → member command broadcast for a multi-process replica
+    group, over the group's own jax coordination service KV store (the
+    PR-4 transport — no second socket layer). The contract is SPMD
+    lockstep: rank 0 publishes one ``fleet.tick.<seq>`` entry per busy
+    loop iteration carrying exactly the commands it is about to apply;
+    every member applies the same commands to an identical engine and
+    then steps — so the collectives inside the compiled step line up by
+    construction. Idle iterations publish nothing (no collectives run);
+    members poll with a timeout so their heartbeats stay fresh while
+    idle. Consumed entries are garbage-collected ``_GC_LAG`` ticks
+    behind the publisher — members can never lag further than one
+    in-flight collective."""
+
+    _GC_LAG = 512
+
+    def __init__(self):
+        from jax._src import distributed as jdist
+
+        self._client = jdist.global_state.client
+        self._seq = 0
+
+    def publish(self, cmds):
+        self._client.key_value_set(f"fleet.tick.{self._seq}",
+                                   json.dumps(cmds))
+        old = self._seq - self._GC_LAG
+        if old >= 0:
+            try:
+                self._client.key_value_delete(f"fleet.tick.{old}")
+            except Exception:
+                pass
+        self._seq += 1
+
+    def fetch(self, timeout_ms=250):
+        """The next tick's commands, or ``None`` on timeout (idle)."""
+        try:
+            raw = self._client.blocking_key_value_get(
+                f"fleet.tick.{self._seq}", int(timeout_ms))
+        except Exception:
+            return None
+        self._seq += 1
+        return json.loads(raw)
 
 
 # the armed inject() context managers must outlive _arm_chaos: a GC'd
@@ -95,7 +147,11 @@ def _emit(obj):
 _CHAOS_CMS: list = []
 
 
-def _chaos_specs(replica_id):
+def _chaos_specs(replica_id, group_rank=0):
+    """Armed (site, after, max_fires) specs for THIS process. Specs may
+    carry a ``"rank"`` (default 0) so a group drill can poison exactly
+    one member — e.g. ``serve.group_member_crash`` on rank 1 while rank
+    0 keeps answering the router until the supervisor fells the group."""
     multi = os.environ.get("CHAOS_SERVE_SITES")
     if multi:
         try:
@@ -104,21 +160,24 @@ def _chaos_specs(replica_id):
             return []
         return [(s["site"], int(s.get("after", 1) or 1),
                  s.get("max_fires")) for s in specs
-                if str(s.get("replica")) == str(replica_id)]
+                if str(s.get("replica")) == str(replica_id)
+                and int(s.get("rank", 0) or 0) == int(group_rank)]
     site = os.environ.get("CHAOS_SERVE_SITE")
-    if site and os.environ.get("CHAOS_SERVE_REPLICA") == str(replica_id):
+    if site and os.environ.get("CHAOS_SERVE_REPLICA") == str(replica_id) \
+            and int(os.environ.get("CHAOS_SERVE_RANK", "0")
+                    or 0) == int(group_rank):
         return [(site,
                  int(os.environ.get("CHAOS_SERVE_AFTER_STEPS", "1") or 1),
                  None)]
     return []
 
 
-def _arm_chaos(replica_id):
+def _arm_chaos(replica_id, group_rank=0):
     if int(os.environ.get(ENV_INCARNATION, "0") or 0) != 0:
         return  # restarted incarnations run clean
     from ....utils import fault_injection as fi
 
-    for site, after, max_fires in _chaos_specs(replica_id):
+    for site, after, max_fires in _chaos_specs(replica_id, group_rank):
         # armed for the process lifetime (the fault ends or taints only
         # this incarnation)
         cm = fi.inject(site, every_n=after, max_fires=max_fires)
@@ -128,8 +187,46 @@ def _arm_chaos(replica_id):
 
 def replica_worker_main():
     replica_id = int(os.environ[ENV_ID])
+    group_size = int(os.environ.get(ENV_GROUP_SIZE, "1") or 1)
+    group_rank = int(os.environ.get(ENV_GROUP_RANK, "0") or 0)
+    _SILENT[0] = group_rank != 0
     cfg = json.loads(os.environ[ENV_CONFIG])
-    _arm_chaos(replica_id)
+    _arm_chaos(replica_id, group_rank)
+
+    if group_size > 1:
+        # multi-process replica group (ISSUE 19): rendezvous on the
+        # incarnation's PRIVATE coordination service (fresh port per
+        # incarnation — a respawned group must never rendezvous with a
+        # half-dead predecessor) before any backend work. gloo backs the
+        # CPU cross-process collectives; real TPU pods override the
+        # platform via env and ride the default backend.
+        if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+            # CPU simulation: each member owns an EQUAL share of the
+            # plan's devices, so the group's global mesh is exactly the
+            # plan — regardless of any device count the parent baked
+            # into XLA_FLAGS (the test harness forces 8 virtual devices
+            # per process, which would hand a 2-process tp=2 group 16
+            # global devices and a mesh living entirely on rank 0).
+            # XLA_FLAGS is still honored here: backends init lazily and
+            # no array op has run yet.
+            import re
+
+            spec = cfg.get("plan") or {}
+            total = 1
+            for v in (spec.get("axes") or {}).values():
+                total *= int(v)
+            per = max(total // group_size, 1)
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                           "", os.environ.get("XLA_FLAGS", ""))
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={per}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            f"127.0.0.1:{os.environ[ENV_COORD_PORT]}",
+            num_processes=group_size, process_id=group_rank)
 
     import numpy as np
 
@@ -143,6 +240,17 @@ def replica_worker_main():
     model = load_llama_artifact(cfg["artifact"])
     role = cfg.get("role") or "both"
     engine_kw = dict(cfg.get("engine") or {})
+    plan_spec = cfg.get("plan")
+    if plan_spec:
+        # sharding plan from its JSON spec ({"axes": {...}, "strategies":
+        # [...]}): the mesh is built over jax.devices() — the group's
+        # GLOBAL device set after the rendezvous above, or this process's
+        # virtual devices for in-process tp (XLA_FLAGS via env_extra)
+        from ....distributed.plan import Plan
+
+        engine_kw["plan"] = Plan.build(
+            dict(plan_spec["axes"]),
+            list(plan_spec.get("strategies") or ()))
     if engine_kw.get("prefix_store_path"):
         # each replica persists its own prefix-store shard — a literal
         # shared path would have every worker clobbering one store file
@@ -165,7 +273,12 @@ def replica_worker_main():
                 or mgr.latest_valid_step() is not None):
             reloaded = eng.reload_weights(mgr)
     hb_dir = cfg.get("hb_dir")
-    hb.write(step=0, dir=hb_dir, rank=replica_id)
+    # group members heartbeat under hb.<replica>.<rank> — EVERY member
+    # beats, so the watchdog condemns the group when ANY member wedges
+    # (single-process replicas keep the bare hb.<replica> name)
+    hb_rank = (f"{replica_id}.{group_rank}" if group_size > 1
+               else replica_id)
+    hb.write(step=0, dir=hb_dir, rank=hb_rank)
 
     # In-graph/window engines (decode_steps_per_sync > 1) warm their
     # decode executable BEFORE reporting ready: the first-call compile of
@@ -173,19 +286,57 @@ def replica_worker_main():
     # with every replica compiling at once — and a replica must never
     # look wedged for unavoidable one-time work. Boot time is covered by
     # the supervisor's boot grace, not the heartbeat. Default engines
-    # keep the lazy first-call compile (pre-window boot behavior).
-    if getattr(eng, "_in_graph", False) and role != "prefill":
-        wid = eng.add_request(np.zeros(4, dtype=np.int64),
-                              SamplingParams(max_new_tokens=2))
-        while not any(o.rid == wid and o.finished for o in eng.step()):
-            pass
-        eng.release(wid)
+    # keep the lazy first-call compile (pre-window boot behavior) —
+    # EXCEPT replica groups, which pre-compile EVERY admissible prefill
+    # bucket: a post-ready first-touch compile stalls the whole group's
+    # collectives with every heartbeat silent, long enough to read as a
+    # hang, and boot (covered by the group-scaled boot grace) is the
+    # only place one-time work belongs. Both ranks run this identical
+    # warmup, so the compile-time collectives line up by construction.
+    if (getattr(eng, "_in_graph", False) or group_size > 1) \
+            and role != "prefill":
+        cap = min(eng.max_model_len,
+                  (eng.cache.num_blocks - 1) * eng.block_size)
+        lens = [4]
+        if group_size > 1:
+            lens, prev = [], 0
+            for b in eng.prefill_buckets:
+                ln = min(b - 1, cap - 1)
+                if ln > prev:
+                    lens.append(ln)
+                prev = b
+        for k, ln in enumerate(lens):
+            wid = eng.add_request(
+                np.zeros(ln, dtype=np.int64),
+                SamplingParams(max_new_tokens=2 if k == 0 else 1))
+            while not any(o.rid == wid and o.finished
+                          for o in eng.step()):
+                pass
+            eng.release(wid)
         eng.reset_metrics()
         eng.reset_block_high_water()
+        # the warmup compiles ran long past the boot-time heartbeat:
+        # refresh it BEFORE ready flips, or the watchdog reads the whole
+        # warmup as staleness the moment boot grace stops protecting us
+        hb.write(step=0, dir=hb_dir, rank=hb_rank)
+
+    chan = None
+    if group_size > 1:
+        # ready only after ALL ranks ack warm-up (ISSUE 19 satellite):
+        # the barrier proves every member built its engine, committed
+        # the plan-sharded weights and warmed its executables — a group
+        # where one rank is still compiling must not take traffic
+        from ....distributed.checkpoint import sync_processes
+
+        sync_processes("fleet.group.warmup")
+        # ranks can skew by whole compiles at the barrier; every member
+        # re-beats on release so nobody's wait reads as a wedge
+        hb.write(step=0, dir=hb_dir, rank=hb_rank)
+        chan = _GroupChannel()
 
     _emit({"e": "ready", "replica": replica_id, "role": role,
            "incarnation": int(os.environ.get(ENV_INCARNATION, "0") or 0),
-           "reloaded_step": reloaded})
+           "reloaded_step": reloaded, "group_size": group_size})
 
     cmd_q: queue.Queue = queue.Queue()
 
@@ -200,7 +351,10 @@ def replica_worker_main():
                 continue
         cmd_q.put({"op": "shutdown"})  # EOF: the router is gone
 
-    threading.Thread(target=_reader, daemon=True).start()
+    if group_rank == 0:
+        # only rank 0 owns an RPC stream; a member's stdin is /dev/null
+        # and its EOF must not shut the group down at boot
+        threading.Thread(target=_reader, daemon=True).start()
 
     rid_of = {}    # gid -> engine rid
     meta = {}      # gid -> {"gen": k}
@@ -420,29 +574,61 @@ def replica_worker_main():
         now = time.monotonic()
         if now - last_hb[0] >= 0.25:
             last_hb[0] = now
-            hb.write(step=steps, dir=hb_dir, rank=replica_id)
+            hb.write(step=steps, dir=hb_dir, rank=hb_rank)
 
     while True:
         # chaos probes count BUSY ticks only: a crash/hang while idle
         # exercises nothing — the interesting failure is mid-serve, with
-        # in-flight requests for the router to recover
+        # in-flight requests for the router to recover. The group sites
+        # are armed on ONE member (the spec's "rank"): member_crash is
+        # the partial-group OOM-kill shape, member_hang wedges this rank
+        # so the next collective stalls the WHOLE group — every member's
+        # heartbeat goes stale and only the watchdog can end it.
         if eng.has_work():
             if fi.should_fire("serve.replica_crash"):
                 os.kill(os.getpid(), signal.SIGKILL)
-            if fi.should_fire("serve.replica_hang"):
+            if fi.should_fire("serve.group_member_crash"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            if fi.should_fire("serve.replica_hang") or \
+                    fi.should_fire("serve.group_member_hang"):
                 while True:  # wedged: no heartbeat, no service, no exit
                     time.sleep(3600)
-        try:
-            cmd = (cmd_q.get_nowait() if eng.has_work() or shutting
-                   else cmd_q.get(timeout=0.05))
-        except queue.Empty:
-            cmd = None
-        while cmd is not None:
-            _handle(cmd)
+        if chan is not None and group_rank > 0:
+            # member rank: commands arrive ONLY on the broadcast channel,
+            # in rank 0's exact application order (SPMD lockstep); a
+            # fetch timeout is an idle tick — heartbeat and re-poll
+            cmds = chan.fetch()
+            if cmds is None:
+                steps += 1
+                _beat()
+                continue
+            for cmd in cmds:
+                _handle(cmd)
+        else:
             try:
-                cmd = cmd_q.get_nowait()
+                cmd = (cmd_q.get_nowait() if eng.has_work() or shutting
+                       else cmd_q.get(timeout=0.05))
             except queue.Empty:
                 cmd = None
+            cmds = []
+            while cmd is not None:
+                cmds.append(cmd)
+                try:
+                    cmd = cmd_q.get_nowait()
+                except queue.Empty:
+                    cmd = None
+            if chan is not None:
+                # group lockstep cannot follow wall clocks: a deadline
+                # expiring between two ranks' admission checks would
+                # desynchronize the collectives, so group replicas strip
+                # it — deadline enforcement stays at the router, whose
+                # cancel commands ride this same ordered channel
+                for c in cmds:
+                    c.pop("deadline", None)
+                if cmds or eng.has_work():
+                    chan.publish(cmds)
+            for cmd in cmds:
+                _handle(cmd)
         if eng.has_work():
             gid_by_rid = {rid: gid for gid, rid in rid_of.items()}
             per_gid = {}

@@ -243,20 +243,30 @@ class Router:
                  hang_timeout_s=0.0, max_restarts=3, log_dir=None,
                  env_extra=None, wait_ready=True, roles=None,
                  max_kv_retries=3, max_pending_handoffs=8,
-                 idle_backoff=(0.0005, 0.05), slo_admission=False):
+                 idle_backoff=(0.0005, 0.05), slo_admission=False,
+                 group_size=1, plan=None):
         self._name = f"fleet#{next(Router._ids)}"
         engine_kwargs = dict(engine_kwargs or {})
         if supervisor is None:
             if artifact is None or n_replicas is None:
                 raise ValueError("pass either a supervisor or "
                                  "artifact= + n_replicas=")
+            # model-parallel replica groups (ISSUE 19): group_size > 1
+            # makes every slot a multi-process group serving ONE
+            # plan-sharded engine; `plan` is the JSON plan spec
+            # ({"axes": {...}, "strategies": [...]}) every group member
+            # rebuilds over its rendezvous'd global mesh. The router
+            # itself is group-blind — a group is one handle, placed by
+            # rank 0's engine-owned load like any other replica.
+            config = {"artifact": artifact, "engine": engine_kwargs,
+                      "ckpt_root": ckpt_root}
+            if plan is not None:
+                config["plan"] = plan
             supervisor = ReplicaSupervisor(
-                n_replicas,
-                {"artifact": artifact, "engine": engine_kwargs,
-                 "ckpt_root": ckpt_root},
+                n_replicas, config,
                 hang_timeout_s=hang_timeout_s, max_restarts=max_restarts,
                 log_dir=log_dir, env_extra=env_extra, instance=self._name,
-                roles=roles)
+                roles=roles, group_size=group_size)
             if wait_ready:
                 try:
                     supervisor.wait_ready()

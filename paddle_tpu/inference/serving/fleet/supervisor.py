@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -79,6 +80,20 @@ _M_SCALE_DOWN = _obs_metrics.counter(
     "fleet_scale_down_total",
     "replicas nominated for drain-then-retire by autoscale (fleet calm "
     "below the low watermark past the cooldown)")
+# model-parallel replica groups (ISSUE 19): per-replica member liveness
+# and whole-group restarts. A group is atomic — members_live < group_size
+# is a transient state the supervisor resolves by felling the whole
+# group, never a serving state.
+_G_GROUP_MEMBERS = _obs_metrics.gauge(
+    "fleet_group_members_live",
+    "processes of this replica group currently running (a value below "
+    "the group size means the group is being felled or respawned — a "
+    "partial group never serves)")
+_M_GROUP_RESTARTS = _obs_metrics.counter(
+    "fleet_group_restarts_total",
+    "whole-group respawns performed by the supervisor (any member "
+    "crash/hang fells and restarts the entire group, charging ONE "
+    "restart-budget slot)")
 
 # repo root (five levels up: fleet/serving/inference/paddle_tpu/<repo>)
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -87,6 +102,23 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
 ENV_ID = "PADDLE_REPLICA_ID"
 ENV_CONFIG = "PADDLE_REPLICA_CONFIG"
 ENV_INCARNATION = "PADDLE_REPLICA_INCARNATION"
+# model-parallel replica groups (ISSUE 19)
+ENV_GROUP_SIZE = "PADDLE_REPLICA_GROUP_SIZE"
+ENV_GROUP_RANK = "PADDLE_REPLICA_GROUP_RANK"
+ENV_COORD_PORT = "PADDLE_REPLICA_COORD_PORT"
+
+
+def _free_port():
+    """A currently free TCP port for an incarnation's private
+    coordination service (racy-but-fine: the group binds it within
+    milliseconds, and a collision just fails the boot — which the
+    watchdog turns into an ordinary group restart on a NEW port)."""
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
 
 
 class ReplicaHandle:
@@ -96,12 +128,22 @@ class ReplicaHandle:
     events come back on stdout, pumped by a daemon reader thread into an
     internal queue that :meth:`events` drains. stderr goes to a per-
     replica log file (jax chatter must never corrupt the RPC stream).
+
+    ``group_size > 1`` (ISSUE 19) makes the handle a multi-process
+    GROUP: rank 0 keeps the RPC pipes (``proc``/``pid`` stay rank 0, so
+    the router's one-handle-one-target view is unchanged) and ranks 1+
+    are spawned headless (stdin ``/dev/null``, stdout+stderr to their
+    own log). The group is ATOMIC: :attr:`alive` demands every member
+    running, and :meth:`kill` fells them all — a half-dead tp group must
+    never answer.
     """
 
     def __init__(self, replica_id, config, *, env=None, log_path=None,
-                 incarnation=0):
+                 incarnation=0, group_size=1, coord_port=None):
         self.id = int(replica_id)
         self.incarnation = int(incarnation)
+        self.group_size = int(group_size)
+        self.coord_port = coord_port
         self.spawn_time = time.time()
         self.ready = False
         self.ready_info = None
@@ -109,18 +151,38 @@ class ReplicaHandle:
         self._lock = threading.Lock()
         self._events: list = []
         self._log_file = open(log_path, "ab") if log_path else None
+        self._member_logs = []
         child_env = dict(env if env is not None else os.environ)
         child_env[ENV_ID] = str(self.id)
         child_env[ENV_CONFIG] = json.dumps(config)
         child_env[ENV_INCARNATION] = str(self.incarnation)
         child_env["PYTHONPATH"] = (_REPO + os.pathsep
                                    + child_env.get("PYTHONPATH", ""))
+        if self.group_size > 1:
+            child_env[ENV_GROUP_SIZE] = str(self.group_size)
+            child_env[ENV_COORD_PORT] = str(coord_port)
+            child_env[ENV_GROUP_RANK] = "0"
         self.proc = subprocess.Popen(
             [sys.executable, "-u", "-m",
              "paddle_tpu.inference.serving.fleet.replica"],
             env=child_env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=(self._log_file or subprocess.DEVNULL), text=True,
             bufsize=1)
+        # ranks 1+: same engine in SPMD lockstep, no RPC stream — their
+        # stdout would corrupt nothing, but it belongs in a log
+        self.members = []
+        for rank in range(1, self.group_size):
+            member_env = dict(child_env)
+            member_env[ENV_GROUP_RANK] = str(rank)
+            mlog = (open(f"{log_path}.r{rank}", "ab") if log_path
+                    else None)
+            self._member_logs.append(mlog)
+            self.members.append(subprocess.Popen(
+                [sys.executable, "-u", "-m",
+                 "paddle_tpu.inference.serving.fleet.replica"],
+                env=member_env, stdin=subprocess.DEVNULL,
+                stdout=(mlog or subprocess.DEVNULL),
+                stderr=(mlog or subprocess.DEVNULL)))
         self._reader = threading.Thread(target=self._read, daemon=True,
                                         name=f"replica{self.id}-reader")
         self._reader.start()
@@ -142,11 +204,32 @@ class ReplicaHandle:
 
     @property
     def alive(self):
-        return not self.retired and self.proc.poll() is None
+        """Every member running (group-atomic: a group missing ANY
+        member must not look placeable)."""
+        return (not self.retired and self.proc.poll() is None
+                and all(m.poll() is None for m in self.members))
 
     @property
     def pid(self):
         return self.proc.pid
+
+    @property
+    def members_live(self):
+        """Running member processes (rank 0 included) — the
+        ``fleet_group_members_live`` gauge."""
+        n = 1 if self.proc.poll() is None else 0
+        return n + sum(1 for m in self.members if m.poll() is None)
+
+    def dead_member(self):
+        """``(rank, rc)`` of the first exited member, or ``None`` when
+        all are running — the supervisor's group-crash probe, naming the
+        failing rank for the crash-loop error."""
+        if self.proc.poll() is not None:
+            return 0, self.proc.poll()
+        for rank, m in enumerate(self.members, start=1):
+            if m.poll() is not None:
+                return rank, m.poll()
+        return None
 
     def send(self, obj):
         """Write one command line; False when the pipe is gone (the
@@ -183,23 +266,33 @@ class ReplicaHandle:
 
     def kill(self, grace_s=5.0):
         """SIGTERM → wait ``grace_s`` → SIGKILL (the launcher's
-        escalation, per process)."""
-        if self.proc.poll() is None:
-            try:
-                self.proc.send_signal(signal.SIGTERM)
-            except OSError:
-                pass
-            try:
-                self.proc.wait(timeout=grace_s)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait()
-        if self._log_file is not None:
-            try:
-                self._log_file.close()
-            except OSError:
-                pass
-            self._log_file = None
+        escalation) — applied to EVERY group member: survivors of a
+        partial failure are felled, never left to answer. SIGTERM goes
+        to all members first so the grace window is shared, not
+        per-process."""
+        procs = [self.proc] + list(self.members)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.time() + float(grace_s)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(deadline - time.time(), 0.0))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        for f in [self._log_file] + self._member_logs:
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        self._log_file = None
+        self._member_logs = []
 
     def close(self):
         """Polite shutdown: ask, wait briefly, then escalate."""
@@ -217,9 +310,22 @@ class ReplicaSupervisor:
     def __init__(self, n_replicas, config, *, hang_timeout_s=0.0,
                  max_restarts=3, term_grace_s=5.0, boot_grace_s=120.0,
                  log_dir=None, env_extra=None, instance="fleet",
-                 roles=None):
+                 roles=None, group_size=1):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
+        # model-parallel replica groups (ISSUE 19): every slot is a
+        # group of `group_size` processes serving ONE plan-sharded
+        # engine in SPMD lockstep (group_size=1 is the exact PR-12
+        # single-process replica, byte-for-byte)
+        self.group_size = int(group_size)
+        if self.group_size < 1:
+            raise ValueError("group_size must be >= 1")
+        if self.group_size > 1 and roles is not None \
+                and any(r == "prefill" for r in roles):
+            raise ValueError(
+                "prefill-role slots cannot be multi-process groups: the "
+                "disaggregated handoff exports KV pages to one host, "
+                "which a process-spanning plan does not support yet")
         # role-disaggregated serving (ISSUE 15): each slot is "prefill",
         # "decode" or "both" (the colocated default). The role is part of
         # the SLOT, not the incarnation — a restarted replica respawns
@@ -245,8 +351,14 @@ class ReplicaSupervisor:
         # judged against this LONGER grace — otherwise a tight watchdog
         # condemns every restart before it can possibly beat, and the
         # budget drains on phantom hangs (the launch bootstrap solves
-        # this with a pre-jax heartbeat; here the import IS the boot)
-        self.boot_grace_s = max(float(boot_grace_s), self.hang_timeout_s)
+        # this with a pre-jax heartbeat; here the import IS the boot).
+        # Groups boot slower still — collective jax.distributed
+        # rendezvous + plan-sharded weight commit + an all-ranks warmup
+        # barrier — so the grace SCALES with the group size (the PR-12
+        # boot_grace_s lesson, re-proven for groups: a phantom boot hang
+        # must never drain the restart budget)
+        self.boot_grace_s = (max(float(boot_grace_s), self.hang_timeout_s)
+                             * max(1, self.group_size))
         self.log_dir = log_dir
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -292,8 +404,12 @@ class ReplicaSupervisor:
         config = self._config
         if self._roles is not None:
             config = dict(config, role=self._roles[i])
+        # fresh coordination port per incarnation: a respawned group's
+        # rendezvous must never reach a predecessor's half-dead service
+        port = _free_port() if self.group_size > 1 else None
         h = ReplicaHandle(i, config, env=self._env,
-                          log_path=log_path, incarnation=incarnation)
+                          log_path=log_path, incarnation=incarnation,
+                          group_size=self.group_size, coord_port=port)
         h.role = self.role(i)
         return h
 
@@ -309,10 +425,12 @@ class ReplicaSupervisor:
                     h.push_back(evs)
                 if h.ready:
                     break
-                if h.proc.poll() is not None:
+                dead = h.dead_member()
+                if dead is not None:
+                    rank, rc = dead
                     raise RuntimeError(
-                        f"replica {h.id} died during startup "
-                        f"(rc={h.proc.poll()}); see its log"
+                        f"replica {h.id} (group rank {rank}) died "
+                        f"during startup (rc={rc}); see its log"
                         + (f" in {self.log_dir}" if self.log_dir else ""))
                 if time.time() > deadline:
                     raise TimeoutError(
@@ -335,6 +453,11 @@ class ReplicaSupervisor:
         _M_RESTARTS.remove(instance=self.instance)
         _M_SCALE_UP.remove(instance=self.instance)
         _M_SCALE_DOWN.remove(instance=self.instance)
+        if self.group_size > 1:
+            _M_GROUP_RESTARTS.remove(instance=self.instance)
+            for h in self.handles:
+                _G_GROUP_MEMBERS.remove(instance=self.instance,
+                                        replica=h.id)
 
     # -- fleet autoscaling (ISSUE 17) -----------------------------------
     @property
@@ -433,6 +556,15 @@ class ReplicaSupervisor:
         if not h.ready:
             # still booting: only the boot grace can condemn it
             return (now - h.spawn_time) > self.boot_grace_s
+        if getattr(h, "group_size", 1) > 1:
+            # groups run in SPMD lockstep, so ONE wedged rank stalls
+            # every member's next collective: judge the group by its
+            # STALEST member's hb.<replica>.<rank> heartbeat
+            ts = []
+            for r in range(h.group_size):
+                t = beats.get(f"{h.id}.{r}", {}).get("time")
+                ts.append(h.spawn_time if t is None else float(t))
+            return (now - min(ts)) > self.hang_timeout_s
         t = beats.get(str(h.id), {}).get("time")
         if t is None:
             t = h.spawn_time  # not-yet-written grace, like launch.stale
@@ -459,32 +591,43 @@ class ReplicaSupervisor:
                 # death already reported; respawn when the backoff lapses
                 if now >= self._pending_respawn[i]:
                     del self._pending_respawn[i]
-                    # stale heartbeat must not re-condemn the new life
-                    try:
-                        os.remove(os.path.join(self._hb_dir, f"hb.{i}"))
-                    except OSError:
-                        pass
+                    # stale heartbeats must not re-condemn the new life
+                    # (hb.<i> and every group member's hb.<i>.<rank>)
+                    self._clear_heartbeats(i)
                     self.handles[i] = self._spawn(i, h.incarnation + 1)
                     _M_RESTARTS.inc(instance=self.instance)
+                    if self.group_size > 1:
+                        _M_GROUP_RESTARTS.inc(instance=self.instance)
                 continue
             reason = None
-            if h.proc.poll() is not None:
+            rank = None
+            dead = (h.dead_member() if hasattr(h, "dead_member")
+                    else ((0, h.proc.poll())
+                          if h.proc.poll() is not None else None))
+            if dead is not None:
+                # ANY member exiting fells the WHOLE group atomically: a
+                # half-dead tp group must never answer — survivors are
+                # SIGTERM→SIGKILL'd before the death is even reported
                 reason = "crash"
+                rank, _ = dead
+                h.kill(grace_s=self.term_grace_s)
             elif self._hung(h, beats, now):
                 reason = "hang"
                 h.kill(grace_s=self.term_grace_s)
             if reason is None:
                 continue
-            rc = h.proc.poll()
+            rc = dead[1] if dead is not None else h.proc.poll()
             leftovers = h.final_events()
             # the dip must be visible BEFORE the respawn restores it
             self._note_liveness()
             budget = self._budgets[i]
             if not budget.try_acquire():
                 self.shutdown()
+                at_rank = f" at group rank {rank}" if rank else ""
                 raise ReplicaCrashLoopError(
-                    f"replica {i} crash loop ({reason}, rc={rc}): restart "
-                    f"budget exhausted ({budget.max_restarts} per "
+                    f"replica {i} crash loop ({reason}{at_rank}, "
+                    f"rc={rc}): restart budget exhausted "
+                    f"({budget.max_restarts} per "
                     f"{budget.window_s:.0f}s window, "
                     f"{budget.total_restarts} performed)",
                     replica=i, exit_code=rc if rc is not None else 1,
@@ -494,9 +637,19 @@ class ReplicaSupervisor:
             # un-placeable (dead handle) until the delayed respawn
             self._pending_respawn[i] = now + budget.backoff()
             deaths.append({"replica": i, "reason": reason, "rc": rc,
-                           "events": leftovers})
+                           "rank": rank, "events": leftovers})
         self._note_liveness(beats=beats, now=now)
         return deaths
+
+    def _clear_heartbeats(self, i):
+        """Remove slot ``i``'s heartbeat files — the bare ``hb.<i>`` and
+        every group member's ``hb.<i>.<rank>``."""
+        for r in [None] + list(range(self.group_size)):
+            fn = f"hb.{i}" if r is None else f"hb.{i}.{r}"
+            try:
+                os.remove(os.path.join(self._hb_dir, fn))
+            except OSError:
+                pass
 
     def _note_liveness(self, beats=None, now=None):
         now = time.time() if now is None else now
@@ -505,6 +658,11 @@ class ReplicaSupervisor:
         n = sum(1 for h in self.handles
                 if h.alive and not self._hung(h, beats, now))
         _G_LIVE.set(n, instance=self.instance)
+        if self.group_size > 1:
+            for h in self.handles:
+                _G_GROUP_MEMBERS.set(
+                    0 if h.retired else h.members_live,
+                    instance=self.instance, replica=h.id)
         if n != self._last_live:
             self._last_live = n
             if self.log_dir:

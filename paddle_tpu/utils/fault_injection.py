@@ -93,6 +93,18 @@ Sites wired into the framework:
   replica is SIGKILLed MID-DRAIN, so its still-queued requests must ride
   the normal crash-redispatch path to healthy peers — scale-down remains
   zero-drop even when the retiring replica dies uncleanly.
+- ``serve.group_member_crash`` — replica-group worker loop (boolean
+  site), armed on ONE member rank of a multi-process replica group:
+  that rank SIGKILLs itself mid-burst, the partial-group failure shape.
+  The supervisor must fell the WHOLE group (survivors SIGTERM→SIGKILL —
+  a half-dead tp group must never answer), charge one restart-budget
+  slot, respawn the group on a fresh coordination port and redispatch
+  its in-flight requests bit-exact.
+- ``serve.group_member_hang`` — replica-group worker loop (boolean
+  site), armed on ONE member rank: the rank wedges without
+  heartbeating, so the group's next collective stalls EVERY member. No
+  process exits — only the hang watchdog (any member's stale
+  ``hb.<replica>.<rank>``) can detect it and fell the group.
 
 Arming a site is scoped and seeded::
 
@@ -124,7 +136,8 @@ SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "io.stream.corrupt", "serve.prefill_crash",
          "serve.kv_transfer_corrupt", "serve.kv_spill",
          "serve.store_write", "serve.tenant_flood",
-         "serve.scale_down_kill")
+         "serve.scale_down_kill", "serve.group_member_crash",
+         "serve.group_member_hang")
 
 
 class InjectedFault(OSError):

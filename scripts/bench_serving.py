@@ -1016,7 +1016,8 @@ def fleet_sizing(tiny):
 
 
 def run_fleet(artifact, stream, *, n_replicas, engine_kwargs,
-              warm_stream=None, log_dir=None, roles=None):
+              warm_stream=None, log_dir=None, roles=None,
+              group_size=1, plan=None):
     """One timed window through a real replica fleet (ISSUE 12):
     ``n_replicas`` worker processes behind the Router, requests admitted
     on the stream's arrival clock. ``warm_stream`` is replayed first so
@@ -1025,12 +1026,15 @@ def run_fleet(artifact, stream, *, n_replicas, engine_kwargs,
     ``roles`` (ISSUE 15) splits the fleet into dedicated prefill/decode
     workers; decode-worker ITL percentiles are collected per replica
     from the stats RPC, so the disagg A/B compares exactly the latency
-    the handoff is supposed to protect."""
+    the handoff is supposed to protect. ``group_size``/``plan``
+    (ISSUE 19) make every replica a tp-sharded PROCESS GROUP — one
+    Router slot, ``group_size`` coordinated workers."""
     from paddle_tpu.inference.serving.fleet import Router
 
     fleet = Router(artifact=artifact, n_replicas=n_replicas,
                    engine_kwargs=engine_kwargs, log_dir=log_dir,
-                   max_queue=1_000_000, roles=roles)
+                   max_queue=1_000_000, roles=roles,
+                   group_size=group_size, plan=plan)
     try:
         if warm_stream is not None:
             for r in warm_stream:
@@ -1134,6 +1138,161 @@ def run_fleet_ab(tiny=True, seed=0, fleet=3):
         scaling=round(many["tokens_per_sec"] / one["tokens_per_sec"], 3),
         n_replicas=fleet,
         bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+    )
+
+
+def _llama_weight_bytes(cfg, shards=1):
+    """fp32 bytes of ONE device's weight shard under tp=``shards``. The
+    default llama tp rules shard every large matrix (vocab-parallel
+    embedding, column-parallel lm_head, q/k/v/gate/up on columns,
+    o/down on rows); only the RMSNorm vectors replicate."""
+    h, inter, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    heads, kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
+    per_layer = (2 * h * heads * hd      # q_proj + o_proj
+                 + 2 * h * kv * hd       # k_proj + v_proj
+                 + 3 * h * inter)        # gate/up/down_proj
+    sharded = cfg.num_hidden_layers * per_layer + 2 * v * h
+    replicated = (2 * cfg.num_hidden_layers + 1) * h
+    return 4 * (sharded // shards + replicated)
+
+
+def _llama_kv_pool_bytes(cfg, engine_kwargs, shards=1):
+    """fp32 bytes of one device's share of the paged KV pool: KV heads
+    shard across tp, so the resident pool halves with the weights."""
+    tokens = engine_kwargs["num_blocks"] * engine_kwargs["block_size"]
+    per_token = (2 * cfg.num_hidden_layers
+                 * (cfg.num_key_value_heads // shards) * cfg.head_dim)
+    return 4 * tokens * per_token
+
+
+def _llama_device_bytes(cfg, engine_kwargs, shards=1):
+    return (_llama_weight_bytes(cfg, shards)
+            + _llama_kv_pool_bytes(cfg, engine_kwargs, shards))
+
+
+def tpfleet_sizing(tiny):
+    """Sizing for the model-parallel fleet A/B (ISSUE 19): a per-device
+    byte budget that the BIG llama's fp32 weights + KV pool exceed on
+    one device but fit once tp=2 shards them, plus a largest-first
+    ladder of single-device candidates (same vocab, so one request
+    stream serves both arms) from which the baseline is chosen."""
+    import dataclasses as _dc
+
+    from paddle_tpu.models import llama_small, llama_tiny
+
+    if tiny:
+        # ~13.0 MiB weights + 8.0 MiB KV pool on one device vs a 16 MiB
+        # budget; the tp=2 shard is ~10.5 MiB and fits
+        big = _dc.replace(llama_tiny(), hidden_size=256,
+                          intermediate_size=768, num_hidden_layers=4,
+                          max_position_embeddings=128)
+        ladder = [_dc.replace(llama_tiny(), hidden_size=192,
+                              intermediate_size=576, num_hidden_layers=3,
+                              max_position_embeddings=128),
+                  llama_tiny()]
+        budget = 16 * 1024 * 1024
+        stream = dict(n=24, rate=400.0, min_prompt=4, max_prompt=24,
+                      min_new=24, max_new=40)
+        engine = dict(num_blocks=256, block_size=8, max_batch_size=4,
+                      max_prefills_per_step=2)
+    else:
+        # llama_small: ~130 MiB weights + 256 MiB KV vs a 256 MiB budget
+        big = llama_small()
+        ladder = [_dc.replace(llama_small(), hidden_size=256,
+                              intermediate_size=704,
+                              num_hidden_layers=4),
+                  _dc.replace(llama_small(), hidden_size=128,
+                              intermediate_size=384,
+                              num_hidden_layers=2,
+                              num_attention_heads=4,
+                              num_key_value_heads=2)]
+        budget = 256 * 1024 * 1024
+        stream = dict(n=64, rate=300.0, min_prompt=16, max_prompt=128,
+                      min_new=32, max_new=64)
+        engine = dict(num_blocks=512, block_size=16, max_batch_size=4)
+    return big, ladder, budget, stream, engine
+
+
+def run_tpfleet_ab(tiny=True, seed=0, groups=2):
+    """Model-parallel fleet A/B (ISSUE 19 acceptance): serve a llama
+    whose fp32 weights + KV pool EXCEED the per-device byte budget — a
+    model NO single-device replica could host — on ``groups`` tp=2
+    replica groups (each group is one Router slot backed by two
+    coordinated worker processes over jax.distributed), against the
+    LARGEST ladder config that does fit one device, served on the same
+    device count as plain replicas. Both arms are real subprocess
+    fleets behind the same Router/RPC path and each must match its own
+    in-process engine greedy reference bit-exactly."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              save_llama_artifact)
+    from paddle_tpu.models import LlamaForCausalLM
+
+    big, ladder, budget, stream_kwargs, engine_kwargs = \
+        tpfleet_sizing(tiny)
+    tp = 2
+    one_dev = _llama_device_bytes(big, engine_kwargs)
+    per_shard = _llama_device_bytes(big, engine_kwargs, shards=tp)
+    assert one_dev > budget, \
+        f"big config fits one device ({one_dev} <= {budget}); no tp case"
+    assert per_shard <= budget, \
+        f"big config does not even fit sharded ({per_shard} > {budget})"
+    fits = [c for c in ladder
+            if _llama_device_bytes(c, engine_kwargs) <= budget]
+    assert fits, "no single-device ladder config fits the budget"
+    small = fits[0]
+    assert small.vocab_size == big.vocab_size, \
+        "arms must share a vocab so one stream serves both"
+
+    n_devices = groups * tp
+    stream = request_stream(big, seed=seed, **stream_kwargs)
+    warm = request_stream(big, seed=seed + 1, **stream_kwargs)
+    tmp = tempfile.mkdtemp(prefix="bench_tpfleet.")
+
+    def arm(cfg, name, n_replicas, group_size, plan):
+        paddle.seed(seed)
+        np.random.seed(seed)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        artifact = os.path.join(tmp, name)
+        save_llama_artifact(model, artifact)
+        eng = LLMEngine(model, ingest_async=False, **engine_kwargs)
+        try:
+            rids = [eng.add_request(
+                r.prompt, SamplingParams(max_new_tokens=r.max_new))
+                for r in stream]
+            for _ in eng.stream():
+                pass
+            refs = [eng.output_tokens(r) for r in rids]
+        finally:
+            eng.close()
+        res = run_fleet(artifact, stream, n_replicas=n_replicas,
+                        engine_kwargs=engine_kwargs, warm_stream=warm,
+                        group_size=group_size, plan=plan)
+        res["bit_exact"] = bool(_bit_exact(refs, res["outputs"]))
+        return res
+
+    try:
+        sharded = arm(big, "big", groups, tp,
+                      {"axes": {"tp": tp}, "strategies": ["tp"]})
+        single = arm(small, "small", n_devices, 1, None)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dict(
+        sharded={k: v for k, v in sharded.items() if k != "outputs"},
+        single={k: v for k, v in single.items() if k != "outputs"},
+        bit_exact=bool(sharded["bit_exact"] and single["bit_exact"]),
+        tp=tp, n_groups=groups, n_devices=n_devices,
+        device_budget_bytes=budget,
+        big_model_device_bytes=one_dev,
+        big_model_shard_bytes=per_shard,
+        single_model_device_bytes=_llama_device_bytes(
+            small, engine_kwargs),
         num_requests=len(stream),
     )
 
@@ -1411,7 +1570,7 @@ def main():
     ap.add_argument("--workload", default="poisson",
                     choices=["poisson", "shared-prefix", "chunked", "spec",
                              "fleet", "quantized", "disagg", "tiering",
-                             "qos", "decode_sync"])
+                             "qos", "decode_sync", "tpfleet"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
@@ -1471,6 +1630,14 @@ def main():
         if not res["bit_exact"]:
             sys.exit("FAIL: fleet outputs diverge from the in-process "
                      "engine greedy reference")
+        return
+    if args.workload == "tpfleet":
+        res = run_tpfleet_ab(tiny=tiny, seed=args.seed)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: tp-sharded or single-device fleet outputs "
+                     "diverge from their in-process engine greedy "
+                     "references")
         return
     if args.workload == "quantized":
         res = run_quantized_ab(tiny=tiny, seed=args.seed)

@@ -84,8 +84,20 @@ processes over one shared model artifact + checkpoint root):
   its in-flight requests ride crash-redispatch, a clean retry retires
   the slot — zero requests dropped end to end.
 
+- ``tpgroup``: the ISSUE-19 model-parallel replica-group drill. Two
+  slots, each a 2-process tp=2 GROUP (one plan-sharded engine in SPMD
+  lockstep, rank 0 owning the RPC stream). Mid-burst, group 0's rank 1
+  SIGKILLs itself (``serve.group_member_crash``) and group 1's rank 1
+  wedges (``serve.group_member_hang``) — both failures start as
+  half-dead groups whose rank 0 still answers. The supervisor must fell
+  each group WHOLE (survivors SIGTERM→SIGKILL — a partial tp group must
+  never serve), charge one restart-budget slot per group, respawn on a
+  fresh coordination port, rejoin from the checkpoint root, and the
+  router replays everything bit-exact; allocators proven clean over the
+  rank-0 stats RPC.
+
 ``--drill all`` (the default) runs kill, hang, drain, shed, quant,
-disagg, warmstore, qos in order.
+disagg, warmstore, qos, tpgroup in order.
 Wired into the slow tier of tests/test_serving.py, the chaos_train.py
 discipline applied to serving. Everything runs on CPU
 (JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
@@ -1000,6 +1012,91 @@ def drill_qos(out, model, n):
         fleet.close()
 
 
+def drill_tpgroup(out, model, n):
+    """ISSUE 19 acceptance: model-parallel replica GROUPS under partial
+    failure. Two slots, each a 2-process tp=2 group (4 worker processes,
+    one plan-sharded engine per group in SPMD lockstep). Mid-burst, the
+    fault sites fire on NON-ZERO ranks only: group 0's rank 1 SIGKILLs
+    itself (``serve.group_member_crash``) while group 1's rank 1 wedges
+    (``serve.group_member_hang``) — so every failure starts as a
+    HALF-DEAD group whose rank 0 still owns a live RPC stream. The
+    supervisor must fell each whole group atomically (survivors
+    SIGTERM→SIGKILL), charge ONE restart-budget slot per group, respawn
+    on fresh coordination ports, rejoin from the checkpoint root, and
+    the router must replay the in-flight requests bit-exact."""
+    import json
+
+    from paddle_tpu.observability import metrics as om
+
+    n = 2  # two groups of two processes — the drill's fixed topology
+    stream = request_stream(_cfg(model))
+    baseline = baseline_outputs(model, stream)
+    env = {"CHAOS_SERVE_SITES": json.dumps([
+        {"site": "serve.group_member_crash", "replica": 0, "rank": 1,
+         "after": _hang_after_steps()},
+        {"site": "serve.group_member_hang", "replica": 1, "rank": 1,
+         "after": _hang_after_steps()},
+    ])}
+    fleet = _fleet(out, n, hang_timeout_s=_hang_timeout_s(),
+                   env_extra=env, group_size=2,
+                   plan={"axes": {"tp": 2}, "strategies": ["tp"]})
+    try:
+        for h in fleet.supervisor.handles:
+            check(h.ready_info.get("group_size") == 2,
+                  f"group {h.id} reported ready only after BOTH ranks "
+                  "acked warm-up")
+        ports0 = [h.coord_port for h in fleet.supervisor.handles]
+        gids, shed, wall = run_burst(fleet, stream)
+        wait_all_ready(fleet)
+        check(not shed, f"no request shed (queue bound ample): {shed}")
+        done = assert_complete_bitexact(fleet, gids, baseline)
+        check(done == len(stream),
+              f"completed == submitted ({done}/{len(stream)}): nothing "
+              "dropped silently")
+        m = fleet.metrics()
+        check(m["redispatches"] >= 1,
+              f"in-flight requests were redispatched "
+              f"({m['redispatches']}x) off the felled groups")
+        check(m["replica_restarts"] >= 2,
+              f"both half-dead groups were felled WHOLE and restarted "
+              f"({m['replica_restarts']} group restarts)")
+        g_restarts = om.REGISTRY.get("fleet_group_restarts_total").value(
+            instance=fleet._name)
+        check(g_restarts >= 2,
+              f"fleet_group_restarts_total counted them ({g_restarts})")
+        check(g_restarts <= 2 * 3,
+              f"group restarts stayed within the leaky-bucket budget "
+              f"({g_restarts} <= 3 per slot)")
+        for h in fleet.supervisor.handles:
+            check(h.incarnation >= 1, f"group {h.id} was respawned")
+            check(h.coord_port != ports0[h.id],
+                  f"group {h.id} respawned on a FRESH coordination port "
+                  f"({ports0[h.id]} -> {h.coord_port})")
+            check(h.ready_info.get("reloaded_step") == 1,
+                  f"group {h.id} rejoined via reload_weights("
+                  "latest_healthy_step()) at checkpoint step 1")
+            live = om.REGISTRY.get("fleet_group_members_live").value(
+                instance=fleet._name, replica=h.id)
+            check(live == 2,
+                  f"fleet_group_members_live recovered to 2 for group "
+                  f"{h.id} ({live})")
+        vals = read_liveness(out)
+        check(any(v < n for v in vals),
+              f"fleet liveness gauge dipped below {n} (transitions: "
+              f"{vals})")
+        first_dip = next(i for i, v in enumerate(vals) if v < n)
+        check(any(v == n for v in vals[first_dip:]),
+              f"fleet liveness gauge recovered to {n} (transitions: "
+              f"{vals})")
+        toks = sum(len(fleet.tokens(g)) for g in gids.values())
+        print(f"  [report] {toks} tokens in {wall:.1f}s "
+              f"({toks / wall:.1f} tok/s, 2 tp=2 groups, one member "
+              "killed, one member hung)")
+        assert_replicas_clean(fleet)
+    finally:
+        fleet.close()
+
+
 def _cfg(model):
     return model.config
 
@@ -1007,14 +1104,15 @@ def _cfg(model):
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
           "shed": drill_shed, "quant": drill_quant,
           "disagg": drill_disagg, "warmstore": drill_warmstore,
-          "qos": drill_qos}
+          "qos": drill_qos, "tpgroup": drill_tpgroup}
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--drill", default="all",
                     choices=["kill", "hang", "drain", "shed", "quant",
-                             "disagg", "warmstore", "qos", "all"])
+                             "disagg", "warmstore", "qos", "tpgroup",
+                             "all"])
     ap.add_argument("--fleet", type=int, default=3)
     ap.add_argument("--decode-window", type=int, default=1,
                     help="decode_steps_per_sync for every engine (baseline "
@@ -1032,7 +1130,7 @@ def main(argv=None):
     print(f"[chaos] serving fleet drill, scratch: {out_root}, "
           f"fleet={args.fleet}")
     drills = (["kill", "hang", "drain", "shed", "quant", "disagg",
-               "warmstore", "qos"]
+               "warmstore", "qos", "tpgroup"]
               if args.drill == "all" else [args.drill])
     model = None
     for name in drills:

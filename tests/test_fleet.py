@@ -606,3 +606,326 @@ class TestRealFleetSmoke:
                 np.testing.assert_array_equal(fleet.result(gid), ref)
         finally:
             fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# model-parallel replica groups (ISSUE 19) — supervisor unit matrix.
+# GroupFakeHandle implements the full group-handle contract (members_live /
+# dead_member / atomic kill) so the watchdog, budget and metrics logic run
+# without subprocesses; the real multi-process lifecycle is slow-tier below.
+# ---------------------------------------------------------------------------
+
+class GroupFakeHandle:
+    class _Proc:
+        def poll(self):
+            return None
+
+    def __init__(self, hid, incarnation=0, group_size=2):
+        self.id = int(hid)
+        self.incarnation = int(incarnation)
+        self.group_size = int(group_size)
+        self.ready = True
+        self.ready_info = {"e": "ready", "replica": hid}
+        self.retired = False
+        self.spawn_time = time.time()
+        self.killed = False
+        self.dead = None  # (rank, rc) set by tests
+        self.proc = self._Proc()
+        self.role = "both"
+
+    @property
+    def alive(self):
+        return not self.retired and not self.killed and self.dead is None
+
+    @property
+    def members_live(self):
+        if self.killed:
+            return 0
+        return self.group_size - (1 if self.dead is not None else 0)
+
+    def dead_member(self):
+        return self.dead
+
+    def kill(self, grace_s=0.0):
+        self.killed = True
+
+    def final_events(self, timeout=2.0):
+        return []
+
+    def send(self, obj):
+        return not self.killed
+
+    def events(self):
+        return []
+
+    def close(self):
+        self.killed = True
+
+
+def make_group_supervisor(monkeypatch, n=1, group_size=2, **kw):
+    from paddle_tpu.inference.serving.fleet.supervisor import \
+        ReplicaSupervisor
+
+    monkeypatch.setattr(
+        ReplicaSupervisor, "_spawn",
+        lambda self, i, inc: GroupFakeHandle(i, inc, self.group_size))
+    kw.setdefault("instance", f"grouptest#{time.monotonic_ns()}")
+    return ReplicaSupervisor(n, {"artifact": "unused"},
+                             group_size=group_size, **kw)
+
+
+class TestGroupSupervisor:
+    def test_validates_group_size_and_prefill_roles(self):
+        from paddle_tpu.inference.serving.fleet.supervisor import \
+            ReplicaSupervisor
+
+        with pytest.raises(ValueError, match="group_size"):
+            ReplicaSupervisor(1, {}, group_size=0)
+        # disaggregated prefill slots cannot be groups: the KV handoff
+        # exports pages to one host, which a process-spanning plan
+        # cannot satisfy yet — typed rejection at construction
+        with pytest.raises(ValueError, match="prefill"):
+            ReplicaSupervisor(2, {}, group_size=2,
+                              roles=["prefill", "decode"])
+
+    def test_boot_grace_scales_with_group_size(self, monkeypatch):
+        # groups boot slower (rendezvous + sharded weight commit + the
+        # all-ranks warmup barrier): the grace scales with the group
+        # size so phantom boot hangs never drain the restart budget
+        sup = make_group_supervisor(monkeypatch, group_size=2,
+                                    boot_grace_s=10.0, hang_timeout_s=5.0)
+        try:
+            assert sup.boot_grace_s == 20.0
+            h = sup.handles[0]
+            h.ready = False
+            now = time.time()
+            h.spawn_time = now - 15.0  # inside the SCALED grace
+            assert not sup._hung(h, {}, now)
+            h.spawn_time = now - 25.0  # past it: condemned
+            assert sup._hung(h, {}, now)
+        finally:
+            sup.shutdown()
+        sup1 = make_group_supervisor(monkeypatch, group_size=1,
+                                     boot_grace_s=10.0, hang_timeout_s=5.0)
+        try:
+            assert sup1.boot_grace_s == 10.0
+        finally:
+            sup1.shutdown()
+
+    def test_hang_judged_by_stalest_member_heartbeat(self, monkeypatch):
+        # one wedged rank stalls every member's next collective, so the
+        # group is condemned by its STALEST hb.<replica>.<rank> — a
+        # fresh rank-0 beat must not vouch for a wedged rank 1
+        sup = make_group_supervisor(monkeypatch, group_size=2,
+                                    hang_timeout_s=5.0)
+        try:
+            h = sup.handles[0]
+            now = time.time()
+            fresh = {"0.0": {"time": now}, "0.1": {"time": now}}
+            assert not sup._hung(h, fresh, now)
+            stale1 = {"0.0": {"time": now}, "0.1": {"time": now - 10.0}}
+            assert sup._hung(h, stale1, now)
+            # a member that never beat is judged from spawn_time
+            h.spawn_time = now - 10.0
+            assert sup._hung(h, {"0.0": {"time": now}}, now)
+        finally:
+            sup.shutdown()
+
+    def test_member_crash_fells_group_one_budget_slot(self, monkeypatch):
+        sup = make_group_supervisor(monkeypatch, group_size=2,
+                                    max_restarts=3)
+        try:
+            h = sup.handles[0]
+            assert om.REGISTRY.get("fleet_group_members_live").value(
+                instance=sup.instance, replica=0) == 2
+            h.dead = (1, -9)  # non-zero rank SIGKILLed
+            now = time.time()
+            deaths = sup.check(now=now)
+            # the death names the failing rank and the survivors were
+            # felled atomically (a half-dead tp group must never answer)
+            assert deaths == [{"replica": 0, "reason": "crash", "rc": -9,
+                               "rank": 1, "events": []}]
+            assert h.killed
+            assert om.REGISTRY.get("fleet_group_members_live").value(
+                instance=sup.instance, replica=0) == 0
+            # the whole-group restart charges exactly ONE budget slot
+            assert sup._budgets[0].used == 1
+            # backoff lapse -> respawn: gauge recovers, group restart
+            # counter ticks once
+            deaths = sup.check(now=now + 120.0)
+            assert deaths == []
+            assert sup.handles[0] is not h
+            assert sup.handles[0].incarnation == 1
+            assert om.REGISTRY.get("fleet_group_members_live").value(
+                instance=sup.instance, replica=0) == 2
+            assert om.REGISTRY.get("fleet_group_restarts_total").value(
+                instance=sup.instance) == 1
+            assert om.REGISTRY.get("fleet_replica_restarts_total").value(
+                instance=sup.instance) == 1
+        finally:
+            sup.shutdown()
+        # shutdown removes the per-replica member gauge series
+        snap = om.REGISTRY.snapshot().get("fleet_group_members_live",
+                                          {"series": {}})
+        assert not any(sup.instance in k for k in snap["series"])
+
+    def test_crash_loop_error_names_failing_rank(self, monkeypatch):
+        sup = make_group_supervisor(monkeypatch, group_size=2,
+                                    max_restarts=0)
+        with pytest.raises(ReplicaCrashLoopError,
+                           match="at group rank 1"):
+            sup.handles[0].dead = (1, -9)
+            sup.check()
+
+    def test_group_retire_zeroes_member_gauge(self, monkeypatch):
+        sup = make_group_supervisor(monkeypatch, n=2, group_size=2)
+        try:
+            sup.retire(1)
+            assert om.REGISTRY.get("fleet_group_members_live").value(
+                instance=sup.instance, replica=1) == 0
+            assert om.REGISTRY.get("fleet_group_members_live").value(
+                instance=sup.instance, replica=0) == 2
+        finally:
+            sup.shutdown()
+
+
+class TestGroupRejoinGate:
+    def test_reload_rejects_stale_plan_fingerprint(self, tmp_path):
+        """Group rejoin gate: a restarted group member reloading from the
+        fleet checkpoint root must refuse a checkpoint recorded under a
+        DIFFERENT sharding plan (typed PlanMismatchError) — silently
+        re-sharding would hand the group weights its peers don't have."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint.manager import \
+            CheckpointManager
+        from paddle_tpu.distributed.plan import Plan
+        from paddle_tpu.inference.serving import LLMEngine
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(100, model=model, plan=Plan.build({"tp": 4}, ["tp"]))
+        with LLMEngine(model, num_blocks=8, block_size=8,
+                       max_batch_size=2, ingest_async=False,
+                       plan=Plan.build({"tp": 2}, ["tp"])) as eng:
+            with pytest.raises(paddle.PlanMismatchError, match="mesh"):
+                eng.reload_weights(mgr)
+
+
+# ---------------------------------------------------------------------------
+# real multi-process replica groups (ISSUE 19, slow tier): each slot is a
+# 2-process tp=2 group over the gloo-backed jax coordination service
+# ---------------------------------------------------------------------------
+
+def _group_refs(tmp_path, lens=(5, 9, 12), max_new=8, seed=7):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              save_llama_artifact)
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    artifact = str(tmp_path / "model")
+    save_llama_artifact(model, artifact)
+    kw = dict(num_blocks=32, block_size=8, max_batch_size=4)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, model.config.vocab_size, n)
+               .astype(np.int32) for n in lens]
+    with LLMEngine(model, ingest_async=False, **kw) as eng:
+        refs = eng.generate(prompts,
+                            SamplingParams(max_new_tokens=max_new))
+    return artifact, kw, prompts, refs
+
+
+TP2_PLAN = {"axes": {"tp": 2}, "strategies": ["tp"]}
+
+
+@pytest.mark.slow
+class TestRealGroupFleet:
+    def test_group_bit_exact_stats_and_retire(self, tmp_path):
+        """A tp=2 group serves bit-identically to the single-process
+        engine; stats aggregate through rank 0 (the group's one mouth);
+        drain-then-retire fells every member process."""
+        artifact, kw, prompts, refs = _group_refs(tmp_path)
+        fleet = Router(artifact=artifact, n_replicas=1, engine_kwargs=kw,
+                       group_size=2, plan=TP2_PLAN,
+                       log_dir=str(tmp_path / "logs"))
+        try:
+            assert fleet.supervisor.handles[0].ready_info[
+                "group_size"] == 2
+            gids = [fleet.submit(p, max_new=8) for p in prompts]
+            fleet.join(timeout=300)
+            for gid, ref in zip(gids, refs):
+                np.testing.assert_array_equal(fleet.result(gid), ref)
+            # engine-owned stats flow through rank 0's RPC stream
+            stats = fleet.replica_stats(0)
+            assert stats["blocks_free"] == kw["num_blocks"] - 1
+            assert stats["running"] == 0 and stats["waiting"] == 0
+            assert om.REGISTRY.get("fleet_group_members_live").value(
+                instance=fleet._name, replica=0) == 2
+            h = fleet.supervisor.handles[0]
+            fleet.drain(0, then="retire", wait=True)
+            assert h.retired
+            assert h.proc.poll() is not None
+            assert all(m.poll() is not None for m in h.members)
+        finally:
+            fleet.close()
+
+    def test_group_member_crash_fells_group_and_replays(self, tmp_path):
+        """SIGKILL of a NON-ZERO rank mid-burst: the supervisor fells
+        the whole group, respawns it on a fresh coordination port, and
+        the redispatched requests replay bit-exactly."""
+        import json as _json
+
+        artifact, kw, prompts, refs = _group_refs(tmp_path, seed=9)
+        fleet = Router(
+            artifact=artifact, n_replicas=1, engine_kwargs=kw,
+            group_size=2, plan=TP2_PLAN, max_restarts=2,
+            log_dir=str(tmp_path / "logs"),
+            env_extra={"CHAOS_SERVE_SITES": _json.dumps(
+                [{"site": "serve.group_member_crash", "replica": 0,
+                  "rank": 1, "after": 3}])})
+        try:
+            port0 = fleet.supervisor.handles[0].coord_port
+            gids = [fleet.submit(p, max_new=8) for p in prompts]
+            fleet.join(timeout=600)
+            m = fleet.metrics()
+            assert m["replica_restarts"] >= 1
+            assert m["redispatches"] >= 1
+            for gid, ref in zip(gids, refs):
+                np.testing.assert_array_equal(fleet.result(gid), ref)
+            h = fleet.supervisor.handles[0]
+            assert h.incarnation >= 1
+            assert h.coord_port != port0  # fresh rendezvous port
+            assert om.REGISTRY.get("fleet_group_restarts_total").value(
+                instance=fleet._name) >= 1
+        finally:
+            fleet.close()
+
+    def test_group_member_hang_watchdog_escalation(self, tmp_path):
+        """A wedged rank 1 stalls the group's collectives WITHOUT any
+        process exiting: only the hang watchdog (stale member
+        heartbeats) can fell the group; the respawn then replays
+        bit-exactly."""
+        import json as _json
+
+        artifact, kw, prompts, refs = _group_refs(tmp_path, seed=11)
+        fleet = Router(
+            artifact=artifact, n_replicas=1, engine_kwargs=kw,
+            group_size=2, plan=TP2_PLAN, max_restarts=2,
+            hang_timeout_s=4.0, log_dir=str(tmp_path / "logs"),
+            env_extra={"CHAOS_SERVE_SITES": _json.dumps(
+                [{"site": "serve.group_member_hang", "replica": 0,
+                  "rank": 1, "after": 3}])})
+        try:
+            gids = [fleet.submit(p, max_new=8) for p in prompts]
+            fleet.join(timeout=600)
+            m = fleet.metrics()
+            assert m["replica_restarts"] >= 1
+            for gid, ref in zip(gids, refs):
+                np.testing.assert_array_equal(fleet.result(gid), ref)
+        finally:
+            fleet.close()
