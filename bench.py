@@ -968,6 +968,41 @@ def bench_serving(on_tpu):
                          "deliberately carries no tenant label); "
                          "interactive outputs bit-exact across arms",
     })
+    # integrity-sentinel audit overhead A/B (ISSUE 20): the same burst
+    # through ONE warmed subprocess fleet with audit_fraction 0.0 vs
+    # 0.1. The tracked line is the audited arm's latency-tier TTFT p99;
+    # the audit-off reference, the ratio (gated at ~1.1x in the
+    # workload itself) and the audits-run count ride as fields, and
+    # both arms must match the in-process greedy reference bit-exactly
+    # (auditing reads streams, never changes them). CPU subprocess for
+    # the same backend reasons as the fleet line.
+    r = subprocess.run(
+        [_sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts", "bench_serving.py"),
+         "--workload", "audit", "--fleet", "3", "--tiny"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, f"audit A/B failed: {r.stderr[-2000:]}"
+    au = _json.loads(r.stdout)
+    assert au["bit_exact"], \
+        "audited fleet diverged from the in-process engine reference"
+    _emit({
+        "metric": "serving_cpu_audit_ttft_p99_ms",
+        "value": au["audit_on"]["ttft"]["p99_ms"], "unit": "ms",
+        "vs_baseline": None,
+        "ttft_p99_ms_audit_off": au["audit_off"]["ttft"]["p99_ms"],
+        "ttft_p99_ratio": au["ttft_p99_ratio"],
+        "ttft_p99_within_bound": au["ttft_p99_within_bound"],
+        "audit_fraction": au["audit_fraction"],
+        "audits_run": au["audit_on"]["audits_run"],
+        "audit_mismatches": au["audit_on"]["audit_mismatches"],
+        "bit_exact": au["bit_exact"],
+        "num_requests": au["num_requests"],
+        "baseline_note": "one warmed 3-replica subprocess fleet, same "
+                         "seeded burst with sampled output audits off "
+                         "vs on (fraction 0.1, batch-tier replays on a "
+                         "different replica); outputs bit-exact vs the "
+                         "in-process CPU engine in both arms",
+    })
 
 
 def make_llama(on_tpu):

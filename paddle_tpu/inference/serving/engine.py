@@ -54,7 +54,11 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
-from .errors import EngineClosedError, RequestTimeoutError
+from .errors import (EngineClosedError, KVIntegrityError,
+                     RequestTimeoutError)
+from .integrity import (_M_PAGES_REJECTED, _M_PAGES_VERIFIED,
+                        _M_WEIGHT_AUDIT_FAIL)
+from .integrity import verify_pages as _verify_pages
 from .kv_cache import (PagedKVCache, PrefixCache, HostKVTier,
                        _G_HOST_BLOCKS, _H_REVIVE_MS, _H_SPILL_MS,
                        _M_HOST_EVICT, _M_REVIVES, _M_REVIVE_BYTES,
@@ -149,17 +153,22 @@ _SERVING_METRICS = (_M_ADMITTED, _M_EVICTIONS, _M_FINISHED, _M_QUEUED_EXH,
                     _M_TOKENS, _M_DEADLINE, _M_KV_SAVED, _H_TTFT, _H_ITL,
                     _G_SPEC_RATIO, _G_KV_UTIL, _G_OCCUPANCY,
                     _G_QUANT_BLOCKS,
-                    # KV tiering + prefix store (ISSUE 16)
+                    # KV tiering + prefix store (ISSUE 16);
+                    # _M_STORE_REJECTED is reason-labeled (ISSUE 20), so
+                    # metrics()/reset_metrics() handle it like
+                    # _M_TENANT_TOKENS (exact-match remove can't reach it)
                     _M_SPILLS, _M_REVIVES, _M_SPILL_BYTES, _M_REVIVE_BYTES,
                     _M_HOST_EVICT, _G_HOST_BLOCKS, _H_SPILL_MS,
                     _H_REVIVE_MS, _M_STORE_SAVED, _M_STORE_LOADED,
-                    _M_STORE_REJECTED,
                     # multi-tenant QoS (ISSUE 17); _M_TENANT_TOKENS is
                     # tenant-labeled, so metrics()/reset_metrics() handle
                     # it separately (exact-match remove can't reach it)
                     _M_THROTTLED, _M_BATCH_YIELD,
                     # device-resident decode (ISSUE 18)
-                    _M_HOST_SYNCS, _M_FETCH_BYTES)
+                    _M_HOST_SYNCS, _M_FETCH_BYTES,
+                    # serving integrity (ISSUE 20)
+                    _M_PAGES_VERIFIED, _M_PAGES_REJECTED,
+                    _M_WEIGHT_AUDIT_FAIL)
 
 
 @dataclasses.dataclass
@@ -282,7 +291,8 @@ class LLMEngine:
                  prefill_only=False, kv_host_blocks=0,
                  prefix_store_path=None, prefix_store_autosave_chains=None,
                  fuse_draft_catchup=True, decode_steps_per_sync=1,
-                 in_graph_sampling=None, capture_logits=False):
+                 in_graph_sampling=None, capture_logits=False,
+                 kv_page_checksums=False, weight_audit=False):
         from ...models.llama import LlamaForCausalLM, sample_next_tokens
 
         if not isinstance(model, LlamaForCausalLM):
@@ -365,6 +375,11 @@ class LLMEngine:
         self.kv_dtype = kv_dtype
         self.cache = PagedKVCache(self.config, num_blocks, block_size,
                                   dtype=dtype, kv_dtype=kv_dtype)
+        # serving integrity (ISSUE 20): arm per-block CRC sealing of
+        # every host-materialized page payload; read-back boundaries
+        # (tier revive, page import, prefix-store entries) verify and
+        # degrade to re-prefill on mismatch
+        self.cache.page_checksums = bool(kv_page_checksums)
         if self._mp:
             self._globalize_cache(self.cache)
         self._kv_bytes_saved = self.cache.bytes_saved_vs_unquantized(
@@ -544,6 +559,20 @@ class LLMEngine:
                       _M_REVIVE_BYTES, _M_HOST_EVICT):
                 m.inc(0, instance=self._name)
             _G_HOST_BLOCKS.set(0, instance=self._name)
+        if self.cache.page_checksums:
+            # publish the verify/reject series at zero from boot
+            _M_PAGES_VERIFIED.inc(0, instance=self._name)
+            _M_PAGES_REJECTED.inc(0, instance=self._name)
+        # weight integrity re-audit (ISSUE 20): capture the live
+        # fingerprint at construction; audit_weights() re-hashes and
+        # compares — a divergence means the weights changed IN PLACE
+        # (silent corruption), not a reload (reload_weights re-captures)
+        self._weight_audit = bool(weight_audit)
+        self._weight_audits = 0
+        self._weight_audit_ref = (weights_fingerprint(model)
+                                  if weight_audit else None)
+        if weight_audit:
+            _M_WEIGHT_AUDIT_FAIL.inc(0, instance=self._name)
         self._store_geometry = None
         if self._store_path is not None:
             self._store_fingerprint = weights_fingerprint(model)
@@ -591,8 +620,10 @@ class LLMEngine:
                 self._store_path, fingerprint=self._store_fingerprint,
                 geometry=self._store_geometry, instance=self._name)
         except PrefixStoreMismatch as e:
-            warnings.warn(f"{self._name}: rejecting prefix store: {e}; "
-                          "cold-starting the prefix cache", RuntimeWarning)
+            warnings.warn(
+                f"{self._name}: rejecting prefix store "
+                f"(reason={e.reason}): {e}; cold-starting the prefix "
+                "cache", RuntimeWarning)
             return 0
         if entries is None:
             return 0
@@ -849,6 +880,11 @@ class LLMEngine:
         # happens HERE, before the request exists — not at import time,
         # when blocks are already allocated and pools about to move
         n_payload = self.cache.validate_request_pages(pages)
+        # ISSUE 20 read-back boundary: a sealed payload must verify
+        # before admission — typed KVIntegrityError instead of decoding
+        # from corrupt transferred pages (unsealed payloads pass)
+        _verify_pages(pages, instance=self._name,
+                      key=("import", req.rid))
         if n_payload != -(-covered // self.block_size):
             raise ValueError(
                 f"pages hold {n_payload} blocks but cover {covered} "
@@ -1875,6 +1911,9 @@ class LLMEngine:
             blocks = [b for b, _, _ in parts]
             merged = dict(parts[0][2])
             merged["covered"] = len(parts) * self.block_size
+            # each part's seal was verified at pop_prefix; the merged
+            # span is a fresh in-memory dict, not a stored payload
+            merged.pop("crc", None)
             if len(parts) > 1:
                 for key in ("k", "v", "k_scale", "v_scale"):
                     if key in merged:
@@ -2206,6 +2245,26 @@ class LLMEngine:
     # ------------------------------------------------------------------
     # weights + teardown
     # ------------------------------------------------------------------
+    def audit_weights(self):
+        """Weight integrity re-audit (ISSUE 20): re-hash the live
+        parameters and compare against the fingerprint captured at
+        construction / last ``reload_weights``. Returns True when they
+        match; False — counting
+        ``serving_weight_audit_failures_total`` — when the weights
+        changed IN PLACE (silent corruption; the caller's degrade is
+        ``reload_weights`` from the artifact + a suspicion charge). The
+        first call on an engine built without ``weight_audit=True``
+        captures the reference instead of comparing."""
+        fp = weights_fingerprint(self.model)
+        self._weight_audits += 1
+        if self._weight_audit_ref is None:
+            self._weight_audit_ref = fp
+            return True
+        if fp != self._weight_audit_ref:
+            _M_WEIGHT_AUDIT_FAIL.inc(instance=self._name)
+            return False
+        return True
+
     def reload_weights(self, source):
         """Hot-reload weights without recompiling: from a
         ``CheckpointManager`` (prefers ``latest_healthy_step()``, falls
@@ -2218,6 +2277,10 @@ class LLMEngine:
                 # restored host arrays must go back to the plan's layouts
                 # or the next step would recompile for replicated inputs
                 self._plan.apply_to_model(self.model)
+        if self._weight_audit_ref is not None or self._weight_audit:
+            # a reload legitimately changes the fingerprint: re-anchor
+            # the audit reference at the freshly loaded weights
+            self._weight_audit_ref = weights_fingerprint(self.model)
         if self._store_path is not None:
             fp = weights_fingerprint(self.model)
             if fp != self._store_fingerprint:
@@ -2333,8 +2396,12 @@ class LLMEngine:
             "prefix_store_saved": int(_M_STORE_SAVED.value(instance=inst)),
             "prefix_store_loaded": int(
                 _M_STORE_LOADED.value(instance=inst)),
-            "prefix_store_rejected": int(
-                _M_STORE_REJECTED.value(instance=inst)),
+            # reason-labeled since ISSUE 20: the plain key stays the
+            # all-reasons sum so existing consumers keep working
+            "prefix_store_rejected": sum(
+                self._store_rejected_by_reason().values()),
+            "prefix_store_rejected_by_reason":
+                self._store_rejected_by_reason(),
             # multi-tenant QoS (ISSUE 17) — zeros when QoS is unused
             "quota_throttled": int(_M_THROTTLED.value(instance=inst)),
             "batch_yields": int(_M_BATCH_YIELD.value(instance=inst)),
@@ -2343,17 +2410,39 @@ class LLMEngine:
             # and the bytes they pulled (prefill fetches excluded)
             "host_syncs": int(_M_HOST_SYNCS.value(instance=inst)),
             "decode_fetch_bytes": int(_M_FETCH_BYTES.value(instance=inst)),
+            # serving integrity (ISSUE 20) — zeros when checksums / the
+            # weight audit are off
+            "kv_pages_verified": int(
+                _M_PAGES_VERIFIED.value(instance=inst)),
+            "kv_pages_rejected": int(
+                _M_PAGES_REJECTED.value(instance=inst)),
+            "weight_audits": int(self._weight_audits),
+            "weight_audit_failures": int(
+                _M_WEIGHT_AUDIT_FAIL.value(instance=inst)),
         }
 
     def _remove_tenant_series(self):
         """Remove THIS instance's tenant-labeled series. The extra
         ``tenant`` label means the plain ``remove(instance=)`` sweep in
         ``reset_metrics``/``close`` cannot reach them — iterate the live
-        label sets instead."""
-        for labels in list(_M_TENANT_TOKENS.labels()):
+        label sets instead. The reason-labeled store-rejected counter
+        (ISSUE 20) needs the same treatment."""
+        for m in (_M_TENANT_TOKENS, _M_STORE_REJECTED):
+            for labels in list(m.labels()):
+                d = dict(labels)
+                if d.get("instance") == self._name:
+                    m.remove(**d)
+
+    def _store_rejected_by_reason(self):
+        """Per-reason store-rejection counts for THIS instance (ISSUE
+        20) — iterated from live label sets, like the tenant tokens."""
+        out = {}
+        for labels in _M_STORE_REJECTED.labels():
             d = dict(labels)
             if d.get("instance") == self._name:
-                _M_TENANT_TOKENS.remove(**d)
+                out[d.get("reason", "corrupt")] = int(
+                    _M_STORE_REJECTED.value(**d))
+        return out
 
     def _tenant_token_counts(self):
         """Per-tenant served-token counts for THIS instance — iterated

@@ -23,6 +23,11 @@ of flapping forever.
 * :class:`TenantQuotaExceededError` — a tenant blew through its
   token-rate quota at the router (ISSUE 17): hard rejection with a
   ``retry_after_s`` hint so the abuser backs off instead of hammering.
+* :class:`KVIntegrityError` — a KV page failed its CRC32 at a read-back
+  boundary (ISSUE 20): the page was corrupted AT REST (host tier, prefix
+  store, transfer payload) after it was sealed. The degrade rule is
+  re-prefill, never serve-the-page — so this error names corruption that
+  was CAUGHT, not tokens that went wrong.
 * :class:`DeadlineInfeasibleError` — SLO-aware placement (ISSUE 17)
   determined the deadline cannot be met (estimated queue wait + prefill
   cost exceed the remaining budget); subclasses
@@ -43,7 +48,7 @@ from ...distributed.launch.controllers.collective import CrashLoopError
 __all__ = ["RequestTimeoutError", "FleetOverloadedError",
            "EngineClosedError", "ReplicaCrashLoopError",
            "KVTransferError", "TenantQuotaExceededError",
-           "DeadlineInfeasibleError"]
+           "DeadlineInfeasibleError", "KVIntegrityError"]
 
 
 class RequestTimeoutError(TimeoutError):
@@ -112,6 +117,22 @@ class ReplicaCrashLoopError(CrashLoopError):
     def __init__(self, msg, replica=None, exit_code=1, restarts=0):
         super().__init__(msg, exit_code=exit_code, restarts=restarts)
         self.replica = replica
+
+
+class KVIntegrityError(RuntimeError):
+    """A KV page payload failed CRC32 verification at a read-back
+    boundary (ISSUE 20): host-tier revive, ``import_request_pages``, or
+    prefix-store load. The page was sealed with per-block checksums at
+    its write boundary, so a mismatch means the bytes changed AT REST —
+    silent data corruption caught before a single wrong token decoded.
+    ``key`` names the tier/store entry (or request) whose page failed;
+    ``block`` is the index of the first mismatching block within the
+    payload (None when the sidecar itself is malformed)."""
+
+    def __init__(self, msg, key=None, block=None):
+        super().__init__(msg)
+        self.key = key
+        self.block = block
 
 
 class KVTransferError(RuntimeError):

@@ -56,6 +56,18 @@ sites fire at the loop head:
 * ``serve.kv_transfer_corrupt`` — fired per kvpage frame: the frame's
   payload is corrupted after its CRC was computed, so the receiver's
   CRC check must catch it.
+* ``serve.bit_flip`` — silent data corruption (ISSUE 20): flips bits in
+  a weight buffer, a host-tier KV entry, or a KV pool page
+  (``CHAOS_SERVE_BIT_FLIP_TARGET`` = ``weights`` | ``host_entry`` |
+  ``kv_page``). Nothing crashes and nothing raises — the integrity
+  sentinel (page CRCs / sampled output audit / weight re-audit) must
+  catch it.
+
+The periodic weight re-audit (ISSUE 20) is armed by
+``PADDLE_SERVE_WEIGHT_AUDIT_TICKS=N``: every N loop ticks the worker
+re-hashes the live weights against the fingerprint captured at load; a
+mismatch emits ``{"e":"integrity","kind":"weight_audit"}`` (a suspicion
+charge at the router) and hot-reloads the artifact's clean weights.
 
 Chaos arming is env-driven so drills can poison exactly one replica:
 ``CHAOS_SERVE_SITE`` + ``CHAOS_SERVE_REPLICA`` + optional
@@ -232,6 +244,7 @@ def replica_worker_main():
 
     from ....distributed.launch import heartbeat as hb
     from ....utils import fault_injection as fi
+    from .. import integrity as _integrity
     from ..engine import LLMEngine, load_llama_artifact
     from ..errors import RequestTimeoutError
     from ..kv_cache import pack_kv_pages, unpack_kv_pages
@@ -362,6 +375,15 @@ def replica_worker_main():
     page_buf = {}  # gid -> {"frames": {seq: bytes}, "bad": reason|None}
     steps = 0
     shutting = False
+    # periodic weight re-audit (ISSUE 20): every N loop ticks, re-hash
+    # the live weights against the load-time fingerprint. Single-process
+    # replicas only — a group rank's params are plan-sharded device
+    # arrays, and the group's SPMD lockstep must not fork on a
+    # host-side reload.
+    audit_every = int(os.environ.get("PADDLE_SERVE_WEIGHT_AUDIT_TICKS",
+                                     "0") or 0)
+    if group_size > 1:
+        audit_every = 0
 
     def _stream_pages(gid, out):
         """Prefill finished for a handed-off request: export its pages,
@@ -540,7 +562,15 @@ def replica_worker_main():
                    # and bench sum these fleet-wide to prove batch-tier
                    # work YIELDED slots rather than being dropped
                    "quota_throttled": s["quota_throttled"],
-                   "batch_yields": s["batch_yields"]})
+                   "batch_yields": s["batch_yields"],
+                   # integrity counters (ISSUE 20). For tp groups, rank
+                   # 0 is the group's one mouth and runs in SPMD
+                   # lockstep with every member, so its engine-owned
+                   # counters ARE the group's aggregate.
+                   "kv_pages_verified": m["kv_pages_verified"],
+                   "kv_pages_rejected": m["kv_pages_rejected"],
+                   "weight_audits": m["weight_audits"],
+                   "weight_audit_failures": m["weight_audit_failures"]})
         elif op == "configure_tenant":
             # QoS envelope push (ISSUE 17): idempotent — the router
             # re-sends the full set to every new incarnation. Cache
@@ -593,6 +623,13 @@ def replica_worker_main():
                     fi.should_fire("serve.group_member_hang"):
                 while True:  # wedged: no heartbeat, no service, no exit
                     time.sleep(3600)
+            if fi.should_fire("serve.bit_flip"):
+                # SILENT corruption: nothing raises, nothing exits — the
+                # flip lands and this replica keeps serving wrong bytes
+                # until the integrity sentinel catches it
+                _integrity.flip_bit(
+                    eng, os.environ.get("CHAOS_SERVE_BIT_FLIP_TARGET",
+                                        "weights"))
         if chan is not None and group_rank > 0:
             # member rank: commands arrive ONLY on the broadcast channel,
             # in rank 0's exact application order (SPMD lockstep); a
@@ -665,6 +702,21 @@ def replica_worker_main():
                        "occ": m["decode_batch_occupancy"] or 0.0,
                        "waiting": len(eng.scheduler.waiting)})
         steps += 1
+        if audit_every and steps % audit_every == 0 and not shutting:
+            if not eng.audit_weights():
+                # in-place weight corruption: tell the router (suspicion
+                # charge) and hot-swap the artifact's clean weights so
+                # this replica stops serving wrong bytes NOW — the
+                # router may still quarantine-restart it
+                _emit({"e": "integrity", "kind": "weight_audit",
+                       "replica": replica_id})
+                try:
+                    eng.reload_weights(cfg["artifact"])
+                except Exception as ex:  # pragma: no cover - defensive
+                    _emit({"e": "err", "gid": None,
+                           "kind": type(ex).__name__,
+                           "msg": f"reload after failed weight audit: "
+                                  f"{ex}"})
         _beat()
         if shutting and not eng.has_work():
             eng.close()

@@ -69,6 +69,20 @@ spawns a replica, scale-down rides :meth:`drain` (``then="retire"``)
 so shrinking the fleet drops zero requests. All of it off by default:
 untagged traffic on an unconfigured router behaves exactly as before.
 
+ISSUE 20 adds the **serving integrity sentinel**'s fleet layer:
+``audit_fraction=p`` replays a deterministic sample of completed
+requests on a DIFFERENT replica as batch-tier background work and
+compares the token streams bit-for-bit — greedy decode is
+deterministic, so two honest replicas cannot disagree, and a mismatch
+IS silent data corruption. A mismatch triggers a third-replica referee
+replay that majority-votes the corrupt side; confirmed corruption (and
+repeated unrefereed disagreement, and failed weight re-audits reported
+by the replicas) charges a per-replica leaky-bucket suspicion score
+whose overflow QUARANTINES the replica: killed without grace, removed
+from placement, restarted under ONE restart-budget slot, with its
+in-flight requests redispatched bit-exact on healthy peers. Off by
+default (``audit_fraction=0.0``).
+
 The router is single-threaded by design: all state mutates inside
 :meth:`step` (the pump), mirroring ``LLMEngine.step``. ``submit`` +
 ``join``/``step`` + ``result`` is the whole client API.
@@ -90,6 +104,7 @@ from ....utils import fault_injection as _fi
 from ..errors import (DeadlineInfeasibleError, EngineClosedError,
                       FleetOverloadedError, KVTransferError,
                       RequestTimeoutError, TenantQuotaExceededError)
+from ..integrity import SuspicionScore, audit_sampled
 from ..scheduler import TIER_BATCH, TIER_LATENCY, TenantQuota
 from .framing import decode_frame, join_frames
 from .supervisor import ReplicaSupervisor
@@ -143,6 +158,24 @@ _M_INFEASIBLE = _obs_metrics.counter(
     "requests rejected at submit by the SLO feasibility check "
     "(estimated queue wait + prefill cost already exceed the deadline "
     "budget)")
+# serving integrity sentinel (ISSUE 20)
+_M_AUDITS = _obs_metrics.counter(
+    "fleet_audits_total",
+    "sampled output audits completed: a finished request replayed "
+    "bit-for-bit on a DIFFERENT replica as batch-tier background work "
+    "(greedy decode is deterministic, so any disagreement IS silent "
+    "data corruption)")
+_M_AUDIT_MISMATCH = _obs_metrics.counter(
+    "fleet_audit_mismatches_total",
+    "output audits whose replayed token stream disagreed with the "
+    "served one; a third-replica referee replay majority-votes which "
+    "side is corrupt")
+_M_QUARANTINED = _obs_metrics.counter(
+    "fleet_replicas_quarantined_total",
+    "replicas force-restarted by the integrity sentinel after their "
+    "leaky-bucket suspicion score crossed the quarantine threshold "
+    "(drain of trust -> removal from placement -> one restart-budget "
+    "slot)")
 
 QUEUED, PREFILLING, PLACED, DONE, FAILED = (
     "queued", "prefilling", "placed", "done", "failed")
@@ -184,7 +217,7 @@ class FleetRequest:
                  "state", "replica", "generation", "emitted", "error",
                  "finish_reason", "t_submit", "t_first", "t_done",
                  "redispatches", "hid", "kv_retries", "frames", "pages",
-                 "tenant", "tier")
+                 "tenant", "tier", "audit")
 
     def __init__(self, gid, prompt, max_new, eos, deadline, session,
                  tenant=None, tier=None):
@@ -218,6 +251,11 @@ class FleetRequest:
         # to the decode worker (no re-encode, no re-CRC)
         self.frames: dict[int, tuple] = {}
         self.pages = None  # {"frames": [(data_b64, crc)], "crc", "count"}
+        # integrity-sentinel replay metadata (ISSUE 20); None for
+        # normal traffic. An audit request carries the gid it audits,
+        # the served token stream it must reproduce, the replicas it
+        # may NOT place on, and the verdict stage (audit | referee).
+        self.audit = None
 
     @property
     def finished(self):
@@ -244,7 +282,7 @@ class Router:
                  env_extra=None, wait_ready=True, roles=None,
                  max_kv_retries=3, max_pending_handoffs=8,
                  idle_backoff=(0.0005, 0.05), slo_admission=False,
-                 group_size=1, plan=None):
+                 group_size=1, plan=None, audit_fraction=0.0):
         self._name = f"fleet#{next(Router._ids)}"
         engine_kwargs = dict(engine_kwargs or {})
         if supervisor is None:
@@ -319,9 +357,18 @@ class Router:
         self._autoscale = None
         self.scale_ups = 0
         self.scale_downs = 0
+        # serving integrity sentinel (ISSUE 20): a deterministic sample
+        # of completed requests is replayed on a DIFFERENT replica as
+        # batch-tier background work; mismatches escalate through a
+        # third-replica referee into per-replica suspicion scores that
+        # drive quarantine (drain from placement + forced restart)
+        self.audit_fraction = float(audit_fraction)
+        self._suspicion: dict[int, SuspicionScore] = {}
+        self.audit_log: list[dict] = []
         for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _M_KV_PAGES,
                   _M_KV_RETRIES, _M_HANDOFFS, _M_FAILOVERS,
-                  _M_QUOTA_REJECTED, _M_INFEASIBLE):
+                  _M_QUOTA_REJECTED, _M_INFEASIBLE, _M_AUDITS,
+                  _M_AUDIT_MISMATCH, _M_QUARANTINED):
             m.inc(0, instance=self._name)
         _G_QUEUE.set(0, instance=self._name)
         _G_DRAINING.set(0, instance=self._name)
@@ -434,11 +481,211 @@ class Router:
     def _note_done(self, req):
         """Completion bookkeeping shared by every terminal transition:
         feeds the drain-rate window and the TTFT EMA."""
+        if req.audit is not None:
+            # background audit replays must not skew the SLO
+            # estimators: their batch-tier latency is not what a
+            # latency-tier admission decision should be priced on
+            return
         self._done_times.append(time.time())
         if req.t_first is not None and req.t_submit is not None:
             dt = req.t_first - req.t_submit
             self._ttft_ema = (dt if self._ttft_ema is None
                               else 0.8 * self._ttft_ema + 0.2 * dt)
+
+    # ------------------------------------------------------------------
+    # sampled output audit + replica quarantine (ISSUE 20)
+    # ------------------------------------------------------------------
+    AUDIT_DEADLINE_S = 120.0
+
+    def _incarnation(self, replica_id):
+        h = self._handle(replica_id)
+        return h.incarnation if h is not None else None
+
+    def _note_audit(self, req):
+        """Terminal-transition hook of the integrity sentinel. A
+        normally finished request that the deterministic sampler picks
+        spawns a batch-tier replay of the same work on a DIFFERENT
+        replica; a finished audit is compared against the served stream
+        and escalates (referee replay -> suspicion charge ->
+        quarantine) on mismatch. Greedy decode is bit-exact across
+        replicas, so two honest replicas CANNOT disagree — a mismatch
+        is, by the core invariant, silent data corruption."""
+        if req.audit is not None:
+            self._audit_finished(req)
+            return
+        if req.state != DONE or not req.emitted:
+            return
+        if sum(1 for h in self.supervisor.handles if not h.retired) < 2:
+            return  # no second replica to disagree with
+        if not audit_sampled(req.gid, self.audit_fraction):
+            return
+        self._spawn_audit(req.prompt, req.max_new, req.eos, req.tenant, {
+            "of": req.gid, "stage": "audit",
+            "expect": list(req.emitted),
+            "exclude": ([req.replica] if req.replica is not None else []),
+            "server": req.replica,
+            "server_inc": (self._incarnation(req.replica)
+                           if req.replica is not None else None),
+            "auditor": None, "auditor_inc": None,
+        })
+
+    def _spawn_audit(self, prompt, max_new, eos, tenant, audit):
+        """Enqueue one audit replay. Bypasses every admission gate
+        (quota, shed, SLO): audits are the sentinel's own background
+        work, not tenant traffic — but they DO carry a deadline, so an
+        audit the fleet cannot run ends inconclusive instead of
+        pinning its request record forever."""
+        req = FleetRequest(next(self._gids), prompt, max_new, eos,
+                           time.time() + self.AUDIT_DEADLINE_S, None,
+                           tenant=tenant, tier=TIER_BATCH)
+        req.audit = audit
+        self._reqs[req.gid] = req
+        self._queue.append(req)
+        return req.gid
+
+    def _audit_finished(self, req):
+        """Verdict logic for a finished audit/referee replay."""
+        audit = req.audit
+        self._reqs.pop(req.gid, None)  # audits self-release
+        of, stage = audit["of"], audit["stage"]
+        auditor, expect = audit.get("auditor"), audit["expect"]
+        got = list(req.emitted)
+        if req.state != DONE:
+            # the replay itself failed (deadline, replica error):
+            # inconclusive — never charge anyone for an audit the
+            # fleet failed to run
+            self.audit_log.append({"of": of, "stage": stage,
+                                   "verdict": "inconclusive",
+                                   "auditor": auditor})
+            return
+        server = audit.get("server")
+        if stage == "audit":
+            _M_AUDITS.inc(instance=self._name)
+            if got == expect:
+                self.audit_log.append({"of": of, "stage": stage,
+                                       "verdict": "match",
+                                       "auditor": auditor})
+                return
+            _M_AUDIT_MISMATCH.inc(instance=self._name)
+            self.audit_log.append({"of": of, "stage": stage,
+                                   "verdict": "mismatch",
+                                   "auditor": auditor, "server": server})
+            warnings.warn(
+                f"{self._name}: output audit mismatch on request {of}: "
+                f"replica {server} served a stream replica {auditor} "
+                "could not reproduce — one of them is corrupt",
+                RuntimeWarning)
+            exclude = [x for x in (server, auditor) if x is not None]
+            if self._audit_candidates(exclude):
+                # referee replay on a THIRD replica majority-votes the
+                # corrupt side
+                self._spawn_audit(req.prompt, req.max_new, req.eos,
+                                  req.tenant, {
+                    "of": of, "stage": "referee",
+                    "expect": expect, "exclude": exclude,
+                    "server": server,
+                    "server_inc": audit.get("server_inc"),
+                    "auditor": None, "auditor_inc": None,
+                    "auditor0": auditor,
+                    "auditor0_inc": audit.get("auditor_inc"),
+                    "audit_toks": got,
+                })
+            else:
+                # no third replica: no majority possible — both
+                # parties take one suspicion point, and whichever is
+                # really corrupt keeps disagreeing until its bucket
+                # overflows
+                why = f"unrefereed audit mismatch on request {of}"
+                self._charge_suspicion(server, 1, why,
+                                       inc=audit.get("server_inc"))
+                self._charge_suspicion(auditor, 1, why,
+                                       inc=audit.get("auditor_inc"))
+            return
+        # stage == "referee": two of the three streams agree — the
+        # odd one out is the corrupt replica (charged straight to the
+        # quarantine threshold); three-way disagreement charges both
+        # original parties one point each
+        auditor0 = audit.get("auditor0")
+        thr = SuspicionScore().threshold
+        if got == expect:
+            self.audit_log.append({"of": of, "stage": stage,
+                                   "verdict": "auditor_corrupt",
+                                   "corrupt": auditor0})
+            self._charge_suspicion(
+                auditor0, thr,
+                f"referee confirmed replica {auditor0} corrupted the "
+                f"audit replay of request {of}",
+                inc=audit.get("auditor0_inc"))
+        elif got == audit.get("audit_toks"):
+            self.audit_log.append({"of": of, "stage": stage,
+                                   "verdict": "server_corrupt",
+                                   "corrupt": server})
+            self._charge_suspicion(
+                server, thr,
+                f"referee confirmed replica {server} served a corrupt "
+                f"stream for request {of}",
+                inc=audit.get("server_inc"))
+        else:
+            self.audit_log.append({"of": of, "stage": stage,
+                                   "verdict": "no_majority"})
+            why = f"three-way audit disagreement on request {of}"
+            self._charge_suspicion(server, 1, why,
+                                   inc=audit.get("server_inc"))
+            self._charge_suspicion(auditor0, 1, why,
+                                   inc=audit.get("auditor0_inc"))
+
+    def _charge_suspicion(self, replica_id, n, why, inc=None):
+        """Charge ``n`` points against a replica's leaky-bucket
+        suspicion score; crossing the threshold quarantines it. A
+        charge whose evidence predates the replica's current
+        incarnation is dropped — a restart already replaced the
+        corrupt process, so old sins must not re-fell the fresh one."""
+        if replica_id is None:
+            return
+        h = self._handle(replica_id)
+        if h is None or h.retired:
+            return
+        if inc is not None and h.incarnation != inc:
+            return
+        s = self._suspicion.get(replica_id)
+        if s is None:
+            s = self._suspicion[replica_id] = SuspicionScore()
+        if s.charge(n):
+            self._quarantine(replica_id, why)
+
+    def _quarantine(self, replica_id, why):
+        """Remove a suspect replica from service NOW: the supervisor
+        kills it (no grace — a corrupt replica must stop emitting),
+        charges one restart-budget slot and schedules the respawn; its
+        final events and in-flight requests ride the exact same
+        recovery path as a crash, so every in-flight request is
+        redispatched bit-exact on a healthy peer."""
+        idx = next((i for i, h in enumerate(self.supervisor.handles)
+                    if h.id == replica_id), None)
+        if idx is None:
+            return
+        self._suspicion.pop(replica_id, None)
+        death = self.supervisor.quarantine(idx)
+        if death is None:
+            return  # already retired or already pending respawn
+        _M_QUARANTINED.inc(instance=self._name)
+        warnings.warn(
+            f"{self._name}: quarantining replica {replica_id}: {why}",
+            RuntimeWarning)
+        self.audit_log.append({"stage": "quarantine",
+                               "replica": replica_id, "why": why})
+        for ev in death["events"]:
+            self._handle_event_from(death["replica"], ev)
+        self._recover_replica(death["replica"])
+
+    def _audit_candidates(self, exclude):
+        return [h for h in self.supervisor.handles
+                if self._role(h) != "prefill" and self._placeable(h)
+                and h.id not in exclude]
+
+    def _pick_audit_replica(self, req):
+        return self._least_loaded(
+            self._audit_candidates(req.audit["exclude"]))
 
     # -- tenant configuration (ISSUE 17) --------------------------------
     def configure_tenant(self, name, *, weight=1.0, rate_tokens_per_s=None,
@@ -685,6 +932,7 @@ class Router:
                     req.finish_reason = reason
                     req.t_done = time.perf_counter()
                     self._note_done(req)
+                    self._note_audit(req)
         elif kind == "kvpage":
             self._handle_kvpage(replica_id, ev)
         elif kind == "kvdone":
@@ -695,10 +943,13 @@ class Router:
             req = self._reqs.get(ev.get("gid"))
             if req is not None and not req.finished:
                 self._inflight[replica_id].discard(req.gid)
-                if ev.get("kind") == "KVTransferError":
+                if ev.get("kind") in ("KVTransferError",
+                                      "KVIntegrityError"):
                     # the decode worker rejected the handed-off pages
-                    # (corrupt/incomplete buffer): transient — re-drive
-                    # the prefill under the transfer retry budget
+                    # (corrupt/incomplete buffer, or the page CRCs
+                    # failed verification at import): transient —
+                    # re-drive the prefill under the transfer retry
+                    # budget rather than ever decoding on garbage
                     self._kv_transfer_failed(
                         req, f"decode replica {replica_id} rejected the "
                              f"pages: {ev.get('msg')}")
@@ -706,6 +957,17 @@ class Router:
                 self._fail(req, RuntimeError(
                     f"replica {replica_id} rejected request {req.gid}: "
                     f"{ev.get('kind')}: {ev.get('msg')}"), "error")
+        elif kind == "integrity":
+            # a replica's periodic weight re-audit failed: its live
+            # fingerprint drifted from the artifact's. The replica
+            # reloads its own weights; the router charges one
+            # suspicion point — repeated drift means the slot's
+            # hardware cannot be trusted and quarantine restarts it
+            self._charge_suspicion(
+                replica_id, 1,
+                f"weight fingerprint audit failed on replica "
+                f"{replica_id} ({ev.get('kind')})",
+                inc=self._incarnation(replica_id))
         elif kind == "reloaded":
             self.reloads.append((replica_id, ev.get("step")))
             d = self._draining.get(replica_id)
@@ -725,6 +987,7 @@ class Router:
         self._note_done(req)
         if isinstance(error, RequestTimeoutError):
             _M_TIMEOUTS.inc(instance=self._name)
+        self._note_audit(req)
 
     # -- disaggregated KV-page handoff (ISSUE 15) ------------------------
     def _handoff_current(self, replica_id, ev):
@@ -799,6 +1062,7 @@ class Router:
             req.finish_reason = ev.get("reason") or "length"
             req.t_done = time.perf_counter()
             self._note_done(req)
+            self._note_audit(req)
             return
         # stage 2 pending: verified pages queue (front — oldest work)
         # for decode placement. Only the already-encoded frames are
@@ -874,12 +1138,18 @@ class Router:
                     req, f"prefill replica {replica_id} died "
                          "mid-transfer", failover=True)
                 continue
+            if req.audit is not None:
+                # clean-room replay: an audit must be served start to
+                # finish by ONE replica, or mismatch attribution is
+                # meaningless — discard partial tokens, replay whole
+                req.emitted = []
             if req.remaining <= 0:
                 # everything was emitted; only the fin event was lost
                 req.state = DONE
                 req.finish_reason = "length"
                 req.t_done = time.perf_counter()
                 self._note_done(req)
+                self._note_audit(req)
                 continue
             req.state = QUEUED
             req.replica = None
@@ -1132,8 +1402,27 @@ class Router:
     def _place(self):
         placed = 0
         split = self.split
+        deferred = []
         while self._queue:
             req = self._queue[0]
+            if req.audit is not None:
+                # integrity-audit replay: place on any decode-capable
+                # replica NOT in the exclusion set (colocated even on
+                # a split fleet — ONE replica must own the whole
+                # replay or mismatch attribution is meaningless).
+                # Unplaceable right now (every candidate excluded or
+                # busy) -> defer past this tick: background audits
+                # never wedge the head of the line for real traffic.
+                h = self._pick_audit_replica(req)
+                if h is None:
+                    deferred.append(self._queue.popleft())
+                    continue
+                if not self._dispatch_submit(req, h):
+                    break
+                req.audit["auditor"] = h.id
+                req.audit["auditor_inc"] = h.incarnation
+                placed += 1
+                continue
             if split and req.pages is not None:
                 # stage 2: pages verified, awaiting a decode worker
                 h = self._pick_replica(req)
@@ -1181,6 +1470,8 @@ class Router:
                 # the death and the replica leaves the placeable set
                 break
             placed += 1
+        if deferred:
+            self._queue.extend(deferred)
         return placed
 
     # ------------------------------------------------------------------
@@ -1280,7 +1571,27 @@ class Router:
                 _M_INFEASIBLE.value(instance=inst)),
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
+            # serving integrity sentinel (ISSUE 20)
+            "audits_run": int(_M_AUDITS.value(instance=inst)),
+            "audit_mismatches": int(
+                _M_AUDIT_MISMATCH.value(instance=inst)),
+            "replicas_quarantined": int(
+                _M_QUARANTINED.value(instance=inst)),
         }
+
+    def stats(self, timeout=10.0):
+        """One-call fleet integrity/ops snapshot: the router's own
+        :meth:`metrics` plus every live replica's synchronous ``stats``
+        RPC (integrity counters included — pages verified/rejected,
+        weight audits run/failed). On a tp-group fleet rank 0 answers
+        for its whole group: SPMD lockstep means rank 0's counters ARE
+        the group aggregate."""
+        out = {"fleet": self.metrics(), "replicas": {}}
+        for h in self.supervisor.handles:
+            if h.alive and not h.retired:
+                out["replicas"][h.id] = self.replica_stats(
+                    h.id, timeout=timeout)
+        return out
 
     def ttft_seconds(self):
         """Per-request submit→first-token latencies (finished requests
@@ -1329,7 +1640,8 @@ class Router:
         self.supervisor.shutdown()
         for m in (_M_REDISPATCH, _M_SHED, _M_TIMEOUTS, _G_QUEUE,
                   _G_DRAINING, _M_KV_PAGES, _M_KV_RETRIES, _M_HANDOFFS,
-                  _M_FAILOVERS, _M_QUOTA_REJECTED, _M_INFEASIBLE):
+                  _M_FAILOVERS, _M_QUOTA_REJECTED, _M_INFEASIBLE,
+                  _M_AUDITS, _M_AUDIT_MISMATCH, _M_QUARANTINED):
             m.remove(instance=self._name)
 
     def __enter__(self):

@@ -641,6 +641,42 @@ class ReplicaSupervisor:
         self._note_liveness(beats=beats, now=now)
         return deaths
 
+    def quarantine(self, i, now=None):
+        """Integrity quarantine (ISSUE 20): kill replica ``i`` NOW —
+        group-atomic, exactly like a watchdog kill — charge ONE restart-
+        budget slot and schedule the respawn through the normal
+        ``_pending_respawn`` path (so a supervision tick racing this
+        call can never double-restart the slot: ``check`` skips slots
+        already pending). Returns the death dict (``reason:
+        "quarantine"``) for the router to replay/redispatch from, or
+        ``None`` when the slot is retired / already dying. Raises
+        :class:`ReplicaCrashLoopError` when the budget is exhausted —
+        a replica that keeps corrupting after restarts is poisoned
+        hardware, not bad luck."""
+        now = time.time() if now is None else now
+        h = self.handles[i]
+        if h.retired or i in self._pending_respawn:
+            return None
+        # no SIGTERM grace: a corrupt replica must stop emitting tokens
+        # immediately, not drain them
+        h.kill(grace_s=0.0)
+        rc = h.proc.poll()
+        leftovers = h.final_events()
+        self._note_liveness()  # the dip precedes the respawn
+        budget = self._budgets[i]
+        if not budget.try_acquire():
+            self.shutdown()
+            raise ReplicaCrashLoopError(
+                f"replica {i} quarantine loop: restart budget exhausted "
+                f"({budget.max_restarts} per {budget.window_s:.0f}s "
+                f"window, {budget.total_restarts} performed) — the slot "
+                "keeps serving corrupt output; suspect the hardware",
+                replica=i, exit_code=rc if rc is not None else 1,
+                restarts=budget.total_restarts)
+        self._pending_respawn[i] = now + budget.backoff()
+        return {"replica": i, "reason": "quarantine", "rc": rc,
+                "rank": None, "events": leftovers}
+
     def _clear_heartbeats(self, i):
         """Remove slot ``i``'s heartbeat files — the bare ``hb.<i>`` and
         every group member's ``hb.<i>.<rank>``."""

@@ -1,0 +1,259 @@
+"""Serving integrity sentinel (ISSUE 20): end-to-end silent-data-
+corruption defense for the fleet.
+
+Every fault the fleet survives elsewhere is LOUD — crashes, hangs, torn
+writes, corrupt CRC frames. Silent data corruption (a flipped bit in a
+host spill buffer, a transfer payload, or a live weight shard) produces
+wrong tokens with no error anywhere. The defense has four layers, all
+built on the stack's hard-won invariant that greedy decode is bit-exact
+across replicas, redispatch, handoff, spill/revive, quantization and tp
+groups — so any two honest replicas must agree token-for-token, and
+disagreement IS corruption:
+
+* **page checksums** (this module + kv_cache) — per-block CRC32 sealed
+  into every page payload the moment it is materialized to host memory
+  (:meth:`~.kv_cache.PageSnapshot.materialize`, which is the single
+  choke point behind ``export_request_pages``, ``HostKVTier`` spills and
+  the prefix-store save pass), and verified at every read-back boundary
+  (host-tier revive / prefix pop, ``add_request_with_pages`` import,
+  prefix-store boot entries on their first revive). Chunk-prefill and
+  COW content is covered transitively: those writes live in the device
+  pool, and the seal is computed from the pool's bytes the instant they
+  cross the host boundary — a flip INSIDE the device pool is the output
+  audit's job, a flip at rest in host RAM or a transfer buffer is
+  caught here, before a single wrong token decodes. Off by default;
+  ``LLMEngine(kv_page_checksums=True)`` arms sealing. Degrade rule:
+  verification failure frees the entry and falls back to re-prefill —
+  a corrupt page is NEVER served (:class:`~.errors.KVIntegrityError`).
+  The CRC chains the int8 scale sidecars after the code planes: codes
+  with a flipped scale row are exactly as wrong as flipped codes.
+
+* **sampled output audit** (router) — ``Router(audit_fraction=p)``
+  replays a deterministic hash-sample of completed requests on a
+  DIFFERENT replica as batch-tier background work and compares the
+  token streams bit-for-bit; a mismatch triggers a third-replica
+  referee replay to majority-vote which replica is corrupt.
+
+* **replica quarantine** (router + supervisor) — a per-replica
+  :class:`SuspicionScore` leaky bucket; crossing the threshold drives
+  remove-from-placement → group-atomic restart through ONE
+  ``RestartBudget`` slot, with the quarantined replica's in-flight
+  requests redispatched bit-exact.
+
+* **weight integrity re-audit** (engine + replica) — periodic
+  re-verification of the live :func:`~.prefix_store.weights_fingerprint`
+  against the value captured at load; a mismatch means the weights
+  changed IN PLACE (SDC, not a reload) → ``reload_weights`` + a
+  suspicion charge.
+
+The whole chain is provable end-to-end via the ``serve.bit_flip`` fault
+site (:func:`flip_bit` is its payload: it can hit a KV pool page, a
+host-tier entry, or a weight buffer) — ``chaos_serve.py --drill sdc``.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from collections import deque
+
+import numpy as np
+
+from ...observability import metrics as _obs_metrics
+from .errors import KVIntegrityError
+
+__all__ = ["compute_page_crcs", "seal_pages", "verify_pages",
+           "SuspicionScore", "flip_bit", "audit_sampled"]
+
+# CRC planes in a fixed order so fp32 and int8 payloads hash
+# deterministically; the scale sidecars chain AFTER the codes — a page
+# with corrupt scales fails exactly like one with corrupt codes.
+_CRC_PARTS = ("k", "v", "k_scale", "v_scale")
+
+_M_PAGES_VERIFIED = _obs_metrics.counter(
+    "serving_kv_pages_verified_total",
+    "KV page blocks whose CRC32 seal verified clean at a read-back "
+    "boundary (host-tier revive, page import, prefix revive)")
+_M_PAGES_REJECTED = _obs_metrics.counter(
+    "serving_kv_pages_rejected_total",
+    "KV page payloads REJECTED at a read-back boundary (CRC mismatch or "
+    "malformed seal) — the entry is freed and the request re-prefills; "
+    "a corrupt page is never served")
+_M_WEIGHT_AUDIT_FAIL = _obs_metrics.counter(
+    "serving_weight_audit_failures_total",
+    "weight integrity re-audits that found the live fingerprint "
+    "diverged from the loaded artifact's — in-place weight corruption, "
+    "answered by reload_weights + a suspicion charge")
+
+
+def compute_page_crcs(pages):
+    """Per-block CRC32 of a page payload (``export_request_pages``
+    format): for block ``i``, the CRC chains the contiguous bytes of
+    every present plane's block-``i`` slice in :data:`_CRC_PARTS` order.
+    Returns ``uint32 [nblocks]``."""
+    parts = [np.asarray(pages[nm]) for nm in _CRC_PARTS if nm in pages
+             and pages[nm] is not None]
+    n = int(parts[0].shape[1])
+    out = np.empty(n, np.uint32)
+    for i in range(n):
+        c = 0
+        for a in parts:
+            c = zlib.crc32(np.ascontiguousarray(a[:, i]).tobytes(), c)
+        out[i] = c
+    return out
+
+
+def seal_pages(pages):
+    """Attach the per-block CRC sidecar (``pages["crc"]``, uint32
+    ``[nblocks]``) to a freshly-materialized payload. The sidecar is a
+    plain ndarray value, so it rides ``pack_kv_pages``/``unpack_kv_pages``
+    and the prefix store with zero format changes."""
+    pages["crc"] = compute_page_crcs(pages)
+    return pages
+
+
+def verify_pages(pages, *, instance=None, key=None):
+    """Verify a payload's CRC seal at a read-back boundary. Unsealed
+    payloads (no ``"crc"`` — checksums were off when the page was
+    written) pass through untouched, so arming mid-flight never rejects
+    pre-existing clean entries. Returns the number of blocks verified
+    (0 when unsealed); raises :class:`KVIntegrityError` — after bumping
+    ``serving_kv_pages_rejected_total`` — on any mismatch. Callers own
+    the degrade rule: free the entry, fall back to re-prefill."""
+    crc = pages.get("crc")
+    if crc is None:
+        return 0
+    crc = np.asarray(crc, np.uint32).reshape(-1)
+    n = int(np.asarray(pages["k"]).shape[1])
+    if crc.shape[0] != n:
+        _M_PAGES_REJECTED.inc(instance=instance)
+        raise KVIntegrityError(
+            f"KV page seal is malformed: {crc.shape[0]} CRCs for {n} "
+            f"blocks (key={key!r})", key=key)
+    got = compute_page_crcs(pages)
+    bad = np.nonzero(got != crc)[0]
+    if bad.size:
+        _M_PAGES_REJECTED.inc(instance=instance)
+        raise KVIntegrityError(
+            f"KV page CRC mismatch on block {int(bad[0])} of {n} "
+            f"(key={key!r}): page bytes changed at rest — refusing to "
+            "serve a corrupt page", key=key, block=int(bad[0]))
+    _M_PAGES_VERIFIED.inc(n, instance=instance)
+    return n
+
+
+def audit_sampled(gid, fraction):
+    """Deterministic audit sampling: whether completed request ``gid``
+    is in the audited fraction. Hash-based (not random) so a replayed /
+    redispatched request makes the same decision everywhere, and so the
+    drill can force ``fraction=1.0`` without touching RNG state."""
+    f = float(fraction)
+    if f <= 0.0:
+        return False
+    if f >= 1.0:
+        return True
+    return zlib.crc32(f"audit:{gid}".encode()) % 10000 < int(f * 10000)
+
+
+class SuspicionScore:
+    """Per-replica leaky-bucket suspicion (the ``RestartBudget`` idiom
+    pointed at corruption instead of crashes): each confirmed-corrupt
+    audit verdict or failed weight audit ``charge()``s the bucket;
+    charges older than ``window_s`` leak out. Crossing ``threshold``
+    live charges returns True ONCE (the bucket resets — the quarantine
+    restart wipes the replica's state, so stale suspicion must not
+    instantly re-quarantine the clean respawn)."""
+
+    def __init__(self, threshold=2, window_s=300.0, clock=time.monotonic):
+        if int(threshold) < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._events = deque()
+
+    def _leak(self, now):
+        while self._events and now - self._events[0] > self.window_s:
+            self._events.popleft()
+
+    def charge(self, n=1, now=None):
+        """Add ``n`` suspicion charges; True when the threshold is
+        crossed (bucket drained — caller quarantines exactly once)."""
+        now = self._clock() if now is None else now
+        self._leak(now)
+        self._events.extend([now] * int(n))
+        if len(self._events) >= self.threshold:
+            self._events.clear()
+            return True
+        return False
+
+    def score(self, now=None):
+        now = self._clock() if now is None else now
+        self._leak(now)
+        return len(self._events)
+
+
+# -- chaos payload (the serve.bit_flip fault site) ----------------------
+
+def flip_bit(eng, target="weights", block=1):
+    """Inject silent data corruption into a live engine — the payload
+    behind the ``serve.bit_flip`` fault site. Returns a description dict
+    (or None when the target had nothing to corrupt, e.g. an empty host
+    tier), so drills can assert the flip actually landed.
+
+    * ``"weights"`` — sign-flip the largest-magnitude element of every
+      floating-point parameter (via ``Tensor.set_value``, so the next
+      compiled step reads the corrupt bytes). One flip per tensor is a
+      worst-case SDC burst: it guarantees the weight fingerprint AND
+      greedy decode both diverge, which is what a deterministic drill
+      needs.
+    * ``"host_entry"`` — flip one payload byte inside an oldest-first
+      resident host-tier entry (after its seal was computed, so the CRC
+      catches it at revive).
+    * ``"kv_page"`` — corrupt pool block ``block`` of layer 0's K plane
+      in place (device-pool flip: invisible to page CRCs by design; the
+      output audit owns this class of flip).
+    """
+    if target == "weights":
+        flips = 0
+        for name, val in sorted(eng.model.state_dict().items()):
+            arr = np.array(np.asarray(
+                val.numpy() if hasattr(val, "numpy") else val))
+            if arr.size == 0 or not np.issubdtype(arr.dtype, np.floating):
+                continue
+            i = int(np.argmax(np.abs(arr)))
+            arr.flat[i] = -arr.flat[i] if arr.flat[i] != 0 else 1.0
+            val.set_value(arr)
+            flips += 1
+        return {"target": "weights", "flips": flips} if flips else None
+    if target == "host_entry":
+        tier = getattr(eng, "kv_tier", None)
+        if tier is None:
+            return None
+        with tier._lock:
+            entries = list(tier._entries.items())
+        for key, entry in entries:  # oldest first
+            # reach the stored bytes directly — going through the
+            # tier's _get would run the very verification this flip
+            # exists to defeat. materialize() is idempotent and caches,
+            # so the mutated dict IS the resident entry (and the flip
+            # lands AFTER the seal was computed).
+            pages = entry if isinstance(entry, dict) else entry.materialize()
+            k = pages.get("k")
+            if k is None or getattr(k, "size", 0) == 0:
+                continue
+            buf = np.asarray(k).view(np.uint8)
+            buf.flat[buf.size // 2] ^= 0x80
+            return {"target": "host_entry", "key": key}
+        return None
+    if target == "kv_page":
+        cache = eng.cache
+        b = int(block)
+        kp = cache.k[0]
+        # -x - 1 differs from x for every int8 code (bitwise NOT) and
+        # every float but -0.5 — a deterministic "flipped" value for
+        # either pool dtype
+        cache.k[0] = kp.at[b].set(-kp[b] - 1)
+        return {"target": "kv_page", "block": b}
+    raise ValueError(f"unknown bit-flip target {target!r} "
+                     "(weights | host_entry | kv_page)")

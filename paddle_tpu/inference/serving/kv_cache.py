@@ -102,6 +102,8 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 from ...utils import fault_injection as _fi
+from . import integrity as _integrity
+from .errors import KVIntegrityError
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "HostKVTier",
            "PageSnapshot", "KV_QMAX",
@@ -557,6 +559,13 @@ class PagedKVCache:
     the fp path's pytrees carry zero extra leaves.
     """
 
+    # ISSUE 20: when armed (``LLMEngine(kv_page_checksums=True)`` sets
+    # it), every :meth:`PageSnapshot.materialize` — the single choke
+    # point behind export_request_pages, host-tier spills and the
+    # prefix-store save pass — seals the payload with per-block CRC32s
+    # (``integrity.seal_pages``); read-back boundaries verify them.
+    page_checksums = False
+
     def __init__(self, config, num_blocks, block_size, dtype=None,
                  allocator=None, kv_dtype=None):
         if dtype is None:
@@ -739,6 +748,10 @@ class PageSnapshot:
         idx = np.asarray(blocks, np.int32)
         self.nblocks = len(blocks)
         self.covered = int(covered)
+        # capture the arming flag NOW: the seal must reflect the policy
+        # at snapshot time, not whenever the transfer thread gets around
+        # to materializing
+        self._seal = bool(cache.page_checksums)
         self._meta = {"covered": int(covered),
                       "block_size": cache.block_size,
                       "kv_dtype": cache.kv_dtype}
@@ -776,6 +789,8 @@ class PageSnapshot:
                 for name, parts in self._parts.items():
                     pages[name] = np.stack(
                         [np.asarray(p) for p in parts])
+                if self._seal:
+                    _integrity.seal_pages(pages)
                 nbytes = sum(a.nbytes for a in pages.values()
                              if isinstance(a, np.ndarray))
                 self._pages = pages
@@ -806,7 +821,10 @@ class _SnapshotView:
     def materialize(self):
         pages = self._snap.materialize()
         i = self._i
-        out = {k: (v[:, i:i + 1] if isinstance(v, np.ndarray) else v)
+        # the CRC sidecar is per-block 1-D: slice it by block index, not
+        # by the [layer, block, ...] payload axes
+        out = {k: (v[i:i + 1] if k == "crc"
+                   else v[:, i:i + 1] if isinstance(v, np.ndarray) else v)
                for k, v in pages.items()}
         out["covered"] = self.covered
         return out
@@ -974,9 +992,25 @@ class HostKVTier:
             else:
                 self._entries.move_to_end(key)
             self._gauge()
-        if isinstance(entry, dict):
-            return entry
-        return entry.materialize()
+        pages = entry if isinstance(entry, dict) else entry.materialize()
+        # ISSUE 20 read-back boundary: a sealed payload (page checksums
+        # armed when it was written, or loaded from the prefix store)
+        # verifies before it can revive. Mismatch degrades EXACTLY like
+        # an LRU drop — the entry is freed and the caller re-prefills;
+        # a corrupt page is never served.
+        try:
+            _integrity.verify_pages(pages, instance=self.instance,
+                                    key=key)
+        except KVIntegrityError as e:
+            warnings.warn(f"HostKVTier dropping corrupt entry: {e}",
+                          RuntimeWarning)
+            with self._lock:
+                stale = self._entries.pop(key, None)
+                if stale is not None:
+                    self._unaccount(key, stale)
+                    self._gauge()
+            return None
+        return pages
 
     def _spill(self, key, blocks, covered, tenant=None):
         """Shared spill path: fire the fault site (failure degrades to

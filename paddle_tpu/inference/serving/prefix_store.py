@@ -62,16 +62,28 @@ _M_STORE_LOADED = _obs_metrics.counter(
     "into the host tier at engine boot / reload_weights")
 _M_STORE_REJECTED = _obs_metrics.counter(
     "serving_prefix_store_rejected_total",
-    "prefix-store files rejected whole (CRC/framing corruption, weight-"
-    "fingerprint mismatch, or pool-geometry mismatch) — the engine "
-    "cold-starts cleanly instead of importing wrong pages")
+    "prefix-store files rejected whole — the engine cold-starts cleanly "
+    "instead of importing wrong pages. Labeled by reason (ISSUE 20): "
+    "'corrupt' (CRC/framing/truncation), 'version', 'fingerprint' "
+    "(different weights), 'geometry' (different pool shape) — a bounded "
+    "set, so operators can tell a corrupt store from a stale one")
+
+# the bounded ``reason`` label set of _M_STORE_REJECTED (and of
+# PrefixStoreMismatch.reason)
+REJECT_REASONS = ("corrupt", "version", "fingerprint", "geometry")
 
 
 class PrefixStoreMismatch(RuntimeError):
     """The store on disk cannot be trusted for THIS engine: corrupt
     framing, a different weight fingerprint, or a different pool
     geometry. The caller degrades to a cold start — never a partial or
-    wrong import."""
+    wrong import. ``reason`` is one of :data:`REJECT_REASONS` (typed,
+    bounded — it labels ``serving_prefix_store_rejected_total``)."""
+
+    def __init__(self, msg, reason="corrupt"):
+        super().__init__(msg)
+        assert reason in REJECT_REASONS, reason
+        self.reason = reason
 
 
 def weights_fingerprint(model):
@@ -158,17 +170,18 @@ def load_prefix_store(path, *, fingerprint, geometry, instance=None):
         if header.get("version") != STORE_VERSION:
             raise PrefixStoreMismatch(
                 f"{path}: store version {header.get('version')!r}, "
-                f"this engine speaks {STORE_VERSION}")
+                f"this engine speaks {STORE_VERSION}", reason="version")
         if header.get("fingerprint") != fingerprint:
             raise PrefixStoreMismatch(
                 f"{path}: weight fingerprint mismatch (store "
                 f"{str(header.get('fingerprint'))[:12]}…, model "
                 f"{fingerprint[:12]}…) — pages from other weights "
-                "would decode garbage")
+                "would decode garbage", reason="fingerprint")
         if header.get("geometry") != geometry:
             raise PrefixStoreMismatch(
                 f"{path}: pool geometry mismatch (store "
-                f"{header.get('geometry')}, engine {geometry})")
+                f"{header.get('geometry')}, engine {geometry})",
+                reason="geometry")
         if header.get("entries") != len(recs) - 1:
             raise PrefixStoreMismatch(
                 f"{path}: header promises {header.get('entries')} "
@@ -183,8 +196,8 @@ def load_prefix_store(path, *, fingerprint, geometry, instance=None):
             except ValueError as e:
                 raise PrefixStoreMismatch(
                     f"{path}: undecodable page payload: {e}") from e
-    except PrefixStoreMismatch:
-        _M_STORE_REJECTED.inc(instance=instance)
+    except PrefixStoreMismatch as e:
+        _M_STORE_REJECTED.inc(instance=instance, reason=e.reason)
         raise
     _M_STORE_LOADED.inc(len(out), instance=instance)
     return out

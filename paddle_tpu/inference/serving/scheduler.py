@@ -350,6 +350,10 @@ class Scheduler:
         # import+adopt before this step's prefill work (drained like
         # pending_cow)
         self.pending_revive: list[tuple] = []
+        # spill revivals that missed: entry LRU-dropped or freed by the
+        # tier's read-back integrity check (ISSUE 20) — each one is a
+        # silent degrade to re-prefill, worth seeing when it spikes
+        self.revive_misses = 0
         # block-table mutation counter: the engine invalidates its cached
         # device table array on change, so steady-state decode does ZERO
         # table H2D (ISSUE 11 satellite)
@@ -383,6 +387,7 @@ class Scheduler:
             "cow_copies": int(_M_COW.value(instance=inst)),
             "quota_throttled": int(_M_THROTTLED.value(instance=inst)),
             "batch_yields": int(_M_BATCH_YIELD.value(instance=inst)),
+            "revive_misses": self.revive_misses,
         }
 
     # -- multi-tenant QoS (ISSUE 17) -------------------------------------
@@ -539,6 +544,11 @@ class Scheduler:
                     req.preloaded = payload
                     req.revived_from_tier = True
                 else:
+                    # LRU-dropped under budget pressure, or freed by the
+                    # tier's read-back CRC verification (ISSUE 20) — both
+                    # degrade identically to plain re-prefill, and the
+                    # miss is counted so an elevated rate is visible
+                    self.revive_misses += 1
                     req.spill_key = None
             # preloaded (disaggregated-handoff) requests charge full
             # blocks and skip prefix matching: their pages arrive by

@@ -105,6 +105,12 @@ Sites wired into the framework:
   heartbeating, so the group's next collective stalls EVERY member. No
   process exits — only the hang watchdog (any member's stale
   ``hb.<replica>.<rank>``) can detect it and fell the group.
+- ``serve.bit_flip`` — replica worker loop (boolean site, ISSUE 20):
+  injects SILENT data corruption (``integrity.flip_bit``) into a KV
+  pool page, a host-tier entry, or a weight buffer
+  (``CHAOS_SERVE_BIT_FLIP_TARGET`` picks which). Nothing crashes and
+  nothing raises — only the integrity sentinel (page CRCs, the sampled
+  output audit, the weight re-audit) can catch it.
 
 Arming a site is scoped and seeded::
 
@@ -137,7 +143,7 @@ SITES = ("ckpt.shard_write", "io.save", "train.grad_nan", "fs.rename",
          "serve.kv_transfer_corrupt", "serve.kv_spill",
          "serve.store_write", "serve.tenant_flood",
          "serve.scale_down_kill", "serve.group_member_crash",
-         "serve.group_member_hang")
+         "serve.group_member_hang", "serve.bit_flip")
 
 
 class InjectedFault(OSError):

@@ -37,6 +37,14 @@ ISSUE 11 adds three more seeded A/Bs over the same harness:
            bit-exact greedy asserted, accept ratio reported from
            ``LLMEngine.metrics()``
 
+ISSUE 20 adds the integrity-sentinel overhead A/B:
+
+  --workload audit           ONE warmed subprocess fleet, the same burst
+           with ``Router(audit_fraction=0.1)`` off vs on — audit
+           replays are batch-tier background work on a different
+           replica, so latency-tier TTFT p99 must stay within ~1.1x
+           and outputs bit-exact vs the in-process greedy reference
+
 ISSUE 18 adds the device-resident decode A/B:
 
   --workload decode_sync      decode-bound mix through three arms over
@@ -1565,12 +1573,121 @@ def run_qos_ab(tiny=True, seed=0):
     )
 
 
+def run_audit_ab(tiny=True, seed=0, fleet=3, fraction=0.1):
+    """Sampled-output-audit overhead A/B (ISSUE 20): the SAME seeded
+    Poisson burst through ONE warmed subprocess fleet, first with
+    ``audit_fraction=0.0`` and then with ``audit_fraction=fraction`` —
+    audit replays are strictly batch-tier background work on a
+    different replica, so the latency-tier TTFT p99 must stay within
+    ~1.1x of the audit-off arm, and both arms' outputs must match the
+    in-process engine greedy reference bit-exactly (auditing reads
+    streams, it never changes them)."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              save_llama_artifact)
+    from paddle_tpu.inference.serving.fleet import Router
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, stream_kwargs, engine_kwargs = fleet_sizing(tiny)
+    paddle.seed(seed)
+    np.random.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    stream = request_stream(cfg, seed=seed, **stream_kwargs)
+    warm = request_stream(cfg, seed=seed + 1, **stream_kwargs)
+
+    tmp = tempfile.mkdtemp(prefix="bench_audit.")
+    fl = None
+    try:
+        artifact = os.path.join(tmp, "model")
+        save_llama_artifact(model, artifact)
+        eng = LLMEngine(model, ingest_async=False, **engine_kwargs)
+        try:
+            rids = [eng.add_request(
+                r.prompt, SamplingParams(max_new_tokens=r.max_new))
+                for r in stream]
+            for _ in eng.stream():
+                pass
+            refs = [eng.output_tokens(r) for r in rids]
+        finally:
+            eng.close()
+
+        fl = Router(artifact=artifact, n_replicas=fleet,
+                    engine_kwargs=engine_kwargs, max_queue=1_000_000)
+        wgids = [fl.submit(r.prompt, max_new=r.max_new) for r in warm]
+        fl.join(timeout=600)
+        for g in wgids:
+            fl.release(g)
+        fl.reset_replica_metrics()
+
+        def arm(f):
+            # one fleet, both arms: the delta is the auditing, not
+            # process boot or compile variance
+            fl.audit_fraction = f
+            audits_before = fl.metrics()["audits_run"]
+            gids = []
+            i = 0
+            t0 = time.perf_counter()
+            while i < len(stream) or fl.pending():
+                now = time.perf_counter() - t0
+                while i < len(stream) and stream[i].arrival <= now:
+                    gids.append(fl.submit(stream[i].prompt,
+                                          max_new=stream[i].max_new))
+                    i += 1
+                if not fl.step():
+                    if fl.pending():
+                        time.sleep(0.001)
+                    elif i < len(stream):
+                        time.sleep(max(0.0, stream[i].arrival - now))
+            fl.join(timeout=600)
+            wall = time.perf_counter() - t0
+            outs = [fl.result(g) for g in gids]
+            # audits self-release on completion, so the surviving
+            # requests (and their TTFTs) are exactly the client burst
+            ttfts = fl.ttft_seconds()
+            m = fl.metrics()
+            for g in gids:
+                fl.release(g)
+            return dict(outputs=outs, wall_s=round(wall, 4),
+                        ttft=_latency_stats(ttfts),
+                        audits_run=m["audits_run"] - audits_before,
+                        audit_mismatches=m["audit_mismatches"],
+                        replicas_quarantined=m["replicas_quarantined"])
+
+        off = arm(0.0)
+        on = arm(float(fraction))
+    finally:
+        if fl is not None:
+            fl.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    bit_exact = (_bit_exact(refs, off["outputs"])
+                 and _bit_exact(refs, on["outputs"]))
+    p_off = off["ttft"]["p99_ms"]
+    p_on = on["ttft"]["p99_ms"]
+    return dict(
+        audit_off={k: v for k, v in off.items() if k != "outputs"},
+        audit_on={k: v for k, v in on.items() if k != "outputs"},
+        audit_fraction=float(fraction),
+        ttft_p99_ratio=(round(p_on / p_off, 3) if p_off else None),
+        # CI boxes are noisy at millisecond TTFTs: the gate is the
+        # 1.1x ratio with a small absolute epsilon, like the qos bound
+        ttft_p99_within_bound=bool(p_on <= p_off * 1.1 + 20.0),
+        audits_ran=on["audits_run"] > 0 and off["audits_run"] == 0,
+        bit_exact=bool(bit_exact),
+        num_requests=len(stream),
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="poisson",
                     choices=["poisson", "shared-prefix", "chunked", "spec",
                              "fleet", "quantized", "disagg", "tiering",
-                             "qos", "decode_sync", "tpfleet"])
+                             "qos", "decode_sync", "tpfleet", "audit"])
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
@@ -1670,6 +1787,21 @@ def main():
             sys.exit("FAIL: contended interactive outputs diverge from "
                      "the uncontended run — QoS must only change WHEN "
                      "work runs, never WHICH tokens")
+        return
+    if args.workload == "audit":
+        res = run_audit_ab(tiny=tiny, seed=args.seed, fleet=args.fleet)
+        print(json.dumps(res, indent=2))
+        if not res["bit_exact"]:
+            sys.exit("FAIL: audited fleet outputs diverge from the "
+                     "in-process engine greedy reference — auditing "
+                     "must never change a served token")
+        if not res["audits_ran"]:
+            sys.exit("FAIL: the audit-on arm ran no audits (or the "
+                     "audit-off arm ran some)")
+        if not res["ttft_p99_within_bound"]:
+            sys.exit("FAIL: audit_fraction=%s pushed latency-tier TTFT "
+                     "p99 past 1.1x the audit-off arm (%s)"
+                     % (res["audit_fraction"], res["ttft_p99_ratio"]))
         return
 
     cfg, stream_kwargs, engine_kwargs = default_sizing(tiny)

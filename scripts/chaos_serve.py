@@ -96,8 +96,20 @@ processes over one shared model artifact + checkpoint root):
   router replays everything bit-exact; allocators proven clean over the
   rank-0 stats RPC.
 
+- ``sdc``: the ISSUE-20 silent-data-corruption drill (fault site
+  ``serve.bit_flip``). Three arms: a host-tier spill entry gets one
+  payload byte flipped after its CRC seal — the read-back verification
+  at revive must reject it, degrade to re-prefill, and deliver
+  bit-exact output anyway; a weight flip on an idle fleet replica is
+  caught by the sampled output audit (``audit_fraction=1.0``) — the
+  corrupt replay mismatches, a third-replica referee votes the auditor
+  corrupt, and it is QUARANTINED through one restart-budget slot
+  (liveness dip + recover, in-flight redispatch, both waves bit-exact);
+  a single-engine weight flip is caught by the periodic fingerprint
+  re-audit and healed by ``reload_weights``.
+
 ``--drill all`` (the default) runs kill, hang, drain, shed, quant,
-disagg, warmstore, qos, tpgroup in order.
+disagg, warmstore, qos, tpgroup, sdc in order.
 Wired into the slow tier of tests/test_serving.py, the chaos_train.py
 discipline applied to serving. Everything runs on CPU
 (JAX_PLATFORMS=cpu is forced for the replicas by the supervisor).
@@ -1097,6 +1109,186 @@ def drill_tpgroup(out, model, n):
         fleet.close()
 
 
+def drill_sdc(out, model, n):
+    """ISSUE 20 acceptance: silent-data-corruption defense, end to end.
+    Three arms, each a different ``serve.bit_flip`` target:
+
+    A. host-tier flip: a spilled request's resident host entry gets one
+       payload byte flipped AFTER its CRC seal was computed — the
+       read-back verification at revive must reject the entry
+       (``serving_kv_pages_rejected_total``), degrade to re-prefill
+       (scheduler ``revive_misses``), and the output must still be
+       bit-identical to an undisturbed reference.
+    B. weight flip on an idle fleet replica: wave-1 traffic is
+       session-pinned to replicas 0/1, so replica 2's FIRST busy tick —
+       the first sampled output audit placed on it — fires the armed
+       flip. The corrupt audit stream mismatches the served one, the
+       third-replica referee votes the auditor corrupt, and replica 2
+       is QUARANTINED: one restart-budget slot, liveness dips and
+       recovers, its in-flight audits redispatch, and every DELIVERED
+       output (both waves) matches the single-engine baseline.
+    C. single-engine weight re-audit: a weight flip is detected by
+       ``audit_weights()`` (fingerprint drift,
+       ``serving_weight_audit_failures_total``), ``reload_weights``
+       from the artifact re-anchors the reference, and serving is
+       bit-exact again."""
+    import json as _json
+
+    from paddle_tpu.inference.serving import (LLMEngine, SamplingParams,
+                                              load_llama_artifact)
+    from paddle_tpu.inference.serving import integrity
+    from paddle_tpu.utils import fault_injection as fi
+
+    cfg = _cfg(model)
+    artifact = os.path.join(out, "model")
+
+    # ---- arm A: host-tier entry flip, caught at revive by CRC --------
+    rng = np.random.RandomState(20)
+    prompts = [rng.randint(0, cfg.vocab_size, 8).astype(np.int32)
+               for _ in range(3)]
+    with LLMEngine(model, num_blocks=64, block_size=8, max_batch_size=3,
+                   ingest_async=False) as ref_eng:
+        refs = ref_eng.generate(prompts,
+                                SamplingParams(max_new_tokens=20))
+    # tiny pool forces decode-pressure eviction -> spill to host tier
+    eng = LLMEngine(model, num_blocks=5, block_size=8, max_batch_size=2,
+                    kv_host_blocks=32, kv_page_checksums=True,
+                    ingest_async=False)
+    try:
+        rids = [eng.add_request(p, SamplingParams(max_new_tokens=20))
+                for p in prompts]
+        flipped = None
+        with fi.inject("serve.bit_flip", max_fires=1):
+            while eng.has_work():
+                eng.step()
+                if (flipped is None and eng.kv_tier is not None
+                        and eng.kv_tier._entries
+                        and fi.should_fire("serve.bit_flip")):
+                    # flip one byte of the resident spill AFTER its
+                    # seal — exactly what a bad DIMM would do
+                    flipped = integrity.flip_bit(eng, "host_entry")
+        outs = [eng.output_tokens(r) for r in rids]
+        em, st = eng.metrics(), eng.stats()
+    finally:
+        eng.close()
+    check(flipped is not None,
+          f"the bit flip landed on a resident host-tier entry "
+          f"({flipped})")
+    check(em["kv_pages_rejected"] >= 1,
+          f"read-back CRC caught the flipped entry "
+          f"({int(em['kv_pages_rejected'])} rejections) — the corrupt "
+          "page was never served")
+    check(st["revive_misses"] >= 1,
+          f"the rejected revive degraded to re-prefill "
+          f"({st['revive_misses']} revive misses)")
+    for got, ref in zip(outs, refs):
+        if not np.array_equal(got, ref):
+            raise AssertionError(
+                f"corrupted-then-reprefilled output diverged: "
+                f"{got.tolist()} vs {ref.tolist()}")
+    print("  ok: outputs bit-identical to the undisturbed reference "
+          "despite the flipped spill")
+
+    # ---- arm B: weight flip on a fleet replica, caught by the audit --
+    stream = request_stream(cfg, n=10)
+    baseline = baseline_outputs(model, stream)
+    stream2 = request_stream(cfg, seed=1, n=6)
+    baseline2 = baseline_outputs(model, stream2)
+    env = {"CHAOS_SERVE_SITES": _json.dumps([
+               {"site": "serve.bit_flip", "replica": 2, "after": 1,
+                "max_fires": 1}]),
+           "CHAOS_SERVE_BIT_FLIP_TARGET": "weights"}
+    fleet = _fleet(out, 3, env_extra=env, audit_fraction=1.0,
+                   max_inflight_per_replica=64)
+    try:
+        # session-pin wave 1 to replicas 0/1: replica 2 stays idle, so
+        # its first busy tick — the first AUDIT placed there — fires
+        # the flip. No corrupt token is ever DELIVERED: the flip can
+        # only touch background audit replays.
+        gids = {}
+        for i, r in enumerate(stream):
+            gids[i] = fleet.submit(r.prompt, max_new=r.max_new,
+                                   session=f"s{i % 2}")
+        fleet.join(timeout=300)
+        wait_all_ready(fleet)
+        m = fleet.metrics()
+        check(m["audits_run"] >= 1,
+              f"sampled output audits ran ({m['audits_run']})")
+        check(m["audit_mismatches"] >= 1,
+              f"the corrupt replica's replay mismatched the served "
+              f"stream ({m['audit_mismatches']} mismatches)")
+        check(m["replicas_quarantined"] == 1,
+              f"referee vote quarantined exactly the corrupt replica "
+              f"({m['replicas_quarantined']} quarantines)")
+        check(m["replica_restarts"] == 1,
+              f"quarantine charged exactly ONE restart-budget slot "
+              f"({m['replica_restarts']} restarts)")
+        check(any(e.get("stage") == "quarantine" and e.get("replica") == 2
+                  for e in fleet.audit_log),
+              "the quarantined replica is the one the flip was armed on")
+        # whether the auditor still holds in-flight audits when the
+        # referee verdict lands is timing-dependent (the verdict races
+        # the auditor draining its queue); the deterministic
+        # requeue + bit-exact-replay property is pinned by
+        # tests/test_integrity.py. When the race does leave work in
+        # flight, the bit-exact checks below cover the redispatches.
+        print(f"  note: {int(m['redispatches'])} in-flight request(s) "
+              f"redispatched at the quarantine")
+        vals = read_liveness(out)
+        check(any(v < 3 for v in vals),
+              f"fleet liveness dipped at the quarantine (transitions: "
+              f"{vals})")
+        first_dip = next(i for i, v in enumerate(vals) if v < 3)
+        check(any(v == 3 for v in vals[first_dip:]),
+              f"fleet liveness recovered after the respawn "
+              f"(transitions: {vals})")
+        assert_complete_bitexact(fleet, gids, baseline)
+        print("  ok: the flip never reached a client — every DELIVERED "
+              "wave-1 output matched the baseline")
+        # wave 2 over the healed fleet (respawned replica serves again)
+        gids2 = {i: fleet.submit(r.prompt, max_new=r.max_new)
+                 for i, r in enumerate(stream2)}
+        fleet.join(timeout=300)
+        assert_complete_bitexact(fleet, gids2, baseline2)
+        print("  ok: wave 2 bit-exact after the heal")
+        assert_replicas_clean(fleet)
+        st = fleet.stats()
+        check(st["fleet"]["audits_run"] >= m["audits_run"]
+              and st["fleet"]["replicas_quarantined"] == 1,
+              "Router.stats() carries the fleet integrity counters")
+        for rid, s in sorted(st["replicas"].items()):
+            check(s is not None and "kv_pages_verified" in s
+                  and "kv_pages_rejected" in s and "weight_audits" in s
+                  and "weight_audit_failures" in s,
+                  f"replica {rid} stats RPC exposes its integrity "
+                  "counters")
+    finally:
+        fleet.close()
+
+    # ---- arm C: weight flip caught by the periodic re-audit ----------
+    m2 = load_llama_artifact(artifact)
+    with LLMEngine(m2, num_blocks=32, block_size=8, max_batch_size=2,
+                   ingest_async=False, weight_audit=True) as eng:
+        p = prompts[0]
+        before = eng.generate([p], SamplingParams(max_new_tokens=8))[0]
+        check(eng.audit_weights(), "clean weights pass the re-audit")
+        flip = integrity.flip_bit(eng, "weights")
+        check(flip is not None and flip["flips"] >= 1,
+              f"weight flip landed ({flip})")
+        check(not eng.audit_weights(),
+              "fingerprint drift detected by the re-audit")
+        em = eng.metrics()
+        check(em["weight_audit_failures"] >= 1,
+              f"serving_weight_audit_failures_total counted it "
+              f"({int(em['weight_audit_failures'])})")
+        eng.reload_weights(artifact)
+        check(eng.audit_weights(),
+              "reload_weights re-anchored the audit reference")
+        after = eng.generate([p], SamplingParams(max_new_tokens=8))[0]
+        check(np.array_equal(before, after),
+              "serving bit-exact again after the reload")
+
+
 def _cfg(model):
     return model.config
 
@@ -1104,7 +1296,7 @@ def _cfg(model):
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "drain": drill_drain,
           "shed": drill_shed, "quant": drill_quant,
           "disagg": drill_disagg, "warmstore": drill_warmstore,
-          "qos": drill_qos, "tpgroup": drill_tpgroup}
+          "qos": drill_qos, "tpgroup": drill_tpgroup, "sdc": drill_sdc}
 
 
 def main(argv=None):
@@ -1112,7 +1304,7 @@ def main(argv=None):
     ap.add_argument("--drill", default="all",
                     choices=["kill", "hang", "drain", "shed", "quant",
                              "disagg", "warmstore", "qos", "tpgroup",
-                             "all"])
+                             "sdc", "all"])
     ap.add_argument("--fleet", type=int, default=3)
     ap.add_argument("--decode-window", type=int, default=1,
                     help="decode_steps_per_sync for every engine (baseline "
@@ -1130,7 +1322,7 @@ def main(argv=None):
     print(f"[chaos] serving fleet drill, scratch: {out_root}, "
           f"fleet={args.fleet}")
     drills = (["kill", "hang", "drain", "shed", "quant", "disagg",
-               "warmstore", "qos", "tpgroup"]
+               "warmstore", "qos", "tpgroup", "sdc"]
               if args.drill == "all" else [args.drill])
     model = None
     for name in drills:

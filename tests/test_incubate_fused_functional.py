@@ -316,6 +316,9 @@ class TestVarlenAndMaskedAttention:
 
         ref = ("/root/reference/python/paddle/incubate/nn/functional/"
                "__init__.py")
+        import os
+        if not os.path.exists(ref):
+            pytest.skip("reference Paddle checkout not present")
         for node in ast.walk(ast.parse(open(ref).read())):
             if isinstance(node, ast.Assign) and any(
                     getattr(t, "id", None) == "__all__"

@@ -87,8 +87,12 @@ class TestFusedLayers:
         assert x.grad is not None and moe.bmm_weight0.grad is not None
 
     def test_namespace_audit(self):
-        src = open("/root/reference/python/paddle/incubate/nn/"
-                   "__init__.py").read()
+        import os
+        ref = ("/root/reference/python/paddle/incubate/nn/"
+               "__init__.py")
+        if not os.path.exists(ref):
+            pytest.skip("reference Paddle checkout not present")
+        src = open(ref).read()
         for node in ast.walk(ast.parse(src)):
             if isinstance(node, ast.Assign):
                 for t in node.targets:

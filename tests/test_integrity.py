@@ -1,0 +1,618 @@
+"""Serving integrity sentinel tests (ISSUE 20): per-block page CRC
+seal/verify (fp32 + int8, scale sidecars chained into the CRC),
+host-tier read-back rejection degrading to re-prefill, typed rejection
+of corrupt imported pages, deterministic audit sampling, the
+SuspicionScore leaky bucket, weight fingerprint re-audits, and the
+router's sampled-output-audit → referee → quarantine pipeline (via the
+test_qos fake-supervisor harness), including hot-swap/drain interplay
+with a quarantined replica."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (
+    HostKVTier, LLMEngine, PagedKVCache, PrefixStoreMismatch,
+    SamplingParams,
+)
+from paddle_tpu.inference.serving import integrity
+from paddle_tpu.inference.serving.errors import KVIntegrityError
+from paddle_tpu.inference.serving.prefix_store import REJECT_REASONS
+from paddle_tpu.observability import metrics as obs_metrics
+
+from test_qos import FakeHandle, FakeSupervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROMPT = np.arange(1, 7, dtype=np.int32)
+
+
+def tiny_cfg():
+    from paddle_tpu.models import llama_tiny
+
+    return llama_tiny()
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(7)
+    m = LlamaForCausalLM(tiny_cfg())
+    m.eval()
+    return m
+
+
+def unique_prompts(cfg, lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, n).astype(np.int32)
+            for n in lengths]
+
+
+def _filled_pool(num_blocks=8, block_size=4, kv_dtype=None, seed=3):
+    import jax.numpy as jnp
+
+    cache = PagedKVCache(tiny_cfg(), num_blocks, block_size,
+                         kv_dtype=kv_dtype)
+    rng = np.random.RandomState(seed)
+
+    def fill(pools, scale=1.0):
+        return [jnp.asarray(
+            (rng.standard_normal(np.shape(p)) * scale).astype(
+                np.asarray(p).dtype)) for p in pools]
+
+    cache.k = fill(cache.k, 20.0 if kv_dtype == "int8" else 1.0)
+    cache.v = fill(cache.v, 20.0 if kv_dtype == "int8" else 1.0)
+    if cache.quantized:
+        cache.k_scale = fill(cache.k_scale)
+        cache.v_scale = fill(cache.v_scale)
+    return cache
+
+
+# ---------------------------------------------------------------------------
+# CRC seal / verify unit behavior
+# ---------------------------------------------------------------------------
+
+class TestPageCRC:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_seal_verify_round_trip(self, kv_dtype):
+        cache = _filled_pool(kv_dtype=kv_dtype)
+        pages = integrity.seal_pages(
+            cache.export_request_pages([2, 5], 2 * cache.block_size))
+        assert pages["crc"].shape == (2,)
+        before = integrity._M_PAGES_VERIFIED.value(instance=None)
+        assert integrity.verify_pages(pages) == 2
+        assert integrity._M_PAGES_VERIFIED.value(
+            instance=None) == before + 2
+
+    @pytest.mark.parametrize("plane", ["k", "v"])
+    def test_flipped_code_plane_rejected(self, plane):
+        cache = _filled_pool()
+        pages = integrity.seal_pages(
+            cache.export_request_pages([1, 3], 2 * cache.block_size))
+        buf = np.asarray(pages[plane]).view(np.uint8)
+        buf.flat[buf.size // 3] ^= 0x01  # a single flipped bit
+        before = integrity._M_PAGES_REJECTED.value(instance=None)
+        with pytest.raises(KVIntegrityError) as ei:
+            integrity.verify_pages(pages)
+        assert ei.value.block in (0, 1)
+        assert integrity._M_PAGES_REJECTED.value(
+            instance=None) == before + 1
+
+    @pytest.mark.parametrize("plane", ["k_scale", "v_scale"])
+    def test_scale_sidecar_in_crc(self, plane):
+        # the satellite's explicit requirement: int8 codes with a
+        # corrupted SCALE row are exactly as wrong as corrupted codes —
+        # the CRC must chain the sidecar
+        cache = _filled_pool(kv_dtype="int8")
+        pages = integrity.seal_pages(
+            cache.export_request_pages([2, 4], 2 * cache.block_size))
+        buf = np.asarray(pages[plane]).view(np.uint8)
+        buf.flat[0] ^= 0x80
+        with pytest.raises(KVIntegrityError):
+            integrity.verify_pages(pages)
+
+    def test_unsealed_payload_passes_through(self):
+        # checksums off when the page was written -> no seal -> never
+        # rejected (arming mid-flight must not drop clean entries)
+        cache = _filled_pool()
+        pages = cache.export_request_pages([0], cache.block_size)
+        assert "crc" not in pages
+        assert integrity.verify_pages(pages) == 0
+
+    def test_malformed_seal_rejected(self):
+        cache = _filled_pool()
+        pages = integrity.seal_pages(
+            cache.export_request_pages([1, 2], 2 * cache.block_size))
+        pages["crc"] = pages["crc"][:1]  # truncated sidecar
+        with pytest.raises(KVIntegrityError, match="malformed"):
+            integrity.verify_pages(pages)
+
+
+class TestAuditSampling:
+    def test_deterministic_and_bounded(self):
+        assert not any(integrity.audit_sampled(g, 0.0) for g in range(50))
+        assert all(integrity.audit_sampled(g, 1.0) for g in range(50))
+        picks = [integrity.audit_sampled(g, 0.3) for g in range(4000)]
+        assert picks == [integrity.audit_sampled(g, 0.3)
+                         for g in range(4000)]
+        frac = sum(picks) / len(picks)
+        assert 0.25 < frac < 0.35, frac
+
+
+class TestSuspicionScore:
+    def test_threshold_crossing_fires_once_and_resets(self):
+        t = [0.0]
+        s = integrity.SuspicionScore(threshold=2, window_s=10.0,
+                                     clock=lambda: t[0])
+        assert not s.charge()
+        assert s.charge()        # crossed -> True exactly once
+        assert s.score() == 0    # bucket drained by the quarantine
+        assert not s.charge()    # fresh evidence starts over
+
+    def test_window_leak(self):
+        t = [0.0]
+        s = integrity.SuspicionScore(threshold=2, window_s=5.0,
+                                     clock=lambda: t[0])
+        assert not s.charge()
+        t[0] = 6.0               # first charge leaked out
+        assert not s.charge()
+        assert s.score() == 1
+
+    def test_bulk_charge_and_validation(self):
+        s = integrity.SuspicionScore(threshold=3)
+        assert s.charge(3)       # a referee verdict charges threshold
+        with pytest.raises(ValueError):
+            integrity.SuspicionScore(threshold=0)
+
+
+# ---------------------------------------------------------------------------
+# host-tier + engine read-back boundaries
+# ---------------------------------------------------------------------------
+
+class TestHostTierChecksums:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_sealed_spill_pop_round_trip(self, kv_dtype):
+        cache = _filled_pool(kv_dtype=kv_dtype, seed=11)
+        cache.page_checksums = True
+        want = cache.export_request_pages([2, 5], 2 * cache.block_size)
+        tier = HostKVTier(cache, 16, async_transfer=False)
+        try:
+            tier.spill_blocks([(2, b"h" * 20), (5, b"g" * 20)])
+            got = tier.pop_prefix(b"h" * 20)
+            assert got is not None
+            for key in ("k", "v") + (("k_scale", "v_scale")
+                                     if kv_dtype == "int8" else ()):
+                np.testing.assert_array_equal(got[key], want[key][:, :1])
+        finally:
+            tier.close()
+
+    @pytest.mark.parametrize("kv_dtype,plane", [
+        (None, "k"), ("int8", "v"), ("int8", "k_scale")])
+    def test_corrupt_resident_entry_dropped_not_served(self, kv_dtype,
+                                                       plane):
+        # flip a byte of the RESIDENT entry after its seal: read-back
+        # must reject, free the entry, and return None (degrade to
+        # re-prefill) — never the corrupt payload. int8 scale-plane
+        # corruption is caught identically to code corruption.
+        cache = _filled_pool(kv_dtype=kv_dtype, seed=5)
+        cache.page_checksums = True
+        tier = HostKVTier(cache, 16, async_transfer=False)
+        try:
+            tier.spill_blocks([(1, b"p" * 20)])
+            with tier._lock:
+                (key, entry), = tier._entries.items()
+            pages = (entry if isinstance(entry, dict)
+                     else entry.materialize())
+            np.asarray(pages[plane]).view(np.uint8).flat[0] ^= 0x40
+            before = integrity._M_PAGES_REJECTED.value(instance=None)
+            with pytest.warns(RuntimeWarning, match="corrupt"):
+                assert tier.pop_prefix(b"p" * 20) is None
+            assert integrity._M_PAGES_REJECTED.value(
+                instance=None) == before + 1
+            with tier._lock:          # entry freed, not quarantined
+                assert key not in tier._entries
+        finally:
+            tier.close()
+
+
+class TestEngineChecksums:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_spill_revive_round_trip_bit_exact(self, model, kv_dtype):
+        # the satellite's checksum round-trip across spill -> revive:
+        # decode pressure on a tiny pool forces eviction to the host
+        # tier; with checksums armed every revived page is verified and
+        # the outputs stay bit-identical to an ample-pool reference
+        cfg = tiny_cfg()
+        prompts = unique_prompts(cfg, [8, 8, 8], seed=2)
+        kw = dict(block_size=8, kv_dtype=kv_dtype, ingest_async=False)
+        with LLMEngine(model, num_blocks=64, max_batch_size=3,
+                       **kw) as ref:
+            want = ref.generate(prompts, SamplingParams(max_new_tokens=20))
+        with LLMEngine(model, num_blocks=5, max_batch_size=2,
+                       kv_host_blocks=32, kv_page_checksums=True,
+                       **kw) as eng:
+            got = eng.generate(prompts, SamplingParams(max_new_tokens=20))
+            m = eng.metrics()
+        assert m["kv_pages_verified"] >= 1, m   # revives actually verified
+        assert m["kv_pages_rejected"] == 0, m
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_corrupt_spill_degrades_to_reprefill(self, model):
+        cfg = tiny_cfg()
+        prompts = unique_prompts(cfg, [8, 8, 8], seed=4)
+        with LLMEngine(model, num_blocks=64, block_size=8,
+                       max_batch_size=3, ingest_async=False) as ref:
+            want = ref.generate(prompts, SamplingParams(max_new_tokens=20))
+        eng = LLMEngine(model, num_blocks=5, block_size=8,
+                        max_batch_size=2, kv_host_blocks=32,
+                        kv_page_checksums=True, ingest_async=False)
+        try:
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=20))
+                    for p in prompts]
+            flipped = None
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                while eng.has_work():
+                    eng.step()
+                    if flipped is None and eng.kv_tier._entries:
+                        flipped = integrity.flip_bit(eng, "host_entry")
+            assert flipped is not None
+            got = [eng.output_tokens(r) for r in rids]
+            m, st = eng.metrics(), eng.stats()
+        finally:
+            eng.close()
+        assert m["kv_pages_rejected"] >= 1, m
+        assert st["revive_misses"] >= 1, st
+        for g, w in zip(got, want):   # re-prefill recovered bit-exact
+            np.testing.assert_array_equal(g, w)
+
+    def test_corrupt_imported_pages_rejected_typed(self, model):
+        # the disaggregated import boundary: a sealed payload whose
+        # bytes changed in transit raises KVIntegrityError BEFORE any
+        # request or allocator state moves
+        kw = dict(num_blocks=16, block_size=4, max_batch_size=2,
+                  ingest_async=False)
+        with LLMEngine(model, prefill_only=True, **kw) as pre, \
+                LLMEngine(model, **kw) as dec:
+            prompt = unique_prompts(tiny_cfg(), [9], seed=6)[0]
+            rid = pre.add_request(
+                prompt, SamplingParams(max_new_tokens=4))
+            first = None
+            while first is None:
+                for out in pre.step():
+                    first = out
+            pages = integrity.seal_pages(pre.export_kv_pages(rid))
+            pre.cancel(rid, reason="handoff")
+            pre.release(rid)
+            prompt2 = np.concatenate(
+                [prompt, np.array([first.token], np.int32)])
+            np.asarray(pages["k"]).view(np.uint8).flat[7] ^= 0x20
+            free_before = dec.cache.allocator.num_free
+            with pytest.raises(KVIntegrityError):
+                dec.add_request_with_pages(
+                    prompt2, pages, SamplingParams(max_new_tokens=3))
+            assert dec.cache.allocator.num_free == free_before
+
+
+class TestWeightAudit:
+    def test_flip_detected_and_restore_reanchors(self):
+        from paddle_tpu.models import LlamaForCausalLM
+
+        # fresh model: flip_bit mutates parameters in place
+        paddle.seed(11)
+        m = LlamaForCausalLM(tiny_cfg())
+        m.eval()
+        saved = {k: np.array(np.asarray(v.numpy()))
+                 for k, v in m.state_dict().items()}
+        with LLMEngine(m, num_blocks=8, block_size=4, max_batch_size=2,
+                       ingest_async=False, weight_audit=True) as eng:
+            assert eng.audit_weights()          # clean weights pass
+            flip = integrity.flip_bit(eng, "weights")
+            assert flip and flip["flips"] >= 1
+            assert not eng.audit_weights()      # fingerprint drifted
+            m0 = eng.metrics()
+            assert m0["weight_audit_failures"] >= 1, m0
+            assert m0["weight_audits"] >= 2, m0
+            for k, v in m.state_dict().items():  # the "reload"
+                v.set_value(saved[k])
+            assert eng.audit_weights()          # back to the reference
+
+    def test_unarmed_engine_anchors_lazily(self, model):
+        with LLMEngine(model, num_blocks=8, block_size=4,
+                       max_batch_size=2, ingest_async=False) as eng:
+            assert eng.audit_weights()   # first call captures the ref
+            assert eng.audit_weights()
+
+
+class TestMetricsRegistered:
+    def test_new_integrity_metrics_registered(self):
+        import paddle_tpu.inference.serving.fleet.router  # noqa: F401
+
+        for name in ("serving_kv_pages_verified_total",
+                     "serving_kv_pages_rejected_total",
+                     "serving_weight_audit_failures_total",
+                     "fleet_audits_total",
+                     "fleet_audit_mismatches_total",
+                     "fleet_replicas_quarantined_total"):
+            assert obs_metrics.REGISTRY.get(name) is not None, name
+
+
+class TestPrefixStoreReasons:
+    def test_typed_reasons(self):
+        e = PrefixStoreMismatch("boom")
+        assert e.reason == "corrupt"
+        e = PrefixStoreMismatch("boom", reason="fingerprint")
+        assert e.reason == "fingerprint"
+        assert set(REJECT_REASONS) == {
+            "corrupt", "version", "fingerprint", "geometry"}
+        with pytest.raises(AssertionError):
+            PrefixStoreMismatch("boom", reason="gremlins")
+
+
+# ---------------------------------------------------------------------------
+# router: sampled output audit -> referee -> quarantine
+# ---------------------------------------------------------------------------
+
+class QSupervisor(FakeSupervisor):
+    """FakeSupervisor + the real supervisor's quarantine contract:
+    guard on retired/pending-respawn, return the death record, leave
+    the slot pending until respawn() (auto_respawn collapses the two
+    for tests that don't care about the window)."""
+
+    def __init__(self, n, auto_respawn=True):
+        super().__init__(n)
+        self.quarantines = []
+        self.auto_respawn = auto_respawn
+        self._pending_respawn = {}
+
+    def quarantine(self, i, now=None):
+        h = self.handles[i]
+        if h.retired or i in self._pending_respawn:
+            return None
+        self.quarantines.append(i)
+        h.alive = False
+        leftovers = list(h.inbox)
+        h.inbox = []
+        self._pending_respawn[i] = 0.0
+        if self.auto_respawn:
+            self.respawn(i)
+        return {"replica": i, "reason": "quarantine", "rc": -9,
+                "rank": None, "events": leftovers}
+
+    def respawn(self, i):
+        old = self.handles[i]
+        self.handles[i] = FakeHandle(i, incarnation=old.incarnation + 1)
+        self._pending_respawn.pop(i, None)
+
+
+def make_fleet(n=3, sup=None, **kw):
+    from paddle_tpu.inference.serving.fleet.router import Router
+
+    sup = sup or QSupervisor(n)
+    kw.setdefault("engine_kwargs", {"max_batch_size": 4})
+    return Router(supervisor=sup, **kw), sup
+
+
+def _serve(fleet, sup, toks=(7, 8, 9), **submit_kw):
+    """Submit + place + finish one request; returns (req, server_id)."""
+    gid = fleet.submit(PROMPT, max_new=len(toks), **submit_kw)
+    fleet.step()
+    req = fleet.request(gid)
+    assert req.replica is not None
+    sup.feed(req.replica, {"e": "tok", "gid": gid, "gen": req.generation,
+                           "toks": list(toks), "fin": True,
+                           "reason": "length"})
+    server = req.replica
+    fleet.step()
+    assert req.state == "done"
+    return req, server
+
+
+def _pending_audit(fleet):
+    return next(r for r in fleet._reqs.values() if r.audit is not None)
+
+
+def _finish_audit(fleet, sup, audit, toks):
+    sup.feed(audit.replica, {"e": "tok", "gid": audit.gid,
+                             "gen": audit.generation, "toks": list(toks),
+                             "fin": True, "reason": "length"})
+    fleet.step()
+
+
+class TestRouterAudit:
+    def test_clean_audit_on_different_replica(self):
+        fleet, sup = make_fleet(audit_fraction=1.0)
+        try:
+            req, server = _serve(fleet, sup)
+            fleet.step()                      # place the audit replay
+            audit = _pending_audit(fleet)
+            assert audit.replica != server    # a DIFFERENT replica
+            assert audit.tier == "batch"      # background work
+            assert list(audit.prompt) == list(PROMPT)
+            _finish_audit(fleet, sup, audit, (7, 8, 9))
+            m = fleet.metrics()
+            assert m["audits_run"] == 1 and m["audit_mismatches"] == 0
+            assert fleet.audit_log[-1]["verdict"] == "match"
+            assert audit.gid not in fleet._reqs   # audits self-release
+            assert not fleet.pending()
+        finally:
+            fleet.close()
+
+    def test_audit_fraction_zero_never_audits(self):
+        fleet, sup = make_fleet()             # default fraction 0.0
+        try:
+            _serve(fleet, sup)
+            fleet.step()
+            assert not any(r.audit for r in fleet._reqs.values())
+            assert fleet.metrics()["audits_run"] == 0
+        finally:
+            fleet.close()
+
+    def test_single_replica_fleet_skips_audits(self):
+        fleet, sup = make_fleet(n=1, audit_fraction=1.0)
+        try:
+            _serve(fleet, sup)
+            fleet.step()
+            assert not any(r.audit for r in fleet._reqs.values())
+        finally:
+            fleet.close()
+
+    def _mismatch(self, fleet, sup, served=(5, 6, 7),
+                  corrupt=(5, 6, 999)):
+        req, server = _serve(fleet, sup, toks=served)
+        fleet.step()
+        audit = _pending_audit(fleet)
+        auditor = audit.replica
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            _finish_audit(fleet, sup, audit, corrupt)
+        return server, auditor
+
+    def test_referee_votes_auditor_corrupt(self):
+        fleet, sup = make_fleet(audit_fraction=1.0)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                server, auditor = self._mismatch(fleet, sup)
+                assert fleet.metrics()["audit_mismatches"] == 1
+                fleet.step()                  # place the referee
+                referee = _pending_audit(fleet)
+                assert referee.audit["stage"] == "referee"
+                assert referee.replica not in (server, auditor)
+                _finish_audit(fleet, sup, referee, (5, 6, 7))  # = served
+            m = fleet.metrics()
+            assert m["replicas_quarantined"] == 1, m
+            assert sup.quarantines == [auditor]
+            assert fleet.audit_log[-1]["stage"] == "quarantine"
+            assert fleet.audit_log[-2]["verdict"] == "auditor_corrupt"
+        finally:
+            fleet.close()
+
+    def test_referee_votes_server_corrupt(self):
+        fleet, sup = make_fleet(audit_fraction=1.0)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                server, auditor = self._mismatch(fleet, sup)
+                fleet.step()
+                referee = _pending_audit(fleet)
+                # referee reproduces the AUDIT stream -> the server
+                # (majority 2-of-3 against it) is the corrupt one
+                _finish_audit(fleet, sup, referee, (5, 6, 999))
+            assert sup.quarantines == [server]
+            assert fleet.audit_log[-2]["verdict"] == "server_corrupt"
+        finally:
+            fleet.close()
+
+    def test_two_replica_mismatch_charges_both(self):
+        # no third replica for a referee: both parties get ONE charge
+        # each (threshold 2) — suspicion, not a verdict
+        fleet, sup = make_fleet(n=2, audit_fraction=1.0)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                server, auditor = self._mismatch(fleet, sup)
+            assert sup.quarantines == []
+            assert fleet._suspicion[server].score() == 1
+            assert fleet._suspicion[auditor].score() == 1
+        finally:
+            fleet.close()
+
+    def test_stale_incarnation_evidence_dropped(self):
+        fleet, sup = make_fleet(audit_fraction=1.0)
+        try:
+            fleet._charge_suspicion(1, 99, "stale", inc=7)  # wrong inc
+            assert sup.quarantines == []
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                fleet._charge_suspicion(1, 99, "fresh",
+                                        inc=sup.handles[1].incarnation)
+            assert sup.quarantines == [1]
+        finally:
+            fleet.close()
+
+    def test_weight_audit_events_charge_to_quarantine(self):
+        fleet, sup = make_fleet()
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for _ in range(2):            # SuspicionScore threshold
+                    fleet._handle_event_from(0, {
+                        "e": "integrity", "kind": "weight_audit",
+                        "replica": 0})
+            assert sup.quarantines == [0]
+            assert fleet.metrics()["replicas_quarantined"] == 1
+        finally:
+            fleet.close()
+
+
+class TestQuarantineReloadDrain:
+    def test_quarantine_mid_drain_redispatches_no_double_restart(self):
+        sup = QSupervisor(3, auto_respawn=False)
+        fleet, sup = make_fleet(sup=sup, ckpt_root="/tmp/nonexistent")
+        try:
+            gid = fleet.submit(PROMPT, max_new=4)
+            fleet.step()
+            req = fleet.request(gid)
+            victim = req.replica
+            fleet.drain(victim, then="reload")
+            assert victim in fleet._draining  # held open by the inflight
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for _ in range(2):
+                    fleet._handle_event_from(victim, {
+                        "e": "integrity", "kind": "weight_audit",
+                        "replica": victim})
+                assert sup.quarantines == [victim]
+                # dying cancels the drain; the in-flight request rides
+                # crash-redispatch to a healthy peer
+                assert victim not in fleet._draining
+                fleet.step()
+            assert req.replica is not None and req.replica != victim
+            assert req.redispatches == 1
+            # more evidence during the respawn window must NOT burn a
+            # second restart-budget slot
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for _ in range(2):
+                    fleet._handle_event_from(victim, {
+                        "e": "integrity", "kind": "weight_audit",
+                        "replica": victim})
+            assert sup.quarantines == [victim]
+            assert fleet.metrics()["replicas_quarantined"] == 1
+            sup.respawn(victim)
+            # post-respawn, stale-incarnation evidence is dropped too
+            fleet._charge_suspicion(victim, 99, "stale", inc=0)
+            assert sup.quarantines == [victim]
+        finally:
+            fleet.close()
+
+    def test_hot_swap_lands_while_peer_quarantined(self):
+        sup = QSupervisor(3, auto_respawn=False)
+        fleet, sup = make_fleet(sup=sup, ckpt_root="/tmp/nonexistent")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for _ in range(2):
+                    fleet._handle_event_from(2, {
+                        "e": "integrity", "kind": "weight_audit",
+                        "replica": 2})
+            assert sup.quarantines == [2]     # 2 is down, respawn pending
+            fleet.drain(0, then="reload")
+            fleet.step()                      # no inflight -> reload now
+            assert any(m.get("op") == "reload"
+                       for m in sup.handles[0].sent)  # weights land
+            fleet._handle_event_from(0, {"e": "reloaded", "step": 7})
+            assert fleet.drains_completed == 1
+            assert (0, 7) in fleet.reloads
+            # the quarantine survived the hot-swap: still pending, still
+            # exactly one restart charged
+            assert 2 in sup._pending_respawn
+            assert fleet.metrics()["replicas_quarantined"] == 1
+            assert sup.quarantines == [2]
+        finally:
+            fleet.close()

@@ -340,13 +340,17 @@ class TestPrefixStore:
         blob = bytearray(open(path, "rb").read())
         blob[len(blob) // 2] ^= 0xFF
         open(path, "wb").write(bytes(blob))
+        # reason-labeled since ISSUE 20: corruption lands on the
+        # 'corrupt' series, never on 'fingerprint'/'geometry'/'version'
         rej = obs_metrics.REGISTRY.get(
-            "serving_prefix_store_rejected_total").value(instance=None)
-        with pytest.raises(PrefixStoreMismatch):
+            "serving_prefix_store_rejected_total").value(
+                instance=None, reason="corrupt")
+        with pytest.raises(PrefixStoreMismatch) as ei:
             load_prefix_store(path, fingerprint="fp", geometry={})
+        assert ei.value.reason == "corrupt"
         assert obs_metrics.REGISTRY.get(
             "serving_prefix_store_rejected_total").value(
-                instance=None) >= rej + 1
+                instance=None, reason="corrupt") >= rej + 1
 
     def test_fingerprint_and_geometry_gates(self, tmp_path):
         path = str(tmp_path / "prefix.pdstream")

@@ -107,7 +107,10 @@ class TestLayers:
         out = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1)
         ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1,
                                              count_include_pad=False)
-        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        # atol floor: XLA and torch reduce the window in different orders,
+        # so near-zero averages carry ~1e-8 float32 reassociation noise
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-7)
         out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3)
         ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 3)
         np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)

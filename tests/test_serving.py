@@ -1645,7 +1645,8 @@ def _chaos_env():
 
 @pytest.mark.slow
 class TestChaosServeDrill:
-    @pytest.mark.parametrize("drill", ["kill", "hang", "drain", "qos"])
+    @pytest.mark.parametrize("drill", ["kill", "hang", "drain", "qos",
+                                       "sdc"])
     def test_drill(self, drill, tmp_path):
         """ISSUE 12 acceptance: scripts/chaos_serve.py --drill kill runs
         the storm (one replica SIGKILLed AND one hung mid-burst with
@@ -1654,6 +1655,13 @@ class TestChaosServeDrill:
         and asserts the latency tier holds p99 TTFT, the abuser is
         rate-limited typed, batch work yields-not-drops, and a
         mid-flood scale-down (draining replica SIGKILLed) drops zero.
+        sdc (ISSUE 20) proves the silent-data-corruption defense via
+        ``serve.bit_flip``: a host-tier flip is rejected by the page
+        CRC at revive (re-prefill, bit-exact), a weight flip on a
+        replica is caught by the sampled output audit + referee vote
+        and quarantined through one restart-budget slot, and a
+        single-engine weight flip is healed by the fingerprint
+        re-audit + reload_weights.
         Every drill asserts bit-exact outputs vs an undisturbed baseline,
         typed-error accounting, liveness dip+recovery and clean
         allocators — see the script for the full checklist."""

@@ -282,6 +282,9 @@ class TestStaticNNCommon:
         import ast
 
         ref = "/root/reference/python/paddle/static/nn/__init__.py"
+        import os
+        if not os.path.exists(ref):
+            pytest.skip("reference Paddle checkout not present")
         for node in ast.walk(ast.parse(open(ref).read())):
             if isinstance(node, ast.Assign) and any(
                     getattr(t, "id", None) == "__all__"
